@@ -1,0 +1,16 @@
+"""Test bootstrap: force an 8-device virtual CPU mesh BEFORE jax imports.
+
+This is the "multi-node without a cluster" analogue the survey prescribes
+(SURVEY.md §4): every sharding/collective code path runs against 8 virtual
+CPU devices, so TP/DP/SP tests execute real XLA collectives with no TPU pod.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")

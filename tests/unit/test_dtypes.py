@@ -1,0 +1,63 @@
+"""Trilingual dtype table tests — the reference's types_test.py parametrized
+pattern (tests/unit/min_tfs_client/types_test.py:7-43), extended to this
+framework's larger dtype set (bf16, uint32/64, complex128)."""
+
+import ml_dtypes
+import numpy as np
+import pytest
+
+from min_tfs_client_tpu.tensor.dtypes import DataType, UnsupportedDtypeError
+
+CASES = [
+    # (np type, DT name, enum, proto field)
+    (np.float32, "DT_FLOAT", 1, "float_val"),
+    (np.float64, "DT_DOUBLE", 2, "double_val"),
+    (np.int32, "DT_INT32", 3, "int_val"),
+    (np.uint8, "DT_UINT8", 4, "int_val"),
+    (np.int16, "DT_INT16", 5, "int_val"),
+    (np.int8, "DT_INT8", 6, "int_val"),
+    (np.object_, "DT_STRING", 7, "string_val"),
+    (np.complex64, "DT_COMPLEX64", 8, "scomplex_val"),
+    (np.int64, "DT_INT64", 9, "int64_val"),
+    (np.bool_, "DT_BOOL", 10, "bool_val"),
+    (ml_dtypes.bfloat16, "DT_BFLOAT16", 14, "half_val"),
+    (np.uint16, "DT_UINT16", 17, "int_val"),
+    (np.complex128, "DT_COMPLEX128", 18, "dcomplex_val"),
+    (np.float16, "DT_HALF", 19, "half_val"),
+    (np.uint32, "DT_UINT32", 22, "uint32_val"),
+    (np.uint64, "DT_UINT64", 23, "uint64_val"),
+]
+
+
+@pytest.mark.parametrize("np_type,name,enum,field", CASES)
+def test_three_spellings_agree(np_type, name, enum, field):
+    for spelling in (np_type, name, enum):
+        dt = DataType(spelling)
+        assert dt.tf_dtype == name
+        assert dt.enum == enum
+        assert dt.proto_field_name == field
+        if name != "DT_STRING":
+            assert dt.numpy_dtype == np.dtype(np_type)
+
+
+def test_ref_variants_resolve_to_base():
+    assert DataType(101).tf_dtype == "DT_FLOAT"  # DT_FLOAT_REF
+    assert DataType(109).tf_dtype == "DT_INT64"
+
+
+def test_string_aliases():
+    assert DataType(str).tf_dtype == "DT_STRING"
+    assert DataType(np.dtype("U5")).tf_dtype == "DT_STRING"
+    assert DataType(np.dtype("S3")).tf_dtype == "DT_STRING"
+
+
+def test_unsupported_raises():
+    with pytest.raises(UnsupportedDtypeError):
+        DataType("DT_NOPE")
+    with pytest.raises(UnsupportedDtypeError):
+        DataType(999)
+
+
+def test_equality_and_hash():
+    assert DataType("DT_FLOAT") == DataType(np.float32)
+    assert len({DataType(1), DataType("DT_FLOAT")}) == 1
