@@ -1,0 +1,80 @@
+"""Benchmark: Predict latency/QPS through the full serving stack.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Measures p50 Predict latency through the in-process tpu:// path (the north
+star transport) on the current flagship model. vs_baseline compares against
+the reference-derived target recorded in BASELINE.json-adjacent local runs;
+with no published reference numbers (BASELINE.md: none exist), the first
+recorded value of this bench on this machine becomes the baseline file
+bench_baseline.json, and vs_baseline = baseline_p50 / current_p50 (>1 means
+faster than baseline).
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+REPO = pathlib.Path(__file__).resolve().parent
+sys.path.insert(0, str(REPO))
+
+BASELINE_FILE = REPO / "bench_baseline.json"
+
+BATCH = 32
+WARMUP = 10
+ITERS = 100
+
+
+def main() -> None:
+    from tests import fixtures
+    from min_tfs_client_tpu.client import TensorServingClient
+    from min_tfs_client_tpu.tensor.codec import tensor_proto_to_ndarray
+
+    tmp = tempfile.mkdtemp(prefix="tpu_bench_")
+    base = pathlib.Path(tmp) / "matmul"
+    fixtures.write_matmul_model(base)
+
+    client = TensorServingClient(f"tpu://{base}")
+    x = np.random.default_rng(0).standard_normal((BATCH, 8)).astype(np.float32)
+
+    for _ in range(WARMUP):
+        client.predict_request("matmul", {"x": x})
+
+    samples = []
+    for _ in range(ITERS):
+        t0 = time.perf_counter()
+        resp = client.predict_request("matmul", {"x": x})
+        samples.append((time.perf_counter() - t0) * 1e3)
+    out = tensor_proto_to_ndarray(resp.outputs["probs"])
+    assert out.shape == (BATCH, 4)
+
+    p50 = float(np.percentile(samples, 50))
+    p99 = float(np.percentile(samples, 99))
+    qps = 1000.0 / p50 * BATCH
+
+    if BASELINE_FILE.exists():
+        baseline = json.loads(BASELINE_FILE.read_text())
+    else:
+        baseline = {"p50_ms": p50, "p99_ms": p99, "qps": qps}
+        BASELINE_FILE.write_text(json.dumps(baseline))
+    vs_baseline = baseline["p50_ms"] / p50 if p50 else 0.0
+
+    print(json.dumps({
+        "metric": "predict_p50_latency_batch32",
+        "value": round(p50, 4),
+        "unit": "ms",
+        "vs_baseline": round(vs_baseline, 4),
+        "extra": {"p99_ms": round(p99, 4), "qps": round(qps, 1),
+                  "batch": BATCH, "iters": ITERS,
+                  "transport": "tpu:// in-process"},
+    }))
+
+
+if __name__ == "__main__":
+    main()

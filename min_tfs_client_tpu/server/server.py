@@ -1,0 +1,180 @@
+"""Server assembly: options -> ServerCore -> gRPC services -> serving.
+
+Parity with model_servers/server.{h,cc} (BuildAndStart): synthesizes a
+single-model config from --model_name/--model_base_path (server.cc:83-96),
+parses text-format proto config files (ParseProtoTextFile, server.cc:59-73),
+builds ServerCore, registers Model/Prediction services on a grpc server with
+optional SSL, and optionally re-polls the model config file
+(PollFilesystemAndReloadConfig, server.cc:164-179).
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent import futures
+from dataclasses import dataclass, field
+from typing import Optional
+
+import grpc
+from google.protobuf import text_format
+
+from min_tfs_client_tpu.core.server_core import (
+    ServerCore,
+    single_model_config,
+)
+from min_tfs_client_tpu.protos import grpc_service as gs
+from min_tfs_client_tpu.protos import tfs_config_pb2
+from min_tfs_client_tpu.server.grpc_services import (
+    ModelServiceImpl,
+    PredictionServiceImpl,
+)
+from min_tfs_client_tpu.server.handlers import Handlers
+from min_tfs_client_tpu.utils.status import ServingError
+
+
+@dataclass
+class ServerOptions:
+    """Mirrors the main.cc flag surface (main.cc:59-195) where applicable."""
+
+    grpc_port: int = 8500
+    rest_api_port: int = 0
+    model_name: str = "default"
+    model_base_path: str = ""
+    model_platform: str = "tensorflow"
+    model_config_file: str = ""
+    model_config_file_poll_wait_seconds: float = 0
+    file_system_poll_wait_seconds: float = 1.0
+    enable_batching: bool = False
+    batching_parameters_file: str = ""
+    monitoring_config_file: str = ""
+    ssl_config_file: str = ""
+    max_num_load_retries: int = 5
+    load_retry_interval_micros: int = 60 * 1000 * 1000
+    num_load_threads: int = 2
+    num_unload_threads: int = 2
+    grpc_max_threads: int = 16
+    enable_model_warmup: bool = True
+    response_tensors_as_content: bool = False
+
+
+def _parse_text_proto(path: str, proto_cls):
+    msg = proto_cls()
+    with open(path, "r") as f:
+        text_format.Parse(f.read(), msg)
+    return msg
+
+
+class Server:
+    def __init__(self, options: ServerOptions):
+        self.options = options
+        self.core: Optional[ServerCore] = None
+        self._grpc_server: Optional[grpc.Server] = None
+        self._rest_server = None
+        self._config_poll_stop = threading.Event()
+        self._config_poll_thread: Optional[threading.Thread] = None
+
+    # -- assembly ------------------------------------------------------------
+
+    def build_and_start(self) -> "Server":
+        opts = self.options
+        if opts.model_config_file:
+            config = _parse_text_proto(
+                opts.model_config_file, tfs_config_pb2.ModelServerConfig)
+        elif opts.model_base_path:
+            config = single_model_config(
+                opts.model_name, opts.model_base_path,
+                platform=opts.model_platform)
+        else:
+            raise ServingError.invalid_argument(
+                "Both server_model_config_file and model_base_path are empty!")
+
+        batching = None
+        if opts.enable_batching and opts.batching_parameters_file:
+            batching = _parse_text_proto(
+                opts.batching_parameters_file, tfs_config_pb2.BatchingParameters)
+
+        self.core = ServerCore(
+            config,
+            file_system_poll_wait_seconds=opts.file_system_poll_wait_seconds,
+            max_load_retries=opts.max_num_load_retries,
+            load_retry_interval_s=opts.load_retry_interval_micros / 1e6,
+            num_load_threads=opts.num_load_threads,
+            num_unload_threads=opts.num_unload_threads,
+            platform_configs=_platform_configs(opts, batching),
+        )
+
+        handlers = Handlers(
+            self.core,
+            response_tensors_as_content=opts.response_tensors_as_content)
+        self._grpc_server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=opts.grpc_max_threads))
+        gs.add_PredictionServiceServicer_to_server(
+            PredictionServiceImpl(handlers), self._grpc_server)
+        gs.add_ModelServiceServicer_to_server(
+            ModelServiceImpl(handlers), self._grpc_server)
+        self.grpc_port = self._bind(self._grpc_server, opts.grpc_port)
+        self._grpc_server.start()
+
+        if opts.rest_api_port or opts.monitoring_config_file:
+            from min_tfs_client_tpu.server.rest import start_rest_server
+
+            monitoring = None
+            if opts.monitoring_config_file:
+                monitoring = _parse_text_proto(
+                    opts.monitoring_config_file, tfs_config_pb2.MonitoringConfig)
+            self._rest_server, self.rest_port = start_rest_server(
+                handlers, opts.rest_api_port, monitoring)
+
+        if opts.model_config_file and opts.model_config_file_poll_wait_seconds > 0:
+            self._config_poll_thread = threading.Thread(
+                target=self._poll_config_file, name="config-file-poll",
+                daemon=True)
+            self._config_poll_thread.start()
+        return self
+
+    def _bind(self, server: grpc.Server, port: int) -> int:
+        opts = self.options
+        if opts.ssl_config_file:
+            ssl = _parse_text_proto(opts.ssl_config_file,
+                                    tfs_config_pb2.SSLConfig)
+            creds = grpc.ssl_server_credentials(
+                [(ssl.server_key.encode(), ssl.server_cert.encode())],
+                root_certificates=ssl.custom_ca.encode() or None,
+                require_client_auth=ssl.client_verify,
+            )
+            return server.add_secure_port(f"0.0.0.0:{port}", creds)
+        return server.add_insecure_port(f"0.0.0.0:{port}")
+
+    def _poll_config_file(self) -> None:
+        interval = self.options.model_config_file_poll_wait_seconds
+        while not self._config_poll_stop.wait(interval):
+            try:
+                config = _parse_text_proto(
+                    self.options.model_config_file,
+                    tfs_config_pb2.ModelServerConfig)
+                self.core.reload_config(config)
+            except Exception:  # pragma: no cover - poll must survive bad files
+                import traceback
+
+                traceback.print_exc()
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def wait_for_termination(self) -> None:
+        self._grpc_server.wait_for_termination()
+
+    def stop(self, grace: float = 5.0) -> None:
+        self._config_poll_stop.set()
+        if self._grpc_server is not None:
+            self._grpc_server.stop(grace).wait()
+        if self._rest_server is not None:
+            self._rest_server.shutdown()
+        if self.core is not None:
+            self.core.stop()
+
+
+def _platform_configs(opts: ServerOptions, batching) -> dict:
+    shared = {}
+    if batching is not None:
+        shared["batching_parameters"] = batching
+    return {platform: dict(shared) for platform in ("tensorflow", "jax", "tpu")}

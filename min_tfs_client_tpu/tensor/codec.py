@@ -28,15 +28,12 @@ from min_tfs_client_tpu.tensor.dtypes import DataType
 TensorProto = tf_tensor_pb2.TensorProto
 
 def coerce_to_bytes(value) -> bytes:
-    """utf-8 coercion for str; pass bytes through (reference tensors.py:10-14)."""
+    """utf-8 coercion for str; pass bytes through (reference tensors.py:10-14).
+    np.bytes_/np.str_ are subclasses, so these two checks cover them too."""
     if isinstance(value, bytes):
         return value
     if isinstance(value, str):
         return value.encode("utf-8")
-    if isinstance(value, np.str_):
-        return str(value).encode("utf-8")
-    if isinstance(value, np.bytes_):
-        return bytes(value)
     raise TypeError(f"cannot coerce {type(value).__name__} to bytes")
 
 
@@ -100,8 +97,14 @@ def _write_typed_field(proto: TensorProto, dt: DataType, arr: np.ndarray) -> Non
     field.extend(flat.tolist())
 
 
-def tensor_proto_to_ndarray(proto: TensorProto) -> np.ndarray:
-    """Decode a TensorProto from either payload representation."""
+def tensor_proto_to_ndarray(proto: TensorProto, *,
+                            writable: bool = True) -> np.ndarray:
+    """Decode a TensorProto from either payload representation.
+
+    ``writable=False`` keeps the tensor_content fast path zero-copy (a
+    read-only view over the proto's bytes) — safe when the array goes
+    straight to jax.device_put, which never mutates its input.
+    """
     dt = DataType(proto.dtype)
     shape = extract_shape(proto)
     if shape is None:
@@ -114,8 +117,14 @@ def tensor_proto_to_ndarray(proto: TensorProto) -> np.ndarray:
         if dt.is_string:
             raise ValueError("DT_STRING tensors cannot use tensor_content")
         wire = np.dtype(dt.numpy_dtype).newbyteorder("<")
+        expected = n * wire.itemsize
+        if len(proto.tensor_content) != expected:
+            raise ValueError(
+                f"tensor_content holds {len(proto.tensor_content)} bytes, "
+                f"shape {shape} of {dt.tf_dtype} requires {expected}")
         arr = np.frombuffer(proto.tensor_content, dtype=wire, count=n)
-        return arr.astype(dt.numpy_dtype, copy=False).reshape(shape)
+        arr = arr.astype(dt.numpy_dtype, copy=False).reshape(shape)
+        return arr.copy() if writable and not arr.flags.writeable else arr
 
     if dt.is_string:
         vals = list(proto.string_val)

@@ -1,0 +1,166 @@
+"""tf.Example construction and vectorized host-side decoding.
+
+Client side: build `Input`/`Example` protos from python feature dicts — the
+piece the reference client is missing (its classification_request writes
+tensor-dict inputs into a field ClassificationRequest does not have,
+reference requests.py:47 vs apis/classification.proto:33-40).
+
+Server side: decode a batch of Examples into dense, padded numpy feature
+batches ready for a single host->device transfer — the TPU-friendly
+equivalent of the reference's in-graph ParseExample
+(servables/tensorflow/classifier.cc feeds serialized Examples to the graph;
+XLA has no string kernels, so parsing happens here on host instead).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Iterable, Mapping, Sequence
+
+import numpy as np
+
+from min_tfs_client_tpu.protos import tf_example_pb2, tfs_apis_pb2
+from min_tfs_client_tpu.tensor.codec import coerce_to_bytes
+
+Example = tf_example_pb2.Example
+Input = tfs_apis_pb2.Input
+
+
+# ---------------------------------------------------------------------------
+# Encoding (client)
+
+
+def example_from_dict(features: Mapping[str, object]) -> Example:
+    """Build an Example from {name: scalar | list | ndarray}.
+
+    bytes/str -> bytes_list; float -> float_list; int/bool -> int64_list.
+    """
+    ex = Example()
+    for name, value in features.items():
+        feat = ex.features.feature[name]
+        arr = np.asarray(value)
+        flat = arr.reshape(-1)
+        if arr.dtype.kind in ("U", "S", "O"):
+            feat.bytes_list.value.extend(coerce_to_bytes(v) for v in flat.tolist())
+        elif arr.dtype.kind == "f":
+            feat.float_list.value.extend(float(v) for v in flat)
+        elif arr.dtype.kind in ("i", "u", "b"):
+            feat.int64_list.value.extend(int(v) for v in flat)
+        else:
+            raise TypeError(f"feature {name!r}: unsupported dtype {arr.dtype}")
+    return ex
+
+
+def build_input(
+    examples: Sequence[Mapping[str, object] | Example],
+    *,
+    context: Mapping[str, object] | Example | None = None,
+) -> Input:
+    """Build the serving Input proto from feature dicts or Example protos."""
+    def as_example(e):
+        return e if isinstance(e, Example) else example_from_dict(e)
+
+    inp = Input()
+    if context is not None:
+        inp.example_list_with_context.examples.extend(as_example(e) for e in examples)
+        inp.example_list_with_context.context.CopyFrom(as_example(context))
+    else:
+        inp.example_list.examples.extend(as_example(e) for e in examples)
+    return inp
+
+
+# ---------------------------------------------------------------------------
+# Decoding (server)
+
+
+@dataclass(frozen=True)
+class FeatureSpec:
+    """Fixed-length dense feature expected by a servable signature."""
+
+    dtype: np.dtype                      # np.float32 / np.int64 / object (bytes)
+    shape: tuple[int, ...] = ()          # per-example shape; () = scalar
+    default: object | None = None        # None = feature required
+
+    def __post_init__(self):
+        object.__setattr__(self, "dtype", np.dtype(self.dtype))
+
+
+class ExampleDecodeError(ValueError):
+    pass
+
+
+def flatten_input(inp: Input) -> list[Example]:
+    """Input -> list of Examples, merging the shared context if present
+    (semantics from reference apis/input.proto:60-64: context features are
+    merged into every example; duplicate keys undefined)."""
+    kind = inp.WhichOneof("kind")
+    if kind == "example_list":
+        return list(inp.example_list.examples)
+    if kind == "example_list_with_context":
+        ctx = inp.example_list_with_context.context
+        merged = []
+        for ex in inp.example_list_with_context.examples:
+            m = Example()
+            m.CopyFrom(ex)
+            for name, feat in ctx.features.feature.items():
+                if name not in m.features.feature:
+                    m.features.feature[name].CopyFrom(feat)
+            merged.append(m)
+        return merged
+    raise ExampleDecodeError("Input proto has no example_list")
+
+
+def _feature_values(feat: tf_example_pb2.Feature, spec: FeatureSpec, name: str):
+    kind = feat.WhichOneof("kind")
+    if kind == "bytes_list":
+        vals = list(feat.bytes_list.value)
+    elif kind == "float_list":
+        vals = list(feat.float_list.value)
+    elif kind == "int64_list":
+        vals = list(feat.int64_list.value)
+    else:
+        vals = None
+    return vals
+
+
+def decode_examples(
+    examples: Sequence[Example],
+    specs: Mapping[str, FeatureSpec],
+) -> dict[str, np.ndarray]:
+    """Decode Examples into dense [batch, *spec.shape] arrays.
+
+    Missing features use spec.default (error if required). Length mismatches
+    against the fixed spec shape are errors, mirroring TF's
+    FixedLenFeature parsing semantics.
+    """
+    batch = len(examples)
+    out: dict[str, np.ndarray] = {}
+    for name, spec in specs.items():
+        per_ex_n = int(np.prod(spec.shape, dtype=np.int64)) if spec.shape else 1
+        if spec.dtype == object:
+            col = np.empty((batch, per_ex_n), dtype=object)
+        else:
+            col = np.zeros((batch, per_ex_n), dtype=spec.dtype)
+        for i, ex in enumerate(examples):
+            feat = ex.features.feature.get(name)
+            vals = _feature_values(feat, spec, name) if feat is not None else None
+            if not vals:
+                if spec.default is None:
+                    raise ExampleDecodeError(
+                        f"example {i}: required feature {name!r} missing")
+                vals = [spec.default] * per_ex_n
+            if len(vals) != per_ex_n:
+                raise ExampleDecodeError(
+                    f"example {i}: feature {name!r} has {len(vals)} values, "
+                    f"spec requires {per_ex_n}")
+            col[i, :] = vals
+        out[name] = col.reshape((batch, *spec.shape))
+    return out
+
+
+def decode_input(
+    inp: Input, specs: Mapping[str, FeatureSpec]
+) -> tuple[dict[str, np.ndarray], int]:
+    """Input proto -> (dense feature batch, num_examples)."""
+    examples = flatten_input(inp)
+    return decode_examples(examples, specs), len(examples)

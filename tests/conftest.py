@@ -1,16 +1,24 @@
-"""Test bootstrap: force an 8-device virtual CPU mesh BEFORE jax imports.
+"""Test bootstrap: force an 8-device virtual CPU mesh BEFORE any jax use.
 
 This is the "multi-node without a cluster" analogue the survey prescribes
 (SURVEY.md §4): every sharding/collective code path runs against 8 virtual
 CPU devices, so TP/DP/SP tests execute real XLA collectives with no TPU pod.
+
+NOTE: this environment's sitecustomize force-registers the TPU ("axon")
+PJRT plugin and rewrites jax_platforms to "axon,cpu" in every process, so
+plain JAX_PLATFORMS=cpu is NOT enough — jax.config.update after import is
+what actually wins. Benches/TPU runs must not import this conftest.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
-os.environ.setdefault("JAX_ENABLE_X64", "0")
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402  (must follow the env setup above)
+
+jax.config.update("jax_platforms", "cpu")

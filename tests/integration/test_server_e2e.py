@@ -1,0 +1,391 @@
+"""End-to-end: boot the real server over loopback gRPC and drive every
+surface with the client — the reference's tensorflow_model_server_test.py
+pattern (model_servers/tensorflow_model_server_test.py:86-525), plus the
+tpu:// in-process path the reference doesn't have."""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from min_tfs_client_tpu.client import TensorServingClient
+from min_tfs_client_tpu.protos import tfs_apis_pb2 as apis
+from min_tfs_client_tpu.protos import tfs_config_pb2 as cfg
+from min_tfs_client_tpu.server.server import Server, ServerOptions
+from min_tfs_client_tpu.tensor.codec import tensor_proto_to_ndarray
+from tests import fixtures
+
+
+@pytest.fixture(scope="module")
+def model_root(tmp_path_factory):
+    root = tmp_path_factory.mktemp("models")
+    fixtures.write_identity_model(root / "identity")
+    fixtures.write_half_plus_two(root / "half_plus_two")
+    fixtures.write_matmul_model(root / "matmul")
+    fixtures.write_jax_servable(root / "native")
+    return root
+
+
+@pytest.fixture(scope="module")
+def config_file(model_root):
+    path = model_root / "models.config"
+    path.write_text(f"""
+model_config_list {{
+  config {{
+    name: "identity"
+    base_path: "{model_root}/identity"
+    model_platform: "tensorflow"
+  }}
+  config {{
+    name: "half_plus_two"
+    base_path: "{model_root}/half_plus_two"
+    model_platform: "tensorflow"
+    version_labels {{ key: "stable" value: 1 }}
+  }}
+  config {{
+    name: "matmul"
+    base_path: "{model_root}/matmul"
+    model_platform: "tensorflow"
+  }}
+  config {{
+    name: "native"
+    base_path: "{model_root}/native"
+    model_platform: "jax"
+  }}
+}}
+""")
+    return path
+
+
+@pytest.fixture(scope="module")
+def server(config_file):
+    srv = Server(ServerOptions(
+        grpc_port=0,
+        rest_api_port=0,
+        model_config_file=str(config_file),
+        file_system_poll_wait_seconds=0.2,
+    ))
+    srv.build_and_start()
+    yield srv
+    srv.stop()
+
+
+@pytest.fixture(scope="module")
+def rest_server(config_file):
+    mon = config_file.parent / "monitoring.config"
+    mon.write_text('prometheus_config { enable: true }\n')
+    srv = Server(ServerOptions(
+        grpc_port=0,
+        rest_api_port=0,  # ephemeral; REST enabled by monitoring config
+        model_config_file=str(config_file),
+        file_system_poll_wait_seconds=0,
+        monitoring_config_file=str(mon),
+    ))
+    srv.build_and_start()
+    yield srv
+    srv.stop()
+
+
+@pytest.fixture()
+def client(server):
+    with TensorServingClient("127.0.0.1", server.grpc_port) as c:
+        yield c
+
+
+def test_identity_predict_roundtrip(client):
+    """The reference's own integration vectors
+    (tests/integration/requests_test.py:17-36)."""
+    resp = client.predict_request("identity", {
+        "string_input": np.array([b"hello", b"world"]),
+        "float_input": np.array([1.5, -2.5], np.float32),
+        "int_input": np.array([3, 4], np.int32),
+    })
+    assert tensor_proto_to_ndarray(resp.outputs["string_input"] if False else
+                                   resp.outputs["string_output"]).tolist() == \
+        [b"hello", b"world"]
+    np.testing.assert_array_equal(
+        tensor_proto_to_ndarray(resp.outputs["float_output"]), [1.5, -2.5])
+    np.testing.assert_array_equal(
+        tensor_proto_to_ndarray(resp.outputs["int_output"]), [3, 4])
+    # default serialization is typed fields (reference server_core.h:186-188)
+    assert not resp.outputs["float_output"].tensor_content
+    assert resp.model_spec.version.value == 1
+
+
+def test_half_plus_two(client):
+    resp = client.predict_request(
+        "half_plus_two", {"x": np.array([0.0, 2.0, 10.0], np.float32)})
+    np.testing.assert_allclose(
+        tensor_proto_to_ndarray(resp.outputs["y"]), [2.0, 3.0, 7.0])
+
+
+def test_version_label_resolution(client):
+    resp = client.predict_request(
+        "half_plus_two", {"x": np.array([2.0], np.float32)},
+        version_label="stable")
+    np.testing.assert_allclose(tensor_proto_to_ndarray(resp.outputs["y"]), [3.0])
+    import grpc
+
+    with pytest.raises(grpc.RpcError) as err:
+        client.predict_request(
+            "half_plus_two", {"x": np.array([2.0], np.float32)},
+            version_label="nope")
+    assert err.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+
+
+def test_matmul_device_model(client):
+    x = np.random.default_rng(0).standard_normal((5, 8)).astype(np.float32)
+    resp = client.predict_request("matmul", {"x": x}, output_filter=["probs"])
+    probs = tensor_proto_to_ndarray(resp.outputs["probs"])
+    assert probs.shape == (5, 4)
+    np.testing.assert_allclose(probs.sum(axis=1), np.ones(5), rtol=1e-5)
+    assert list(resp.outputs) == ["probs"]
+
+
+def test_native_jax_model_predict(client):
+    resp = client.predict_request(
+        "native", {"x": np.array([1.0, 2.0], np.float32)})
+    np.testing.assert_allclose(
+        tensor_proto_to_ndarray(resp.outputs["y"]), [4.0, 7.0])
+
+
+def test_classify_and_regress(client):
+    resp = client.classification_request(
+        "native", [{"score": 2.0}, {"score": -2.0}],
+        signature_name="classify")
+    assert len(resp.result.classifications) == 2
+    first = resp.result.classifications[0].classes
+    assert [c.label for c in first] == ["neg", "pos"]
+    assert first[1].score > 0.8
+
+    rresp = client.regression_request(
+        "native", [{"x": 1.5}], signature_name="regress")
+    assert rresp.result.regressions[0].value == pytest.approx(3.0)
+
+
+def test_multi_inference(client):
+    resp = client.multi_inference_request(
+        "native",
+        [{"score": 1.0, "x": 2.0}],
+        methods=[("classify", "tensorflow/serving/classify"),
+                 ("regress", "tensorflow/serving/regress")])
+    assert len(resp.results) == 2
+    assert resp.results[0].WhichOneof("result") == "classification_result"
+    assert resp.results[1].regression_result.regressions[0].value == \
+        pytest.approx(4.0)
+
+
+def test_model_status(client):
+    resp = client.model_status_request("half_plus_two")
+    assert resp.model_version_status[0].state == \
+        apis.ModelVersionStatus.AVAILABLE
+
+
+def test_model_metadata(client):
+    resp = client.model_metadata_request("identity")
+    sig_map = apis.SignatureDefMap()
+    assert resp.metadata["signature_def"].Unpack(sig_map)
+    assert "serving_default" in sig_map.signature_def
+    assert "inputs" in sig_map.signature_def
+    sig = sig_map.signature_def["serving_default"]
+    assert set(sig.inputs) == {"string_input", "float_input", "int_input"}
+
+
+def test_unknown_model_not_found(client):
+    import grpc
+
+    with pytest.raises(grpc.RpcError) as err:
+        client.predict_request("ghost", {"x": np.zeros(1, np.float32)})
+    assert err.value.code() == grpc.StatusCode.NOT_FOUND
+
+
+def test_bad_signature_invalid(client):
+    import grpc
+
+    with pytest.raises(grpc.RpcError) as err:
+        client.predict_request(
+            "half_plus_two", {"x": np.zeros(1, np.float32)},
+            signature_name="nope")
+    assert err.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+
+
+def test_missing_input_invalid(client):
+    import grpc
+
+    with pytest.raises(grpc.RpcError) as err:
+        client.predict_request("half_plus_two", {})
+    assert err.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+
+
+def test_hot_reload_new_version(client, server, model_root):
+    """New version dir appears -> server picks it up -> serves it; old
+    version unloads (Latest policy)."""
+    import time
+
+    fixtures.write_half_plus_two(model_root / "half_plus_two", version=2)
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        resp = client.model_status_request("half_plus_two")
+        states = {s.version: s.state for s in resp.model_version_status}
+        if states.get(2) == apis.ModelVersionStatus.AVAILABLE:
+            break
+        time.sleep(0.1)
+    assert states.get(2) == apis.ModelVersionStatus.AVAILABLE
+    resp = client.predict_request(
+        "half_plus_two", {"x": np.array([2.0], np.float32)})
+    assert resp.model_spec.version.value == 2
+
+
+def test_reload_config_removes_model(config_file, model_root):
+    """ReloadConfig RPC with a model omitted -> model unloads
+    (model_service_impl.cc:41-60 semantics)."""
+    srv = Server(ServerOptions(
+        grpc_port=0, model_config_file=str(config_file),
+        file_system_poll_wait_seconds=0)).build_and_start()
+    try:
+        with TensorServingClient("127.0.0.1", srv.grpc_port) as c:
+            config = cfg.ModelServerConfig()
+            m = config.model_config_list.config.add()
+            m.name = "half_plus_two"
+            m.base_path = str(model_root / "half_plus_two")
+            m.model_platform = "tensorflow"
+            resp = c.reload_config_request(config)
+            assert resp.status.error_code == 0
+            import grpc, time
+
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                try:
+                    c.predict_request(
+                        "identity",
+                        {"string_input": np.array([b"x"]),
+                         "float_input": np.zeros(1, np.float32),
+                         "int_input": np.zeros(1, np.int32)},
+                        timeout=2)
+                except grpc.RpcError as e:
+                    if e.code() in (grpc.StatusCode.NOT_FOUND,
+                                    grpc.StatusCode.UNAVAILABLE,
+                                    grpc.StatusCode.FAILED_PRECONDITION):
+                        break
+                time.sleep(0.1)
+            resp2 = c.predict_request(
+                "half_plus_two", {"x": np.array([0.0], np.float32)})
+            assert tensor_proto_to_ndarray(resp2.outputs["y"]).tolist() == [2.0]
+    finally:
+        srv.stop()
+
+
+class TestInProcessChannel:
+    def test_tpu_scheme_serves_in_process(self, model_root):
+        client = TensorServingClient(f"tpu://{model_root}/half_plus_two")
+        try:
+            resp = client.predict_request(
+                "half_plus_two", {"x": np.array([4.0], np.float32)})
+            np.testing.assert_allclose(
+                tensor_proto_to_ndarray(resp.outputs["y"]), [4.0])
+        finally:
+            from min_tfs_client_tpu.client import inprocess
+
+            key = inprocess._normalize(f"tpu://{model_root}/half_plus_two")
+            invoker = inprocess._registry.get(key)
+            if invoker is not None:
+                invoker.stop()
+                inprocess.unregister_server(key)
+
+    def test_tpu_scheme_native_platform(self, model_root):
+        client = TensorServingClient(f"tpu://{model_root}/native")
+        try:
+            resp = client.predict_request(
+                "native", {"x": np.array([1.0], np.float32)})
+            np.testing.assert_allclose(
+                tensor_proto_to_ndarray(resp.outputs["y"]), [4.0])
+        finally:
+            from min_tfs_client_tpu.client import inprocess
+
+            key = inprocess._normalize(f"tpu://{model_root}/native")
+            invoker = inprocess._registry.get(key)
+            if invoker is not None:
+                invoker.stop()
+                inprocess.unregister_server(key)
+
+
+class TestRest:
+    """REST surface — reference tensorflow_model_server_test.py:385-545."""
+
+    def _get(self, srv, path):
+        return urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.rest_port}{path}", timeout=10)
+
+    def _post(self, srv, path, payload):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.rest_port}{path}",
+            data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"})
+        return urllib.request.urlopen(req, timeout=10)
+
+    def test_rest_status(self, rest_server):
+        with self._get(rest_server, "/v1/models/half_plus_two") as r:
+            body = json.load(r)
+        assert body["model_version_status"][0]["state"] == "AVAILABLE"
+
+    def test_rest_predict_row_format(self, rest_server):
+        with self._post(rest_server, "/v1/models/half_plus_two:predict",
+                        {"instances": [{"x": 0.0}, {"x": 2.0}]}) as r:
+            body = json.load(r)
+        assert body["predictions"] == [2.0, 3.0]
+
+    def test_rest_predict_columnar(self, rest_server):
+        with self._post(rest_server, "/v1/models/half_plus_two:predict",
+                        {"inputs": {"x": [4.0, 6.0]}}) as r:
+            body = json.load(r)
+        assert body["outputs"] == [4.0, 5.0]
+
+    def test_rest_classify(self, rest_server):
+        with self._post(
+                rest_server, "/v1/models/native:classify",
+                {"signature_name": "classify",
+                 "examples": [{"score": 2.0}]}) as r:
+            body = json.load(r)
+        (pairs,) = body["results"]
+        assert [p[0] for p in pairs] == ["neg", "pos"]
+
+    def test_rest_regress(self, rest_server):
+        with self._post(
+                rest_server, "/v1/models/native:regress",
+                {"signature_name": "regress", "examples": [{"x": 2.5}]}) as r:
+            body = json.load(r)
+        assert body["results"] == [5.0]
+
+    def test_rest_metadata(self, rest_server):
+        with self._get(rest_server, "/v1/models/identity/metadata") as r:
+            body = json.load(r)
+        sigs = body["metadata"]["signature_def"]["signature_def"]
+        assert "serving_default" in sigs
+
+    def test_rest_version_path(self, rest_server):
+        # Discover the served version (an earlier test may have added v2 to
+        # the shared model root before this server booted with Latest(1)).
+        with self._get(rest_server, "/v1/models/half_plus_two") as r:
+            status = json.load(r)
+        version = status["model_version_status"][0]["version"]
+        with self._post(rest_server,
+                        f"/v1/models/half_plus_two/versions/{version}:predict",
+                        {"instances": [{"x": 2.0}]}) as r:
+            body = json.load(r)
+        assert body["predictions"] == [3.0]
+
+    def test_rest_error_shape(self, rest_server):
+        import urllib.error
+
+        with pytest.raises(urllib.error.HTTPError) as err:
+            self._post(rest_server, "/v1/models/ghost:predict",
+                       {"instances": [{"x": 1.0}]})
+        assert err.value.code == 404
+        assert "error" in json.load(err.value)
+
+    def test_prometheus_endpoint(self, rest_server):
+        with self._get(rest_server,
+                       "/monitoring/prometheus/metrics") as r:
+            text = r.read().decode()
+        assert "# TYPE" in text

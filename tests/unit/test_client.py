@@ -1,0 +1,143 @@
+"""Client SDK tests against a loopback gRPC server — covering the reference's
+integration surface (tests/integration/requests_test.py:17-50) plus the
+classify/regress paths the reference never tests because they are broken
+there (SURVEY.md §2.1 known defects)."""
+
+import concurrent.futures
+
+import grpc
+import numpy as np
+import pytest
+
+from min_tfs_client_tpu.client import TensorServingClient
+from min_tfs_client_tpu.protos import grpc_service as gs
+from min_tfs_client_tpu.protos import tfs_apis_pb2 as apis
+from min_tfs_client_tpu.tensor.codec import (
+    ndarray_to_tensor_proto,
+    tensor_proto_to_ndarray,
+)
+from min_tfs_client_tpu.tensor.example_codec import FeatureSpec, decode_input
+
+
+class FakePredictionService(gs.PredictionServiceServicer):
+    """Echo Predict; Classify/Regress decode the Input and score features."""
+
+    def Predict(self, request, context):
+        resp = apis.PredictResponse()
+        resp.model_spec.CopyFrom(request.model_spec)
+        if not request.model_spec.HasField("version"):
+            resp.model_spec.version.value = 1
+        keys = request.output_filter or list(request.inputs)
+        for k in keys:
+            arr = tensor_proto_to_ndarray(request.inputs[k])
+            resp.outputs[k].CopyFrom(ndarray_to_tensor_proto(arr))
+        return resp
+
+    def Classify(self, request, context):
+        feats, n = decode_input(
+            request.input, {"score": FeatureSpec(np.float32)})
+        resp = apis.ClassificationResponse()
+        for i in range(n):
+            c = resp.result.classifications.add().classes.add()
+            c.label = "pos" if feats["score"][i] > 0 else "neg"
+            c.score = float(feats["score"][i])
+        return resp
+
+    def Regress(self, request, context):
+        feats, n = decode_input(request.input, {"x": FeatureSpec(np.float32)})
+        resp = apis.RegressionResponse()
+        for i in range(n):
+            resp.result.regressions.add().value = float(feats["x"][i]) * 2
+        return resp
+
+
+class FakeModelService(gs.ModelServiceServicer):
+    def GetModelStatus(self, request, context):
+        resp = apis.GetModelStatusResponse()
+        s = resp.model_version_status.add()
+        s.version = request.model_spec.version.value or 1
+        s.state = apis.ModelVersionStatus.AVAILABLE
+        return resp
+
+
+@pytest.fixture(scope="module")
+def server_port():
+    server = grpc.server(concurrent.futures.ThreadPoolExecutor(max_workers=4))
+    gs.add_PredictionServiceServicer_to_server(FakePredictionService(), server)
+    gs.add_ModelServiceServicer_to_server(FakeModelService(), server)
+    port = server.add_insecure_port("127.0.0.1:0")
+    server.start()
+    yield port
+    server.stop(0)
+
+
+@pytest.fixture()
+def client(server_port):
+    with TensorServingClient("127.0.0.1", server_port) as c:
+        yield c
+
+
+def test_predict_roundtrip(client):
+    resp = client.predict_request(
+        "m",
+        {
+            "f": np.array([1.5, 2.5], np.float32),
+            "i": np.array([[1, 2]], np.int64),
+            "s": np.array([b"a", b"b"]),
+        },
+    )
+    np.testing.assert_array_equal(
+        tensor_proto_to_ndarray(resp.outputs["f"]), [1.5, 2.5])
+    np.testing.assert_array_equal(
+        tensor_proto_to_ndarray(resp.outputs["i"]), [[1, 2]])
+    assert tensor_proto_to_ndarray(resp.outputs["s"]).tolist() == [b"a", b"b"]
+    assert resp.model_spec.version.value == 1  # effective version filled
+
+
+def test_predict_version_and_filter(client):
+    resp = client.predict_request(
+        "m", {"a": np.zeros(1, np.float32), "b": np.ones(1, np.float32)},
+        model_version=7, output_filter=["b"])
+    assert list(resp.outputs) == ["b"]
+    assert resp.model_spec.version.value == 7
+
+
+def test_classification_request_with_examples(client):
+    resp = client.classification_request(
+        "m", [{"score": 0.9}, {"score": -0.4}])
+    labels = [c.classes[0].label for c in resp.result.classifications]
+    assert labels == ["pos", "neg"]
+
+
+def test_classification_request_tensor_dict_compat(client):
+    """Reference-signature call shape (tensor dict) must work — unlike the
+    reference, where it can never succeed (requests.py:40,49)."""
+    resp = client.classification_request(
+        "m", {"score": np.array([0.5, -0.5], np.float32)})
+    labels = [c.classes[0].label for c in resp.result.classifications]
+    assert labels == ["pos", "neg"]
+
+
+def test_regression_request(client):
+    resp = client.regression_request("m", [{"x": 1.5}, {"x": 2.0}])
+    assert [r.value for r in resp.result.regressions] == [3.0, 4.0]
+
+
+def test_model_status_request(client):
+    resp = client.model_status_request("m", model_version=3)
+    s = resp.model_version_status[0]
+    assert s.version == 3
+    assert s.state == apis.ModelVersionStatus.AVAILABLE
+
+
+def test_inconsistent_example_dims_rejected(client):
+    with pytest.raises(ValueError, match="leading"):
+        client.classification_request(
+            "m", {"a": np.zeros(2, np.float32), "b": np.zeros(3, np.float32)})
+
+
+def test_timeout_surfaces_as_deadline(client, server_port):
+    # unreachable port: connection can't be established within the deadline
+    with TensorServingClient("127.0.0.1", 1) as dead:
+        with pytest.raises(grpc.RpcError):
+            dead.predict_request("m", {"x": np.zeros(1, np.float32)}, timeout=0.2)

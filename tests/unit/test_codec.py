@@ -150,3 +150,19 @@ def test_overlong_typed_field_rejected():
     p.float_val.extend([1.0, 2.0, 3.0])
     with pytest.raises(ValueError):
         tensor_proto_to_ndarray(p)
+
+
+def test_decoded_content_array_is_writable():
+    proto = ndarray_to_tensor_proto(np.arange(4, dtype=np.float32))
+    arr = tensor_proto_to_ndarray(proto)
+    arr[0] = 9.0  # must not raise
+    ro = tensor_proto_to_ndarray(proto, writable=False)
+    assert not ro.flags.writeable or ro.flags.owndata
+
+
+def test_tensor_content_size_mismatch_rejected():
+    proto = tf_tensor_pb2.TensorProto(dtype=1)
+    proto.tensor_shape.dim.add(size=2)
+    proto.tensor_content = b"\x00" * 20  # 20 bytes, needs 8
+    with pytest.raises(ValueError, match="20 bytes"):
+        tensor_proto_to_ndarray(proto)

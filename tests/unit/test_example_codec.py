@@ -1,0 +1,80 @@
+"""Example encode/decode tests (the host-side ParseExample equivalent)."""
+
+import numpy as np
+import pytest
+
+from min_tfs_client_tpu.protos import tfs_apis_pb2 as apis
+from min_tfs_client_tpu.tensor.example_codec import (
+    ExampleDecodeError,
+    FeatureSpec,
+    build_input,
+    decode_examples,
+    decode_input,
+    example_from_dict,
+    flatten_input,
+)
+
+
+def test_example_from_dict_kinds():
+    ex = example_from_dict({"b": b"raw", "s": "txt", "f": 1.5, "i": 7,
+                            "fv": np.array([1.0, 2.0], np.float32)})
+    f = ex.features.feature
+    assert f["b"].bytes_list.value == [b"raw"]
+    assert f["s"].bytes_list.value == [b"txt"]
+    assert f["f"].float_list.value == [1.5]
+    assert f["i"].int64_list.value == [7]
+    assert list(f["fv"].float_list.value) == [1.0, 2.0]
+
+
+def test_build_input_and_flatten():
+    inp = build_input([{"x": 1.0}, {"x": 2.0}])
+    assert inp.WhichOneof("kind") == "example_list"
+    assert len(flatten_input(inp)) == 2
+
+
+def test_context_merge():
+    inp = build_input([{"x": 1.0}, {"x": 2.0}], context={"q": b"pizza"})
+    exs = flatten_input(inp)
+    assert all(e.features.feature["q"].bytes_list.value == [b"pizza"] for e in exs)
+    # example's own feature wins on collision
+    inp2 = build_input([{"q": b"own"}], context={"q": b"ctx"})
+    assert flatten_input(inp2)[0].features.feature["q"].bytes_list.value == [b"own"]
+
+
+def test_decode_dense_batch():
+    inp = build_input([
+        {"ids": np.array([1, 2, 3]), "w": 0.5},
+        {"ids": np.array([4, 5, 6]), "w": 1.5},
+    ])
+    feats, n = decode_input(inp, {
+        "ids": FeatureSpec(np.int64, (3,)),
+        "w": FeatureSpec(np.float32),
+    })
+    assert n == 2
+    np.testing.assert_array_equal(feats["ids"], [[1, 2, 3], [4, 5, 6]])
+    np.testing.assert_array_equal(feats["w"], np.array([0.5, 1.5], np.float32))
+
+
+def test_decode_missing_with_default_and_required():
+    exs = [example_from_dict({"a": 1.0}), example_from_dict({})]
+    feats = decode_examples(exs, {"a": FeatureSpec(np.float32, default=9.0)})
+    np.testing.assert_array_equal(feats["a"], np.array([1.0, 9.0], np.float32))
+    with pytest.raises(ExampleDecodeError, match="required"):
+        decode_examples(exs[1:], {"a": FeatureSpec(np.float32)})
+
+
+def test_decode_length_mismatch():
+    exs = [example_from_dict({"v": np.array([1.0, 2.0])})]
+    with pytest.raises(ExampleDecodeError, match="2 values"):
+        decode_examples(exs, {"v": FeatureSpec(np.float32, (3,))})
+
+
+def test_decode_bytes_feature():
+    exs = [example_from_dict({"t": "hello"})]
+    feats = decode_examples(exs, {"t": FeatureSpec(np.object_)})
+    assert feats["t"].tolist() == [b"hello"]
+
+
+def test_empty_input_rejected():
+    with pytest.raises(ExampleDecodeError):
+        flatten_input(apis.Input())

@@ -1,0 +1,287 @@
+"""Lifecycle tests with fake loaders — the reference's core/ test pattern
+(aspired_versions_manager_test.cc, loader_harness_test.cc style: drive
+states to AVAILABLE without real models, FakeLoader fakes)."""
+
+import threading
+import time
+
+import pytest
+
+from min_tfs_client_tpu.core.fs_source import (
+    FileSystemStoragePathSource,
+    MonitoredServable,
+    VersionPolicy,
+)
+from min_tfs_client_tpu.core.loader import Loader, LoaderHarness, SimpleLoader
+from min_tfs_client_tpu.core.manager import AspiredVersionsManager
+from min_tfs_client_tpu.core.monitor import ServableStateMonitor
+from min_tfs_client_tpu.core.resource import ResourceTracker
+from min_tfs_client_tpu.core.states import (
+    HarnessState,
+    ManagerState,
+    ServableId,
+)
+from min_tfs_client_tpu.utils.event_bus import EventBus
+from min_tfs_client_tpu.utils.status import ServingError
+
+
+class FakeLoader(Loader):
+    """core/test_util/fake_loader.{h,cc} equivalent."""
+
+    def __init__(self, payload="servable", estimate=0, fail=False,
+                 load_delay_s=0.0):
+        self.payload = payload
+        self.estimate = estimate
+        self.fail = fail
+        self.load_delay_s = load_delay_s
+        self.loaded = False
+        self.unloaded = False
+
+    def estimate_resources(self):
+        return self.estimate
+
+    def load(self):
+        if self.load_delay_s:
+            time.sleep(self.load_delay_s)
+        if self.fail:
+            raise RuntimeError("deliberate load failure")
+        self.loaded = True
+
+    def unload(self):
+        self.unloaded = True
+
+    def servable(self):
+        return self.payload
+
+
+def make_manager(**kw):
+    kw.setdefault("start_thread", False)
+    kw.setdefault("max_load_retries", 0)
+    kw.setdefault("load_retry_interval_s", 0.0)
+    return AspiredVersionsManager(**kw)
+
+
+def pump(manager, predicate, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        manager.tick()
+        if predicate():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+class TestHarness:
+    def test_happy_path_states(self):
+        bus = EventBus()
+        seen = []
+        bus.subscribe(lambda e: seen.append(e.manager_state))
+        h = LoaderHarness(ServableId("m", 1), FakeLoader(), bus,
+                          max_load_retries=0, load_retry_interval_s=0)
+        h.request_load()
+        h.approve_load()
+        h.load()
+        assert h.state == HarnessState.READY
+        assert h.acquire() == "servable"
+        h.release()
+        h.request_unload()
+        h.unload()
+        assert h.state == HarnessState.DISABLED
+        assert seen[0] == ManagerState.START
+        assert ManagerState.AVAILABLE in seen
+        assert seen[-1] == ManagerState.END
+
+    def test_illegal_transition_rejected(self):
+        h = LoaderHarness(ServableId("m", 1), FakeLoader(), EventBus())
+        with pytest.raises(ServingError, match="illegal transition"):
+            h.approve_load()  # NEW -> LOAD_APPROVED skips LOAD_REQUESTED
+
+    def test_load_failure_sets_error(self):
+        h = LoaderHarness(ServableId("m", 1), FakeLoader(fail=True), EventBus(),
+                          max_load_retries=1, load_retry_interval_s=0)
+        h.request_load()
+        h.approve_load()
+        h.load()
+        assert h.state == HarnessState.ERROR
+        assert "deliberate load failure" in h.error.message
+        with pytest.raises(ServingError, match="not available"):
+            h.acquire()
+
+    def test_unload_waits_for_inflight(self):
+        h = LoaderHarness(ServableId("m", 1), FakeLoader(), EventBus(),
+                          max_load_retries=0, load_retry_interval_s=0)
+        h.request_load(); h.approve_load(); h.load()
+        h.acquire()
+        h.request_unload()
+        done = threading.Event()
+        t = threading.Thread(target=lambda: (h.unload(), done.set()))
+        t.start()
+        time.sleep(0.05)
+        assert not done.is_set(), "unload must wait for in-flight request"
+        h.release()
+        t.join(timeout=5)
+        assert done.is_set()
+        assert h.state == HarnessState.DISABLED
+
+
+class TestManager:
+    def test_load_and_serve(self):
+        m = make_manager()
+        m.set_aspired_versions("model", [(1, FakeLoader("v1"))])
+        assert pump(m, lambda: m.list_available() == [ServableId("model", 1)])
+        with m.get_servable_handle("model") as h:
+            assert h.servable == "v1"
+            assert h.id.version == 1
+        m.stop()
+
+    def test_latest_version_wins(self):
+        m = make_manager()
+        m.set_aspired_versions(
+            "model", [(1, FakeLoader("v1")), (3, FakeLoader("v3"))])
+        assert pump(m, lambda: len(m.list_available()) == 2)
+        with m.get_servable_handle("model") as h:
+            assert h.servable == "v3"
+        with m.get_servable_handle("model", version=1) as h:
+            assert h.servable == "v1"
+        with pytest.raises(ServingError, match="not found"):
+            m.get_servable_handle("model", version=9)
+        m.stop()
+
+    def test_aspired_omission_unloads(self):
+        m = make_manager()
+        l1, l2 = FakeLoader("v1"), FakeLoader("v2")
+        m.set_aspired_versions("model", [(1, l1)])
+        assert pump(m, lambda: m.list_available() == [ServableId("model", 1)])
+        m.set_aspired_versions("model", [(2, l2)])
+        assert pump(m, lambda: m.list_available() == [ServableId("model", 2)])
+        assert l1.unloaded
+        m.stop()
+
+    def test_availability_preserved_during_swap(self):
+        """Old version keeps serving while the replacement loads
+        (availability_preserving_policy.h semantics)."""
+        m = make_manager(start_thread=True, tick_interval_s=0.01)
+        l1 = FakeLoader("v1")
+        l2 = FakeLoader("v2", load_delay_s=0.3)
+        m.set_aspired_versions("model", [(1, l1)])
+        monitor = ServableStateMonitor(m.event_bus)
+        m.set_aspired_versions("model", [(1, l1)])
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and not m.list_available():
+            time.sleep(0.01)
+        m.set_aspired_versions("model", [(2, l2)])
+        # While v2 loads, v1 must still serve.
+        saw_v1_during_load = False
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            avail = m.list_available()
+            if ServableId("model", 2) in avail:
+                break
+            if ServableId("model", 1) in avail:
+                saw_v1_during_load = True
+            time.sleep(0.02)
+        assert saw_v1_during_load
+        assert ServableId("model", 2) in m.list_available()
+        monitor.close()
+        m.stop()
+
+    def test_resource_gating_defers_load(self):
+        tracker = ResourceTracker(pool_bytes=100)
+        m = make_manager(resource_tracker=tracker)
+        big = FakeLoader("big", estimate=80)
+        bigger = FakeLoader("bigger", estimate=90)
+        m.set_aspired_versions("a", [(1, big)])
+        assert pump(m, lambda: m.list_available() == [ServableId("a", 1)])
+        m.set_aspired_versions("b", [(1, bigger)])
+        for _ in range(5):
+            m.tick()
+        assert ServableId("b", 1) not in m.list_available()
+        # Freeing a's reservation lets b load.
+        m.set_aspired_versions("a", [])
+        assert pump(m, lambda: m.list_available() == [ServableId("b", 1)])
+        m.stop()
+
+    def test_error_load_reports_end_state(self):
+        bus_events = []
+        m = make_manager()
+        m.event_bus.subscribe(lambda e: bus_events.append(e))
+        m.set_aspired_versions("model", [(1, FakeLoader(fail=True))])
+        assert pump(
+            m, lambda: any(e.manager_state == ManagerState.END
+                           for e in bus_events))
+        err_event = [e for e in bus_events
+                     if e.manager_state == ManagerState.END][0]
+        assert err_event.error is not None
+        m.stop()
+
+
+class TestMonitor:
+    def test_wait_until_available(self):
+        m = make_manager(start_thread=True, tick_interval_s=0.01)
+        monitor = ServableStateMonitor(m.event_bus)
+        m.set_aspired_versions("model", [(1, FakeLoader())])
+        state = monitor.wait_until_in_state(
+            ServableId("model", 1), ManagerState.AVAILABLE, timeout_s=5)
+        assert state.manager_state == ManagerState.AVAILABLE
+        assert monitor.versions_of("model")[1].manager_state == \
+            ManagerState.AVAILABLE
+        monitor.close()
+        m.stop()
+
+    def test_wait_timeout(self):
+        monitor = ServableStateMonitor(EventBus())
+        with pytest.raises(TimeoutError):
+            monitor.wait_until_in_state(
+                ServableId("nope", 1), ManagerState.AVAILABLE, timeout_s=0.05)
+        monitor.close()
+
+
+class TestFsSource:
+    def test_policies(self, tmp_path):
+        for v in (1, 3, 7):
+            (tmp_path / str(v)).mkdir()
+        (tmp_path / "not_a_version").mkdir()
+        calls = []
+        src = FileSystemStoragePathSource(
+            [MonitoredServable("m", str(tmp_path), VersionPolicy("latest", 2))],
+            poll_wait_seconds=-1)
+        src.set_aspired_versions_callback(
+            lambda name, versions: calls.append((name, versions)))
+        src.poll_once()
+        assert calls[-1][0] == "m"
+        assert [v for v, _ in calls[-1][1]] == [3, 7]
+
+        src.update_config(
+            [MonitoredServable("m", str(tmp_path), VersionPolicy("all"))])
+        assert [v for v, _ in calls[-1][1]] == [1, 3, 7]
+
+        src.update_config([MonitoredServable(
+            "m", str(tmp_path), VersionPolicy("specific", specific=(3,)))])
+        assert [v for v, _ in calls[-1][1]] == [3]
+
+    def test_removed_servable_aspires_zero(self, tmp_path):
+        (tmp_path / "1").mkdir()
+        calls = []
+        src = FileSystemStoragePathSource(
+            [MonitoredServable("m", str(tmp_path))], poll_wait_seconds=-1)
+        src.set_aspired_versions_callback(
+            lambda name, versions: calls.append((name, versions)))
+        src.poll_once()
+        src.update_config([])
+        assert ("m", []) in calls
+
+    def test_polling_picks_up_new_version(self, tmp_path):
+        (tmp_path / "1").mkdir()
+        calls = []
+        src = FileSystemStoragePathSource(
+            [MonitoredServable("m", str(tmp_path))], poll_wait_seconds=0.05)
+        src.set_aspired_versions_callback(
+            lambda name, versions: calls.append(versions))
+        (tmp_path / "2").mkdir()
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            if calls and [v for v, _ in calls[-1]] == [2]:
+                break
+            time.sleep(0.02)
+        assert [v for v, _ in calls[-1]] == [2]
+        src.stop()
