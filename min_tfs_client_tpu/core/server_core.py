@@ -21,6 +21,7 @@ from min_tfs_client_tpu.core.fs_source import (
 )
 from min_tfs_client_tpu.core.manager import AspiredVersionsManager, ServableHandle
 from min_tfs_client_tpu.core.monitor import ServableStateMonitor
+from min_tfs_client_tpu.core.request_logger import ServerRequestLogger
 from min_tfs_client_tpu.core.resource import ResourceTracker
 from min_tfs_client_tpu.core.states import ManagerState, ServableId
 from min_tfs_client_tpu.protos import tfs_apis_pb2, tfs_config_pb2
@@ -62,6 +63,7 @@ class ServerCore:
             num_load_threads=num_load_threads,
             num_unload_threads=num_unload_threads,
         )
+        self.request_logger = ServerRequestLogger()
         # model name -> ModelConfig (current generation)
         self._model_configs: dict[str, ModelConfig] = {}
         self._source: FileSystemStoragePathSource | None = None
@@ -120,6 +122,9 @@ class ServerCore:
             self._model_configs = {m.name: ModelConfig() for m in models}
             for m in models:
                 self._model_configs[m.name].CopyFrom(m)
+        self.request_logger.update(
+            {m.name: m.logging_config for m in models
+             if m.HasField("logging_config")})
         if initial:
             self._source = FileSystemStoragePathSource(
                 self._monitored(models), poll_wait_seconds=self._poll_wait)

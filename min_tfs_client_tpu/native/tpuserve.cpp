@@ -1,0 +1,140 @@
+// Native runtime support library.
+//
+// The reference's record I/O and checksumming live in C++
+// (tensorflow/core/lib/io/record_reader.cc, lib/hash/crc32c.cc); this
+// library is their equivalent for the TPU serving stack, exposed to Python
+// via ctypes (no pybind11 in this image). Python fallbacks exist for every
+// entry point, so the .so is an accelerator, not a hard dependency.
+//
+// Contents:
+//   crc32c            Castagnoli CRC, slice-by-8 software implementation
+//   masked crc        TFRecord's rotated+offset masking
+//   tfrecord framing  batch scan of [len][lencrc][data][datacrc] records
+//   pad_rows          batched row-padding memcpy kernel (batch assembly)
+//
+// Build: cc -O3 -shared -fPIC -o libtpuserve.so tpuserve.cpp  (see build.py)
+
+#include <cstdint>
+#include <cstring>
+#include <cstddef>
+
+namespace {
+
+// ---------------------------------------------------------------------------
+// CRC32C (Castagnoli, polynomial 0x82f63b78), slice-by-8.
+
+uint32_t kCrcTable[8][256];
+bool table_init_done = false;
+
+void InitTables() {
+  if (table_init_done) return;
+  for (uint32_t i = 0; i < 256; i++) {
+    uint32_t crc = i;
+    for (int j = 0; j < 8; j++) {
+      crc = (crc >> 1) ^ ((crc & 1) ? 0x82f63b78u : 0);
+    }
+    kCrcTable[0][i] = crc;
+  }
+  for (int t = 1; t < 8; t++) {
+    for (uint32_t i = 0; i < 256; i++) {
+      kCrcTable[t][i] =
+          (kCrcTable[t - 1][i] >> 8) ^ kCrcTable[0][kCrcTable[t - 1][i] & 0xff];
+    }
+  }
+  table_init_done = true;
+}
+
+uint32_t Extend(uint32_t crc, const uint8_t* data, size_t n) {
+  InitTables();
+  crc = ~crc;
+  while (n >= 8) {
+    uint64_t word;
+    memcpy(&word, data, 8);
+    word ^= crc;
+    crc = kCrcTable[7][word & 0xff] ^ kCrcTable[6][(word >> 8) & 0xff] ^
+          kCrcTable[5][(word >> 16) & 0xff] ^ kCrcTable[4][(word >> 24) & 0xff] ^
+          kCrcTable[3][(word >> 32) & 0xff] ^ kCrcTable[2][(word >> 40) & 0xff] ^
+          kCrcTable[1][(word >> 48) & 0xff] ^ kCrcTable[0][(word >> 56) & 0xff];
+    data += 8;
+    n -= 8;
+  }
+  while (n--) {
+    crc = kCrcTable[0][(crc ^ *data++) & 0xff] ^ (crc >> 8);
+  }
+  return ~crc;
+}
+
+constexpr uint32_t kMaskDelta = 0xa282ead8u;
+
+uint32_t Mask(uint32_t crc) {
+  return ((crc >> 15) | (crc << 17)) + kMaskDelta;
+}
+
+uint32_t Unmask(uint32_t masked) {
+  uint32_t rot = masked - kMaskDelta;
+  return (rot >> 17) | (rot << 15);
+}
+
+}  // namespace
+
+extern "C" {
+
+uint32_t tpuserve_crc32c(const uint8_t* data, size_t n) {
+  return Extend(0, data, n);
+}
+
+uint32_t tpuserve_masked_crc32c(const uint8_t* data, size_t n) {
+  return Mask(Extend(0, data, n));
+}
+
+// Scan a TFRecord buffer; fill (offset, length) pairs for each record's
+// payload. Returns the record count, or -1-based negative error codes:
+//   -1 truncated header/payload, -2 length-crc mismatch, -3 data-crc
+//   mismatch. `verify` 0 skips crc checks. `max_records` caps output.
+long tpuserve_scan_tfrecords(const uint8_t* buf, size_t n, uint64_t* offsets,
+                             uint64_t* lengths, long max_records, int verify) {
+  size_t pos = 0;
+  long count = 0;
+  while (pos < n && count < max_records) {
+    if (pos + 12 > n) return -1;
+    uint64_t len;
+    memcpy(&len, buf + pos, 8);
+    uint32_t len_crc;
+    memcpy(&len_crc, buf + pos + 8, 4);
+    if (verify && Unmask(len_crc) != Extend(0, buf + pos, 8)) return -2;
+    if (pos + 12 + len + 4 > n) return -1;
+    if (verify) {
+      uint32_t data_crc;
+      memcpy(&data_crc, buf + pos + 12 + len, 4);
+      if (Unmask(data_crc) != Extend(0, buf + pos + 12, len)) return -3;
+    }
+    offsets[count] = pos + 12;
+    lengths[count] = len;
+    count++;
+    pos += 12 + len + 4;
+  }
+  return count;
+}
+
+// Write the 12-byte header and 4-byte footer for one record of length n.
+void tpuserve_frame_tfrecord(const uint8_t* data, uint64_t n, uint8_t* header,
+                             uint8_t* footer) {
+  memcpy(header, &n, 8);
+  uint32_t len_crc = Mask(Extend(0, header, 8));
+  memcpy(header + 8, &len_crc, 4);
+  uint32_t data_crc = Mask(Extend(0, data, n));
+  memcpy(footer, &data_crc, 4);
+}
+
+// Copy `rows` rows of `row_bytes` each from src into dst, then fill dst up
+// to `total_rows` with copies of the first row (the batch-padding rule:
+// pad with valid data, batching_session.h:94-99). One call per tensor.
+void tpuserve_pad_rows(const uint8_t* src, uint64_t rows, uint64_t row_bytes,
+                       uint8_t* dst, uint64_t total_rows) {
+  memcpy(dst, src, rows * row_bytes);
+  for (uint64_t r = rows; r < total_rows; r++) {
+    memcpy(dst + r * row_bytes, src, row_bytes);
+  }
+}
+
+}  // extern "C"

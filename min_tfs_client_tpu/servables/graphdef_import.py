@@ -172,13 +172,17 @@ def _tensor_name(ref: str) -> tuple[str, int]:
 
 class GraphFunction:
     """Evaluates a GraphDef slice from feeds to fetches. Pure; traceable
-    under jax.jit when no string tensors are involved."""
+    under jax.jit when no string tensors are involved. `target_names` are
+    evaluated for completeness but produce no outputs (Session targets —
+    typically NoOps with only control inputs)."""
 
     def __init__(self, graph_def: tf_graph_pb2.GraphDef,
-                 feed_names: Sequence[str], fetch_names: Sequence[str]):
+                 feed_names: Sequence[str], fetch_names: Sequence[str],
+                 target_names: Sequence[str] = ()):
         self._nodes = {n.name: n for n in graph_def.node}
         self._feeds = [_tensor_name(f) for f in feed_names]
         self._fetches = [_tensor_name(f) for f in fetch_names]
+        self._targets = [_tensor_name(t)[0] for t in target_names]
         self._consts: dict[str, np.ndarray] = {}
         self.has_string = self._scan(graph_def)
 
@@ -188,7 +192,7 @@ class GraphFunction:
         has_string = False
         feeds = {name for name, _ in self._feeds}
         seen: set[str] = set()
-        stack = [name for name, _ in self._fetches]
+        stack = [name for name, _ in self._fetches] + list(self._targets)
         while stack:
             name = stack.pop()
             if name in seen:
@@ -248,6 +252,8 @@ class GraphFunction:
             memo[name] = OPS[node.op](node, args, lib)
             return memo[name]
 
+        for target in self._targets:
+            evaluate(target)  # side-effect/validation only, no output slot
         return [evaluate(name)[idx] for name, idx in self._fetches]
 
 
@@ -327,7 +333,47 @@ def load_saved_model(
 
     estimate = sum(f.stat().st_size for f in pathlib.Path(path).rglob("*")
                    if f.is_file())
-    return Servable(name, version, signatures, hbm_estimate_bytes=estimate)
+    servable = Servable(name, version, signatures, hbm_estimate_bytes=estimate)
+    # Raw-graph escape hatch for the SessionService surface
+    # (apis/session_service.proto): arbitrary feeds/fetches on the imported
+    # graph, GraphFunctions cached per (feeds, fetches) key.
+    servable.session_runner = SessionRunner(meta_graph.graph_def)
+    return servable
+
+
+class SessionRunner:
+    # Feed/fetch keys are client-controlled: cap the plan cache so a client
+    # iterating combinations cannot grow server memory without bound.
+    MAX_CACHED_PLANS = 32
+
+    def __init__(self, graph_def: tf_graph_pb2.GraphDef):
+        import collections
+
+        self._graph_def = graph_def
+        self._cache: "collections.OrderedDict[tuple, GraphFunction]" =             collections.OrderedDict()
+
+    def run(self, feeds: dict[str, object], fetches: Sequence[str],
+            targets: Sequence[str] = ()) -> list[object]:
+        key = (tuple(sorted(feeds)), tuple(fetches), tuple(targets))
+        graph_fn = self._cache.get(key)
+        if graph_fn is None:
+            graph_fn = GraphFunction(
+                self._graph_def, list(sorted(feeds)), list(fetches),
+                target_names=targets)
+            self._cache[key] = graph_fn
+            if len(self._cache) > self.MAX_CACHED_PLANS:
+                self._cache.popitem(last=False)  # LRU eviction
+        else:
+            self._cache.move_to_end(key)
+        lib = np if graph_fn.has_string else _jnp()
+        outs = graph_fn([feeds[k] for k in sorted(feeds)], lib)
+        return [np.asarray(o) for o in outs]
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
 
 
 PREDICT_METHOD_NAME_DEFAULT = "tensorflow/serving/predict"

@@ -52,6 +52,27 @@ def make_loader(
         servable = factory(name, version, path, platform_config or {})
         servable.name = name
         servable.version = version
+        config = platform_config or {}
+        # Warmup runs against the bare signatures, BEFORE the batching
+        # wrapper: replaying through the batch queue would stall each record
+        # up to batch_timeout (the reference replays directly against the
+        # session, saved_model_warmup.cc:94-146).
+        if config.get("enable_model_warmup", True):
+            from min_tfs_client_tpu.servables.warmup import (
+                run_warmup,
+                synthesize_warmup,
+            )
+
+            replayed = run_warmup(
+                servable, path,
+                num_iterations=config.get("warmup_iterations", 1))
+            if replayed == 0 and config.get("synthesize_warmup", False):
+                synthesize_warmup(servable)
+        batching = config.get("batching_parameters")
+        if batching is not None:
+            from min_tfs_client_tpu.batching.session import maybe_wrap_servable
+
+            servable = maybe_wrap_servable(servable, batching)
         return servable
 
     return SimpleLoader(create, resource_estimate=estimate)

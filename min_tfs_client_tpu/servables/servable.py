@@ -96,12 +96,14 @@ class Signature:
 
     # -- execution -----------------------------------------------------------
 
-    def run(
+    def validate(
         self,
         inputs: Mapping[str, np.ndarray],
         output_filter: Sequence[str] = (),
     ) -> dict[str, np.ndarray]:
-        """Validate, pad, execute, slice, return alias-keyed outputs."""
+        """Per-request checks, shared by the direct and batched paths (the
+        batched path must reject a bad request BEFORE it joins a batch, or
+        one caller's mistake fails every co-batched caller)."""
         missing = set(self.inputs) - set(inputs)
         if missing:
             raise ServingError.invalid_argument(
@@ -116,7 +118,6 @@ class Signature:
                 raise ServingError.invalid_argument(
                     f"output_filter name {name!r} is not in the signature "
                     f"outputs {sorted(self.outputs)}")
-
         arrays = {}
         for alias, spec in self.inputs.items():
             arr = np.asarray(inputs[alias])
@@ -125,9 +126,22 @@ class Signature:
                     raise ServingError.invalid_argument(
                         f"input {alias!r}: expected string tensor, got {arr.dtype}")
             else:
-                arr = arr.astype(spec.dtype.numpy_dtype, copy=False)
+                try:
+                    arr = arr.astype(spec.dtype.numpy_dtype, copy=False)
+                except (ValueError, TypeError) as exc:
+                    raise ServingError.invalid_argument(
+                        f"input {alias!r}: {exc}")
             spec.validate(arr, alias)
             arrays[alias] = arr
+        return arrays
+
+    def run(
+        self,
+        inputs: Mapping[str, np.ndarray],
+        output_filter: Sequence[str] = (),
+    ) -> dict[str, np.ndarray]:
+        """Validate, pad, execute, slice, return alias-keyed outputs."""
+        arrays = self.validate(inputs, output_filter)
 
         if self.on_host:
             outputs = self.fn(arrays)

@@ -46,6 +46,14 @@ class PredictionServiceImpl(gs.PredictionServiceServicer):
         return _guard(self._handlers.get_model_metadata, request, context)
 
 
+class SessionServiceImpl(gs.SessionServiceServicer):
+    def __init__(self, handlers: Handlers):
+        self._handlers = handlers
+
+    def SessionRun(self, request, context):
+        return _guard(self._handlers.session_run, request, context)
+
+
 class ModelServiceImpl(gs.ModelServiceServicer):
     def __init__(self, handlers: Handlers):
         self._handlers = handlers

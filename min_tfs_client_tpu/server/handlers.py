@@ -99,6 +99,10 @@ class Handlers:
             for alias, arr in outputs.items():
                 response.outputs[alias].CopyFrom(ndarray_to_tensor_proto(
                     arr, use_tensor_content=self._as_content))
+            self.core.request_logger.maybe_log(
+                request.model_spec.name,
+                lambda: _predict_log(request, response),
+                response.model_spec)
             return response
 
     def _example_signature(self, servable, model_spec, want_method: str) -> Signature:
@@ -138,6 +142,10 @@ class Handlers:
                             request.model_spec.signature_name)
             _assemble_classifications(
                 response.result, outputs, n, signature.class_labels)
+            self.core.request_logger.maybe_log(
+                request.model_spec.name,
+                lambda: _classify_log(request, response),
+                response.model_spec)
             return response
 
     @_instrumented("regress")
@@ -152,6 +160,10 @@ class Handlers:
                             handle.id.version,
                             request.model_spec.signature_name)
             _assemble_regressions(response.result, outputs, n)
+            self.core.request_logger.maybe_log(
+                request.model_spec.name,
+                lambda: _regress_log(request, response),
+                response.model_spec)
             return response
 
     @_instrumented("multi_inference")
@@ -213,6 +225,29 @@ class Handlers:
             response.model_spec.version.value = handle.id.version
             response.metadata[SIGNATURE_DEF_METADATA_FIELD].Pack(
                 handle.servable.signature_def_map())
+            return response
+
+    @_instrumented("session_run")
+    def session_run(self, request: apis.SessionRunRequest) -> apis.SessionRunResponse:
+        """Raw feeds/fetches on the imported graph (session_service.proto:11-44;
+        RunOptions are carried but ignored, matching the proto's own note)."""
+        with self.core.servable_handle(request.model_spec) as handle:
+            runner = getattr(handle.servable, "session_runner", None)
+            if runner is None:
+                raise ServingError.unimplemented(
+                    f"model {request.model_spec.name!r} does not support raw "
+                    "SessionRun (no imported graph)")
+            feeds = {nt.name: tensor_proto_to_ndarray(nt.tensor, writable=False)
+                     for nt in request.feed}
+            outs = runner.run(feeds, list(request.fetch), list(request.target))
+            response = apis.SessionRunResponse()
+            _effective_spec(response.model_spec, request.model_spec,
+                            handle.id.version, "")
+            for name, value in zip(request.fetch, outs):
+                nt = response.tensor.add()
+                nt.name = name
+                nt.tensor.CopyFrom(ndarray_to_tensor_proto(
+                    value, use_tensor_content=self._as_content))
             return response
 
     # -- ModelService --------------------------------------------------------
@@ -288,3 +323,24 @@ def _assemble_regressions(result, outputs, n: int) -> None:
             f"regression output count {values.shape[0]} != examples {n}")
     for i in range(n):
         result.regressions.add().value = float(values[i])
+
+
+def _predict_log(request, response) -> apis.PredictionLog:
+    log = apis.PredictionLog()
+    log.predict_log.request.CopyFrom(request)
+    log.predict_log.response.CopyFrom(response)
+    return log
+
+
+def _classify_log(request, response) -> apis.PredictionLog:
+    log = apis.PredictionLog()
+    log.classify_log.request.CopyFrom(request)
+    log.classify_log.response.CopyFrom(response)
+    return log
+
+
+def _regress_log(request, response) -> apis.PredictionLog:
+    log = apis.PredictionLog()
+    log.regress_log.request.CopyFrom(request)
+    log.regress_log.response.CopyFrom(response)
+    return log

@@ -35,6 +35,8 @@ class LocalServer(LocalInvoker):
                 handlers.multi_inference,
             "/tensorflow.serving.PredictionService/GetModelMetadata":
                 handlers.get_model_metadata,
+            "/tensorflow.serving.SessionService/SessionRun":
+                handlers.session_run,
             "/tensorflow.serving.ModelService/GetModelStatus":
                 handlers.get_model_status,
             "/tensorflow.serving.ModelService/HandleReloadConfigRequest":

@@ -27,6 +27,7 @@ from min_tfs_client_tpu.protos import tfs_config_pb2
 from min_tfs_client_tpu.server.grpc_services import (
     ModelServiceImpl,
     PredictionServiceImpl,
+    SessionServiceImpl,
 )
 from min_tfs_client_tpu.server.handlers import Handlers
 from min_tfs_client_tpu.utils.status import ServingError
@@ -89,9 +90,15 @@ class Server:
                 "Both server_model_config_file and model_base_path are empty!")
 
         batching = None
-        if opts.enable_batching and opts.batching_parameters_file:
-            batching = _parse_text_proto(
-                opts.batching_parameters_file, tfs_config_pb2.BatchingParameters)
+        if opts.enable_batching:
+            if opts.batching_parameters_file:
+                batching = _parse_text_proto(
+                    opts.batching_parameters_file,
+                    tfs_config_pb2.BatchingParameters)
+            else:
+                # Reference behavior: the flag alone enables batching with
+                # default parameters (server.cc:208-273).
+                batching = tfs_config_pb2.BatchingParameters()
 
         self.core = ServerCore(
             config,
@@ -112,6 +119,8 @@ class Server:
             PredictionServiceImpl(handlers), self._grpc_server)
         gs.add_ModelServiceServicer_to_server(
             ModelServiceImpl(handlers), self._grpc_server)
+        gs.add_SessionServiceServicer_to_server(
+            SessionServiceImpl(handlers), self._grpc_server)
         self.grpc_port = self._bind(self._grpc_server, opts.grpc_port)
         self._grpc_server.start()
 
@@ -147,12 +156,17 @@ class Server:
 
     def _poll_config_file(self) -> None:
         interval = self.options.model_config_file_poll_wait_seconds
+        last_applied = None
         while not self._config_poll_stop.wait(interval):
             try:
                 config = _parse_text_proto(
                     self.options.model_config_file,
                     tfs_config_pb2.ModelServerConfig)
+                serialized = config.SerializeToString(deterministic=True)
+                if serialized == last_applied:
+                    continue  # unchanged: no reload churn, no collector swap
                 self.core.reload_config(config)
+                last_applied = serialized
             except Exception:  # pragma: no cover - poll must survive bad files
                 import traceback
 

@@ -389,3 +389,46 @@ class TestRest:
                        "/monitoring/prometheus/metrics") as r:
             text = r.read().decode()
         assert "# TYPE" in text
+
+
+def test_enable_batching_end_to_end(model_root, tmp_path):
+    """Server with --enable_batching: concurrent Predicts coalesce on the
+    shared scheduler and all return correct per-caller slices."""
+    import threading
+
+    params = tmp_path / "batching.config"
+    params.write_text("""
+max_batch_size { value: 16 }
+batch_timeout_micros { value: 50000 }
+allowed_batch_sizes: 4
+allowed_batch_sizes: 8
+allowed_batch_sizes: 16
+""")
+    srv = Server(ServerOptions(
+        grpc_port=0,
+        model_name="native",
+        model_base_path=str(model_root / "native"),
+        model_platform="jax",
+        enable_batching=True,
+        batching_parameters_file=str(params),
+        file_system_poll_wait_seconds=0,
+    )).build_and_start()
+    try:
+        with TensorServingClient("127.0.0.1", srv.grpc_port) as c:
+            results = {}
+
+            def call(i):
+                resp = c.predict_request(
+                    "native", {"x": np.array([float(i)], np.float32)})
+                results[i] = tensor_proto_to_ndarray(resp.outputs["y"])
+
+            threads = [threading.Thread(target=call, args=(i,))
+                       for i in range(6)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=30)
+            for i in range(6):
+                np.testing.assert_allclose(results[i], [3.0 * i + 1.0])
+    finally:
+        srv.stop()

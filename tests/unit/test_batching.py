@@ -1,0 +1,297 @@
+"""Dynamic batching tests — scheduler maturity/fairness semantics
+(batching_util tests' FakeClock-style determinism where possible) and
+BatchingSession merge/pad/split behavior (batching_session_test.cc surface)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from min_tfs_client_tpu.batching.scheduler import (
+    BatchTask,
+    QueueOptions,
+    SharedBatchScheduler,
+)
+from min_tfs_client_tpu.batching.session import (
+    BatchedSignatureRunner,
+    maybe_wrap_servable,
+    pad_ragged,
+    params_from_proto,
+)
+from min_tfs_client_tpu.protos import tfs_config_pb2
+from min_tfs_client_tpu.servables.servable import Servable, Signature, TensorSpec
+from min_tfs_client_tpu.utils.status import ServingError
+
+
+@pytest.fixture()
+def scheduler():
+    s = SharedBatchScheduler(num_threads=2)
+    yield s
+    s.stop()
+
+
+def _submit(scheduler, queue, inputs, size):
+    task = BatchTask(inputs=inputs, size=size)
+    scheduler.schedule(queue, task)
+    return task
+
+
+class TestScheduler:
+    def test_full_batch_processes_immediately(self, scheduler):
+        batches = []
+        queue = scheduler.add_queue(
+            "q", QueueOptions(max_batch_size=4, batch_timeout_s=30),
+            lambda b: batches.append([t.size for t in b]))
+        tasks = [_submit(scheduler, queue, {}, 2) for _ in range(2)]
+        for t in tasks:
+            assert t.done.wait(5)
+        assert batches == [[2, 2]]
+
+    def test_timeout_flushes_partial_batch(self, scheduler):
+        batches = []
+        queue = scheduler.add_queue(
+            "q", QueueOptions(max_batch_size=100, batch_timeout_s=0.05),
+            lambda b: batches.append(sum(t.size for t in b)))
+        task = _submit(scheduler, queue, {}, 3)
+        assert task.done.wait(5)
+        assert batches == [3]
+
+    def test_zero_timeout_runs_each_task(self, scheduler):
+        batches = []
+        queue = scheduler.add_queue(
+            "q", QueueOptions(max_batch_size=100, batch_timeout_s=0.0),
+            lambda b: batches.append(sum(t.size for t in b)))
+        t1 = _submit(scheduler, queue, {}, 1)
+        assert t1.done.wait(5)
+        assert 1 in batches
+
+    def test_task_larger_than_max_rejected(self, scheduler):
+        queue = scheduler.add_queue(
+            "q", QueueOptions(max_batch_size=4), lambda b: None)
+        with pytest.raises(ServingError, match="exceeds max_batch_size"):
+            queue.schedule(BatchTask(inputs={}, size=5))
+
+    def test_queue_full_unavailable(self, scheduler):
+        block = threading.Event()
+        queue = scheduler.add_queue(
+            "q", QueueOptions(max_batch_size=1, batch_timeout_s=0,
+                              max_enqueued_batches=2),
+            lambda b: block.wait(10))
+        # 2 workers occupied + queue capacity 2 -> 5th schedule must fail.
+        submitted = []
+        with pytest.raises(ServingError, match="full"):
+            for _ in range(8):
+                submitted.append(_submit(scheduler, queue, {}, 1))
+        block.set()
+        for t in submitted:
+            t.done.wait(5)
+
+    def test_processing_error_propagates_to_all_waiters(self, scheduler):
+        def boom(batch):
+            raise RuntimeError("kaboom")
+
+        queue = scheduler.add_queue(
+            "q", QueueOptions(max_batch_size=2, batch_timeout_s=10), boom)
+        tasks = [_submit(scheduler, queue, {}, 1) for _ in range(2)]
+        for t in tasks:
+            assert t.done.wait(5)
+            assert isinstance(t.error, RuntimeError)
+
+    def test_remove_queue_fails_stranded_tasks(self):
+        s = SharedBatchScheduler(num_threads=1)
+        gate = threading.Event()
+        q1 = s.add_queue("busy", QueueOptions(max_batch_size=1),
+                         lambda b: gate.wait(10))
+        _submit(s, q1, {}, 1)  # occupy the single worker
+        q2 = s.add_queue("victim", QueueOptions(max_batch_size=10,
+                                                batch_timeout_s=30),
+                         lambda b: None)
+        stranded = _submit(s, q2, {}, 1)
+        s.remove_queue(q2)
+        assert stranded.done.wait(5)
+        assert isinstance(stranded.error, ServingError)
+        gate.set()
+        s.stop()
+
+    def test_round_robin_across_queues(self, scheduler):
+        order = []
+        lock = threading.Lock()
+        q1 = scheduler.add_queue("a", QueueOptions(max_batch_size=1),
+                                 lambda b: order.append("a"))
+        q2 = scheduler.add_queue("b", QueueOptions(max_batch_size=1),
+                                 lambda b: order.append("b"))
+        tasks = []
+        for _ in range(3):
+            tasks.append(_submit(scheduler, q1, {}, 1))
+            tasks.append(_submit(scheduler, q2, {}, 1))
+        for t in tasks:
+            assert t.done.wait(5)
+        assert set(order) == {"a", "b"}
+        assert order.count("a") == 3 and order.count("b") == 3
+
+
+def make_signature(record):
+    """record logs each .run() merged-batch size (jit traces are cached by
+    shape, so counting inside fn would undercount executions)."""
+    sig = Signature(
+        fn=lambda inputs: {"y": inputs["x"] * 2.0},
+        inputs={"x": TensorSpec(np.float32, (None,))},
+        outputs={"y": TensorSpec(np.float32, (None,))},
+    )
+    original_run = sig.run
+
+    def counting_run(inputs, output_filter=()):
+        record.append(np.asarray(inputs["x"]).shape[0])
+        return original_run(inputs, output_filter)
+
+    sig.run = counting_run
+    return sig
+
+
+class TestBatchedRunner:
+    def test_concurrent_callers_coalesce(self, scheduler):
+        executed = []
+        sig = make_signature(executed)
+        runner = BatchedSignatureRunner(
+            sig, scheduler, max_batch_size=8, batch_timeout_s=0.2,
+            allowed_batch_sizes=[2, 4, 8])
+        results = {}
+
+        def call(i):
+            results[i] = runner.run({"x": np.array([float(i)], np.float32)})
+
+        threads = [threading.Thread(target=call, args=(i,)) for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10)
+        for i in range(4):
+            np.testing.assert_array_equal(results[i]["y"], [2.0 * i])
+        # All four size-1 tasks must have merged into one device execution,
+        # padded up to the allowed bucket 4.
+        assert executed == [4]
+        runner.close()
+
+    def test_oversized_request_splits(self, scheduler):
+        executed = []
+        sig = make_signature(executed)
+        runner = BatchedSignatureRunner(
+            sig, scheduler, max_batch_size=4, allowed_batch_sizes=[2, 4])
+        out = runner.run({"x": np.arange(10, dtype=np.float32)})
+        np.testing.assert_array_equal(
+            out["y"], np.arange(10, dtype=np.float32) * 2)
+        assert executed == [4, 4, 2]
+        runner.close()
+
+    def test_allowed_sizes_last_must_match_max(self, scheduler):
+        with pytest.raises(ServingError, match="must equal max_batch_size"):
+            BatchedSignatureRunner(
+                make_signature([]), scheduler,
+                max_batch_size=8, allowed_batch_sizes=[2, 4])
+
+    def test_ragged_merge_requires_flag(self, scheduler):
+        calls = []
+
+        def fn(inputs):
+            calls.append(inputs["x"].shape)
+            return {"y": inputs["x"].sum(axis=1)}
+
+        sig = Signature(
+            fn=fn,
+            inputs={"x": TensorSpec(np.float32, (None, None))},
+            outputs={"y": TensorSpec(np.float32, (None,))},
+        )
+        runner = BatchedSignatureRunner(
+            sig, scheduler, max_batch_size=4, batch_timeout_s=0.2,
+            pad_variable_length_inputs=True)
+        results = {}
+
+        def call(i, width):
+            results[i] = runner.run(
+                {"x": np.ones((1, width), np.float32)})
+
+        threads = [threading.Thread(target=call, args=(0, 2)),
+                   threading.Thread(target=call, args=(1, 5))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10)
+        # padded to width 5; row 0 padded with its first element (1.0)
+        np.testing.assert_array_equal(results[0]["y"], [5.0])
+        np.testing.assert_array_equal(results[1]["y"], [5.0])
+        runner.close()
+
+
+def test_pad_ragged_pads_with_first_element():
+    a = np.array([[1.0, 2.0]], np.float32)
+    b = np.array([[3.0, 4.0, 5.0, 6.0]], np.float32)
+    pa, pb = pad_ragged([a, b])
+    assert pa.shape == pb.shape == (1, 4)
+    np.testing.assert_array_equal(pa, [[1.0, 2.0, 1.0, 1.0]])
+
+
+def test_params_from_proto():
+    proto = tfs_config_pb2.BatchingParameters()
+    proto.max_batch_size.value = 16
+    proto.batch_timeout_micros.value = 2000
+    proto.allowed_batch_sizes.extend([4, 8, 16])
+    proto.pad_variable_length_inputs = True
+    params = params_from_proto(proto)
+    assert params["max_batch_size"] == 16
+    assert params["batch_timeout_s"] == pytest.approx(0.002)
+    assert params["allowed_batch_sizes"] == [4, 8, 16]
+    assert params["pad_variable_length_inputs"]
+
+
+def test_maybe_wrap_servable_and_unload_closes_queues(scheduler):
+    executed = []
+    servable = Servable("m", 1, {"serving_default": make_signature(executed)})
+    proto = tfs_config_pb2.BatchingParameters()
+    proto.max_batch_size.value = 8
+    proto.allowed_batch_sizes.extend([2, 4, 8])
+    wrapped = maybe_wrap_servable(servable, proto, scheduler)
+    out = wrapped.signature("serving_default").run(
+        {"x": np.array([1.0, 2.0], np.float32)})
+    np.testing.assert_array_equal(out["y"], [2.0, 4.0])
+    assert executed == [2]
+    wrapped.unload()
+    with pytest.raises(ServingError, match="closed"):
+        wrapped.signature("serving_default").run(
+            {"x": np.array([1.0], np.float32)})
+
+
+def test_bad_request_fails_alone_not_batchmates(scheduler):
+    """A malformed request must get INVALID_ARGUMENT without poisoning the
+    batch; a valid concurrent request still succeeds."""
+    sig = make_signature([])
+    runner = BatchedSignatureRunner(
+        sig, scheduler, max_batch_size=8, batch_timeout_s=0.2,
+        allowed_batch_sizes=[2, 4, 8])
+    results = {}
+
+    def good():
+        results["good"] = runner.run({"x": np.array([1.0], np.float32)})
+
+    def bad():
+        try:
+            runner.run({"zz": np.array([1.0], np.float32)})
+            results["bad"] = "no error"
+        except ServingError as e:
+            results["bad"] = e
+
+    t1, t2 = threading.Thread(target=good), threading.Thread(target=bad)
+    t1.start(); t2.start(); t1.join(10); t2.join(10)
+    np.testing.assert_array_equal(results["good"]["y"], [2.0])
+    assert isinstance(results["bad"], ServingError)
+    assert results["bad"].code == 3  # INVALID_ARGUMENT
+    runner.close()
+
+
+def test_bad_output_filter_on_batched_path(scheduler):
+    sig = make_signature([])
+    runner = BatchedSignatureRunner(
+        sig, scheduler, max_batch_size=8, allowed_batch_sizes=[2, 4, 8])
+    with pytest.raises(ServingError, match="output_filter"):
+        runner.run({"x": np.array([1.0], np.float32)}, ("bogus",))
+    runner.close()

@@ -1,0 +1,270 @@
+"""TFRecord framing, warmup replay, request logging, SessionRun tests."""
+
+import pathlib
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from min_tfs_client_tpu.protos import tfs_apis_pb2 as apis
+from min_tfs_client_tpu.protos import tfs_config_pb2
+from min_tfs_client_tpu.core.request_logger import (
+    MemoryLogCollector,
+    RequestLogger,
+    ServerRequestLogger,
+    register_log_collector,
+)
+from min_tfs_client_tpu.servables import warmup
+from min_tfs_client_tpu.tensor.codec import ndarray_to_tensor_proto
+from min_tfs_client_tpu.utils import tfrecord
+from min_tfs_client_tpu.utils.status import ServingError
+from tests import fixtures
+
+
+class TestTFRecord:
+    def test_roundtrip(self, tmp_path):
+        path = tmp_path / "data.tfrecord"
+        records = [b"alpha", b"", b"x" * 10000]
+        assert tfrecord.write_records(path, records) == 3
+        assert list(tfrecord.read_records(path)) == records
+
+    def test_native_and_python_agree(self, tmp_path):
+        """The C++ and Python crc32c implementations must be identical."""
+        data = bytes(range(256)) * 7
+        from min_tfs_client_tpu import native
+
+        lib = native.load()
+        if lib is None:
+            pytest.skip("native lib unavailable")
+        assert lib.tpuserve_crc32c(data, len(data)) == tfrecord._py_crc32c(data)
+
+    def test_corruption_detected(self, tmp_path):
+        path = tmp_path / "data.tfrecord"
+        tfrecord.write_records(path, [b"payload"])
+        raw = bytearray(path.read_bytes())
+        raw[14] ^= 0xFF  # flip a payload byte
+        path.write_bytes(bytes(raw))
+        with pytest.raises(tfrecord.TFRecordError):
+            list(tfrecord.read_records(path))
+
+    def test_max_records(self, tmp_path):
+        path = tmp_path / "data.tfrecord"
+        tfrecord.write_records(path, [b"a", b"b", b"c"])
+        assert list(tfrecord.read_records(path, max_records=2)) == [b"a", b"b"]
+
+    def test_tf_compatibility(self, tmp_path):
+        """Byte-compatibility against TensorFlow's own TFRecordWriter,
+        generated in a subprocess (TF + our protos cannot share a process)."""
+        path = tmp_path / "tf.tfrecord"
+        script = (
+            "import tensorflow as tf\n"
+            f"with tf.io.TFRecordWriter({str(path)!r}) as w:\n"
+            "    w.write(b'from-tf')\n"
+            "    w.write(b'second')\n"
+        )
+        proc = subprocess.run([sys.executable, "-c", script],
+                              capture_output=True, timeout=120)
+        if proc.returncode != 0:
+            pytest.skip(f"tf writer unavailable: {proc.stderr[-200:]}")
+        assert list(tfrecord.read_records(path)) == [b"from-tf", b"second"]
+
+
+def _predict_log_bytes(x):
+    log = apis.PredictionLog()
+    req = log.predict_log.request
+    req.model_spec.name = "m"
+    req.inputs["x"].CopyFrom(
+        ndarray_to_tensor_proto(np.asarray(x, np.float32)))
+    return log.SerializeToString()
+
+
+class TestWarmup:
+    def _servable(self, tmp_path, calls):
+        from min_tfs_client_tpu.servables.servable import (
+            Servable, Signature, TensorSpec)
+
+        def fn(inputs):
+            return {"y": inputs["x"] * 2}
+
+        sig = Signature(fn=fn, inputs={"x": TensorSpec(np.float32, (None,))},
+                        outputs={"y": TensorSpec(np.float32, (None,))},
+                        batch_buckets=(2, 4))
+        original_run = sig.run
+
+        def counting_run(inputs, output_filter=()):
+            calls.append(np.asarray(inputs["x"]).shape[0])
+            return original_run(inputs, output_filter)
+
+        sig.run = counting_run
+        return Servable("m", 1, {"serving_default": sig})
+
+    def test_replay(self, tmp_path):
+        wdir = tmp_path / "assets.extra"
+        wdir.mkdir()
+        tfrecord.write_records(
+            wdir / "tf_serving_warmup_requests",
+            [_predict_log_bytes([1.0]), _predict_log_bytes([1.0, 2.0])])
+        calls = []
+        servable = self._servable(tmp_path, calls)
+        replayed = warmup.run_warmup(servable, tmp_path, num_iterations=2)
+        assert replayed == 2
+        assert calls.count(1) == 2 and calls.count(2) == 2
+
+    def test_no_file_is_noop(self, tmp_path):
+        assert warmup.run_warmup(
+            self._servable(tmp_path, []), tmp_path) == 0
+
+    def test_unsupported_log_type_fails_load(self, tmp_path):
+        wdir = tmp_path / "assets.extra"
+        wdir.mkdir()
+        log = apis.PredictionLog()  # no log_type set
+        tfrecord.write_records(
+            wdir / "tf_serving_warmup_requests", [log.SerializeToString()])
+        with pytest.raises(ServingError, match="Unsupported log_type"):
+            warmup.run_warmup(self._servable(tmp_path, []), tmp_path)
+
+    def test_synthesize_primes_every_bucket(self, tmp_path):
+        calls = []
+        servable = self._servable(tmp_path, calls)
+        runs = warmup.synthesize_warmup(servable)
+        assert runs == 2
+        assert calls == [2, 4]
+
+    def test_warmup_runs_at_load_through_platform(self, tmp_path):
+        """End-to-end: version dir with a warmup file loads + replays."""
+        from min_tfs_client_tpu.servables import platforms
+
+        vdir = fixtures.write_jax_servable(tmp_path / "native")
+        wdir = vdir / "assets.extra"
+        wdir.mkdir()
+        tfrecord.write_records(
+            wdir / "tf_serving_warmup_requests", [_predict_log_bytes([1.0])])
+        loader = platforms.make_loader("jax", "native", 1, str(vdir))
+        loader.load()  # raises if warmup replay fails
+        servable = loader.servable()
+        assert servable.name == "native"
+
+
+class TestRequestLogging:
+    def test_sampling(self):
+        config = tfs_config_pb2.LoggingConfig()
+        config.sampling_config.sampling_rate = 1.0
+        collector = MemoryLogCollector()
+        logger = RequestLogger(config, collector)
+        assert logger.should_log()
+        spec = apis.ModelSpec(name="m")
+        logger.log(apis.PredictionLog(), spec)
+        assert collector.logs[0].log_metadata.model_spec.name == "m"
+        config.sampling_config.sampling_rate = 0.0
+        assert not RequestLogger(config, collector).should_log()
+
+    def test_server_logger_swap_and_unknown_type(self):
+        srl = ServerRequestLogger()
+        config = tfs_config_pb2.LoggingConfig()
+        config.log_collector_config.type = "memory"
+        config.sampling_config.sampling_rate = 1.0
+        srl.update({"m": config})
+        seen = []
+        srl.maybe_log("m", lambda: apis.PredictionLog(), apis.ModelSpec(name="m"))
+        srl.maybe_log("ghost", lambda: seen.append(1) or apis.PredictionLog(),
+                      apis.ModelSpec())
+        assert not seen  # unknown model never builds the log
+        bad = tfs_config_pb2.LoggingConfig()
+        bad.log_collector_config.type = "nope"
+        with pytest.raises(ServingError, match="unknown log collector"):
+            srl.update({"m": bad})
+
+    def test_tfrecord_collector_roundtrip(self, tmp_path):
+        config = tfs_config_pb2.LoggingConfig()
+        config.log_collector_config.type = "tfrecord"
+        config.log_collector_config.filename_prefix = str(tmp_path / "logs")
+        config.sampling_config.sampling_rate = 1.0
+        srl = ServerRequestLogger()
+        srl.update({"m": config})
+        log = apis.PredictionLog()
+        log.predict_log.request.model_spec.name = "m"
+        srl.maybe_log("m", lambda: log, apis.ModelSpec(name="m"))
+        srl.update({})  # swap out -> flush
+        records = list(tfrecord.read_records(tmp_path / "logs.tfrecord"))
+        parsed = apis.PredictionLog.FromString(records[0])
+        assert parsed.predict_log.request.model_spec.name == "m"
+        assert parsed.log_metadata.model_spec.name == "m"
+
+
+class TestSessionRun:
+    def test_session_run_on_imported_graph(self, tmp_path):
+        from min_tfs_client_tpu.servables.graphdef_import import load_saved_model
+
+        fixtures.write_half_plus_two(tmp_path / "hpt")
+        servable = load_saved_model(str(tmp_path / "hpt" / "1"), "hpt", 1)
+        outs = servable.session_runner.run(
+            {"x:0": np.array([2.0, 4.0], np.float32)}, ["mul:0", "y:0"])
+        np.testing.assert_allclose(outs[0], [1.0, 2.0])
+        np.testing.assert_allclose(outs[1], [3.0, 4.0])
+
+    def test_session_run_rpc(self, tmp_path):
+        """Through the full local transport."""
+        from min_tfs_client_tpu.client.inprocess import (
+            InProcessChannel, unregister_server, _normalize)
+        from min_tfs_client_tpu.protos.grpc_service import SessionServiceStub
+
+        fixtures.write_half_plus_two(tmp_path / "hpt")
+        target = f"tpu://{tmp_path}/hpt"
+        channel = InProcessChannel.for_target(target)
+        try:
+            stub = SessionServiceStub(channel)
+            request = apis.SessionRunRequest()
+            request.model_spec.name = "hpt"
+            feed = request.feed.add()
+            feed.name = "x:0"
+            feed.tensor.CopyFrom(
+                ndarray_to_tensor_proto(np.array([6.0], np.float32)))
+            request.fetch.append("y:0")
+            response = stub.SessionRun(request, timeout=10)
+            assert response.tensor[0].name == "y:0"
+            from min_tfs_client_tpu.tensor.codec import tensor_proto_to_ndarray
+
+            np.testing.assert_allclose(
+                tensor_proto_to_ndarray(response.tensor[0].tensor), [5.0])
+        finally:
+            from min_tfs_client_tpu.client import inprocess
+
+            key = _normalize(target)
+            invoker = inprocess._registry.get(key)
+            if invoker is not None:
+                invoker.stop()
+                unregister_server(key)
+
+
+def test_session_run_noop_target(tmp_path):
+    """Targets naming zero-output ops (NoOp) must evaluate, not crash."""
+    from min_tfs_client_tpu.protos import tf_graph_pb2, tf_tensor_pb2
+    from min_tfs_client_tpu.servables.graphdef_import import SessionRunner
+
+    g = tf_graph_pb2.GraphDef()
+    n = g.node.add(); n.name = "x"; n.op = "Placeholder"
+    n.attr["dtype"].type = tf_tensor_pb2.DT_FLOAT
+    n = g.node.add(); n.name = "y"; n.op = "Identity"; n.input.append("x")
+    n.attr["T"].type = tf_tensor_pb2.DT_FLOAT
+    n = g.node.add(); n.name = "init"; n.op = "NoOp"; n.input.append("^y")
+    runner = SessionRunner(g)
+    outs = runner.run({"x": np.array([5.0], np.float32)}, ["y:0"],
+                      targets=["init"])
+    np.testing.assert_array_equal(outs[0], [5.0])
+
+
+def test_session_runner_cache_bounded():
+    from min_tfs_client_tpu.protos import tf_graph_pb2, tf_tensor_pb2
+    from min_tfs_client_tpu.servables.graphdef_import import SessionRunner
+
+    g = tf_graph_pb2.GraphDef()
+    n = g.node.add(); n.name = "x"; n.op = "Placeholder"
+    n.attr["dtype"].type = tf_tensor_pb2.DT_FLOAT
+    for i in range(40):
+        n = g.node.add(); n.name = f"y{i}"; n.op = "Identity"
+        n.input.append("x"); n.attr["T"].type = tf_tensor_pb2.DT_FLOAT
+    runner = SessionRunner(g)
+    for i in range(40):
+        runner.run({"x": np.zeros(1, np.float32)}, [f"y{i}:0"])
+    assert len(runner._cache) <= SessionRunner.MAX_CACHED_PLANS
