@@ -41,6 +41,42 @@ def params_from_proto(proto: BatchingParameters) -> dict:
     }
 
 
+def resolve_allowed_batch_sizes(
+    signature: Signature, params: dict) -> tuple[int, ...]:
+    """The allowed-sizes rule shared by the runner and pre-warmup bucket
+    setup: explicit allowed_batch_sizes (last entry must equal
+    max_batch_size, main.cc rule), else the signature's default buckets
+    clipped to max_batch_size."""
+    max_batch_size = params.get("max_batch_size", 32)
+    allowed_batch_sizes = params.get("allowed_batch_sizes")
+    if allowed_batch_sizes:
+        allowed = sorted(int(v) for v in allowed_batch_sizes)
+        if allowed[-1] != max_batch_size:
+            raise ServingError.invalid_argument(
+                f"allowed_batch_sizes last entry {allowed[-1]} must equal "
+                f"max_batch_size {max_batch_size}")
+    else:
+        allowed = [s for s in signature.batch_buckets
+                   if s <= max_batch_size] or [max_batch_size]
+        if allowed[-1] != max_batch_size:
+            allowed.append(max_batch_size)
+    return tuple(allowed)
+
+
+def apply_batch_buckets(servable, params: BatchingParameters | dict) -> dict:
+    """Set every batched device signature's compile buckets from the
+    batching config. Runs BEFORE warmup so warmup primes exactly the
+    executables that will serve (not the default power-of-two ladder).
+    Returns the normalized params dict for maybe_wrap_servable."""
+    if isinstance(params, BatchingParameters):
+        params = params_from_proto(params)
+    for signature in servable.signatures.values():
+        if signature.batched and not signature.on_host:
+            signature.batch_buckets = resolve_allowed_batch_sizes(
+                signature, params)
+    return params
+
+
 def pad_ragged(arrays: list[np.ndarray]) -> list[np.ndarray]:
     """Pad non-batch dims to the per-batch max (batching_util.cc semantics:
     rank 1-6, pad value = tensor's first element)."""
@@ -77,18 +113,10 @@ class BatchedSignatureRunner:
         allowed_batch_sizes: list[int] | None = None,
         pad_variable_length_inputs: bool = False,
     ):
-        if allowed_batch_sizes:
-            allowed = sorted(int(v) for v in allowed_batch_sizes)
-            if allowed[-1] != max_batch_size:
-                # main.cc rule: last allowed size must equal max_batch_size.
-                raise ServingError.invalid_argument(
-                    f"allowed_batch_sizes last entry {allowed[-1]} must equal "
-                    f"max_batch_size {max_batch_size}")
-        else:
-            allowed = [s for s in signature.batch_buckets
-                       if s <= max_batch_size] or [max_batch_size]
-            if allowed[-1] != max_batch_size:
-                allowed.append(max_batch_size)
+        allowed = list(resolve_allowed_batch_sizes(signature, {
+            "max_batch_size": max_batch_size,
+            "allowed_batch_sizes": allowed_batch_sizes,
+        }))
         self.signature = signature
         # Captured BEFORE maybe_wrap_servable replaces signature.run with
         # runner.run — _process must execute the real signature, not re-enter

@@ -52,13 +52,10 @@ class TFRecordLogCollector(LogCollector):
         self._file = open(self._path, "ab")
 
     def collect(self, log: apis.PredictionLog) -> None:
-        import struct
-
-        data = log.SerializeToString()
-        length = struct.pack("<Q", len(data))
-        framed = (length + struct.pack("<I", tfrecord.masked_crc32c(length)) +
-                  data + struct.pack("<I", tfrecord.masked_crc32c(data)))
+        framed = tfrecord.frame(log.SerializeToString())
         with self._lock:
+            if self._file.closed:
+                return  # config swap closed us mid-request: drop, don't raise
             self._file.write(framed)
             # Durable immediately: request logs must survive a server kill
             # (records are small; the OS page cache absorbs the cost).
@@ -66,7 +63,8 @@ class TFRecordLogCollector(LogCollector):
 
     def flush(self) -> None:
         with self._lock:
-            self._file.flush()
+            if not self._file.closed:
+                self._file.flush()
 
     def close(self) -> None:
         with self._lock:
@@ -136,6 +134,11 @@ class ServerRequestLogger:
 
     def maybe_log(self, model_name: str, build_log: Callable[[], apis.PredictionLog],
                   model_spec: apis.ModelSpec) -> None:
-        logger = self._loggers.get(model_name)
-        if logger is not None and logger.should_log():
-            logger.log(build_log(), model_spec)
+        try:
+            logger = self._loggers.get(model_name)
+            if logger is not None and logger.should_log():
+                logger.log(build_log(), model_spec)
+        except Exception:  # pragma: no cover - logging must never fail a
+            import traceback  # healthy request (disk full, collector race)
+
+            traceback.print_exc()

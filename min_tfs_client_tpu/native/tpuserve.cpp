@@ -102,7 +102,10 @@ long tpuserve_scan_tfrecords(const uint8_t* buf, size_t n, uint64_t* offsets,
     uint32_t len_crc;
     memcpy(&len_crc, buf + pos + 8, 4);
     if (verify && Unmask(len_crc) != Extend(0, buf + pos, 8)) return -2;
-    if (pos + 12 + len + 4 > n) return -1;
+    // Overflow-safe bounds check: a corrupt u64 length must not wrap
+    // `pos + 12 + len + 4` back into range and read out of bounds.
+    size_t rem = n - pos - 12;  // bytes after the header; >= 0 by the check above
+    if (len > rem || rem - len < 4) return -1;
     if (verify) {
       uint32_t data_crc;
       memcpy(&data_crc, buf + pos + 12 + len, 4);

@@ -348,23 +348,29 @@ class SessionRunner:
 
     def __init__(self, graph_def: tf_graph_pb2.GraphDef):
         import collections
+        import threading
 
         self._graph_def = graph_def
         self._cache: "collections.OrderedDict[tuple, GraphFunction]" =             collections.OrderedDict()
+        # Serves concurrent gRPC threads: get/move/evict must be atomic or
+        # move_to_end can KeyError after a concurrent eviction.
+        self._cache_lock = threading.Lock()
 
     def run(self, feeds: dict[str, object], fetches: Sequence[str],
             targets: Sequence[str] = ()) -> list[object]:
         key = (tuple(sorted(feeds)), tuple(fetches), tuple(targets))
-        graph_fn = self._cache.get(key)
+        with self._cache_lock:
+            graph_fn = self._cache.get(key)
+            if graph_fn is not None:
+                self._cache.move_to_end(key)
         if graph_fn is None:
             graph_fn = GraphFunction(
                 self._graph_def, list(sorted(feeds)), list(fetches),
                 target_names=targets)
-            self._cache[key] = graph_fn
-            if len(self._cache) > self.MAX_CACHED_PLANS:
-                self._cache.popitem(last=False)  # LRU eviction
-        else:
-            self._cache.move_to_end(key)
+            with self._cache_lock:
+                self._cache[key] = graph_fn
+                if len(self._cache) > self.MAX_CACHED_PLANS:
+                    self._cache.popitem(last=False)  # LRU eviction
         lib = np if graph_fn.has_string else _jnp()
         outs = graph_fn([feeds[k] for k in sorted(feeds)], lib)
         return [np.asarray(o) for o in outs]

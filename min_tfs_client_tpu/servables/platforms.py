@@ -53,6 +53,13 @@ def make_loader(
         servable.name = name
         servable.version = version
         config = platform_config or {}
+        batching = config.get("batching_parameters")
+        if batching is not None:
+            from min_tfs_client_tpu.batching.session import apply_batch_buckets
+
+            # Compile buckets must be final BEFORE warmup, or warmup primes
+            # shapes that will never serve.
+            batching = apply_batch_buckets(servable, batching)
         # Warmup runs against the bare signatures, BEFORE the batching
         # wrapper: replaying through the batch queue would stall each record
         # up to batch_timeout (the reference replays directly against the
@@ -68,7 +75,6 @@ def make_loader(
                 num_iterations=config.get("warmup_iterations", 1))
             if replayed == 0 and config.get("synthesize_warmup", False):
                 synthesize_warmup(servable)
-        batching = config.get("batching_parameters")
         if batching is not None:
             from min_tfs_client_tpu.batching.session import maybe_wrap_servable
 

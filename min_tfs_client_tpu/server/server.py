@@ -156,7 +156,15 @@ class Server:
 
     def _poll_config_file(self) -> None:
         interval = self.options.model_config_file_poll_wait_seconds
-        last_applied = None
+        try:
+            # Seed with the startup config: the first tick must not re-apply
+            # a file that ServerCore already loaded at build time.
+            last_applied = _parse_text_proto(
+                self.options.model_config_file,
+                tfs_config_pb2.ModelServerConfig,
+            ).SerializeToString(deterministic=True)
+        except Exception:
+            last_applied = None
         while not self._config_poll_stop.wait(interval):
             try:
                 config = _parse_text_proto(

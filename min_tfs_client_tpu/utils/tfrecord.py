@@ -65,37 +65,46 @@ class TFRecordError(ValueError):
     pass
 
 
+def frame(data: bytes) -> bytes:
+    """One record's full wire framing [len][crc(len)][data][crc(data)] —
+    the single owner of the format for writers (files and log sinks)."""
+    lib = native.load()
+    if lib is not None:
+        header = ctypes.create_string_buffer(12)
+        footer = ctypes.create_string_buffer(4)
+        lib.tpuserve_frame_tfrecord(data, len(data), header, footer)
+        return header.raw + data + footer.raw
+    length = struct.pack("<Q", len(data))
+    return (length + struct.pack("<I", masked_crc32c(length)) +
+            data + struct.pack("<I", masked_crc32c(data)))
+
+
 def write_records(path, records: Iterable[bytes]) -> int:
     """Write records to a TFRecord file; returns the count."""
-    lib = native.load()
     count = 0
     with open(path, "wb") as f:
         for data in records:
-            if lib is not None:
-                header = ctypes.create_string_buffer(12)
-                footer = ctypes.create_string_buffer(4)
-                lib.tpuserve_frame_tfrecord(data, len(data), header, footer)
-                f.write(header.raw)
-                f.write(data)
-                f.write(footer.raw)
-            else:
-                length = struct.pack("<Q", len(data))
-                f.write(length)
-                f.write(struct.pack("<I", masked_crc32c(length)))
-                f.write(data)
-                f.write(struct.pack("<I", masked_crc32c(data)))
+            f.write(frame(data))
             count += 1
     return count
+
+
+# Files up to this size use one native batch scan; larger files (or bounded
+# reads) stream record-by-record so memory tracks records consumed, not
+# file size (request logs replayed as warmup can be huge).
+_SLURP_LIMIT = 16 << 20
 
 
 def read_records(path, *, max_records: int | None = None,
                  verify: bool = True) -> Iterator[bytes]:
     """Yield record payloads from a TFRecord file."""
-    data = pathlib.Path(path).read_bytes()
+    path = pathlib.Path(path)
     limit = max_records if max_records is not None else (1 << 40)
     lib = native.load()
-    if lib is not None:
-        cap = min(limit, max(1, len(data) // 16))
+    if (lib is not None and max_records is None
+            and path.stat().st_size <= _SLURP_LIMIT):
+        data = path.read_bytes()
+        cap = max(1, len(data) // 16)
         offsets = (ctypes.c_uint64 * cap)()
         lengths = (ctypes.c_uint64 * cap)()
         n = lib.tpuserve_scan_tfrecords(
@@ -107,23 +116,29 @@ def read_records(path, *, max_records: int | None = None,
         for i in range(n):
             yield data[offsets[i]:offsets[i] + lengths[i]]
         return
-    # Python fallback
-    pos, produced = 0, 0
-    while pos < len(data) and produced < limit:
-        if pos + 12 > len(data):
-            raise TFRecordError("truncated record")
-        (length,) = struct.unpack_from("<Q", data, pos)
-        (len_crc,) = struct.unpack_from("<I", data, pos + 8)
-        if verify and _unmask(len_crc) != crc32c(data[pos:pos + 8]):
-            raise TFRecordError("corrupt length crc")
-        start = pos + 12
-        end = start + length
-        if end + 4 > len(data):
-            raise TFRecordError("truncated record")
-        payload = data[start:end]
-        (data_crc,) = struct.unpack_from("<I", data, end)
-        if verify and _unmask(data_crc) != crc32c(payload):
-            raise TFRecordError("corrupt data crc")
-        yield payload
-        produced += 1
-        pos = end + 4
+    # Streaming path (crc32c is still native-accelerated when available).
+    produced = 0
+    file_size = path.stat().st_size
+    with open(path, "rb") as f:
+        while produced < limit:
+            header = f.read(12)
+            if not header:
+                return
+            if len(header) < 12:
+                raise TFRecordError("truncated record")
+            (length,) = struct.unpack_from("<Q", header, 0)
+            (len_crc,) = struct.unpack_from("<I", header, 8)
+            if verify and _unmask(len_crc) != crc32c(header[:8]):
+                raise TFRecordError("corrupt length crc")
+            if length + 16 > file_size:
+                # Corrupt u64 length: refuse before trying to allocate it.
+                raise TFRecordError("truncated record")
+            body = f.read(length + 4)
+            if len(body) < length + 4:
+                raise TFRecordError("truncated record")
+            payload = body[:length]
+            (data_crc,) = struct.unpack_from("<I", body, length)
+            if verify and _unmask(data_crc) != crc32c(payload):
+                raise TFRecordError("corrupt data crc")
+            yield payload
+            produced += 1
