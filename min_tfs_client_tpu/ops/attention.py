@@ -42,12 +42,15 @@ def attention_reference(
     lengths: Optional[jax.Array] = None,
     bias: Optional[jax.Array] = None,
     scale: Optional[float] = None,
+    causal_offset: Optional[int] = None,
 ) -> jax.Array:
     """Plain softmax(q k^T / sqrt(d) + bias) v.
 
     Shapes: q (B, H, Sq, D); k, v (B, H, Skv, D); lengths (B,) int32 valid
     key counts; bias broadcastable to (B, H, Sq, Skv). Returns (B, H, Sq, D)
-    in q.dtype; softmax runs in f32.
+    in q.dtype; softmax runs in f32. `causal_offset` is query row 0's
+    absolute key position (default Skv-Sq: right-aligned, the KV-cache
+    decode convention; pass 0 for cache prefill).
     """
     *_, sq, d = q.shape
     skv = k.shape[-2]
@@ -58,13 +61,19 @@ def attention_reference(
     if bias is not None:
         s = s + bias.astype(jnp.float32)
     if causal:
-        qi = jnp.arange(sq)[:, None] + (skv - sq)  # right-aligned for caches
+        offset = skv - sq if causal_offset is None else causal_offset
+        qi = jnp.arange(sq)[:, None] + offset
         ki = jnp.arange(skv)[None, :]
         s = jnp.where(qi >= ki, s, NEG_INF)
     if lengths is not None:
         ki = jnp.arange(skv)[None, None, None, :]
         s = jnp.where(ki < lengths[:, None, None, None], s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
+    if lengths is not None:
+        # Fully-masked rows -> zeros (not a uniform mean over masked V),
+        # matching the flash kernel's row_valid semantics.
+        all_masked = jnp.max(s, axis=-1, keepdims=True) <= NEG_INF * 0.5
+        p = jnp.where(all_masked, 0.0, p)
     return jnp.einsum(
         "bhqk,bhkd->bhqd", p.astype(v.dtype), v,
         preferred_element_type=jnp.float32).astype(q.dtype)
@@ -123,8 +132,12 @@ def _flash_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, *,
     else:
         n_run = n_kv
     m, l, acc = jax.lax.fori_loop(0, n_run, body, (m0, l0, acc0))
-    l = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows -> zeros, not NaN
-    o_ref[...] = (acc / l).astype(o_ref.dtype)
+    # Fully-masked rows (valid_len 0, or causal skip ran zero blocks) must
+    # return zeros: m never left NEG_INF there (exp(s-m)=1 would otherwise
+    # leak a mean over masked V rows into acc).
+    row_valid = m > NEG_INF * 0.5
+    l = jnp.where(l == 0.0, 1.0, l)
+    o_ref[...] = jnp.where(row_valid, acc / l, 0.0).astype(o_ref.dtype)
 
 
 try:  # Pallas import is deferred-safe: CPU-only envs still get reference.
@@ -146,7 +159,7 @@ def _pad_to(x: jax.Array, axis: int, multiple: int) -> jax.Array:
 
 
 @functools.partial(
-    jax.jit, static_argnames=("causal", "scale", "interpret"))
+    jax.jit, static_argnames=("causal", "scale", "interpret", "causal_offset"))
 def flash_attention(
     q: jax.Array,
     k: jax.Array,
@@ -156,6 +169,7 @@ def flash_attention(
     lengths: Optional[jax.Array] = None,
     scale: Optional[float] = None,
     interpret: bool = False,
+    causal_offset: Optional[int] = None,
 ) -> jax.Array:
     """Pallas flash attention. Same contract as attention_reference
     (minus bias). Sequence dims are padded to block multiples internally;
@@ -183,8 +197,10 @@ def flash_attention(
     kernel = functools.partial(
         _flash_kernel, scale=scale, causal=causal, block_kv=_BLOCK_KV,
         kv_seq_len=skv_p,
-        # Right-align causal masking when decoding with a KV cache.
-        q_offset=skv - sq if causal else 0)
+        # Right-align causal masking when decoding with a KV cache, unless
+        # the caller pins query row 0's absolute position (cache prefill).
+        q_offset=(skv - sq if causal_offset is None else causal_offset)
+        if causal else 0)
     out = pl.pallas_call(
         kernel,
         grid=grid,
@@ -217,6 +233,7 @@ def attention(
     lengths: Optional[jax.Array] = None,
     bias: Optional[jax.Array] = None,
     scale: Optional[float] = None,
+    causal_offset: Optional[int] = None,
 ) -> jax.Array:
     """Dispatch: Pallas kernel on TPU when it applies (no additive bias,
     MXU-friendly head dim), jnp reference otherwise. Semantics identical."""
@@ -229,6 +246,8 @@ def attention(
     )
     if use_pallas:
         return flash_attention(
-            q, k, v, causal=causal, lengths=lengths, scale=scale)
+            q, k, v, causal=causal, lengths=lengths, scale=scale,
+            causal_offset=causal_offset)
     return attention_reference(
-        q, k, v, causal=causal, lengths=lengths, bias=bias, scale=scale)
+        q, k, v, causal=causal, lengths=lengths, bias=bias, scale=scale,
+        causal_offset=causal_offset)

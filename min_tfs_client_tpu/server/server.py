@@ -135,6 +135,11 @@ class Server:
                 handlers, opts.rest_api_port, monitoring)
 
         if opts.model_config_file and opts.model_config_file_poll_wait_seconds > 0:
+            # Seed poll dedup with the config ServerCore ACTUALLY applied —
+            # re-reading the file here would silently swallow an edit made
+            # during model load/warmup.
+            self._applied_config_serialized = config.SerializeToString(
+                deterministic=True)
             self._config_poll_thread = threading.Thread(
                 target=self._poll_config_file, name="config-file-poll",
                 daemon=True)
@@ -156,15 +161,7 @@ class Server:
 
     def _poll_config_file(self) -> None:
         interval = self.options.model_config_file_poll_wait_seconds
-        try:
-            # Seed with the startup config: the first tick must not re-apply
-            # a file that ServerCore already loaded at build time.
-            last_applied = _parse_text_proto(
-                self.options.model_config_file,
-                tfs_config_pb2.ModelServerConfig,
-            ).SerializeToString(deterministic=True)
-        except Exception:
-            last_applied = None
+        last_applied = getattr(self, "_applied_config_serialized", None)
         while not self._config_poll_stop.wait(interval):
             try:
                 config = _parse_text_proto(

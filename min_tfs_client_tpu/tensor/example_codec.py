@@ -148,7 +148,15 @@ def decode_examples(
                 if spec.default is None:
                     raise ExampleDecodeError(
                         f"example {i}: required feature {name!r} missing")
-                vals = [spec.default] * per_ex_n
+                default = np.asarray(spec.default, dtype=col.dtype).reshape(-1)
+                if default.size == 1:
+                    vals = list(default) * per_ex_n
+                elif default.size == per_ex_n:
+                    vals = list(default)
+                else:
+                    raise ExampleDecodeError(
+                        f"feature {name!r}: default has {default.size} "
+                        f"values, spec requires {per_ex_n}")
             if len(vals) != per_ex_n:
                 raise ExampleDecodeError(
                     f"example {i}: feature {name!r} has {len(vals)} values, "

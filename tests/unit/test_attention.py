@@ -69,8 +69,15 @@ def test_attention_dispatch_with_bias_uses_reference():
     np.testing.assert_allclose(np.asarray(out), np.asarray(want), atol=1e-6)
 
 
-def test_reference_fully_masked_rows_are_finite():
-    b, h, s, d = 1, 1, 8, 8
+def test_fully_masked_rows_are_zero_in_both_paths():
+    # lengths[b]=0 (e.g. cross-attention over an empty input) must yield
+    # zeros — not NaN, not a mean over masked V — identically on both paths.
+    b, h, s, d = 2, 2, 64, 32
     q, k, v = (_rand((b, h, s, d), i) for i in range(3))
-    out = attention_reference(q, k, v, lengths=jnp.asarray([0]))
-    assert np.isfinite(np.asarray(out)).all()
+    lengths = jnp.asarray([0, 40], jnp.int32)
+    ref = np.asarray(attention_reference(q, k, v, lengths=lengths))
+    fl = np.asarray(flash_attention(q, k, v, lengths=lengths, interpret=True))
+    assert np.isfinite(ref).all() and np.isfinite(fl).all()
+    np.testing.assert_array_equal(ref[0], 0.0)
+    np.testing.assert_array_equal(fl[0], 0.0)
+    np.testing.assert_allclose(fl[1], ref[1], atol=2e-5, rtol=2e-5)
