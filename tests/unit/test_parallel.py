@@ -1,0 +1,197 @@
+"""TP sharding, ring attention, and distributed helpers on the 8-device
+virtual CPU mesh (conftest.py) — the SURVEY.md §4 "multi-node without a
+cluster" tier: real XLA collectives, no TPU pod."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from min_tfs_client_tpu.models import bert, t5
+from min_tfs_client_tpu.ops.attention import attention_reference
+from min_tfs_client_tpu.parallel import (
+    distributed,
+    infer_transformer_specs,
+    logical_spec,
+    make_mesh,
+    ring_attention,
+    shard_params,
+)
+
+
+@pytest.fixture(scope="module")
+def dp_tp_mesh():
+    return make_mesh({"data": 4, "model": 2})
+
+
+@pytest.fixture(scope="module")
+def seq_mesh():
+    return make_mesh({"seq": 8})
+
+
+# -- logical specs -----------------------------------------------------------
+
+
+def test_logical_spec_mapping(dp_tp_mesh):
+    assert logical_spec("embed", "mlp") == P(None, "model")
+    assert logical_spec("mlp", "embed") == P("model")
+    assert logical_spec("batch") == P("data")
+    # Axis absent from the mesh resolves to replicated.
+    data_only = make_mesh({"data": 8})
+    assert logical_spec("embed", "mlp", mesh=data_only) == P()
+
+
+def test_infer_bert_specs_structure():
+    params = bert.init_params(jax.random.PRNGKey(0), bert.BertConfig.tiny())
+    specs = infer_transformer_specs(params)
+    layer = specs["layers"][0]
+    assert layer["attention"]["query"]["kernel"] == P(None, "model")
+    assert layer["attention"]["out"]["kernel"] == P("model")
+    assert layer["mlp"]["wi"]["kernel"] == P(None, "model")
+    assert layer["mlp"]["wo"]["kernel"] == P("model")
+    assert layer["attention_norm"]["scale"] == P()
+    assert specs["embeddings"]["word"]["embedding"] == P()
+    # Spec tree must mirror the param tree exactly.
+    jax.tree_util.tree_map(
+        lambda p, s: None, params, specs,
+        is_leaf=lambda x: isinstance(x, P))
+
+
+def test_bert_tp_matches_single_device(dp_tp_mesh):
+    """TP-sharded forward == unsharded forward (GSPMD inserts the psums)."""
+    config = bert.BertConfig.tiny()
+    params = bert.init_params(jax.random.PRNGKey(0), config)
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, config.vocab_size, (8, 16)).astype(np.int32)
+    mask = np.ones((8, 16), np.int32)
+
+    expect = np.asarray(bert.logits_fn(params, config, ids, mask))
+
+    specs = infer_transformer_specs(params, mesh=dp_tp_mesh)
+    sharded = shard_params(params, specs, dp_tp_mesh)
+    x_sharding = NamedSharding(dp_tp_mesh, P("data", None))
+    ids_s = jax.device_put(ids, x_sharding)
+    mask_s = jax.device_put(mask, x_sharding)
+
+    step = jax.jit(
+        lambda p, i, m: bert.logits_fn(p, config, i, m),
+        out_shardings=NamedSharding(dp_tp_mesh, P("data", None)))
+    got = np.asarray(step(sharded, ids_s, mask_s))
+    np.testing.assert_allclose(got, expect, atol=2e-2, rtol=2e-2)
+
+
+def test_t5_specs_infer():
+    config = t5.T5Config.tiny()
+    params = t5.init_params(jax.random.PRNGKey(0), config)
+    specs = infer_transformer_specs(params)
+    blk = specs["decoder"]["layers"][0]
+    assert blk["cross_attention"]["value"]["kernel"] == P(None, "model")
+    assert blk["mlp"]["wo"]["kernel"] == P("model")
+    jax.tree_util.tree_map(
+        lambda p, s: None, params, specs,
+        is_leaf=lambda x: isinstance(x, P))
+
+
+# -- ring attention ----------------------------------------------------------
+
+
+def _qkv(rng, b=2, h=2, s=32, d=8, dtype=np.float32):
+    q = rng.standard_normal((b, h, s, d)).astype(dtype)
+    k = rng.standard_normal((b, h, s, d)).astype(dtype)
+    v = rng.standard_normal((b, h, s, d)).astype(dtype)
+    return jnp.asarray(q), jnp.asarray(k), jnp.asarray(v)
+
+
+def test_ring_attention_full(seq_mesh):
+    q, k, v = _qkv(np.random.default_rng(0))
+    got = ring_attention(q, k, v, mesh=seq_mesh)
+    want = attention_reference(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_ring_attention_causal(seq_mesh):
+    q, k, v = _qkv(np.random.default_rng(1))
+    got = ring_attention(q, k, v, mesh=seq_mesh, causal=True)
+    want = attention_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_ring_attention_lengths(seq_mesh):
+    q, k, v = _qkv(np.random.default_rng(2))
+    lengths = jnp.asarray([20, 32], jnp.int32)
+    got = ring_attention(q, k, v, mesh=seq_mesh, lengths=lengths)
+    want = attention_reference(q, k, v, lengths=lengths)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_ring_attention_causal_with_lengths_jit(seq_mesh):
+    q, k, v = _qkv(np.random.default_rng(3))
+    lengths = jnp.asarray([9, 27], jnp.int32)
+    fn = jax.jit(lambda q, k, v, ln: ring_attention(
+        q, k, v, mesh=seq_mesh, causal=True, lengths=ln))
+    got = fn(q, k, v, lengths)
+    want = attention_reference(q, k, v, causal=True, lengths=lengths)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_ring_attention_rejects_indivisible(seq_mesh):
+    q, k, v = _qkv(np.random.default_rng(4), s=30)
+    with pytest.raises(ValueError, match="not divisible"):
+        ring_attention(q, k, v, mesh=seq_mesh)
+
+
+def test_ring_attention_bf16(seq_mesh):
+    q, k, v = _qkv(np.random.default_rng(5))
+    qb, kb, vb = (x.astype(jnp.bfloat16) for x in (q, k, v))
+    got = ring_attention(qb, kb, vb, mesh=seq_mesh, causal=True)
+    assert got.dtype == jnp.bfloat16
+    want = attention_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(want), atol=5e-2, rtol=5e-2)
+
+
+# -- distributed helpers -----------------------------------------------------
+
+
+def test_probe_devices_all_healthy():
+    health = distributed.probe_devices()
+    assert len(health) == 8
+    assert all(h.ok for h in health)
+    assert distributed.healthy()
+
+
+def test_hybrid_mesh_single_slice_fallback():
+    mesh = distributed.hybrid_mesh({"data": 4, "model": 2})
+    assert dict(mesh.shape) == {"data": 4, "model": 2}
+    mesh2 = distributed.hybrid_mesh({"data": 4, "model": 2}, {"replica": 1})
+    assert dict(mesh2.shape) == {"data": 4, "model": 2}
+
+
+def test_hybrid_mesh_multi_slice_call_contract(monkeypatch):
+    """CPU devices have no slice_index, so fake mesh_utils and check the
+    same-rank padded shapes and direct (no reshape) use of the grid."""
+    from jax.experimental import mesh_utils
+
+    seen = {}
+
+    def fake_create(mesh_shape, dcn_mesh_shape):
+        seen["mesh_shape"] = mesh_shape
+        seen["dcn_mesh_shape"] = dcn_mesh_shape
+        total_shape = [a * b for a, b in zip(mesh_shape, dcn_mesh_shape)]
+        return np.array(jax.devices()).reshape(total_shape)
+
+    monkeypatch.setattr(mesh_utils, "create_hybrid_device_mesh", fake_create)
+    mesh = distributed.hybrid_mesh({"data": 2, "model": 2}, {"replica": 2})
+    assert seen["mesh_shape"] == [1, 2, 2]
+    assert seen["dcn_mesh_shape"] == [2, 1, 1]
+    assert dict(mesh.shape) == {"replica": 2, "data": 2, "model": 2}
+
+
+def test_initialize_noop_single_process(monkeypatch):
+    monkeypatch.delenv("JAX_COORDINATOR_ADDRESS", raising=False)
+    distributed.initialize()  # must not raise or call jax.distributed
