@@ -173,24 +173,29 @@ class BatchedSignatureRunner:
     # -- scheduler side ------------------------------------------------------
 
     def _process(self, batch: list[BatchTask]) -> None:
+        from min_tfs_client_tpu.server.profiler import trace
+
         sizes = [t.size for t in batch]
         total = sum(sizes)
         merged = {}
-        for alias in batch[0].inputs:
-            columns = [t.inputs[alias] for t in batch]
-            if self._pad_ragged:
-                columns = pad_ragged(columns)
-            else:
-                shapes = {c.shape[1:] for c in columns}
-                if len(shapes) != 1:
-                    raise ServingError.invalid_argument(
-                        f"input {alias!r}: ragged non-batch dims {sorted(shapes)} "
-                        "need pad_variable_length_inputs=true")
-            merged[alias] = np.concatenate(columns, axis=0)
+        with trace("batching/merge"):
+            for alias in batch[0].inputs:
+                columns = [t.inputs[alias] for t in batch]
+                if self._pad_ragged:
+                    columns = pad_ragged(columns)
+                else:
+                    shapes = {c.shape[1:] for c in columns}
+                    if len(shapes) != 1:
+                        raise ServingError.invalid_argument(
+                            f"input {alias!r}: ragged non-batch dims "
+                            f"{sorted(shapes)} need "
+                            "pad_variable_length_inputs=true")
+                merged[alias] = np.concatenate(columns, axis=0)
 
         # Execute once; the inner run rounds total up to the allowed bucket
         # and pads with repeated real rows.
-        outputs = self._inner_run(merged)
+        with trace("batching/execute"):
+            outputs = self._inner_run(merged)
 
         try:
             from min_tfs_client_tpu.server import metrics

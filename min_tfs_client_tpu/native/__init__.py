@@ -43,5 +43,17 @@ def load() -> ctypes.CDLL | None:
         lib.tpuserve_frame_tfrecord.argtypes = [
             ctypes.c_char_p, ctypes.c_uint64, ctypes.c_char_p, ctypes.c_char_p,
         ]
+        lib.tpuserve_parse_examples_dense.restype = ctypes.c_long
+        lib.tpuserve_parse_examples_dense.argtypes = [
+            ctypes.c_char_p,                      # concatenated examples
+            ctypes.POINTER(ctypes.c_uint64),      # offsets
+            ctypes.POINTER(ctypes.c_uint64),      # lengths
+            ctypes.c_long,                        # n examples
+            ctypes.c_char_p, ctypes.c_uint64,     # feature name
+            ctypes.c_int,                         # mode: 0 f32, 1 i64
+            ctypes.c_void_p,                      # out column
+            ctypes.c_uint64,                      # per-example value count
+            ctypes.POINTER(ctypes.c_int64),       # per-example found counts
+        ]
         _lib = lib
         return _lib

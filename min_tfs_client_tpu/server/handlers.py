@@ -56,10 +56,12 @@ def _instrumented(api: str):
         @functools.wraps(fn)
         def inner(self, request):
             from min_tfs_client_tpu.server import metrics
+            from min_tfs_client_tpu.server.profiler import trace
 
             start = time.perf_counter()
             try:
-                response = fn(self, request)
+                with trace(f"serving/{api}"):
+                    response = fn(self, request)
             except Exception as exc:
                 err = ServingError if isinstance(exc, ServingError) else None
                 code = exc.code if err else 2

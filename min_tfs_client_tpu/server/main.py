@@ -42,6 +42,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--response_tensors_as_content", action="store_true",
                    help="serialize response tensors as tensor_content "
                         "instead of typed fields")
+    p.add_argument("--profiler_port", type=int, default=0,
+                   help="jax.profiler server port for on-demand trace "
+                        "capture; 0 disables")
     return p
 
 
@@ -66,6 +69,7 @@ def options_from_args(args) -> ServerOptions:
         grpc_max_threads=args.grpc_max_threads,
         enable_model_warmup=args.enable_model_warmup,
         response_tensors_as_content=args.response_tensors_as_content,
+        profiler_port=args.profiler_port,
     )
 
 

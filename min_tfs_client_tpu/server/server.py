@@ -56,6 +56,9 @@ class ServerOptions:
     grpc_max_threads: int = 16
     enable_model_warmup: bool = True
     response_tensors_as_content: bool = False
+    # On-demand profiling (reference registers a profiler service on the
+    # main server, server.cc:324,339); 0 disables.
+    profiler_port: int = 0
 
 
 def _parse_text_proto(path: str, proto_cls):
@@ -133,6 +136,18 @@ class Server:
                     opts.monitoring_config_file, tfs_config_pb2.MonitoringConfig)
             self._rest_server, self.rest_port = start_rest_server(
                 handlers, opts.rest_api_port, monitoring)
+
+        if opts.profiler_port:
+            from min_tfs_client_tpu.server.profiler import (
+                start_profiler_server,
+            )
+
+            if not start_profiler_server(opts.profiler_port):
+                import logging
+
+                logging.getLogger("min_tfs_client_tpu").warning(
+                    "profiler server failed to start on port %d; trace "
+                    "capture will be unavailable", opts.profiler_port)
 
         if opts.model_config_file and opts.model_config_file_poll_wait_seconds > 0:
             # Seed poll dedup with the config ServerCore ACTUALLY applied —

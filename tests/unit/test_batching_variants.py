@@ -1,0 +1,202 @@
+"""Scheduler variants: retrier, streaming, adaptive (SURVEY.md §2.5)."""
+
+import threading
+import time
+
+import pytest
+
+from min_tfs_client_tpu.batching.scheduler import BatchTask, QueueOptions
+from min_tfs_client_tpu.batching.variants import (
+    AdaptiveOptions,
+    AdaptiveSharedBatchScheduler,
+    BatchSchedulerRetrier,
+    RetrierOptions,
+    StreamingBatchScheduler,
+)
+from min_tfs_client_tpu.utils.status import ServingError
+
+
+def _task(n=1):
+    return BatchTask(inputs={}, size=n)
+
+
+# -- retrier -----------------------------------------------------------------
+
+
+class FakeClock:
+    def __init__(self):
+        self.now = 0.0
+
+    def __call__(self):
+        return self.now
+
+    def sleep(self, dt):
+        self.now += dt
+
+
+def test_retrier_succeeds_after_transient_full():
+    attempts = []
+
+    def flaky(task):
+        attempts.append(task)
+        if len(attempts) < 3:
+            raise ServingError.unavailable("queue full")
+
+    clock = FakeClock()
+    r = BatchSchedulerRetrier(flaky, RetrierOptions(max_time_s=1.0,
+                                                    retry_delay_s=0.01),
+                              clock=clock, sleep=clock.sleep)
+    r.schedule(_task())
+    assert len(attempts) == 3
+
+
+def test_retrier_gives_up_at_budget():
+    def always_full(task):
+        raise ServingError.unavailable("queue full")
+
+    clock = FakeClock()
+    r = BatchSchedulerRetrier(always_full,
+                              RetrierOptions(max_time_s=0.05,
+                                             retry_delay_s=0.01),
+                              clock=clock, sleep=clock.sleep)
+    with pytest.raises(ServingError, match="queue full"):
+        r.schedule(_task())
+    assert 0.05 <= clock.now <= 0.1
+
+
+def test_retrier_propagates_non_unavailable():
+    def bad(task):
+        raise ServingError.invalid_argument("nope")
+
+    r = BatchSchedulerRetrier(bad)
+    with pytest.raises(ServingError, match="nope"):
+        r.schedule(_task())
+
+
+# -- streaming ---------------------------------------------------------------
+
+
+def test_streaming_full_batch_processes_immediately():
+    got = []
+    s = StreamingBatchScheduler(
+        QueueOptions(max_batch_size=2, batch_timeout_s=10.0),
+        lambda batch: got.append(len(batch)), num_threads=2)
+    t1, t2 = _task(), _task()
+    s.schedule(t1)
+    s.schedule(t2)  # fills the batch -> seals, processes without timeout
+    assert t2.done.wait(2.0) and t1.done.wait(2.0)
+    assert got == [2]
+    s.stop()
+
+
+def test_streaming_timeout_flushes_partial_batch():
+    got = []
+    s = StreamingBatchScheduler(
+        QueueOptions(max_batch_size=8, batch_timeout_s=0.05),
+        lambda batch: got.append(len(batch)), num_threads=2)
+    t1 = _task()
+    s.schedule(t1)
+    assert t1.done.wait(2.0)
+    assert got == [1]
+    s.stop()
+
+
+def test_streaming_overflow_opens_second_batch():
+    got = []
+    s = StreamingBatchScheduler(
+        QueueOptions(max_batch_size=4, batch_timeout_s=0.05),
+        lambda batch: got.append(sum(t.size for t in batch)), num_threads=2)
+    big, small = _task(3), _task(2)
+    s.schedule(big)
+    s.schedule(small)  # does not fit -> first batch seals, second opens
+    assert big.done.wait(2.0) and small.done.wait(2.0)
+    assert sorted(got) == [2, 3]
+    s.stop()
+
+
+def test_streaming_rejects_when_all_threads_busy():
+    release = threading.Event()
+    s = StreamingBatchScheduler(
+        QueueOptions(max_batch_size=1, batch_timeout_s=10.0),
+        lambda batch: release.wait(5.0), num_threads=1)
+    s.schedule(_task())  # occupies the only worker
+    time.sleep(0.05)
+    with pytest.raises(ServingError, match="busy"):
+        s.schedule(_task())
+    release.set()
+    s.stop()
+
+
+def test_streaming_rejected_task_leaves_open_batch_intact():
+    """A task rejected for thread capacity must not seal the open batch
+    other callers could still join."""
+    release = threading.Event()
+    got = []
+
+    def process(batch):
+        if not got:
+            release.wait(5.0)
+        got.append([t.size for t in batch])
+
+    s = StreamingBatchScheduler(
+        QueueOptions(max_batch_size=4, batch_timeout_s=0.2), process,
+        num_threads=1)
+    s.schedule(_task(3))  # opens the only batch (worker busy-waits on it)
+    with pytest.raises(ServingError, match="busy"):
+        s.schedule(_task(2))  # does not fit; no thread for a new batch
+    joiner = _task(1)
+    s.schedule(joiner)  # still fits the (unsealed) open batch
+    release.set()
+    assert joiner.done.wait(2.0)
+    assert got == [[3, 1]]
+    s.stop()
+
+
+def test_streaming_process_error_propagates():
+    def boom(batch):
+        raise RuntimeError("kaput")
+
+    s = StreamingBatchScheduler(
+        QueueOptions(max_batch_size=1, batch_timeout_s=1.0), boom,
+        num_threads=1)
+    t = _task()
+    s.schedule(t)
+    assert t.done.wait(2.0)
+    assert isinstance(t.error, RuntimeError)
+    s.stop()
+
+
+# -- adaptive ----------------------------------------------------------------
+
+
+def test_adaptive_processes_all_and_respects_bounds():
+    done = []
+    sched = AdaptiveSharedBatchScheduler(
+        AdaptiveOptions(num_threads=3, initial_in_flight_limit=2,
+                        batches_to_average_over=2),
+        lambda batch: done.append(len(batch)), max_batch_size=4)
+    tasks = [_task() for _ in range(40)]
+    for t in tasks:
+        sched.schedule(t)
+    for t in tasks:
+        assert t.done.wait(5.0)
+    assert sum(done) == 40
+    assert 1 <= sched.in_flight_limit <= 3
+    sched.stop()
+
+
+def test_adaptive_stop_strands_queued_tasks_with_unavailable():
+    block = threading.Event()
+    sched = AdaptiveSharedBatchScheduler(
+        AdaptiveOptions(num_threads=1, initial_in_flight_limit=1),
+        lambda batch: block.wait(5.0), max_batch_size=1)
+    first, queued = _task(), _task()
+    sched.schedule(first)
+    time.sleep(0.05)
+    sched.schedule(queued)
+    block.set()
+    sched.stop()
+    assert queued.done.is_set()
+    # queued either processed (worker got to it before stop) or stranded
+    if queued.error is not None:
+        assert isinstance(queued.error, ServingError)

@@ -1,0 +1,176 @@
+"""Native Example wire-format scanner vs the Python decoder
+(native/tpuserve.cpp tpuserve_parse_examples_dense; SURVEY.md hard part d)."""
+
+import numpy as np
+import pytest
+
+from min_tfs_client_tpu import native
+from min_tfs_client_tpu.tensor import example_codec as ec
+
+pytestmark = pytest.mark.skipif(native.load() is None,
+                                reason="native library unavailable")
+
+
+def _examples(n=5, seq=16, seed=0):
+    rng = np.random.default_rng(seed)
+    return [
+        ec.example_from_dict({
+            "ids": rng.integers(0, 100, (seq,)).astype(np.int64),
+            "weights": rng.standard_normal((seq,)).astype(np.float32),
+            "label": int(rng.integers(0, 4)),
+            "tag": b"x%d" % i,
+        })
+        for i in range(n)
+    ]
+
+
+def _decode_python(examples, specs):
+    return {name: ec._decode_examples_python(examples, name, spec,
+                                             len(examples))
+            for name, spec in specs.items()}
+
+
+def test_native_matches_python_for_numeric_batch():
+    examples = _examples()
+    specs = {
+        "ids": ec.FeatureSpec(np.int64, (16,)),
+        "weights": ec.FeatureSpec(np.float32, (16,)),
+        "label": ec.FeatureSpec(np.int64, ()),
+        "tag": ec.FeatureSpec(object, ()),
+    }
+    got = ec.decode_examples(examples, specs)
+    want = _decode_python(examples, specs)
+    for name in specs:
+        np.testing.assert_array_equal(got[name], want[name], err_msg=name)
+
+
+def test_native_path_actually_engages():
+    examples = _examples(n=3)
+    serialized = ec._serialize_batch(examples)
+    col = ec._decode_numeric_native(
+        serialized, "ids", ec.FeatureSpec(np.int64, (16,)), 16)
+    assert col is not None and col.shape == (3, 16)
+    want = _decode_python(examples, {"ids": ec.FeatureSpec(np.int64, (16,))})
+    np.testing.assert_array_equal(col, want["ids"])
+
+
+def test_native_dtype_casts_match_python():
+    examples = _examples(n=4)
+    specs = {
+        "ids": ec.FeatureSpec(np.int32, (16,)),      # i64 wire -> int32
+        "weights": ec.FeatureSpec(np.float64, (16,)),  # f32 wire -> float64
+        "label": ec.FeatureSpec(np.bool_, ()),         # i64 wire -> bool
+    }
+    got = ec.decode_examples(examples, specs)
+    want = _decode_python(examples, specs)
+    for name in specs:
+        assert got[name].dtype == want[name].dtype
+        np.testing.assert_array_equal(got[name], want[name], err_msg=name)
+
+
+def test_native_default_fill_and_required_error():
+    examples = [ec.example_from_dict({"a": [1, 2]}),
+                ec.example_from_dict({"b": [7.0]})]
+    specs = {"a": ec.FeatureSpec(np.int64, (2,), default=np.array([9, 9]))}
+    got = ec.decode_examples(examples, specs)
+    np.testing.assert_array_equal(got["a"], [[1, 2], [9, 9]])
+
+    with pytest.raises(ec.ExampleDecodeError, match="required feature 'a'"):
+        ec.decode_examples(examples,
+                           {"a": ec.FeatureSpec(np.int64, (2,))})
+
+
+def test_arity_mismatch_error_matches_python_path():
+    examples = [ec.example_from_dict({"a": [1, 2, 3]})]
+    with pytest.raises(ec.ExampleDecodeError, match="has 3 values"):
+        ec.decode_examples(examples, {"a": ec.FeatureSpec(np.int64, (2,))})
+
+
+def test_kind_mismatch_falls_back_to_python_cast():
+    # float_list under an int spec: native reports kind mismatch, Python
+    # fallback casts — decode_examples must keep the cast behavior.
+    examples = [ec.example_from_dict({"a": [1.0, 2.0]})]
+    got = ec.decode_examples(examples, {"a": ec.FeatureSpec(np.int64, (2,))})
+    np.testing.assert_array_equal(got["a"], [[1, 2]])
+
+
+def test_narrow_int_overflow_raises_like_python():
+    examples = [ec.example_from_dict({"a": [2 ** 40, 1]})]
+    with pytest.raises(OverflowError):
+        ec.decode_examples(examples, {"a": ec.FeatureSpec(np.int32, (2,))})
+    # Negative into unsigned must not wrap either.
+    neg = [ec.example_from_dict({"a": [-1]})]
+    with pytest.raises(OverflowError):
+        ec.decode_examples(neg, {"a": ec.FeatureSpec(np.uint32, (1,))})
+
+
+def test_float64_default_keeps_precision():
+    examples = [ec.example_from_dict({"other": [1.0]})]
+    got = ec.decode_examples(
+        examples, {"a": ec.FeatureSpec(np.float64, (), default=0.1)})
+    assert got["a"][0] == 0.1  # exact, not the f32 round-trip of 0.1
+
+
+def _varint(v):
+    out = b""
+    while True:
+        b7 = v & 0x7F
+        v >>= 7
+        out += bytes([b7 | (0x80 if v else 0)])
+        if not v:
+            return out
+
+
+def _ld(tag_field, payload):
+    return _varint(tag_field << 3 | 2) + _varint(len(payload)) + payload
+
+
+def test_duplicate_map_key_is_last_wins():
+    # Features map with key "a" twice ([1] then [2]): conforming parsers
+    # keep only the last entry.
+    def entry(values):
+        i64_list = b"".join(_varint(1 << 3 | 0) + _varint(v) for v in values)
+        return _ld(1, _ld(1, b"a") + _ld(2, _ld(3, i64_list)))
+
+    example = _ld(1, entry([1]) + entry([2]))
+    offsets = np.array([0], np.uint64)
+    lengths = np.array([len(example)], np.uint64)
+    col = ec._decode_numeric_native((example, offsets, lengths, 1), "a",
+                                    ec.FeatureSpec(np.int64, (1,)), 1)
+    np.testing.assert_array_equal(col, [[2]])
+    # Against a 2-element spec the last-wins single value is an arity
+    # mismatch -> native defers (None) so Python raises the exact error.
+    assert ec._decode_numeric_native(
+        (example, offsets, lengths, 1), "a",
+        ec.FeatureSpec(np.int64, (2,)), 2) is None
+
+
+def test_unpacked_wire_format():
+    # Hand-encode an unpacked Int64List (wt0 values) and FloatList (wt5):
+    # field tags: Example.features=1, map entry key=1 val=2,
+    # Feature.float_list=2/int64_list=3, list.value=1.
+    def varint(v):
+        out = b""
+        while True:
+            b7 = v & 0x7F
+            v >>= 7
+            out += bytes([b7 | (0x80 if v else 0)])
+            if not v:
+                return out
+
+    def ld(tag_field, payload):
+        return varint(tag_field << 3 | 2) + varint(len(payload)) + payload
+
+    unpacked_i64 = varint(1 << 3 | 0) + varint(5) + \
+        varint(1 << 3 | 0) + varint(600)
+    feature = ld(3, unpacked_i64)
+    entry = ld(1, b"a") + ld(2, feature)
+    example = ld(1, ld(1, entry))
+
+    import numpy as np
+    buf = example
+    offsets = np.array([0], np.uint64)
+    lengths = np.array([len(buf)], np.uint64)
+    col = ec._decode_numeric_native((buf, offsets, lengths, 1), "a",
+                                    ec.FeatureSpec(np.int64, (2,)), 2)
+    np.testing.assert_array_equal(col, [[5, 600]])

@@ -1,0 +1,201 @@
+"""StaticManager / CachingManager / load_servables_fast (SURVEY.md §2.4)."""
+
+import threading
+
+import pytest
+
+from min_tfs_client_tpu.core.loader import SimpleLoader
+from min_tfs_client_tpu.core.manager import AspiredVersionsManager
+from min_tfs_client_tpu.core.managers import (
+    CachingManager,
+    StaticManager,
+    load_servables_fast,
+)
+from min_tfs_client_tpu.core.states import ServableId
+from min_tfs_client_tpu.utils.status import ServingError
+
+
+class FakeServable:
+    def __init__(self, name, version):
+        self.name = name
+        self.version = version
+        self.unloaded = False
+
+    def unload(self):
+        self.unloaded = True
+
+
+# -- StaticManager -----------------------------------------------------------
+
+
+def test_static_manager_serves_fixed_set():
+    mgr = (StaticManager.Builder()
+           .add_servable(FakeServable("m", 1))
+           .add_servable(FakeServable("m", 2))
+           .add_servable(FakeServable("other", 7))
+           .build())
+    assert mgr.list_available() == [
+        ServableId("m", 1), ServableId("m", 2), ServableId("other", 7)]
+    with mgr.get_servable_handle("m") as h:
+        assert h.servable.version == 2  # latest by default
+    with mgr.get_servable_handle("m", earliest=True) as h:
+        assert h.servable.version == 1
+    with mgr.get_servable_handle("m", 1) as h:
+        assert h.servable.version == 1
+    with pytest.raises(ServingError, match="not found"):
+        mgr.get_servable_handle("missing")
+    with pytest.raises(ServingError, match="not found"):
+        mgr.get_servable_handle("m", 9)
+
+
+def test_static_manager_rejects_duplicates_and_bad_loads():
+    b = StaticManager.Builder().add_servable(FakeServable("m", 1))
+    with pytest.raises(ServingError, match="duplicate"):
+        b.add_servable(FakeServable("m", 1))
+
+    def boom():
+        raise RuntimeError("no disk")
+
+    with pytest.raises(ServingError):
+        StaticManager.Builder().add_loader("x", 1, SimpleLoader(boom))
+
+
+# -- CachingManager ----------------------------------------------------------
+
+
+def test_caching_manager_loads_on_first_request():
+    loads = []
+
+    def factory(name, version):
+        v = version if version is not None else 3
+        loads.append((name, v))
+        return v, SimpleLoader(lambda: FakeServable(name, v))
+
+    mgr = CachingManager(factory)
+    assert mgr.list_available() == []
+    with mgr.get_servable_handle("m") as h:
+        assert h.servable.version == 3
+    with mgr.get_servable_handle("m") as h:  # cached: no second load
+        assert h.servable.version == 3
+    assert loads == [("m", 3)]
+    with mgr.get_servable_handle("m", 5) as h:
+        assert h.servable.version == 5
+    assert loads == [("m", 3), ("m", 5)]
+    assert mgr.list_available() == [ServableId("m", 3), ServableId("m", 5)]
+
+
+def test_caching_manager_coalesces_concurrent_loads():
+    started = threading.Event()
+    release = threading.Event()
+    loads = []
+
+    def factory(name, version):
+        loads.append(name)
+
+        def make():
+            started.set()
+            release.wait(5.0)
+            return FakeServable(name, 1)
+
+        return 1, SimpleLoader(make)
+
+    mgr = CachingManager(factory)
+    results = []
+
+    def request():
+        with mgr.get_servable_handle("m", 1) as h:
+            results.append(h.servable.version)
+
+    threads = [threading.Thread(target=request) for _ in range(4)]
+    threads[0].start()
+    started.wait(5.0)
+    for t in threads[1:]:
+        t.start()
+    release.set()
+    for t in threads:
+        t.join(5.0)
+    assert results == [1, 1, 1, 1]
+    assert loads == ["m"]  # one factory call for four concurrent requests
+
+
+def test_caching_manager_latest_vs_explicit_race_keeps_one_harness():
+    """A None-version and an explicit-version request racing to the same
+    resolved version must end with ONE stored harness and the duplicate
+    unloaded (no leak, no overwrite)."""
+    start_a = threading.Event()
+    release = threading.Event()
+    servables = []
+
+    def factory(name, version):
+        def make():
+            s = FakeServable(name, 3)
+            servables.append(s)
+            start_a.set()
+            release.wait(5.0)
+            return s
+
+        return 3, SimpleLoader(make)
+
+    mgr = CachingManager(factory)
+    got = []
+
+    def latest():
+        with mgr.get_servable_handle("m") as h:
+            got.append(h.servable)
+
+    def explicit():
+        start_a.wait(5.0)  # ensure the None-version load is mid-flight
+        release.set()
+        with mgr.get_servable_handle("m", 3) as h:
+            got.append(h.servable)
+
+    ta = threading.Thread(target=latest)
+    tb = threading.Thread(target=explicit)
+    ta.start()
+    tb.start()
+    ta.join(5.0)
+    tb.join(5.0)
+    assert len(got) == 2
+    assert mgr.list_available() == [ServableId("m", 3)]
+    if len(servables) == 2:
+        # both loads ran: exactly one survives, the duplicate was unloaded
+        assert sum(s.unloaded for s in servables) == 1
+        assert not [s for s in got if s.unloaded]
+
+
+def test_caching_manager_factory_error_propagates():
+    def factory(name, version):
+        raise RuntimeError("storage down")
+
+    mgr = CachingManager(factory)
+    with pytest.raises(ServingError, match="storage down"):
+        mgr.get_servable_handle("m", 1)
+
+
+# -- load_servables_fast -----------------------------------------------------
+
+
+def test_load_servables_fast_waits_for_ready():
+    mgr = AspiredVersionsManager(start_thread=False)
+    try:
+        mgr.set_aspired_versions(
+            "a", [(1, SimpleLoader(lambda: FakeServable("a", 1)))])
+        mgr.set_aspired_versions(
+            "b", [(1, SimpleLoader(lambda: FakeServable("b", 1)))])
+        load_servables_fast(mgr, ["a", "b"], timeout_s=10.0)
+        assert {s.name for s in mgr.list_available()} == {"a", "b"}
+    finally:
+        mgr.stop()
+
+
+def test_load_servables_fast_raises_load_error():
+    def boom():
+        raise RuntimeError("bad model")
+
+    mgr = AspiredVersionsManager(start_thread=False, max_load_retries=0)
+    try:
+        mgr.set_aspired_versions("a", [(1, SimpleLoader(boom))])
+        with pytest.raises(ServingError):
+            load_servables_fast(mgr, ["a"], timeout_s=10.0)
+    finally:
+        mgr.stop()
