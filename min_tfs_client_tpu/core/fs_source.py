@@ -68,6 +68,23 @@ def list_version_dirs(base_path: str) -> list[tuple[int, str]]:
     return sorted(out)
 
 
+class StaticStoragePathSource:
+    """Emits one fixed (version, path) exactly once when connected —
+    sources/storage_path/static_storage_path_source.{h,cc} parity, used for
+    test fixtures and frozen deployments."""
+
+    def __init__(self, servable_name: str, version: int, path: str):
+        self._name = servable_name
+        self._version = version
+        self._path = path
+
+    def set_aspired_versions_callback(self, callback: AspiredCallback) -> None:
+        callback(self._name, [(self._version, self._path)])
+
+    def stop(self) -> None:  # Source interface symmetry
+        pass
+
+
 class FileSystemStoragePathSource:
     def __init__(
         self,

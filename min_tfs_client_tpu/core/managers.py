@@ -187,6 +187,20 @@ class CachingManager:
                 done.set()
 
 
+class ManagerWrapper:
+    """Forwarding base for managers (core/manager_wrapper.{h,cc}): subclass
+    and override selectively (e.g. to add per-request policy or metrics)."""
+
+    def __init__(self, wrapped):
+        self._wrapped = wrapped
+
+    def list_available(self):
+        return self._wrapped.list_available()
+
+    def get_servable_handle(self, name, version=None, **kwargs):
+        return self._wrapped.get_servable_handle(name, version, **kwargs)
+
+
 def load_servables_fast(
     manager: AspiredVersionsManager,
     names: list[str],

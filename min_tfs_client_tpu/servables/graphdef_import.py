@@ -101,6 +101,238 @@ def _concat_v2(node, inputs, lib):
     return [lib.concatenate(inputs[:-1], axis=axis)]
 
 
+# -- convolution / pooling / normalization (ResNet-class graphs) -------------
+# These lower through jax.lax regardless of `lib`: they are numeric by
+# definition, and jax on CPU covers the host path (string graphs never
+# contain convs; mixing is safe because host outputs pass through
+# np.asarray at the signature boundary).
+
+
+def _str_attr(node, key, default):
+    a = _attr(node, key)
+    return a.s.decode() if a is not None and a.s else default
+
+
+def _int_list(node, key, default=()):
+    a = _attr(node, key)
+    return list(a.list.i) if a is not None else list(default)
+
+
+def _conv_padding(node, data_format):
+    pad = _str_attr(node, "padding", "VALID")
+    if pad != "EXPLICIT":
+        return pad
+    ep = _int_list(node, "explicit_paddings")
+    if data_format == "NHWC":
+        return [(ep[2], ep[3]), (ep[4], ep[5])]
+    return [(ep[4], ep[5]), (ep[6], ep[7])]
+
+
+def _conv2d(node, inputs, lib):
+    import jax.numpy as jnp
+    from jax import lax
+
+    x, w = jnp.asarray(inputs[0]), jnp.asarray(inputs[1])
+    df = _str_attr(node, "data_format", "NHWC")
+    strides = _int_list(node, "strides", (1, 1, 1, 1))
+    dil = _int_list(node, "dilations", (1, 1, 1, 1))
+    sp = slice(1, 3) if df == "NHWC" else slice(2, 4)
+    dn = lax.conv_dimension_numbers(x.shape, w.shape, (df, "HWIO", df))
+    out = lax.conv_general_dilated(
+        x, w, tuple(strides[sp]), _conv_padding(node, df),
+        rhs_dilation=tuple(dil[sp]), dimension_numbers=dn)
+    return [out]
+
+
+def _depthwise_conv2d(node, inputs, lib):
+    import jax.numpy as jnp
+    from jax import lax
+
+    x, w = jnp.asarray(inputs[0]), jnp.asarray(inputs[1])
+    df = _str_attr(node, "data_format", "NHWC")
+    strides = _int_list(node, "strides", (1, 1, 1, 1))
+    dil = _int_list(node, "dilations", (1, 1, 1, 1))
+    sp = slice(1, 3) if df == "NHWC" else slice(2, 4)
+    h, wk, c, m = w.shape  # TF depthwise filter: (H, W, C_in, multiplier)
+    w = w.reshape(h, wk, 1, c * m)
+    dn = lax.conv_dimension_numbers(x.shape, w.shape, (df, "HWIO", df))
+    out = lax.conv_general_dilated(
+        x, w, tuple(strides[sp]), _conv_padding(node, df),
+        rhs_dilation=tuple(dil[sp]), dimension_numbers=dn,
+        feature_group_count=c)
+    return [out]
+
+
+def _pool(kind):
+    def impl(node, inputs, lib):
+        import jax.numpy as jnp
+        from jax import lax
+
+        x = jnp.asarray(inputs[0])
+        window = tuple(_int_list(node, "ksize", (1, 1, 1, 1)))
+        strides = tuple(_int_list(node, "strides", (1, 1, 1, 1)))
+        pad = _str_attr(node, "padding", "VALID")
+        if kind == "max":
+            init = (np.array(-np.inf, x.dtype)
+                    if np.issubdtype(x.dtype, np.floating)
+                    else np.array(np.iinfo(x.dtype).min, x.dtype))
+            return [lax.reduce_window(x, init, lax.max, window, strides, pad)]
+        total = lax.reduce_window(x, np.array(0, x.dtype), lax.add, window,
+                                  strides, pad)
+        # TF AvgPool averages over VALID elements only under SAME padding.
+        count = lax.reduce_window(jnp.ones_like(x), np.array(0, x.dtype),
+                                  lax.add, window, strides, pad)
+        return [total / count]
+
+    return impl
+
+
+def _fused_batch_norm(node, inputs, lib):
+    x, scale, offset, mean, var = inputs[:5]
+    training = _attr(node, "is_training")
+    if training is not None and training.b:
+        raise GraphImportError(
+            f"FusedBatchNorm node {node.name!r} has is_training=true; only "
+            "inference graphs are servable")
+    a = _attr(node, "epsilon")
+    eps = float(a.f) if a is not None else 1e-4
+    df = _str_attr(node, "data_format", "NHWC")
+    if df == "NCHW":
+        shape = (1, -1, 1, 1)
+        scale, offset, mean, var = (
+            lib.reshape(lib.asarray(v), shape)
+            for v in (scale, offset, mean, var))
+    inv = scale / lib.sqrt(var + eps)
+    y = x * inv + (offset - mean * inv)
+    # V1 declares 5 outputs, V3 six; inference consumers only read slot 0.
+    return [y, mean, var, mean, var, var]
+
+
+# -- indexing / shaping ------------------------------------------------------
+
+
+def _strided_slice(node, inputs, lib):
+    x, begin, end, strides = inputs
+    begin = [int(v) for v in np.asarray(begin).reshape(-1)]
+    end = [int(v) for v in np.asarray(end).reshape(-1)]
+    strides = [int(v) for v in np.asarray(strides).reshape(-1)]
+
+    def mask(key):
+        a = _attr(node, key)
+        return int(a.i) if a is not None else 0
+
+    bm, em = mask("begin_mask"), mask("end_mask")
+    ellipsis, new_axis, shrink = (mask("ellipsis_mask"),
+                                  mask("new_axis_mask"),
+                                  mask("shrink_axis_mask"))
+    n_specs = len(begin)
+    consuming = sum(1 for k in range(n_specs)
+                    if not (new_axis >> k) & 1 and not (ellipsis >> k) & 1)
+    ndim = np.ndim(x)
+    idx: list = []
+    for k in range(n_specs):
+        if (ellipsis >> k) & 1:
+            idx.extend([slice(None)] * (ndim - consuming))
+        elif (new_axis >> k) & 1:
+            idx.append(None)
+        elif (shrink >> k) & 1:
+            idx.append(begin[k])
+        else:
+            b = None if (bm >> k) & 1 else begin[k]
+            e = None if (em >> k) & 1 else end[k]
+            idx.append(slice(b, e, strides[k]))
+    return [x[tuple(idx)]]
+
+
+def _slice_op(node, inputs, lib):
+    x, begin, size = inputs
+    begin = [int(v) for v in np.asarray(begin).reshape(-1)]
+    size = [int(v) for v in np.asarray(size).reshape(-1)]
+    idx = tuple(slice(b, None if s == -1 else b + s)
+                for b, s in zip(begin, size))
+    return [x[idx]]
+
+
+def _gather_v2(node, inputs, lib):
+    params, indices = inputs[0], inputs[1]
+    axis = int(np.asarray(inputs[2])) if len(inputs) > 2 else 0
+    a = _attr(node, "batch_dims")
+    if a is not None and int(a.i):
+        raise GraphImportError(
+            f"GatherV2 node {node.name!r}: batch_dims != 0 unsupported")
+    return [lib.take(params, lib.asarray(indices), axis=axis)]
+
+
+def _one_hot(node, inputs, lib):
+    indices, depth, on, off = inputs
+    a = _attr(node, "axis")
+    axis = int(a.i) if a is not None else -1
+    depth = int(np.asarray(depth))
+    indices = lib.asarray(indices)
+    hot = lib.asarray(indices)[..., None] == lib.arange(depth)
+    out = lib.where(hot, on, off)
+    if axis not in (-1, np.ndim(out) - 1):
+        out = lib.moveaxis(out, -1, axis)
+    return [out]
+
+
+def _split(node, inputs, lib):
+    axis, value = int(np.asarray(inputs[0])), inputs[1]
+    num = int(node.attr["num_split"].i)
+    return list(lib.split(value, num, axis=axis))
+
+
+def _split_v(node, inputs, lib):
+    value, sizes, axis = inputs
+    axis = int(np.asarray(axis))
+    sizes = [int(v) for v in np.asarray(sizes).reshape(-1)]
+    if -1 in sizes:
+        known = sum(s for s in sizes if s != -1)
+        sizes[sizes.index(-1)] = np.shape(value)[axis] - known
+    cuts = np.cumsum(sizes[:-1]).tolist()
+    return list(lib.split(value, cuts, axis=axis))
+
+
+def _unpack(node, inputs, lib):
+    a = _attr(node, "axis")
+    axis = int(a.i) if a is not None else 0
+    num = int(node.attr["num"].i)
+    return [lib.squeeze(s, axis=axis)
+            for s in lib.split(inputs[0], num, axis=axis)]
+
+
+def _erf(node, inputs, lib):
+    import jax.numpy as jnp
+    from jax.scipy.special import erf
+
+    return [erf(jnp.asarray(inputs[0]))]
+
+
+def _select_v1(inputs, lib):
+    # TF1 Select: a rank-1 condition of length batch selects whole rows of
+    # higher-rank t/e (array_ops semantics SelectV2 dropped).
+    cond, t, e = inputs
+    if np.ndim(cond) == 1 and np.ndim(t) > 1:
+        cond = lib.reshape(lib.asarray(cond),
+                           (-1,) + (1,) * (np.ndim(t) - 1))
+    return lib.where(cond, t, e)
+
+
+def _leaky_relu(node, inputs, lib):
+    a = _attr(node, "alpha")
+    alpha = float(a.f) if a is not None else 0.2
+    x = inputs[0]
+    return [lib.where(x > 0, x, alpha * x)]
+
+
+def _log_softmax(node, inputs, lib):
+    x = inputs[0]
+    m = lib.max(x, axis=-1, keepdims=True)
+    shifted = x - m
+    return [shifted - lib.log(lib.sum(lib.exp(shifted), axis=-1,
+                                      keepdims=True))]
+
+
 OPS: dict[str, Callable] = {
     "Identity": lambda n, i, lib: [i[0]],
     "StopGradient": lambda n, i, lib: [i[0]],
@@ -116,7 +348,10 @@ OPS: dict[str, Callable] = {
     "Minimum": _binop(lambda lib, a, b: lib.minimum(a, b)),
     "Pow": _binop(lambda lib, a, b: lib.power(a, b)),
     "SquaredDifference": _binop(lambda lib, a, b: lib.square(lib.subtract(a, b))),
-    "BiasAdd": _binop(lambda lib, a, b: lib.add(a, b)),
+    "BiasAdd": lambda n, i, lib: [
+        i[0] + (lib.reshape(lib.asarray(i[1]), (1, -1) + (1,) * (np.ndim(i[0]) - 2))
+                if _str_attr(n, "data_format", "NHWC") == "NCHW"
+                and np.ndim(i[0]) > 2 else i[1])],
     "MatMul": _matmul,
     "BatchMatMul": _matmul,
     "BatchMatMulV2": _matmul,
@@ -152,8 +387,68 @@ OPS: dict[str, Callable] = {
     "Max": _reduce("max"),
     "Min": _reduce("min"),
     "ArgMax": lambda n, i, lib: [lib.argmax(i[0], axis=int(np.asarray(i[1])))],
+    "ArgMin": lambda n, i, lib: [lib.argmin(i[0], axis=int(np.asarray(i[1])))],
     "Tile": lambda n, i, lib: [
         lib.tile(i[0], tuple(int(d) for d in np.asarray(i[1]).reshape(-1)))],
+    # convolution / pooling / normalization
+    "Conv2D": _conv2d,
+    "DepthwiseConv2dNative": _depthwise_conv2d,
+    "MaxPool": _pool("max"),
+    "AvgPool": _pool("avg"),
+    "FusedBatchNorm": _fused_batch_norm,
+    "FusedBatchNormV2": _fused_batch_norm,
+    "FusedBatchNormV3": _fused_batch_norm,
+    "Pad": lambda n, i, lib: [lib.pad(
+        i[0], [(int(a), int(b)) for a, b in np.asarray(i[1])])],
+    "PadV2": lambda n, i, lib: [lib.pad(
+        i[0], [(int(a), int(b)) for a, b in np.asarray(i[1])],
+        constant_values=i[2])],
+    # indexing / shaping
+    "StridedSlice": _strided_slice,
+    "Slice": _slice_op,
+    "Gather": lambda n, i, lib: [lib.take(i[0], lib.asarray(i[1]), axis=0)],
+    "GatherV2": _gather_v2,
+    "Shape": lambda n, i, lib: [np.asarray(np.shape(i[0]), np.int32)],
+    "Size": lambda n, i, lib: [np.asarray(np.size(i[0]), np.int32)],
+    "Rank": lambda n, i, lib: [np.asarray(np.ndim(i[0]), np.int32)],
+    "Fill": lambda n, i, lib: [lib.full(
+        tuple(int(d) for d in np.asarray(i[0]).reshape(-1)), i[1])],
+    "Range": lambda n, i, lib: [lib.arange(
+        np.asarray(i[0]).item(), np.asarray(i[1]).item(),
+        np.asarray(i[2]).item())],
+    "OneHot": _one_hot,
+    "Split": _split,
+    "SplitV": _split_v,
+    "Unpack": _unpack,
+    "ZerosLike": lambda n, i, lib: [lib.zeros_like(i[0])],
+    "OnesLike": lambda n, i, lib: [lib.ones_like(i[0])],
+    "Einsum": lambda n, i, lib: [
+        lib.einsum(n.attr["equation"].s.decode(), *i)],
+    # comparison / selection / logic
+    "Greater": _binop(lambda lib, a, b: lib.greater(a, b)),
+    "GreaterEqual": _binop(lambda lib, a, b: lib.greater_equal(a, b)),
+    "Less": _binop(lambda lib, a, b: lib.less(a, b)),
+    "LessEqual": _binop(lambda lib, a, b: lib.less_equal(a, b)),
+    "Equal": _binop(lambda lib, a, b: lib.equal(a, b)),
+    "NotEqual": _binop(lambda lib, a, b: lib.not_equal(a, b)),
+    "LogicalAnd": _binop(lambda lib, a, b: lib.logical_and(a, b)),
+    "LogicalOr": _binop(lambda lib, a, b: lib.logical_or(a, b)),
+    "LogicalNot": _unary("logical_not"),
+    "Select": lambda n, i, lib: [_select_v1(i, lib)],
+    "SelectV2": lambda n, i, lib: [lib.where(i[0], i[1], i[2])],
+    # activations / math
+    "Erf": _erf,
+    "Softplus": lambda n, i, lib: [lib.logaddexp(i[0], 0)],
+    "Elu": lambda n, i, lib: [lib.where(i[0] > 0, i[0],
+                                        lib.exp(lib.minimum(i[0], 0)) - 1)],
+    "LeakyRelu": _leaky_relu,
+    "LogSoftmax": _log_softmax,
+    "ClipByValue": lambda n, i, lib: [lib.clip(i[0], i[1], i[2])],
+    "AddN": lambda n, i, lib: [sum(i[1:], start=i[0])],
+    "Reciprocal": lambda n, i, lib: [1 / i[0]],
+    "FloorDiv": _binop(lambda lib, a, b: lib.floor_divide(a, b)),
+    "FloorMod": _binop(lambda lib, a, b: lib.mod(a, b)),
+    "Prod": _reduce("prod"),
 }
 
 # Ops legal in host (string-carrying) mode only as pass-throughs.

@@ -45,6 +45,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--profiler_port", type=int, default=0,
                    help="jax.profiler server port for on-demand trace "
                         "capture; 0 disables")
+    p.add_argument("--grpc_socket_path", default="",
+                   help="also listen on this UNIX-domain socket path")
+    p.add_argument("--grpc_channel_arguments", default="",
+                   help='extra gRPC server args, "key=value,key=value"')
+    p.add_argument("--version", action="store_true",
+                   help="print the server version and exit")
     return p
 
 
@@ -70,11 +76,18 @@ def options_from_args(args) -> ServerOptions:
         enable_model_warmup=args.enable_model_warmup,
         response_tensors_as_content=args.response_tensors_as_content,
         profiler_port=args.profiler_port,
+        grpc_socket_path=args.grpc_socket_path,
+        grpc_channel_arguments=args.grpc_channel_arguments,
     )
 
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
+    if args.version:
+        from min_tfs_client_tpu.server.version import version_string
+
+        print(version_string())
+        return 0
     server = Server(options_from_args(args)).build_and_start()
     ports = f"gRPC on {server.grpc_port}"
     if getattr(server, "rest_port", None):

@@ -59,6 +59,27 @@ class ServerOptions:
     # On-demand profiling (reference registers a profiler service on the
     # main server, server.cc:324,339); 0 disables.
     profiler_port: int = 0
+    # Additional UNIX-domain listening socket (server.cc:330-336); "" off.
+    grpc_socket_path: str = ""
+    # "key=value,key=value" extra gRPC channel args (main.cc
+    # grpc_channel_arguments flag).
+    grpc_channel_arguments: str = ""
+
+
+def _parse_channel_arguments(spec: str) -> list[tuple[str, object]]:
+    """"grpc.max_send_message_length=4194304,..." -> grpc options list,
+    ints coerced (the main.cc grpc_channel_arguments format)."""
+    out: list[tuple[str, object]] = []
+    for part in (spec or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        key, sep, value = part.partition("=")
+        if not sep:
+            raise ServingError.invalid_argument(
+                f"malformed gRPC channel argument {part!r} (want key=value)")
+        out.append((key, int(value) if value.lstrip("-").isdigit() else value))
+    return out
 
 
 def _parse_text_proto(path: str, proto_cls):
@@ -117,7 +138,8 @@ class Server:
             self.core,
             response_tensors_as_content=opts.response_tensors_as_content)
         self._grpc_server = grpc.server(
-            futures.ThreadPoolExecutor(max_workers=opts.grpc_max_threads))
+            futures.ThreadPoolExecutor(max_workers=opts.grpc_max_threads),
+            options=_parse_channel_arguments(opts.grpc_channel_arguments))
         gs.add_PredictionServiceServicer_to_server(
             PredictionServiceImpl(handlers), self._grpc_server)
         gs.add_ModelServiceServicer_to_server(
@@ -125,6 +147,9 @@ class Server:
         gs.add_SessionServiceServicer_to_server(
             SessionServiceImpl(handlers), self._grpc_server)
         self.grpc_port = self._bind(self._grpc_server, opts.grpc_port)
+        if opts.grpc_socket_path:
+            self._grpc_server.add_insecure_port(
+                f"unix:{opts.grpc_socket_path}")
         self._grpc_server.start()
 
         if opts.rest_api_port or opts.monitoring_config_file:

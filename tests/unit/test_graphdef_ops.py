@@ -1,0 +1,314 @@
+"""Expanded GraphDef op coverage: conv/pool/norm, indexing, activations
+(SURVEY.md hard part (a): SavedModel import fidelity)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from min_tfs_client_tpu.protos import tf_graph_pb2, tf_tensor_pb2
+from min_tfs_client_tpu.servables.graphdef_import import (
+    GraphFunction,
+    GraphImportError,
+)
+from min_tfs_client_tpu.tensor.codec import ndarray_to_tensor_proto
+from tests.fixtures import _node
+
+DT = tf_tensor_pb2
+
+
+def _graph():
+    return tf_graph_pb2.GraphDef()
+
+
+def _const(g, name, arr):
+    _node(g, name, "Const", dtype=DT.DT_FLOAT if arr.dtype == np.float32
+          else DT.DT_INT32, value=ndarray_to_tensor_proto(arr))
+
+
+def _run(g, feeds, fetches, feed_values):
+    fn = GraphFunction(g, feeds, fetches)
+    return [np.asarray(o) for o in fn(feed_values, jnp)]
+
+
+def test_conv2d_same_matches_manual():
+    g = _graph()
+    _node(g, "x", "Placeholder", dtype=DT.DT_FLOAT)
+    rng = np.random.default_rng(0)
+    w = rng.standard_normal((3, 3, 2, 4)).astype(np.float32)
+    _const(g, "w", w)
+    _node(g, "y", "Conv2D", ["x", "w"], T=DT.DT_FLOAT,
+          strides=[1, 1, 1, 1], padding="SAME", data_format="NHWC")
+    x = rng.standard_normal((2, 8, 8, 2)).astype(np.float32)
+    (got,) = _run(g, ["x:0"], ["y:0"], [x])
+
+    from jax import lax
+
+    dn = lax.conv_dimension_numbers(x.shape, w.shape, ("NHWC", "HWIO", "NHWC"))
+    want = np.asarray(lax.conv_general_dilated(x, w, (1, 1), "SAME",
+                                               dimension_numbers=dn))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+    assert got.shape == (2, 8, 8, 4)
+
+
+def test_conv2d_strided_valid_shape():
+    g = _graph()
+    _node(g, "x", "Placeholder", dtype=DT.DT_FLOAT)
+    _const(g, "w", np.ones((2, 2, 1, 3), np.float32))
+    _node(g, "y", "Conv2D", ["x", "w"], strides=[1, 2, 2, 1],
+          padding="VALID")
+    x = np.ones((1, 6, 6, 1), np.float32)
+    (got,) = _run(g, ["x:0"], ["y:0"], [x])
+    assert got.shape == (1, 3, 3, 3)
+    np.testing.assert_allclose(got, 4.0)
+
+
+def test_depthwise_conv():
+    g = _graph()
+    _node(g, "x", "Placeholder", dtype=DT.DT_FLOAT)
+    w = np.ones((2, 2, 3, 1), np.float32)
+    _const(g, "w", w)
+    _node(g, "y", "DepthwiseConv2dNative", ["x", "w"],
+          strides=[1, 1, 1, 1], padding="VALID")
+    x = np.arange(2 * 3 * 3 * 3, dtype=np.float32).reshape(2, 3, 3, 3)
+    (got,) = _run(g, ["x:0"], ["y:0"], [x])
+    assert got.shape == (2, 2, 2, 3)
+    # Each output channel = sum over its own input channel's 2x2 window.
+    want = (x[:, :2, :2] + x[:, :2, 1:] + x[:, 1:, :2] + x[:, 1:, 1:])
+    np.testing.assert_allclose(got, want)
+
+
+def test_max_and_avg_pool():
+    g = _graph()
+    _node(g, "x", "Placeholder", dtype=DT.DT_FLOAT)
+    _node(g, "mx", "MaxPool", ["x"], ksize=[1, 2, 2, 1],
+          strides=[1, 2, 2, 1], padding="VALID")
+    _node(g, "av", "AvgPool", ["x"], ksize=[1, 2, 2, 1],
+          strides=[1, 2, 2, 1], padding="VALID")
+    x = np.arange(16, dtype=np.float32).reshape(1, 4, 4, 1)
+    mx, av = _run(g, ["x:0"], ["mx:0", "av:0"], [x])
+    np.testing.assert_allclose(mx[0, :, :, 0], [[5, 7], [13, 15]])
+    np.testing.assert_allclose(av[0, :, :, 0], [[2.5, 4.5], [10.5, 12.5]])
+
+
+def test_avg_pool_same_counts_valid_only():
+    g = _graph()
+    _node(g, "x", "Placeholder", dtype=DT.DT_FLOAT)
+    _node(g, "y", "AvgPool", ["x"], ksize=[1, 2, 2, 1],
+          strides=[1, 2, 2, 1], padding="SAME")
+    x = np.ones((1, 3, 3, 1), np.float32)
+    (got,) = _run(g, ["x:0"], ["y:0"], [x])
+    # With TF SAME avg pooling, edge windows average only real elements.
+    np.testing.assert_allclose(got, 1.0)
+
+
+def test_fused_batch_norm_inference():
+    g = _graph()
+    _node(g, "x", "Placeholder", dtype=DT.DT_FLOAT)
+    for nm, v in [("scale", np.array([2.0], np.float32)),
+                  ("offset", np.array([1.0], np.float32)),
+                  ("mean", np.array([0.5], np.float32)),
+                  ("var", np.array([4.0], np.float32))]:
+        _const(g, nm, v)
+    _node(g, "y", "FusedBatchNormV3", ["x", "scale", "offset", "mean", "var"],
+          epsilon=0.0, is_training=False)
+    x = np.array([[2.5]], np.float32)
+    (got,) = _run(g, ["x:0"], ["y:0"], [x])
+    np.testing.assert_allclose(got, [[3.0]])  # 2*(2.5-0.5)/2 + 1
+
+
+def test_fused_batch_norm_training_rejected():
+    g = _graph()
+    _node(g, "x", "Placeholder", dtype=DT.DT_FLOAT)
+    for nm in ("scale", "offset", "mean", "var"):
+        _const(g, nm, np.array([1.0], np.float32))
+    _node(g, "y", "FusedBatchNormV3", ["x", "scale", "offset", "mean", "var"],
+          is_training=True)
+    fn = GraphFunction(g, ["x:0"], ["y:0"])
+    with pytest.raises(GraphImportError, match="is_training"):
+        fn([np.ones((1, 1), np.float32)], jnp)
+
+
+def test_strided_slice_masks():
+    g = _graph()
+    _node(g, "x", "Placeholder", dtype=DT.DT_FLOAT)
+    _const(g, "b", np.array([0, 1], np.int32))
+    _const(g, "e", np.array([0, 3], np.int32))
+    _const(g, "s", np.array([1, 1], np.int32))
+    _node(g, "y", "StridedSlice", ["x", "b", "e", "s"],
+          begin_mask=1, end_mask=1, shrink_axis_mask=0)
+    x = np.arange(12, dtype=np.float32).reshape(3, 4)
+    (got,) = _run(g, ["x:0"], ["y:0"], [x])
+    np.testing.assert_allclose(got, x[:, 1:3])
+
+
+def test_strided_slice_shrink_and_newaxis():
+    g = _graph()
+    _node(g, "x", "Placeholder", dtype=DT.DT_FLOAT)
+    _const(g, "b", np.array([1, 0], np.int32))
+    _const(g, "e", np.array([2, 0], np.int32))
+    _const(g, "s", np.array([1, 1], np.int32))
+    _node(g, "y", "StridedSlice", ["x", "b", "e", "s"],
+          shrink_axis_mask=1, new_axis_mask=2)
+    x = np.arange(12, dtype=np.float32).reshape(3, 4)
+    (got,) = _run(g, ["x:0"], ["y:0"], [x])
+    np.testing.assert_allclose(got, x[1][None, :])
+
+
+def test_gather_one_hot_select():
+    g = _graph()
+    _node(g, "ids", "Placeholder", dtype=DT.DT_INT32)
+    _const(g, "table", np.arange(12, dtype=np.float32).reshape(4, 3))
+    _const(g, "axis", np.array(0, np.int32))
+    _node(g, "emb", "GatherV2", ["table", "ids", "axis"])
+    x = np.array([3, 0, 1], np.int32)
+    (emb,) = _run(g, ["ids:0"], ["emb:0"], [x])
+    np.testing.assert_allclose(emb, np.arange(12).reshape(4, 3)[x])
+
+    g2 = _graph()
+    _node(g2, "i", "Placeholder", dtype=DT.DT_INT32)
+    _const(g2, "depth", np.array(4, np.int32))
+    _const(g2, "on", np.array(1.0, np.float32))
+    _const(g2, "off", np.array(0.0, np.float32))
+    _node(g2, "oh", "OneHot", ["i", "depth", "on", "off"])
+    (oh,) = _run(g2, ["i:0"], ["oh:0"], [np.array([2, 0], np.int32)])
+    np.testing.assert_allclose(oh, [[0, 0, 1, 0], [1, 0, 0, 0]])
+
+
+def test_split_and_unpack_multi_output():
+    g = _graph()
+    _node(g, "x", "Placeholder", dtype=DT.DT_FLOAT)
+    _const(g, "axis", np.array(1, np.int32))
+    _node(g, "s", "Split", ["axis", "x"], num_split=2)
+    _node(g, "u", "Unpack", ["x"], num=2, axis=0)
+    x = np.arange(8, dtype=np.float32).reshape(2, 4)
+    s0, s1, u1 = _run(g, ["x:0"], ["s:0", "s:1", "u:1"], [x])
+    np.testing.assert_allclose(s0, x[:, :2])
+    np.testing.assert_allclose(s1, x[:, 2:])
+    np.testing.assert_allclose(u1, x[1])
+
+
+def test_erf_softplus_logsoftmax():
+    g = _graph()
+    _node(g, "x", "Placeholder", dtype=DT.DT_FLOAT)
+    _node(g, "e", "Erf", ["x"])
+    _node(g, "sp", "Softplus", ["x"])
+    _node(g, "ls", "LogSoftmax", ["x"])
+    x = np.array([[-1.0, 0.0, 2.0]], np.float32)
+    e, sp, ls = _run(g, ["x:0"], ["e:0", "sp:0", "ls:0"], [x])
+    import math
+    np.testing.assert_allclose(e[0], [math.erf(v) for v in x[0]], rtol=1e-5)
+    np.testing.assert_allclose(sp, np.log1p(np.exp(x)), rtol=1e-5)
+    np.testing.assert_allclose(
+        ls, x - np.log(np.exp(x).sum(-1, keepdims=True)), rtol=1e-5)
+
+
+def test_shape_fill_range_addn():
+    g = _graph()
+    _node(g, "x", "Placeholder", dtype=DT.DT_FLOAT)
+    _node(g, "sh", "Shape", ["x"])
+    _const(g, "dims", np.array([2, 2], np.int32))
+    _const(g, "val", np.array(7.0, np.float32))
+    _node(g, "fl", "Fill", ["dims", "val"])
+    _const(g, "start", np.array(0, np.int32))
+    _const(g, "limit", np.array(6, np.int32))
+    _const(g, "delta", np.array(2, np.int32))
+    _node(g, "rg", "Range", ["start", "limit", "delta"])
+    _node(g, "ad", "AddN", ["x", "x", "x"])
+    x = np.ones((3, 5), np.float32)
+    sh, fl, rg, ad = _run(g, ["x:0"], ["sh:0", "fl:0", "rg:0", "ad:0"], [x])
+    np.testing.assert_array_equal(sh, [3, 5])
+    np.testing.assert_allclose(fl, np.full((2, 2), 7.0))
+    np.testing.assert_array_equal(rg, [0, 2, 4])
+    np.testing.assert_allclose(ad, 3 * x)
+
+
+def test_comparisons_and_select():
+    g = _graph()
+    _node(g, "a", "Placeholder", dtype=DT.DT_FLOAT)
+    _node(g, "b", "Placeholder", dtype=DT.DT_FLOAT)
+    _node(g, "gt", "Greater", ["a", "b"])
+    _node(g, "sel", "SelectV2", ["gt", "a", "b"])
+    a = np.array([1.0, 5.0], np.float32)
+    b = np.array([2.0, 3.0], np.float32)
+    gt, sel = _run(g, ["a:0", "b:0"], ["gt:0", "sel:0"], [a, b])
+    np.testing.assert_array_equal(gt, [False, True])
+    np.testing.assert_allclose(sel, [2.0, 5.0])
+
+
+def test_one_hot_axis_zero():
+    g = _graph()
+    _node(g, "i", "Placeholder", dtype=DT.DT_INT32)
+    _const(g, "depth", np.array(3, np.int32))
+    _const(g, "on", np.array(1.0, np.float32))
+    _const(g, "off", np.array(0.0, np.float32))
+    _node(g, "oh", "OneHot", ["i", "depth", "on", "off"], axis=0)
+    (oh,) = _run(g, ["i:0"], ["oh:0"], [np.array([2, 0], np.int32)])
+    assert oh.shape == (3, 2)
+    np.testing.assert_allclose(oh, [[0, 1], [0, 0], [1, 0]])
+
+
+def test_select_v1_rank1_condition_selects_rows():
+    g = _graph()
+    _node(g, "c", "Placeholder", dtype=DT.DT_BOOL)
+    _node(g, "a", "Placeholder", dtype=DT.DT_FLOAT)
+    _node(g, "b", "Placeholder", dtype=DT.DT_FLOAT)
+    _node(g, "y", "Select", ["c", "a", "b"])
+    cond = np.array([True, False], bool)
+    a = np.ones((2, 3), np.float32)
+    b = np.zeros((2, 3), np.float32)
+    (got,) = _run(g, ["c:0", "a:0", "b:0"], ["y:0"], [cond, a, b])
+    np.testing.assert_allclose(got, [[1, 1, 1], [0, 0, 0]])
+
+
+def test_max_pool_int_dtype():
+    g = _graph()
+    _node(g, "x", "Placeholder", dtype=DT.DT_INT32)
+    _node(g, "y", "MaxPool", ["x"], ksize=[1, 2, 2, 1],
+          strides=[1, 2, 2, 1], padding="VALID")
+    x = np.arange(16, dtype=np.int32).reshape(1, 4, 4, 1)
+    (got,) = _run(g, ["x:0"], ["y:0"], [x])
+    np.testing.assert_array_equal(got[0, :, :, 0], [[5, 7], [13, 15]])
+
+
+def test_pad_and_einsum():
+    g = _graph()
+    _node(g, "x", "Placeholder", dtype=DT.DT_FLOAT)
+    _const(g, "p", np.array([[1, 0], [0, 2]], np.int32))
+    _node(g, "pd", "Pad", ["x", "p"])
+    _node(g, "es", "Einsum", ["x", "x"], equation="ij,kj->ik")
+    x = np.ones((2, 2), np.float32)
+    pd, es = _run(g, ["x:0"], ["pd:0", "es:0"], [x])
+    assert pd.shape == (3, 4)
+    assert pd.sum() == 4.0
+    np.testing.assert_allclose(es, x @ x.T)
+
+
+def test_resnet_style_block_under_jit():
+    """conv -> bn -> relu -> pool -> reshape -> matmul, jitted end to end."""
+    import jax
+
+    g = _graph()
+    _node(g, "x", "Placeholder", dtype=DT.DT_FLOAT)
+    rng = np.random.default_rng(1)
+    _const(g, "w", rng.standard_normal((3, 3, 1, 4)).astype(np.float32) * 0.1)
+    _node(g, "c", "Conv2D", ["x", "w"], strides=[1, 1, 1, 1], padding="SAME")
+    for nm, v in [("scale", np.ones(4, np.float32)),
+                  ("off", np.zeros(4, np.float32)),
+                  ("mean", np.zeros(4, np.float32)),
+                  ("var", np.ones(4, np.float32))]:
+        _const(g, nm, v)
+    _node(g, "bn", "FusedBatchNormV3", ["c", "scale", "off", "mean", "var"])
+    _node(g, "r", "Relu", ["bn"])
+    _node(g, "p", "MaxPool", ["r"], ksize=[1, 4, 4, 1],
+          strides=[1, 4, 4, 1], padding="VALID")
+    _const(g, "shape2", np.array([-1, 4], np.int32))
+    _node(g, "flat", "Reshape", ["p", "shape2"])
+    _const(g, "wd", rng.standard_normal((4, 3)).astype(np.float32))
+    _node(g, "logits", "MatMul", ["flat", "wd"])
+
+    fn = GraphFunction(g, ["x:0"], ["logits:0"])
+    x = rng.standard_normal((2, 4, 4, 1)).astype(np.float32)
+    eager = np.asarray(fn([x], jnp)[0])
+    jitted = np.asarray(jax.jit(lambda v: fn([v], jnp)[0])(x))
+    assert eager.shape == (2, 3)
+    np.testing.assert_allclose(eager, jitted, rtol=1e-5, atol=1e-5)

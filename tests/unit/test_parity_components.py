@@ -1,0 +1,169 @@
+"""Small parity components: routers, static source, PeriodicFunction,
+executors, observer, curried signatures, channel args, version stamp."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from min_tfs_client_tpu.core.fs_source import StaticStoragePathSource
+from min_tfs_client_tpu.core.router import (
+    DynamicSourceRouter,
+    StaticSourceRouter,
+)
+from min_tfs_client_tpu.server.server import _parse_channel_arguments
+from min_tfs_client_tpu.server.version import version_string
+from min_tfs_client_tpu.servables.curried import curry_signature
+from min_tfs_client_tpu.servables.servable import Signature, TensorSpec
+from min_tfs_client_tpu.utils.executor import InlineExecutor, ThreadPoolExecutor
+from min_tfs_client_tpu.utils.observer import Observer
+from min_tfs_client_tpu.utils.periodic import PeriodicFunction
+from min_tfs_client_tpu.utils.status import ServingError
+
+
+# -- routers -----------------------------------------------------------------
+
+
+def _collecting_ports(router, n):
+    seen = {i: [] for i in range(n)}
+    for i in range(n):
+        router.set_output_callback(
+            i, lambda name, v, i=i: seen[i].append((name, list(v))))
+    return seen
+
+
+def test_static_source_router_substring_and_default():
+    r = StaticSourceRouter(["tflite", "tpu"])
+    seen = _collecting_ports(r, 3)
+    cb = r.aspired_versions_callback()
+    cb("model_tflite_a", [(1, "/a")])
+    cb("tpu_model", [(2, "/b")])
+    cb("plain", [(3, "/c")])
+    assert seen[0] == [("model_tflite_a", [(1, "/a")])]
+    assert seen[1] == [("tpu_model", [(2, "/b")])]
+    assert seen[2] == [("plain", [(3, "/c")])]
+
+
+def test_dynamic_source_router_reconfiguration():
+    r = DynamicSourceRouter(3, {"a": 0, "b": 1})
+    seen = _collecting_ports(r, 3)
+    cb = r.aspired_versions_callback()
+    cb("a", [(1, "/a")])
+    cb("unmapped", [(9, "/u")])
+    r.update_routes({"a": 1})
+    cb("a", [(2, "/a2")])
+    assert seen[0] == [("a", [(1, "/a")])]
+    assert seen[1] == [("a", [(2, "/a2")])]
+    assert seen[2] == [("unmapped", [(9, "/u")])]
+    with pytest.raises(ValueError, match="default"):
+        r.update_routes({"x": 2})  # last port is reserved for default
+
+
+def test_static_storage_path_source_emits_once():
+    src = StaticStoragePathSource("m", 7, "/models/m/7")
+    got = []
+    src.set_aspired_versions_callback(lambda n, v: got.append((n, list(v))))
+    assert got == [("m", [(7, "/models/m/7")])]
+
+
+# -- periodic function -------------------------------------------------------
+
+
+def test_periodic_function_runs_and_stops():
+    hits = []
+    pf = PeriodicFunction(lambda: hits.append(time.monotonic()),
+                          interval_s=0.02)
+    time.sleep(0.15)
+    pf.stop()
+    count = len(hits)
+    assert count >= 3
+    time.sleep(0.06)
+    assert len(hits) == count  # nothing fires after stop
+
+
+def test_periodic_function_survives_errors():
+    hits = []
+    errors = []
+
+    def boom():
+        hits.append(1)
+        raise RuntimeError("x")
+
+    pf = PeriodicFunction(boom, interval_s=0.01, on_error=errors.append)
+    time.sleep(0.08)
+    pf.stop()
+    assert len(hits) >= 2 and len(errors) == len(hits)
+
+
+def test_periodic_function_rejects_bad_interval():
+    with pytest.raises(ValueError):
+        PeriodicFunction(lambda: None, interval_s=0)
+
+
+# -- executors / observer ----------------------------------------------------
+
+
+def test_inline_executor_runs_on_caller_thread():
+    tid = []
+    InlineExecutor().schedule(lambda: tid.append(threading.get_ident()))
+    assert tid == [threading.get_ident()]
+
+
+def test_threadpool_executor_runs_async():
+    done = threading.Event()
+    pool = ThreadPoolExecutor(2)
+    pool.schedule(done.set)
+    assert done.wait(2.0)
+    pool.shutdown()
+
+
+def test_observer_notifier_goes_dead_after_close():
+    got = []
+    obs = Observer(got.append)
+    notify = obs.notifier()
+    notify(1)
+    obs.close()
+    notify(2)
+    assert got == [1]
+
+
+# -- curried signature -------------------------------------------------------
+
+
+def test_curry_signature_binds_fixed_inputs():
+    def fn(inputs):
+        return {"y": inputs["x"] * inputs["scale"]}
+
+    sig = Signature(
+        fn=fn,
+        inputs={"x": TensorSpec(np.float32, (None,)),
+                "scale": TensorSpec(np.float32, ())},
+        outputs={"y": TensorSpec(np.float32, (None,))},
+        batched=False,
+    )
+    curried = curry_signature(sig, {"scale": np.float32(3.0)})
+    assert set(curried.inputs) == {"x"}
+    out = curried.run({"x": np.array([1.0, 2.0], np.float32)})
+    np.testing.assert_allclose(out["y"], [3.0, 6.0])
+    # Original is untouched and unknown aliases are rejected.
+    assert set(sig.inputs) == {"x", "scale"}
+    with pytest.raises(ServingError, match="not in signature"):
+        curry_signature(sig, {"nope": 1})
+
+
+# -- channel args / version --------------------------------------------------
+
+
+def test_parse_channel_arguments():
+    assert _parse_channel_arguments("") == []
+    assert _parse_channel_arguments(
+        "grpc.max_send_message_length=4194304,grpc.lb_policy_name=pick_first"
+    ) == [("grpc.max_send_message_length", 4194304),
+          ("grpc.lb_policy_name", "pick_first")]
+    with pytest.raises(ServingError, match="key=value"):
+        _parse_channel_arguments("bogus")
+
+
+def test_version_string():
+    assert "tpu_model_server" in version_string()
