@@ -24,6 +24,7 @@ PROTO_FILES = [
     "tf_example.proto",
     "tf_error.proto",
     "tf_graph.proto",
+    "tf_bundle.proto",
     "tf_config.proto",
     "tfs_config.proto",
     "tfs_apis.proto",

@@ -8,9 +8,11 @@ jit-compile straight onto the TPU (the op set below lowers to XLA 1:1), and
 signatures touching DT_STRING run on host exactly where the reference runs
 string kernels on CPU.
 
-Scope: inference graphs of the op set below, with variables already frozen
-to Const (TF1 checkpoint tensor_bundle restore is a planned follow-up).
-SavedModel tag/signature semantics follow loader.cc + predict_util.cc.
+Scope: inference graphs of the op set below. Variables may be frozen to
+Const OR live in a `variables/` checkpoint bundle — the bundle is restored
+into host arrays at load (servables/tensor_bundle.py; the loader.cc:198
+RunRestore equivalent, without executing restore ops). SavedModel
+tag/signature semantics follow loader.cc + predict_util.cc.
 """
 
 from __future__ import annotations
@@ -449,12 +451,32 @@ OPS: dict[str, Callable] = {
     "FloorDiv": _binop(lambda lib, a, b: lib.floor_divide(a, b)),
     "FloorMod": _binop(lambda lib, a, b: lib.mod(a, b)),
     "Prod": _reduce("prod"),
+    # Variable reads: the variable nodes themselves resolve to checkpoint
+    # tensors during _scan (restored via servables/tensor_bundle.py — the
+    # RunRestore parity path, loader.cc:198); ReadVariableOp then just
+    # forwards the resolved handle value.
+    "ReadVariableOp": lambda n, i, lib: [i[0]],
 }
+
+_VARIABLE_OPS = ("VariableV2", "Variable", "VarHandleOp")
+_CKPT_VALUE_SUFFIX = "/.ATTRIBUTES/VARIABLE_VALUE"
 
 # Ops legal in host (string-carrying) mode only as pass-throughs.
 _HOST_SAFE_OPS = {"Identity", "StopGradient", "Snapshot", "NoOp", "Placeholder",
                   "PlaceholderWithDefault", "Const", "Pack", "ConcatV2",
                   "Reshape", "ExpandDims", "Squeeze"}
+
+
+def _variable_lookup(variables: Mapping[str, np.ndarray]
+                     ) -> dict[str, np.ndarray]:
+    """Checkpoint keys -> variable-name lookup table. TF1 savers key by the
+    variable op name directly; TF2 object-graph checkpoints append
+    '/.ATTRIBUTES/VARIABLE_VALUE' — index both spellings."""
+    table = dict(variables)
+    for key, value in variables.items():
+        if key.endswith(_CKPT_VALUE_SUFFIX):
+            table.setdefault(key[: -len(_CKPT_VALUE_SUFFIX)], value)
+    return table
 
 
 def _tensor_name(ref: str) -> tuple[str, int]:
@@ -473,12 +495,14 @@ class GraphFunction:
 
     def __init__(self, graph_def: tf_graph_pb2.GraphDef,
                  feed_names: Sequence[str], fetch_names: Sequence[str],
-                 target_names: Sequence[str] = ()):
+                 target_names: Sequence[str] = (),
+                 variables: Mapping[str, np.ndarray] | None = None):
         self._nodes = {n.name: n for n in graph_def.node}
         self._feeds = [_tensor_name(f) for f in feed_names]
         self._fetches = [_tensor_name(f) for f in fetch_names]
         self._targets = [_tensor_name(t)[0] for t in target_names]
         self._consts: dict[str, np.ndarray] = {}
+        self._variables = _variable_lookup(variables or {})
         self.has_string = self._scan(graph_def)
 
     def _scan(self, graph_def) -> bool:
@@ -504,6 +528,14 @@ class GraphFunction:
                 self._consts[name] = tensor_proto_to_ndarray(
                     node.attr["value"].tensor)
                 continue
+            if node.op in _VARIABLE_OPS:
+                value = self._resolve_variable(node)
+                if value is None:
+                    raise GraphImportError(
+                        f"variable node {name!r} has no tensor in the "
+                        "checkpoint bundle (and the graph is not frozen)")
+                self._consts[name] = value
+                continue
             if node.op in ("Placeholder", "PlaceholderWithDefault"):
                 if name not in feeds and node.op == "Placeholder":
                     raise GraphImportError(
@@ -517,6 +549,16 @@ class GraphFunction:
                     continue
                 stack.append(_tensor_name(ref)[0])
         return has_string
+
+    def _resolve_variable(self, node) -> np.ndarray | None:
+        """Checkpoint lookup by node name, then by the VarHandleOp
+        shared_name (TF2 resource variables)."""
+        value = self._variables.get(node.name)
+        if value is None:
+            a = _attr(node, "shared_name")
+            if a is not None and a.s:
+                value = self._variables.get(a.s.decode())
+        return value
 
     def __call__(self, feed_values: Sequence[object], lib) -> list[object]:
         memo: dict[str, list] = {}
@@ -583,6 +625,16 @@ def load_saved_model(
         raise ServingError.not_found(
             f"SavedModel at {path} has no meta graph with tags {sorted(want)}")
 
+    # Un-frozen graphs: restore variables/variables.* straight into host
+    # arrays (the RunRestore step, loader.cc:198, without executing any
+    # restore ops).
+    variables: dict[str, np.ndarray] = {}
+    ckpt_prefix = pathlib.Path(path) / "variables" / "variables"
+    if (ckpt_prefix.parent / "variables.index").is_file():
+        from min_tfs_client_tpu.servables.tensor_bundle import read_bundle
+
+        variables = read_bundle(ckpt_prefix)
+
     signatures: dict[str, Signature] = {}
     for key, sig_def in meta_graph.signature_def.items():
         if not sig_def.inputs or not sig_def.outputs:
@@ -591,7 +643,8 @@ def load_saved_model(
         out_aliases = sorted(sig_def.outputs)
         feed_names = [sig_def.inputs[a].name for a in in_aliases]
         fetch_names = [sig_def.outputs[a].name for a in out_aliases]
-        graph_fn = GraphFunction(meta_graph.graph_def, feed_names, fetch_names)
+        graph_fn = GraphFunction(meta_graph.graph_def, feed_names, fetch_names,
+                                 variables=variables)
 
         in_specs = {a: _spec_from_tensor_info(sig_def.inputs[a])
                     for a in in_aliases}
@@ -632,7 +685,8 @@ def load_saved_model(
     # Raw-graph escape hatch for the SessionService surface
     # (apis/session_service.proto): arbitrary feeds/fetches on the imported
     # graph, GraphFunctions cached per (feeds, fetches) key.
-    servable.session_runner = SessionRunner(meta_graph.graph_def)
+    servable.session_runner = SessionRunner(meta_graph.graph_def,
+                                            variables=variables)
     return servable
 
 
@@ -641,12 +695,15 @@ class SessionRunner:
     # iterating combinations cannot grow server memory without bound.
     MAX_CACHED_PLANS = 32
 
-    def __init__(self, graph_def: tf_graph_pb2.GraphDef):
+    def __init__(self, graph_def: tf_graph_pb2.GraphDef,
+                 variables: Mapping[str, np.ndarray] | None = None):
         import collections
         import threading
 
         self._graph_def = graph_def
-        self._cache: "collections.OrderedDict[tuple, GraphFunction]" =             collections.OrderedDict()
+        self._variables = variables or {}
+        self._cache: "collections.OrderedDict[tuple, GraphFunction]" = \
+            collections.OrderedDict()
         # Serves concurrent gRPC threads: get/move/evict must be atomic or
         # move_to_end can KeyError after a concurrent eviction.
         self._cache_lock = threading.Lock()
@@ -661,7 +718,7 @@ class SessionRunner:
         if graph_fn is None:
             graph_fn = GraphFunction(
                 self._graph_def, list(sorted(feeds)), list(fetches),
-                target_names=targets)
+                target_names=targets, variables=self._variables)
             with self._cache_lock:
                 self._cache[key] = graph_fn
                 if len(self._cache) > self.MAX_CACHED_PLANS:

@@ -148,8 +148,10 @@ class Server:
             SessionServiceImpl(handlers), self._grpc_server)
         self.grpc_port = self._bind(self._grpc_server, opts.grpc_port)
         if opts.grpc_socket_path:
-            self._grpc_server.add_insecure_port(
-                f"unix:{opts.grpc_socket_path}")
+            if not self._grpc_server.add_insecure_port(
+                    f"unix:{opts.grpc_socket_path}"):
+                raise ServingError.unavailable(
+                    f"could not bind UNIX socket {opts.grpc_socket_path}")
         self._grpc_server.start()
 
         if opts.rest_api_port or opts.monitoring_config_file:
