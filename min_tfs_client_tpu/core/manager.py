@@ -226,6 +226,15 @@ class AspiredVersionsManager:
                 for name, streams in self._harnesses.items()
                 for v, h in streams.items() if h.is_serving())
 
+    def states(self, name: str) -> dict[int, tuple]:
+        """Snapshot of one stream: {version: (state, error-or-None)}.
+        The public read API for boot/monitoring helpers (the
+        ServableStateMonitor equivalent of BasicManager's
+        GetManagedServableStateSnapshots)."""
+        with self._lock:
+            return {v: (h.state, h.error)
+                    for v, h in self._harnesses.get(name, {}).items()}
+
     def get_servable_handle(
         self, name: str, version: Optional[int] = None, *, earliest: bool = False
     ) -> ServableHandle:

@@ -217,14 +217,13 @@ def load_servables_fast(
     while pending:
         manager.tick()
         for name in list(pending):
-            with manager._lock:
-                streams = manager._harnesses.get(name, {})
-                states = {v: h.state for v, h in streams.items()}
-                errors = [h.error for h in streams.values()
-                          if h.state == HarnessState.ERROR and h.error]
+            snapshot = manager.states(name)
+            errors = [err for state, err in snapshot.values()
+                      if state == HarnessState.ERROR and err]
             if errors:
                 raise errors[0]
-            if any(s == HarnessState.READY for s in states.values()):
+            if any(state == HarnessState.READY
+                   for state, _ in snapshot.values()):
                 pending.discard(name)
         if pending and time.monotonic() > deadline:
             raise ServingError.deadline_exceeded(

@@ -270,15 +270,21 @@ def read_bundle(prefix: str | pathlib.Path, *, verify: bool = True
             if len(raw) != entry.size:
                 raise BundleError(
                     f"tensor {key.decode()!r}: data out of range")
-            if verify and entry.crc32c:
-                if tfrecord.masked_crc32c(raw) != entry.crc32c:
-                    raise BundleError(
-                        f"tensor {key.decode()!r}: data checksum mismatch")
             dt = DataType(int(entry.dtype))
             shape = tuple(int(d.size) for d in entry.shape.dim)
             if dt.is_string:
-                out[key.decode()] = _decode_string_tensor(raw, shape, key)
+                # String tensors have their own crc recipe (over the
+                # fixed-width length values, not the stored varints) —
+                # verified inside the decoder.
+                out[key.decode()] = _decode_string_tensor(
+                    raw, shape, key, verify=verify,
+                    expected_crc=entry.crc32c if verify else 0)
             else:
+                if verify and entry.crc32c:
+                    if tfrecord.masked_crc32c(raw) != entry.crc32c:
+                        raise BundleError(
+                            f"tensor {key.decode()!r}: data checksum "
+                            "mismatch")
                 arr = np.frombuffer(raw, dtype=dt.numpy_dtype)
                 out[key.decode()] = arr.reshape(shape)
     finally:
@@ -312,15 +318,50 @@ def _index_by_variable_name(tensors: dict[str, np.ndarray]) -> None:
                                    tensors[attr.checkpoint_key])
 
 
-def _decode_string_tensor(raw: bytes, shape: tuple, key: bytes) -> np.ndarray:
-    """Bundle string tensors: N varint lengths, then the concatenated
-    bytes (tensor_bundle.cc WriteStringTensor)."""
+def _fixed_width_lengths(lengths: list[int]) -> bytes:
+    """The crc32c for string tensors covers the *fixed-width* length
+    values, not their stored varint encoding: uint32 LE per element when
+    it fits, uint64 LE otherwise (tensor_bundle.cc WriteStringTensor's
+    crc32c::Extend calls)."""
+    out = bytearray()
+    for ln in lengths:
+        out += struct.pack("<I", ln) if ln <= 0xFFFFFFFF else struct.pack(
+            "<Q", ln)
+    return bytes(out)
+
+
+def _decode_string_tensor(raw: bytes, shape: tuple, key: bytes, *,
+                          verify: bool, expected_crc: int) -> np.ndarray:
+    """Bundle string tensors (tensor_bundle.cc WriteStringTensor):
+
+        [varint64 len_0]..[varint64 len_{N-1}]
+        [4-byte masked crc32c over the fixed-width length values]
+        [string_0 bytes]..[string_{N-1} bytes]
+
+    The entry-level crc32c covers fixed-width lengths + the 4 masked
+    length-checksum bytes + the string bytes (NOT the raw stored bytes).
+    """
     n = int(np.prod(shape, dtype=np.int64)) if shape else 1
     lengths = []
     pos = 0
     for _ in range(n):
         ln, pos = _read_varint(raw, pos)
         lengths.append(ln)
+    if pos + 4 > len(raw):
+        raise BundleError(
+            f"tensor {key.decode()!r}: truncated length checksum")
+    cksum_bytes = raw[pos:pos + 4]
+    pos += 4
+    fixed = _fixed_width_lengths(lengths)
+    if verify:
+        (stored_len_crc,) = struct.unpack("<I", cksum_bytes)
+        if stored_len_crc != tfrecord.masked_crc32c(fixed):
+            raise BundleError(
+                f"tensor {key.decode()!r}: length checksum mismatch")
+        if expected_crc and tfrecord.masked_crc32c(
+                fixed + cksum_bytes + raw[pos:]) != expected_crc:
+            raise BundleError(
+                f"tensor {key.decode()!r}: data checksum mismatch")
     out = np.empty((n,), dtype=object)
     for i, ln in enumerate(lengths):
         out[i] = raw[pos:pos + ln]
@@ -349,18 +390,23 @@ def write_bundle(prefix: str | pathlib.Path,
         if arr.dtype == object or arr.dtype.kind in ("U", "S"):
             flat = [v if isinstance(v, bytes) else str(v).encode()
                     for v in arr.reshape(-1).tolist()]
+            fixed = _fixed_width_lengths([len(s) for s in flat])
+            len_cksum = struct.pack("<I", tfrecord.masked_crc32c(fixed))
+            payload = b"".join(flat)
             raw = (b"".join(_write_varint(len(s)) for s in flat) +
-                   b"".join(flat))
+                   len_cksum + payload)
+            crc = tfrecord.masked_crc32c(fixed + len_cksum + payload)
             dtype_enum = DataType("DT_STRING").enum
         else:
             raw = arr.tobytes()
+            crc = tfrecord.masked_crc32c(raw)
             dtype_enum = DataType(arr.dtype.type).enum
         entry = tf_bundle_pb2.BundleEntryProto(
             dtype=dtype_enum,
             shard_id=0,
             offset=len(data),
             size=len(raw),
-            crc32c=tfrecord.masked_crc32c(raw))
+            crc32c=crc)
         for dim in arr.shape:
             entry.shape.dim.add(size=dim)
         data += raw

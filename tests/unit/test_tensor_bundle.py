@@ -29,6 +29,7 @@ def _tensors():
         "dense/bias": rng.standard_normal((3,)).astype(np.float32),
         "step": np.array(7, np.int64),
         "table": rng.integers(0, 100, (5, 2)).astype(np.int32),
+        "words": np.array([b"alpha", b"", b"\xffbin"], object),
     }
 
 
@@ -74,6 +75,7 @@ tensors = {
     "dense/bias": rng.standard_normal((3,)).astype(np.float32),
     "step": np.array(7, np.int64),
     "table": rng.integers(0, 100, (5, 2)).astype(np.int32),
+    "words": [b"alpha", b"", b"\\xffbin"],
 }
 names = sorted(tensors)
 tf.raw_ops.SaveV2(prefix=prefix, tensor_names=names,
@@ -94,8 +96,12 @@ kernel = tf.raw_ops.RestoreV2(prefix=prefix, tensor_names=["dense/kernel"],
 step = tf.raw_ops.RestoreV2(prefix=prefix, tensor_names=["step"],
                             shape_and_slices=[""],
                             dtypes=[tf.int64])[0].numpy()
+words = tf.raw_ops.RestoreV2(prefix=prefix, tensor_names=["words"],
+                             shape_and_slices=[""],
+                             dtypes=[tf.string])[0].numpy()
 np.save(sys.argv[2], kernel)
 assert step == 7, step
+assert list(words) == [b"alpha", b"", b"\\xffbin"], words
 print("READ")
 """
 
@@ -223,6 +229,56 @@ def test_string_tensor_round_trip(tmp_path):
     tb.write_bundle(prefix, {"words": vals})
     got = tb.read_bundle(prefix)
     np.testing.assert_array_equal(got["words"], vals)
+
+
+def test_string_tensor_reference_layout(tmp_path):
+    """Hand-encode a string tensor exactly per tensor_bundle.cc
+    WriteStringTensor — varint lengths, then a 4-byte masked crc32c over
+    the FIXED-WIDTH (uint32 LE) length values, then the string bytes;
+    entry.crc32c over fixed lengths + checksum bytes + string bytes —
+    independent of this module's writer, so a layout regression in either
+    direction fails here."""
+    import struct
+
+    from min_tfs_client_tpu.protos import tf_bundle_pb2
+    from min_tfs_client_tpu.utils import tfrecord
+
+    vals = [b"abc", b"", b"hello"]
+    varints = b"\x03\x00\x05"  # lengths 3, 0, 5 each fit in one varint byte
+    fixed = struct.pack("<III", 3, 0, 5)
+    len_cksum = struct.pack("<I", tfrecord.masked_crc32c(fixed))
+    payload = b"".join(vals)
+    raw = varints + len_cksum + payload
+    entry_crc = tfrecord.masked_crc32c(fixed + len_cksum + payload)
+
+    header = tf_bundle_pb2.BundleHeaderProto(
+        num_shards=1, endianness=tf_bundle_pb2.BundleHeaderProto.LITTLE)
+    entry = tf_bundle_pb2.BundleEntryProto(
+        dtype=7,  # DT_STRING
+        shard_id=0, offset=0, size=len(raw), crc32c=entry_crc)
+    entry.shape.dim.add(size=3)
+    pairs = [(b"", header.SerializeToString()),
+             (b"words", entry.SerializeToString())]
+
+    prefix = tmp_path / "ref"
+    (tmp_path / "ref.data-00000-of-00001").write_bytes(raw)
+    (tmp_path / "ref.index").write_bytes(tb._TableWriter().finish(pairs))
+
+    got = tb.read_bundle(prefix, verify=True)
+    np.testing.assert_array_equal(got["words"], np.array(vals, object))
+
+    # corrupting one payload byte must now be caught by the entry crc
+    bad = bytearray(raw)
+    bad[-1] ^= 0xFF
+    (tmp_path / "ref.data-00000-of-00001").write_bytes(bytes(bad))
+    with pytest.raises(tb.BundleError, match="checksum"):
+        tb.read_bundle(prefix, verify=True)
+
+    # and our own writer must produce byte-identical tensor data
+    tb.write_bundle(tmp_path / "ours" / "v", {"words": np.array(vals, object)})
+    written = (tmp_path / "ours" /
+               "v.data-00000-of-00001").read_bytes()
+    assert written == raw
 
 
 def test_unfrozen_graph_without_checkpoint_errors(tmp_path):
