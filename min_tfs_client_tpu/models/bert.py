@@ -137,7 +137,7 @@ def build_signatures(params: dict, config: BertConfig, *, seq_len: int,
         TensorSpec,
     )
 
-    def predict(inputs):
+    def predict(params, inputs):
         logits = logits_fn(params, config,
                            jnp.asarray(inputs["input_ids"]),
                            jnp.asarray(inputs["attention_mask"]))
@@ -146,6 +146,7 @@ def build_signatures(params: dict, config: BertConfig, *, seq_len: int,
 
     predict_sig = Signature(
         fn=predict,
+        params=params,
         inputs={"input_ids": TensorSpec(np.int32, (None, seq_len)),
                 "attention_mask": TensorSpec(np.int32, (None, seq_len))},
         outputs={"logits": TensorSpec(np.float32, (None, config.num_labels)),
@@ -159,7 +160,7 @@ def build_signatures(params: dict, config: BertConfig, *, seq_len: int,
                                       default=np.ones(seq_len, np.int64)),
     }
 
-    def classify(inputs):
+    def classify(params, inputs):
         logits = logits_fn(params, config,
                            jnp.asarray(inputs["input_ids"], jnp.int32),
                            jnp.asarray(inputs["attention_mask"], jnp.int32))
@@ -167,6 +168,7 @@ def build_signatures(params: dict, config: BertConfig, *, seq_len: int,
 
     classify_sig = Signature(
         fn=classify,
+        params=params,
         inputs={"input_ids": TensorSpec(np.int64, (None, seq_len)),
                 "attention_mask": TensorSpec(np.int64, (None, seq_len))},
         outputs={CLASSIFY_OUTPUT_SCORES: TensorSpec(
@@ -176,7 +178,7 @@ def build_signatures(params: dict, config: BertConfig, *, seq_len: int,
         class_labels=class_labels,
     )
 
-    def regress(inputs):
+    def regress(params, inputs):
         logits = logits_fn(params, config,
                            jnp.asarray(inputs["input_ids"], jnp.int32),
                            jnp.asarray(inputs["attention_mask"], jnp.int32))
@@ -184,6 +186,7 @@ def build_signatures(params: dict, config: BertConfig, *, seq_len: int,
 
     regress_sig = Signature(
         fn=regress,
+        params=params,
         inputs={"input_ids": TensorSpec(np.int64, (None, seq_len)),
                 "attention_mask": TensorSpec(np.int64, (None, seq_len))},
         outputs={REGRESS_OUTPUTS: TensorSpec(np.float32, (None,))},

@@ -124,13 +124,14 @@ def forward(params: dict, config: ResNetConfig, images: jax.Array
 def build_signatures(params: dict, config: ResNetConfig) -> dict:
     from min_tfs_client_tpu.servables.servable import Signature, TensorSpec
 
-    def predict(inputs):
+    def predict(params, inputs):
         logits = forward(params, config, jnp.asarray(inputs["images"]))
         return {"logits": logits,
                 "probabilities": jax.nn.softmax(logits, axis=-1)}
 
     sig = Signature(
         fn=predict,
+        params=params,
         inputs={"images": TensorSpec(
             np.float32,
             (None, config.image_size, config.image_size, 3))},

@@ -239,7 +239,7 @@ def build_signatures(params: dict, config: T5Config, *, seq_len: int,
                      max_decode_len: int) -> dict:
     from min_tfs_client_tpu.servables.servable import Signature, TensorSpec
 
-    def decode_fn(inputs):
+    def decode_fn(params, inputs):
         ids = jnp.asarray(inputs["input_ids"], jnp.int32)
         lengths = jnp.sum((ids != config.pad_id).astype(jnp.int32), axis=-1)
         output_ids, out_lengths = greedy_decode(
@@ -248,6 +248,7 @@ def build_signatures(params: dict, config: T5Config, *, seq_len: int,
 
     decode_sig = Signature(
         fn=decode_fn,
+        params=params,
         inputs={"input_ids": TensorSpec(np.int32, (None, seq_len))},
         outputs={"output_ids": TensorSpec(np.int32, (None, max_decode_len)),
                  "output_lengths": TensorSpec(np.int32, (None,))},
@@ -255,7 +256,7 @@ def build_signatures(params: dict, config: T5Config, *, seq_len: int,
         batch_buckets=(1, 4, 16, 32),
     )
 
-    def encode_sig_fn(inputs):
+    def encode_sig_fn(params, inputs):
         ids = jnp.asarray(inputs["input_ids"], jnp.int32)
         lengths = jnp.sum((ids != config.pad_id).astype(jnp.int32), axis=-1)
         return {"encodings": encode(params, config, ids, lengths).astype(
@@ -263,6 +264,7 @@ def build_signatures(params: dict, config: T5Config, *, seq_len: int,
 
     encode_sig = Signature(
         fn=encode_sig_fn,
+        params=params,
         inputs={"input_ids": TensorSpec(np.int32, (None, seq_len))},
         outputs={"encodings": TensorSpec(
             np.float32, (None, seq_len, config.d_model))},

@@ -131,10 +131,12 @@ def build_signatures(params: dict, config: USEConfig, *,
                      batch_buckets=(1, 2, 4, 8, 16, 32)) -> dict:
     from min_tfs_client_tpu.servables.servable import Signature, TensorSpec
 
+    # params ride as a jit argument (not a closure) so TP/DP placements on
+    # the leaves survive partitioning — see servable.Signature.params.
     device_fn = jax.jit(
-        lambda ids, lengths: encode(params, config, ids, lengths))
+        lambda params, ids, lengths: encode(params, config, ids, lengths))
 
-    def host_fn(inputs):
+    def host_fn(params, inputs):
         texts = np.asarray(inputs["text"], object).reshape(-1)
         n = len(texts)
         ids, lengths = tokenize_batch(texts, config)
@@ -145,11 +147,12 @@ def build_signatures(params: dict, config: USEConfig, *,
             ids = np.concatenate([ids, np.repeat(ids[:1], padded - n, 0)])
             lengths = np.concatenate(
                 [lengths, np.repeat(lengths[:1], padded - n)])
-        emb = np.asarray(device_fn(ids, lengths))[:n]
+        emb = np.asarray(device_fn(params, ids, lengths))[:n]
         return {"embeddings": emb}
 
     sig = Signature(
         fn=host_fn,
+        params=params,
         inputs={"text": TensorSpec(object, (None,))},
         outputs={"embeddings": TensorSpec(
             np.float32, (None, config.embed_dim))},

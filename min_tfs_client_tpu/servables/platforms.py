@@ -53,6 +53,21 @@ def make_loader(
         servable.name = name
         servable.version = version
         config = platform_config or {}
+        # Server-level mesh ("mesh_axes": {"data": -1, ...}): every batched
+        # device signature serves data-parallel over it. Exports with their
+        # own TP sharding config already attached a mesh at build; the
+        # server mesh fills in for servables without one (incl. imported
+        # GraphDefs, whose consts GSPMD replicates across the mesh).
+        mesh_axes = config.get("mesh_axes")
+        if mesh_axes:
+            from min_tfs_client_tpu.parallel.mesh import make_mesh
+            from min_tfs_client_tpu.servables.servable import attach_mesh
+
+            try:
+                mesh = make_mesh({k: int(v) for k, v in mesh_axes.items()})
+            except ValueError:
+                mesh = None  # fewer devices than the mesh asks: single-chip
+            attach_mesh(servable, mesh, only_if_absent=True)
         batching = config.get("batching_parameters")
         if batching is not None:
             from min_tfs_client_tpu.batching.session import apply_batch_buckets

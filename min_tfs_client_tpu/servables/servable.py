@@ -68,11 +68,24 @@ class TensorSpec:
 
 @dataclass
 class Signature:
-    """One named entry point of a servable."""
+    """One named entry point of a servable.
 
-    fn: Callable[[dict[str, object]], dict[str, object]]
+    When `params` is set, `fn(params, inputs)` and the param pytree is
+    passed as a jit ARGUMENT — mandatory for sharded serving: a pytree
+    merely closed over is inlined into the jaxpr as compile-time
+    constants, which GSPMD is then free to replicate per shard, silently
+    discarding the tensor-parallel placement (and baking a full copy of
+    the weights into the executable). As arguments, the leaves'
+    NamedShardings constrain the partitioner and the ICI collectives are
+    emitted. `params=None` keeps the plain `fn(inputs)` closure contract
+    (GraphDef-imported consts, host signatures, toy fixtures).
+    """
+
+    fn: Callable[..., dict[str, object]]
     inputs: dict[str, TensorSpec]
     outputs: dict[str, TensorSpec]
+    params: Optional[object] = dc_field(default=None, repr=False,
+                                        compare=False)
     method_name: str = PREDICT_METHOD_NAME
     # Example parsing spec for Classify/Regress/MultiInference surfaces.
     feature_specs: Optional[dict[str, FeatureSpec]] = None
@@ -84,6 +97,14 @@ class Signature:
     batch_buckets: Sequence[int] = DEFAULT_BATCH_BUCKETS
     # Optional class-id -> label vocabulary for classification outputs.
     class_labels: Optional[Sequence[bytes]] = None
+    # Optional jax.sharding.Mesh: formed batches are device_put with the
+    # batch dim sharded over the mesh's "data" axis before execution
+    # (TP'd params carry their own shardings; GSPMD emits the ICI
+    # collectives). This is the batching->mesh handoff the reference's
+    # batching_session.h:178-215 hands to Session::Run — here it lands on
+    # the mesh (SURVEY.md §7.6).
+    mesh: Optional[object] = dc_field(default=None, repr=False,
+                                      compare=False)
 
     _jitted: Callable | None = dc_field(default=None, repr=False, compare=False)
 
@@ -93,6 +114,18 @@ class Signature:
 
             self._jitted = jax.jit(self.fn)
         return self._jitted
+
+    def _execute(self, arrays: dict) -> dict:
+        if self.params is not None:
+            return self.jitted()(self.params, arrays)
+        return self.jitted()(arrays)
+
+    def _data_axis_size(self) -> int:
+        if self.mesh is None:
+            return 1
+        from min_tfs_client_tpu.parallel.mesh import DATA_AXIS
+
+        return int(dict(self.mesh.shape).get(DATA_AXIS, 1))
 
     # -- execution -----------------------------------------------------------
 
@@ -144,7 +177,8 @@ class Signature:
         arrays = self.validate(inputs, output_filter)
 
         if self.on_host:
-            outputs = self.fn(arrays)
+            outputs = (self.fn(self.params, arrays)
+                       if self.params is not None else self.fn(arrays))
         else:
             outputs = self._run_device(arrays)
 
@@ -159,7 +193,7 @@ class Signature:
 
     def _run_device(self, arrays: dict[str, np.ndarray]) -> dict[str, object]:
         if not self.batched or not arrays:
-            return self.jitted()(arrays)
+            return self._execute(arrays)
         batch = next(iter(arrays.values())).shape[0]
         for alias, arr in arrays.items():
             if arr.shape[0] != batch:
@@ -175,14 +209,29 @@ class Signature:
                     [arr, np.repeat(arr[:1], padded_batch - batch, axis=0)])
                 for alias, arr in arrays.items()
             }
-        outputs = self.jitted()(arrays)
+        if self.mesh is not None:
+            arrays = self._shard_inputs(arrays)
+        outputs = self._execute(arrays)
         return {k: np.asarray(v)[:batch] for k, v in outputs.items()}
 
+    def _shard_inputs(self, arrays: dict[str, np.ndarray]) -> dict:
+        """Place the padded batch on the mesh, dim 0 over the data axis
+        (parallel.mesh.shard_batch; its pad-to-multiple is a no-op here
+        since round_up_batch already chose an ndata-divisible bucket).
+        GSPMD then propagates through the jit: TP'd params keep their
+        load-time shardings, activations follow the data."""
+        from min_tfs_client_tpu.parallel.mesh import shard_batch
+
+        return shard_batch(self.mesh, arrays)
+
     def round_up_batch(self, batch: int) -> int:
+        """Smallest allowed bucket >= batch; with a mesh, the bucket must
+        also split evenly over the data axis (static per-shard shapes)."""
+        ndata = self._data_axis_size()
         for bucket in self.batch_buckets:
-            if bucket >= batch:
+            if bucket >= batch and bucket % ndata == 0:
                 return bucket
-        return batch  # beyond the largest bucket: compile exact size
+        return -(-batch // ndata) * ndata  # next multiple of ndata
 
     # -- metadata ------------------------------------------------------------
 
@@ -241,3 +290,32 @@ class Servable:
         """Drop jit caches so XLA executables free their HBM."""
         for sig in self.signatures.values():
             sig._jitted = None
+
+
+def attach_mesh(signatures, mesh, *, only_if_absent: bool = False):
+    """Attach a device mesh to every batched device signature so formed
+    batches execute data-parallel over it. Host (string) signatures and
+    unbatched signatures are untouched.
+
+    `signatures` may be a Servable, a name->Signature mapping, or an
+    iterable of Signatures (the single attach rule for platforms.py and
+    models/export.py). only_if_absent keeps a mesh already chosen at
+    export time (TP geometry) over a server-level default. Drops the jit
+    cache on change; idempotent; returns its argument."""
+    if mesh is None:
+        return signatures
+    if isinstance(signatures, Servable):
+        sigs = list(signatures.signatures.values())
+    elif isinstance(signatures, Mapping):
+        sigs = list(signatures.values())
+    else:
+        sigs = list(signatures)
+    for sig in sigs:
+        if sig.on_host or not sig.batched:
+            continue
+        if only_if_absent and sig.mesh is not None:
+            continue
+        if sig.mesh is not mesh:
+            sig.mesh = mesh
+            sig._jitted = None  # re-trace with the new placement
+    return signatures

@@ -39,6 +39,16 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--grpc_max_threads", type=int, default=16)
     p.add_argument("--enable_model_warmup", type=lambda v: v != "false",
                    default=True)
+    p.add_argument("--num_request_iterations_for_warmup", type=int, default=1,
+                   help="replay count per warmup record (ModelWarmupOptions."
+                        "num_request_iterations)")
+    p.add_argument("--synthesize_warmup", action="store_true",
+                   help="synthesize compile-priming requests for models "
+                        "that ship no warmup file")
+    p.add_argument("--mesh_axes", default="",
+                   help='serving device mesh, e.g. "data:-1" or '
+                        '"data:4,model:2"; batched signatures execute '
+                        'data-parallel over it ("" = single device)')
     p.add_argument("--response_tensors_as_content", action="store_true",
                    help="serialize response tensors as tensor_content "
                         "instead of typed fields")
@@ -74,6 +84,9 @@ def options_from_args(args) -> ServerOptions:
         num_unload_threads=args.num_unload_threads,
         grpc_max_threads=args.grpc_max_threads,
         enable_model_warmup=args.enable_model_warmup,
+        warmup_iterations=args.num_request_iterations_for_warmup,
+        synthesize_warmup=args.synthesize_warmup,
+        mesh_axes=args.mesh_axes,
         response_tensors_as_content=args.response_tensors_as_content,
         profiler_port=args.profiler_port,
         grpc_socket_path=args.grpc_socket_path,

@@ -55,7 +55,17 @@ class ServerOptions:
     num_unload_threads: int = 2
     grpc_max_threads: int = 16
     enable_model_warmup: bool = True
+    # ModelWarmupOptions analogues (session_bundle_config.proto): replay
+    # count per record, and whether to synthesize compile-priming requests
+    # when a model ships no warmup file.
+    warmup_iterations: int = 1
+    synthesize_warmup: bool = False
     response_tensors_as_content: bool = False
+    # Serving mesh: "data:-1" or "data:4,model:2" — batched device
+    # signatures execute data-parallel (x tensor-parallel for exports with
+    # a sharding config) over this device mesh. "" = single device. The
+    # reference has no in-server parallelism at all (SURVEY.md §2.11).
+    mesh_axes: str = ""
     # On-demand profiling (reference registers a profiler service on the
     # main server, server.cc:324,339); 0 disables.
     profiler_port: int = 0
@@ -234,8 +244,31 @@ class Server:
             self.core.stop()
 
 
+def _parse_mesh_axes(spec: str) -> dict[str, int]:
+    """"data:4,model:2" -> {"data": 4, "model": 2} (-1 = absorb rest)."""
+    out: dict[str, int] = {}
+    for part in (spec or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, sep, size = part.partition(":")
+        try:
+            out[name] = int(size) if sep else int("")
+        except ValueError:
+            raise ServingError.invalid_argument(
+                f"malformed mesh_axes entry {part!r} (want axis:size)")
+    return out
+
+
 def _platform_configs(opts: ServerOptions, batching) -> dict:
-    shared = {}
+    shared: dict = {
+        "enable_model_warmup": opts.enable_model_warmup,
+        "warmup_iterations": opts.warmup_iterations,
+        "synthesize_warmup": opts.synthesize_warmup,
+    }
     if batching is not None:
         shared["batching_parameters"] = batching
+    mesh_axes = _parse_mesh_axes(opts.mesh_axes)
+    if mesh_axes:
+        shared["mesh_axes"] = mesh_axes
     return {platform: dict(shared) for platform in ("tensorflow", "jax", "tpu")}

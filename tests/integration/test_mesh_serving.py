@@ -1,0 +1,154 @@
+"""Serving-path mesh integration: a Predict formed by the batching
+front-end executes DP x TP sharded over the device mesh (the
+batching->Session::Run handoff of batching_session.h:178-215, landed on a
+jax mesh per SURVEY.md §7.6).
+
+Runs on the 8-device virtual CPU mesh from tests/conftest.py.
+"""
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from min_tfs_client_tpu.core.server_core import (
+    ServerCore,
+    single_model_config,
+)
+from min_tfs_client_tpu.models import bert, export
+from min_tfs_client_tpu.protos import tfs_apis_pb2 as apis
+from min_tfs_client_tpu.protos import tfs_config_pb2
+from min_tfs_client_tpu.server.handlers import Handlers
+from min_tfs_client_tpu.tensor.codec import (
+    ndarray_to_tensor_proto,
+    tensor_proto_to_ndarray,
+)
+
+SEQ = 8
+
+
+def _bert_kwargs(config):
+    return {
+        "vocab_size": config.vocab_size, "hidden_size": config.hidden_size,
+        "num_layers": config.num_layers, "num_heads": config.num_heads,
+        "intermediate_size": config.intermediate_size,
+        "max_position": config.max_position,
+        "num_labels": config.num_labels,
+    }
+
+
+def test_predict_through_batching_executes_dp_tp_on_mesh(tmp_path):
+    config = bert.BertConfig.tiny(num_labels=4)
+    params = bert.init_params(jax.random.PRNGKey(0), config)
+    export.export_servable(
+        tmp_path / "m", 1, "bert", _bert_kwargs(config), params,
+        {"seq_len": SEQ},
+        sharding={"axes": {"data": 4, "model": 2}})
+
+    core = ServerCore(
+        single_model_config("m", str(tmp_path / "m"), platform="jax"),
+        file_system_poll_wait_seconds=0.1,
+        platform_configs={"jax": {
+            "batching_parameters": tfs_config_pb2.BatchingParameters(),
+            "enable_model_warmup": False,
+        }},
+    )
+    try:
+        handlers = Handlers(core)
+        rng = np.random.default_rng(0)
+        ids = rng.integers(0, config.vocab_size, (5, SEQ)).astype(np.int32)
+        mask = np.ones((5, SEQ), np.int32)
+
+        req = apis.PredictRequest()
+        req.model_spec.name = "m"
+        req.inputs["input_ids"].CopyFrom(ndarray_to_tensor_proto(ids))
+        req.inputs["attention_mask"].CopyFrom(ndarray_to_tensor_proto(mask))
+        resp = handlers.predict(req)
+        probs = tensor_proto_to_ndarray(resp.outputs["probabilities"])
+        assert probs.shape == (5, 4)
+        assert np.isfinite(probs).all()
+
+        with core.servable_handle(req.model_spec) as handle:
+            sig = handle.servable.signature("")
+            # the export's sharding config became a serving mesh
+            assert sig.mesh is not None
+            assert dict(sig.mesh.shape) == {"data": 4, "model": 2}
+            # batch rounds to a bucket divisible by the data axis
+            assert sig.round_up_batch(5) % 4 == 0
+
+            # the formed batch lands batch-dim-sharded over "data"
+            arrays = sig.validate(
+                {"input_ids": np.repeat(ids[:1], 8, 0),
+                 "attention_mask": np.repeat(mask[:1], 8, 0)})
+            sharded = sig._shard_inputs(arrays)
+            want = NamedSharding(sig.mesh, P("data"))
+            for arr in sharded.values():
+                assert arr.sharding.is_equivalent_to(want, arr.ndim)
+
+            # the compiled executable really runs collectives (TP params
+            # force cross-device reduction on the row-parallel matmuls)
+            compiled = sig.jitted().lower(sig.params, sharded).compile()
+            hlo = compiled.as_text()
+            assert any(op in hlo for op in
+                       ("all-reduce", "all-gather", "reduce-scatter",
+                        "collective-permute")), hlo[:2000]
+
+        # numerics: mesh-served == single-device reference, same params
+        export.export_servable(
+            tmp_path / "ref", 1, "bert", _bert_kwargs(config), params,
+            {"seq_len": SEQ})
+        ref_sigs = export.load_signatures(tmp_path / "ref" / "1")
+        ref = ref_sigs["serving_default"].run(
+            {"input_ids": ids, "attention_mask": mask})
+        # bf16 compute: TP reduction reordering moves probabilities ~1e-3
+        np.testing.assert_allclose(probs, ref["probabilities"],
+                                   rtol=3e-2, atol=8e-3)
+    finally:
+        core.stop()
+
+
+def test_server_mesh_axes_attaches_dp_mesh_to_unsharded_export(tmp_path):
+    """A server-level mesh ("mesh_axes" platform config / --mesh_axes flag)
+    gives plain exports data-parallel serving with replicated params."""
+    from min_tfs_client_tpu.servables.platforms import make_loader
+
+    config = bert.BertConfig.tiny(num_labels=2)
+    params = bert.init_params(jax.random.PRNGKey(0), config)
+    export.export_servable(
+        tmp_path / "m", 1, "bert", _bert_kwargs(config), params,
+        {"seq_len": SEQ})
+
+    loader = make_loader(
+        "jax", "m", 1, str(tmp_path / "m" / "1"),
+        {"mesh_axes": {"data": -1}, "enable_model_warmup": False})
+    loader.load()
+    try:
+        sig = loader.servable().signature("")
+        assert sig.mesh is not None
+        assert dict(sig.mesh.shape) == {"data": 8}
+        ids = np.ones((3, SEQ), np.int32)
+        out = sig.run({"input_ids": ids, "attention_mask": ids})
+        assert out["probabilities"].shape == (3, 2)
+    finally:
+        loader.unload()
+
+
+def test_mesh_axes_exceeding_devices_falls_back_single_chip(tmp_path):
+    from min_tfs_client_tpu.servables.platforms import make_loader
+
+    config = bert.BertConfig.tiny(num_labels=2)
+    params = bert.init_params(jax.random.PRNGKey(0), config)
+    export.export_servable(
+        tmp_path / "m", 1, "bert", _bert_kwargs(config), params,
+        {"seq_len": SEQ})
+    loader = make_loader(
+        "jax", "m", 1, str(tmp_path / "m" / "1"),
+        {"mesh_axes": {"data": 64}, "enable_model_warmup": False})
+    loader.load()
+    try:
+        sig = loader.servable().signature("")
+        assert sig.mesh is None  # not enough devices: replicated single-chip
+        ids = np.ones((3, SEQ), np.int32)
+        out = sig.run({"input_ids": ids, "attention_mask": ids})
+        assert out["probabilities"].shape == (3, 2)
+    finally:
+        loader.unload()

@@ -221,18 +221,19 @@ def test_exported_servable_loads_tp_sharded(tmp_path):
     assert out["probabilities"].shape == (4, config.num_labels)
     np.testing.assert_allclose(out["probabilities"].sum(-1), 1.0, rtol=1e-3)
 
-    # The loaded signature must actually hold mesh-sharded params.
-    closure_params = sig.fn.__closure__
+    # The loaded signature must actually hold mesh-sharded params — as jit
+    # ARGUMENTS (sig.params), not closure constants, or GSPMD would inline
+    # and replicate them (see servable.Signature.params).
+    assert sig.params is not None
     found_sharded = False
-    for cell in closure_params or ():
-        leaves = jax.tree_util.tree_leaves(cell.cell_contents) \
-            if isinstance(cell.cell_contents, dict) else []
-        for leaf in leaves:
-            sharding = getattr(leaf, "sharding", None)
-            if sharding is not None and getattr(sharding, "mesh", None) is \
-                    not None and sharding.mesh.size == 8:
-                found_sharded = True
+    for leaf in jax.tree_util.tree_leaves(sig.params):
+        sharding = getattr(leaf, "sharding", None)
+        if sharding is not None and getattr(sharding, "mesh", None) is \
+                not None and sharding.mesh.size == 8:
+            found_sharded = True
     assert found_sharded
+    # and the serving mesh rides along for batch-dim DP placement
+    assert sig.mesh is not None and sig.mesh.size == 8
 
 
 def test_exported_servable_sharding_falls_back_gracefully(tmp_path):
