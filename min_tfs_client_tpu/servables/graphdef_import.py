@@ -461,6 +461,257 @@ OPS: dict[str, Callable] = {
 _VARIABLE_OPS = ("VariableV2", "Variable", "VarHandleOp")
 _CKPT_VALUE_SUFFIX = "/.ATTRIBUTES/VARIABLE_VALUE"
 
+# TF2 function-calling graphs (loader.cc:166-324 loads these through the
+# FunctionLibraryRuntime; here the FunctionDefLibrary is interpreted
+# directly): call ops take their callee from a func-valued attr; control
+# flow carries cond/body (While) or then/else (If) function attrs and maps
+# onto lax.while_loop / lax.cond on the device path.
+_FUNCTION_CALL_OPS = ("PartitionedCall", "StatefulPartitionedCall")
+_WHILE_OPS = ("StatelessWhile", "While")
+_IF_OPS = ("StatelessIf", "If")
+
+# Multi-output ops: output-arg name -> flat index base, for resolving
+# function-body tensor refs of the form "node:out_name:k". Ops absent here
+# are single-output (flat index = k). List-valued outputs (Split's
+# "output") are the op's only output arg, so base 0 + k is exact.
+_OP_OUTPUT_ARGS: dict[str, tuple[str, ...]] = {
+    "Split": ("output",),
+    "SplitV": ("output",),
+    "Unpack": ("output",),
+    "FusedBatchNorm": ("y", "batch_mean", "batch_variance",
+                       "reserve_space_1", "reserve_space_2"),
+    "FusedBatchNormV2": ("y", "batch_mean", "batch_variance",
+                         "reserve_space_1", "reserve_space_2"),
+    "FusedBatchNormV3": ("y", "batch_mean", "batch_variance",
+                         "reserve_space_1", "reserve_space_2",
+                         "reserve_space_3"),
+}
+
+
+def _out_flat_index(op: str, out_name: str, k: int) -> int:
+    names = _OP_OUTPUT_ARGS.get(op)
+    if names is None or out_name not in names:
+        return k
+    return names.index(out_name) + k
+
+
+def _func_attr_name(node, key: str) -> str:
+    a = _attr(node, key)
+    if a is None or not a.func.name:
+        raise GraphImportError(
+            f"{node.op} node {node.name!r} is missing function attr {key!r}")
+    return a.func.name
+
+
+def _eval_while(node, args, lib, funclib):
+    cond = _func_attr_name(node, "cond")
+    body = _func_attr_name(node, "body")
+    if lib is np:
+        vals = list(args)
+        while bool(np.asarray(funclib.call(cond, vals, lib)[0]).reshape(())):
+            vals = list(funclib.call(body, vals, lib))
+        return vals
+    import jax.numpy as jnp
+    from jax import lax
+
+    init = tuple(jnp.asarray(a) for a in args)
+
+    def cond_f(carry):
+        return jnp.reshape(funclib.call(cond, list(carry), lib)[0], ())
+
+    def body_f(carry):
+        outs = funclib.call(body, list(carry), lib)
+        # dtype discipline: TF While requires body output types == carry
+        # types; re-assert so numpy consts inside the body can't weaken
+        return tuple(jnp.asarray(o).astype(c.dtype)
+                     for o, c in zip(outs, carry))
+
+    return list(lax.while_loop(cond_f, body_f, init))
+
+
+def _eval_if(node, args, lib, funclib):
+    then_name = _func_attr_name(node, "then_branch")
+    else_name = _func_attr_name(node, "else_branch")
+    pred, rest = args[0], list(args[1:])
+    if lib is np:
+        branch = then_name if bool(np.asarray(pred).reshape(())) else else_name
+        return list(funclib.call(branch, rest, lib))
+    import jax.numpy as jnp
+    from jax import lax
+
+    operands = tuple(jnp.asarray(r) for r in rest)
+
+    def make_branch(name):
+        def run(ops):
+            return tuple(jnp.asarray(o)
+                         for o in funclib.call(name, list(ops), lib))
+        return run
+
+    return list(lax.cond(jnp.reshape(jnp.asarray(pred), ()).astype(bool),
+                         make_branch(then_name), make_branch(else_name),
+                         operands))
+
+
+class _FunctionEvaluator:
+    """Evaluates one FunctionDef body. Tensor refs inside a function body
+    use the 3-part form 'node:out_name:idx' (2-part 'node:idx' graph style
+    and bare arg names also accepted); outputs come from the ret map in
+    signature.output_arg order."""
+
+    def __init__(self, fdef, funclib: "_FuncLib"):
+        self._fdef = fdef
+        self._funclib = funclib
+        self._nodes = {n.name: n for n in fdef.node_def}
+        self._arg_names = [a.name for a in fdef.signature.input_arg]
+        self._rets = [fdef.ret[o.name] for o in fdef.signature.output_arg]
+        self._consts: dict[str, np.ndarray] = {}
+        self.has_string = False
+        self._scanned = False
+
+    @property
+    def name(self) -> str:
+        return self._fdef.signature.name
+
+    def scan(self) -> bool:
+        """Validate ops + decode consts once; returns has_string. Runs
+        under the owning _FuncLib's lock; the early _scanned flag only
+        guards same-thread recursion (self/mutually-recursive functions)."""
+        if self._scanned:
+            return self.has_string
+        self._scanned = True
+        for node in self._fdef.node_def:
+            for key in ("dtype", "T"):
+                a = _attr(node, key)
+                if a is not None and a.type == DT_STRING:
+                    self.has_string = True
+            if node.op == "Const":
+                self._consts[node.name] = tensor_proto_to_ndarray(
+                    node.attr["value"].tensor)
+                continue
+            called = _scan_node_functions(node, self._funclib)
+            if called is not None:
+                self.has_string |= called
+            elif node.op not in OPS:
+                raise GraphImportError(
+                    f"unsupported op {node.op!r} (node {node.name!r} in "
+                    f"function {self.name!r})")
+        return self.has_string
+
+    def __call__(self, args: Sequence[object], lib) -> list[object]:
+        if len(args) != len(self._arg_names):
+            raise GraphImportError(
+                f"function {self.name!r} expects {len(self._arg_names)} "
+                f"args, got {len(args)}")
+        arg_memo = dict(zip(self._arg_names, args))
+        memo: dict[str, list] = {}
+
+        def eval_node(name: str) -> list:
+            if name in memo:
+                return memo[name]
+            if name in self._consts:
+                memo[name] = [self._consts[name]]
+                return memo[name]
+            node = self._nodes.get(name)
+            if node is None:
+                raise GraphImportError(
+                    f"function {self.name!r} references unknown node "
+                    f"{name!r}")
+            vals = []
+            for ref in node.input:
+                if ref.startswith("^"):
+                    dep = ref[1:]
+                    if dep not in arg_memo:
+                        eval_node(dep)  # control dep: force evaluation
+                    continue
+                vals.append(resolve(ref))
+            memo[name] = _dispatch(node, vals, lib, self._funclib)
+            return memo[name]
+
+        def resolve(ref: str) -> object:
+            parts = ref.split(":")
+            name = parts[0]
+            if name in arg_memo:
+                return arg_memo[name]
+            outs = eval_node(name)
+            node = self._nodes[name]
+            if len(parts) == 1:
+                idx = 0
+            elif len(parts) == 2:
+                idx = (int(parts[1]) if parts[1].isdigit()
+                       else _out_flat_index(node.op, parts[1], 0))
+            else:
+                idx = _out_flat_index(node.op, parts[1], int(parts[2]))
+            return outs[idx]
+
+        return [resolve(ref) for ref in self._rets]
+
+
+class _FuncLib:
+    """FunctionDefLibrary wrapper: name -> cached _FunctionEvaluator.
+
+    Shared across signatures and SessionRunner plans, which serve
+    concurrent gRPC threads — get/scan hold an RLock so a half-finished
+    scan on one thread is never observed as complete on another (the
+    recursive same-thread scans of nested functions re-enter the lock)."""
+
+    def __init__(self, library):
+        import threading
+
+        self._defs = {f.signature.name: f
+                      for f in (library.function if library else ())}
+        self._evaluators: dict[str, _FunctionEvaluator] = {}
+        self._lock = threading.RLock()
+
+    def _get(self, name: str) -> _FunctionEvaluator:
+        ev = self._evaluators.get(name)
+        if ev is None:
+            fdef = self._defs.get(name)
+            if fdef is None:
+                raise GraphImportError(
+                    f"graph calls unknown function {name!r}; library has: "
+                    f"{sorted(self._defs)}")
+            ev = self._evaluators[name] = _FunctionEvaluator(fdef, self)
+        return ev
+
+    def scan(self, name: str) -> bool:
+        with self._lock:
+            return self._get(name).scan()
+
+    def call(self, name: str, args: Sequence[object], lib) -> list[object]:
+        with self._lock:
+            ev = self._get(name)
+            ev.scan()  # no-op when already scanned; required for evaluators
+            # first reached at eval time (e.g. a branch functions tree)
+        return ev(args, lib)
+
+
+def _scan_node_functions(node, funclib: _FuncLib):
+    """Scan the functions a node carries; None when it carries none.
+    The single place listing function-valued attrs per op (shared by
+    GraphFunction._scan and _FunctionEvaluator.scan, mirroring how
+    _dispatch unifies the eval side)."""
+    if node.op in _FUNCTION_CALL_OPS:
+        return funclib.scan(_func_attr_name(node, "f"))
+    if node.op in _WHILE_OPS:
+        return (funclib.scan(_func_attr_name(node, "cond"))
+                | funclib.scan(_func_attr_name(node, "body")))
+    if node.op in _IF_OPS:
+        return (funclib.scan(_func_attr_name(node, "then_branch"))
+                | funclib.scan(_func_attr_name(node, "else_branch")))
+    return None
+
+
+def _dispatch(node, args, lib, funclib) -> list[object]:
+    """Shared op dispatch for graph- and function-body evaluation."""
+    op = node.op
+    if op in _FUNCTION_CALL_OPS:
+        return funclib.call(_func_attr_name(node, "f"), args, lib)
+    if op in _WHILE_OPS:
+        return _eval_while(node, args, lib, funclib)
+    if op in _IF_OPS:
+        return _eval_if(node, args, lib, funclib)
+    return OPS[op](node, args, lib)
+
 # Ops legal in host (string-carrying) mode only as pass-throughs.
 _HOST_SAFE_OPS = {"Identity", "StopGradient", "Snapshot", "NoOp", "Placeholder",
                   "PlaceholderWithDefault", "Const", "Pack", "ConcatV2",
@@ -496,13 +747,16 @@ class GraphFunction:
     def __init__(self, graph_def: tf_graph_pb2.GraphDef,
                  feed_names: Sequence[str], fetch_names: Sequence[str],
                  target_names: Sequence[str] = (),
-                 variables: Mapping[str, np.ndarray] | None = None):
+                 variables: Mapping[str, np.ndarray] | None = None,
+                 funclib: "_FuncLib | None" = None):
         self._nodes = {n.name: n for n in graph_def.node}
         self._feeds = [_tensor_name(f) for f in feed_names]
         self._fetches = [_tensor_name(f) for f in fetch_names]
         self._targets = [_tensor_name(t)[0] for t in target_names]
         self._consts: dict[str, np.ndarray] = {}
         self._variables = _variable_lookup(variables or {})
+        self._funclib = funclib or _FuncLib(
+            graph_def.library if graph_def.HasField("library") else None)
         self.has_string = self._scan(graph_def)
 
     def _scan(self, graph_def) -> bool:
@@ -540,10 +794,14 @@ class GraphFunction:
                 if name not in feeds and node.op == "Placeholder":
                     raise GraphImportError(
                         f"placeholder {name!r} is not fed by the signature")
-            elif node.op not in OPS:
-                raise GraphImportError(
-                    f"unsupported op {node.op!r} (node {name!r}); supported: "
-                    f"{sorted(OPS)}")
+            else:
+                called = _scan_node_functions(node, self._funclib)
+                if called is not None:
+                    has_string |= called
+                elif node.op not in OPS:
+                    raise GraphImportError(
+                        f"unsupported op {node.op!r} (node {name!r}); "
+                        f"supported: {sorted(OPS)}")
             for ref in node.input:
                 if ref.startswith("^"):
                     continue
@@ -586,7 +844,7 @@ class GraphFunction:
                     continue
                 dep, idx = _tensor_name(ref)
                 args.append(evaluate(dep)[idx])
-            memo[name] = OPS[node.op](node, args, lib)
+            memo[name] = _dispatch(node, args, lib, self._funclib)
             return memo[name]
 
         for target in self._targets:
@@ -635,6 +893,12 @@ def load_saved_model(
 
         variables = read_bundle(ckpt_prefix)
 
+    # One function library shared by every signature and the SessionRunner
+    # (one scan + one decoded-const set per FunctionDef, not per caller).
+    funclib = _FuncLib(
+        meta_graph.graph_def.library
+        if meta_graph.graph_def.HasField("library") else None)
+
     signatures: dict[str, Signature] = {}
     for key, sig_def in meta_graph.signature_def.items():
         if not sig_def.inputs or not sig_def.outputs:
@@ -644,7 +908,7 @@ def load_saved_model(
         feed_names = [sig_def.inputs[a].name for a in in_aliases]
         fetch_names = [sig_def.outputs[a].name for a in out_aliases]
         graph_fn = GraphFunction(meta_graph.graph_def, feed_names, fetch_names,
-                                 variables=variables)
+                                 variables=variables, funclib=funclib)
 
         in_specs = {a: _spec_from_tensor_info(sig_def.inputs[a])
                     for a in in_aliases}
@@ -686,7 +950,8 @@ def load_saved_model(
     # (apis/session_service.proto): arbitrary feeds/fetches on the imported
     # graph, GraphFunctions cached per (feeds, fetches) key.
     servable.session_runner = SessionRunner(meta_graph.graph_def,
-                                            variables=variables)
+                                            variables=variables,
+                                            funclib=funclib)
     return servable
 
 
@@ -696,12 +961,15 @@ class SessionRunner:
     MAX_CACHED_PLANS = 32
 
     def __init__(self, graph_def: tf_graph_pb2.GraphDef,
-                 variables: Mapping[str, np.ndarray] | None = None):
+                 variables: Mapping[str, np.ndarray] | None = None,
+                 funclib: _FuncLib | None = None):
         import collections
         import threading
 
         self._graph_def = graph_def
         self._variables = variables or {}
+        self._funclib = funclib or _FuncLib(
+            graph_def.library if graph_def.HasField("library") else None)
         self._cache: "collections.OrderedDict[tuple, GraphFunction]" = \
             collections.OrderedDict()
         # Serves concurrent gRPC threads: get/move/evict must be atomic or
@@ -718,7 +986,8 @@ class SessionRunner:
         if graph_fn is None:
             graph_fn = GraphFunction(
                 self._graph_def, list(sorted(feeds)), list(fetches),
-                target_names=targets, variables=self._variables)
+                target_names=targets, variables=self._variables,
+                funclib=self._funclib)
             with self._cache_lock:
                 self._cache[key] = graph_fn
                 if len(self._cache) > self.MAX_CACHED_PLANS:

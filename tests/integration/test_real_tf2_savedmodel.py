@@ -1,0 +1,136 @@
+"""Cross-validation against genuine TF2 exports: tf.Module and Keras
+SavedModels produced by the real `tf.saved_model.save` import and serve
+correctly (loader.cc:166-324 / tensorflow_model_server_test.py:570-670
+parity). TF runs in a subprocess — its descriptor pool collides with this
+package's protos in-process."""
+
+import pathlib
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from min_tfs_client_tpu.servables.graphdef_import import load_saved_model
+
+MODULE_EXPORT = """
+import sys
+import numpy as np
+import tensorflow as tf
+
+class M(tf.Module):
+    def __init__(self):
+        super().__init__()
+        rng = np.random.default_rng(3)
+        self.w = tf.Variable(
+            rng.standard_normal((4, 3)).astype(np.float32), name="w")
+        self.b = tf.Variable(
+            rng.standard_normal((3,)).astype(np.float32), name="b")
+
+    @tf.function(input_signature=[
+        tf.TensorSpec([None, 4], tf.float32, name="x")])
+    def serve(self, x):
+        h = tf.nn.relu(tf.matmul(x, self.w) + self.b)
+        return {"y": tf.nn.softmax(h)}
+
+m = M()
+tf.saved_model.save(m, sys.argv[1], signatures={"serving_default": m.serve})
+np.save(sys.argv[2], m.w.numpy())
+np.save(sys.argv[3], m.b.numpy())
+print("SAVED")
+"""
+
+KERAS_EXPORT = """
+import sys
+import numpy as np
+import tensorflow as tf
+
+tf.keras.utils.set_random_seed(11)
+model = tf.keras.Sequential([
+    tf.keras.layers.Input(shape=(8,), dtype=tf.float32, name="x"),
+    tf.keras.layers.Dense(16, activation="relu", name="hidden"),
+    tf.keras.layers.Dense(4, activation="softmax", name="probs"),
+])
+x = np.random.default_rng(0).standard_normal((6, 8)).astype(np.float32)
+np.save(sys.argv[2], x)
+np.save(sys.argv[3], model(x).numpy())
+
+@tf.function(input_signature=[
+    tf.TensorSpec([None, 8], tf.float32, name="x")])
+def serve(x):
+    return {"probs": model(x)}
+
+tf.saved_model.save(model, sys.argv[1],
+                    signatures={"serving_default": serve})
+print("SAVED")
+"""
+
+
+def _run_tf(script, *args):
+    return subprocess.run(
+        [sys.executable, "-c", script, *args], capture_output=True,
+        text=True, timeout=300,
+        env={"PATH": "/usr/bin:/bin:/usr/local/bin",
+             "CUDA_VISIBLE_DEVICES": "-1", "JAX_PLATFORMS": "cpu",
+             "TF_CPP_MIN_LOG_LEVEL": "3", "HOME": "/root"})
+
+
+@pytest.mark.integration
+def test_real_tf_module_export_serves(tmp_path):
+    wp, bp = str(tmp_path / "w.npy"), str(tmp_path / "b.npy")
+    proc = _run_tf(MODULE_EXPORT, str(tmp_path / "1"), wp, bp)
+    if "SAVED" not in proc.stdout:
+        pytest.skip(f"tensorflow unavailable: {proc.stderr[-400:]}")
+    servable = load_saved_model(str(tmp_path / "1"), "real", 1)
+    sig = servable.signature("")
+    assert not sig.on_host  # numeric graph jits on device
+    w, b = np.load(wp), np.load(bp)
+    x = np.random.default_rng(0).standard_normal((6, 4)).astype(np.float32)
+    out = sig.run({"x": x})
+    h = np.maximum(x @ w + b, 0)
+    want = np.exp(h) / np.exp(h).sum(-1, keepdims=True)
+    np.testing.assert_allclose(out["y"], want, rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.integration
+def test_real_keras_export_serves(tmp_path):
+    xp, yp = str(tmp_path / "x.npy"), str(tmp_path / "y.npy")
+    proc = _run_tf(KERAS_EXPORT, str(tmp_path / "1"), xp, yp)
+    if "SAVED" not in proc.stdout:
+        pytest.skip(f"tensorflow/keras unavailable: {proc.stderr[-400:]}")
+    servable = load_saved_model(str(tmp_path / "1"), "keras", 1)
+    sig = servable.signature("")
+    x, want = np.load(xp), np.load(yp)
+    out = sig.run({"x": x})
+    np.testing.assert_allclose(out["probs"], want, rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.integration
+def test_real_tf2_export_through_server(tmp_path):
+    """Full parity slice: real TF2 export -> this server -> gRPC client."""
+    from min_tfs_client_tpu.client import TensorServingClient
+    from min_tfs_client_tpu.server.server import Server, ServerOptions
+    from min_tfs_client_tpu.tensor.codec import tensor_proto_to_ndarray
+
+    base = tmp_path / "model"
+    base.mkdir()
+    wp, bp = str(tmp_path / "w.npy"), str(tmp_path / "b.npy")
+    proc = _run_tf(MODULE_EXPORT, str(base / "1"), wp, bp)
+    if "SAVED" not in proc.stdout:
+        pytest.skip(f"tensorflow unavailable: {proc.stderr[-400:]}")
+    server = Server(ServerOptions(
+        grpc_port=0, model_name="real", model_base_path=str(base),
+        model_platform="tensorflow",
+        file_system_poll_wait_seconds=0.1)).build_and_start()
+    try:
+        client = TensorServingClient("127.0.0.1", server.grpc_port)
+        x = np.random.default_rng(2).standard_normal((3, 4)).astype(
+            np.float32)
+        resp = client.predict_request("real", {"x": x}, timeout=60)
+        got = tensor_proto_to_ndarray(resp.outputs["y"])
+        w, b = np.load(wp), np.load(bp)
+        h = np.maximum(x @ w + b, 0)
+        want = np.exp(h) / np.exp(h).sum(-1, keepdims=True)
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+    finally:
+        server.stop()

@@ -1,0 +1,103 @@
+"""TF2 function-based SavedModel import: FunctionDefLibrary interpretation,
+PartitionedCall inlining (incl. nesting), ReadVariableOp through captured
+resources, StatelessWhile/StatelessIf -> lax control flow.
+
+Reference behavior: loader.cc:166-324 (function library load + restore),
+tensorflow_model_server_test.py:570-670 (TF2 SavedModel / Keras serving).
+"""
+
+import numpy as np
+import pytest
+
+from min_tfs_client_tpu.servables.graphdef_import import (
+    GraphImportError,
+    load_saved_model,
+)
+from min_tfs_client_tpu.utils.status import ServingError
+from tests import fixtures
+
+
+class TestFunctionCall:
+    def test_nested_partitioned_call_with_resource_variables(self, tmp_path):
+        vdir, (kernel, bias) = fixtures.write_tf2_function_model(tmp_path)
+        servable = load_saved_model(str(vdir), "tf2", 1)
+        sig = servable.signature("")
+        assert not sig.on_host
+        x = np.random.default_rng(0).standard_normal((5, 4)).astype(
+            np.float32)
+        out = sig.run({"x": x})
+        want = np.maximum(x @ kernel + bias, 0)
+        np.testing.assert_allclose(out["y"], want, rtol=1e-5, atol=1e-6)
+
+    def test_function_model_without_checkpoint_errors(self, tmp_path):
+        vdir, _ = fixtures.write_tf2_function_model(tmp_path)
+        for f in (vdir / "variables").iterdir():
+            f.unlink()
+        (vdir / "variables").rmdir()
+        with pytest.raises(ServingError, match="no tensor in the checkpoint"):
+            load_saved_model(str(vdir), "tf2", 1)
+
+    def test_unknown_function_name_errors(self, tmp_path):
+        vdir, _ = fixtures.write_tf2_function_model(tmp_path)
+        from min_tfs_client_tpu.protos import tf_graph_pb2
+
+        pb = vdir / "saved_model.pb"
+        sm = tf_graph_pb2.SavedModel.FromString(pb.read_bytes())
+        del sm.meta_graphs[0].graph_def.library.function[:]
+        pb.write_bytes(sm.SerializeToString())
+        with pytest.raises(GraphImportError, match="unknown function"):
+            load_saved_model(str(vdir), "tf2", 1)
+
+
+class TestControlFlow:
+    def test_stateless_while_doubles_n_times(self, tmp_path):
+        vdir = fixtures.write_tf2_while_model(tmp_path)
+        servable = load_saved_model(str(vdir), "loop", 1)
+        sig = servable.signature("")
+        x = np.array([1.0, 3.0], np.float32)
+        out = sig.run({"x": x, "n": np.int32(3)})
+        np.testing.assert_allclose(out["y"], x * 8.0)
+        # different trip count, same compiled program (dynamic in-loop)
+        out = sig.run({"x": x, "n": np.int32(5)})
+        np.testing.assert_allclose(out["y"], x * 32.0)
+
+    def test_stateless_if_branches(self, tmp_path):
+        vdir = fixtures.write_tf2_if_model(tmp_path)
+        servable = load_saved_model(str(vdir), "cond", 1)
+        sig = servable.signature("")
+        x = np.array([1.0, 2.0], np.float32)
+        np.testing.assert_allclose(
+            sig.run({"x": x, "pred": np.bool_(True)})["y"], x * 2.0)
+        np.testing.assert_allclose(
+            sig.run({"x": x, "pred": np.bool_(False)})["y"], x + 10.0)
+
+
+class TestEndToEnd:
+    def test_tf2_function_model_serves_over_grpc(self, tmp_path):
+        """The VERDICT done-criterion: a TF2 object-graph SavedModel
+        (function-calling graph + variables/ checkpoint) serves through
+        gRPC e2e."""
+        import grpc
+
+        from min_tfs_client_tpu.client import TensorServingClient
+        from min_tfs_client_tpu.server.server import Server, ServerOptions
+        from min_tfs_client_tpu.tensor.codec import tensor_proto_to_ndarray
+
+        _, (kernel, bias) = fixtures.write_tf2_function_model(
+            tmp_path / "tf2")
+        server = Server(ServerOptions(
+            grpc_port=0, model_name="tf2",
+            model_base_path=str(tmp_path / "tf2"),
+            model_platform="tensorflow",
+            file_system_poll_wait_seconds=0.1)).build_and_start()
+        try:
+            client = TensorServingClient("127.0.0.1", server.grpc_port)
+            x = np.random.default_rng(1).standard_normal((3, 4)).astype(
+                np.float32)
+            resp = client.predict_request("tf2", {"x": x}, timeout=60)
+            got = tensor_proto_to_ndarray(resp.outputs["y"])
+            np.testing.assert_allclose(
+                got, np.maximum(x @ kernel + bias, 0), rtol=1e-5, atol=1e-6)
+        finally:
+            server.stop()
+            del grpc  # silence linters; import proves grpc path used

@@ -145,6 +145,39 @@ class TestWarmup:
         servable = loader.servable()
         assert servable.name == "native"
 
+    def test_enable_model_warmup_false_skips_replay(self, tmp_path):
+        """--enable_model_warmup=false (main.cc warmup flag) must actually
+        skip replay — the ServerOptions -> platform-config plumbing."""
+        from min_tfs_client_tpu.server.server import (
+            ServerOptions,
+            _platform_configs,
+        )
+        from min_tfs_client_tpu.servables import platforms
+
+        cfgs = _platform_configs(
+            ServerOptions(enable_model_warmup=False), None)
+        assert cfgs["jax"]["enable_model_warmup"] is False
+        cfgs_on = _platform_configs(
+            ServerOptions(warmup_iterations=3, synthesize_warmup=True), None)
+        assert cfgs_on["tensorflow"] == {
+            "enable_model_warmup": True, "warmup_iterations": 3,
+            "synthesize_warmup": True}
+
+        vdir = fixtures.write_jax_servable(tmp_path / "native")
+        wdir = vdir / "assets.extra"
+        wdir.mkdir()
+        # a warmup record whose replay would fail loudly (bad log type)
+        tfrecord.write_records(
+            wdir / "tf_serving_warmup_requests",
+            [apis.PredictionLog().SerializeToString()])
+        with pytest.raises(ServingError, match="Unsupported log_type"):
+            platforms.make_loader("jax", "native", 1, str(vdir)).load()
+        # disabled warmup never touches the bad file -> load succeeds
+        loader = platforms.make_loader(
+            "jax", "native", 1, str(vdir), cfgs["jax"])
+        loader.load()
+        assert loader.servable().name == "native"
+
 
 class TestRequestLogging:
     def test_sampling(self):
