@@ -1,0 +1,225 @@
+"""Shared batch scheduler: N queues multiplexed onto one worker pool.
+
+Parity with the reference's SharedBatchScheduler + BasicBatchScheduler
+(batching_util/shared_batch_scheduler.h:53-105, basic_batch_scheduler.h):
+
+ * one queue per (model, signature); queues come and go with versions;
+ * a fixed worker pool sized ~= number of accelerator units round-robins
+   mature batches across queues (shared_batch_scheduler.h:53-76);
+ * a batch matures when full (sum of task sizes reaches max_batch_size) or
+   when its oldest task has waited batch_timeout_micros;
+ * Schedule() rejects with UNAVAILABLE when max_enqueued_batches is hit
+   (callers see the reference's "queue full" behavior and may retry via
+   BatchSchedulerRetrier semantics).
+
+The processing callback runs on scheduler threads; batch concat / pad /
+split lives in batching/session.py.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Optional, Sequence
+
+from min_tfs_client_tpu.utils.status import ServingError
+
+
+@dataclass
+class BatchTask:
+    """One caller's unit of work: a dict of arrays sharing batch dim 0."""
+
+    inputs: dict
+    size: int
+    enqueue_time: float = field(default_factory=time.monotonic)
+    # filled by the processor:
+    outputs: dict | None = None
+    error: Exception | None = None
+    done: threading.Event = field(default_factory=threading.Event)
+
+
+@dataclass(frozen=True)
+class QueueOptions:
+    max_batch_size: int = 32
+    batch_timeout_s: float = 0.0
+    max_enqueued_batches: int = 64
+
+
+class BatchQueue:
+    """Accumulates tasks into batches; thread-safe."""
+
+    def __init__(self, name: str, options: QueueOptions,
+                 process: Callable[[list[BatchTask]], None]):
+        self.name = name
+        self.options = options
+        self.process = process
+        self._lock = threading.Lock()
+        self._batches: collections.deque[list[BatchTask]] = collections.deque()
+        self._open_size = 0
+        self.closed = False
+
+    def schedule(self, task: BatchTask) -> None:
+        if task.size > self.options.max_batch_size:
+            raise ServingError.invalid_argument(
+                f"task size {task.size} exceeds max_batch_size "
+                f"{self.options.max_batch_size}")
+        with self._lock:
+            if self.closed:
+                raise ServingError.unavailable(f"queue {self.name} is closed")
+            if not self._batches or \
+                    self._open_size + task.size > self.options.max_batch_size:
+                if len(self._batches) >= self.options.max_enqueued_batches:
+                    raise ServingError.unavailable(
+                        f"batch queue {self.name} is full "
+                        f"({self.options.max_enqueued_batches} batches)")
+                self._batches.append([])
+                self._open_size = 0
+            self._batches[-1].append(task)
+            self._open_size += task.size
+
+    def _pop_mature(self, now: float) -> Optional[list[BatchTask]]:
+        with self._lock:
+            if not self._batches:
+                return None
+            head = self._batches[0]
+            head_size = sum(t.size for t in head)
+            is_last_open = len(self._batches) == 1
+            full = head_size >= self.options.max_batch_size
+            timed_out = head and (
+                now - head[0].enqueue_time >= self.options.batch_timeout_s)
+            if full or (is_last_open and timed_out) or not is_last_open:
+                self._batches.popleft()
+                if is_last_open:
+                    self._open_size = 0
+                return head
+            return None
+
+    def next_deadline(self) -> Optional[float]:
+        with self._lock:
+            if not self._batches or not self._batches[0]:
+                return None
+            return self._batches[0][0].enqueue_time + self.options.batch_timeout_s
+
+    def close(self) -> list[BatchTask]:
+        """Stop accepting work; return stranded tasks for error completion."""
+        with self._lock:
+            self.closed = True
+            stranded = [t for b in self._batches for t in b]
+            self._batches.clear()
+            return stranded
+
+
+class SharedBatchScheduler:
+    """Worker pool draining mature batches from registered queues."""
+
+    def __init__(self, num_threads: int | None = None):
+        if num_threads is None:
+            num_threads = _default_thread_count()
+        self._queues: list[BatchQueue] = []
+        self._lock = threading.Condition()
+        self._stop = False
+        self._rr = 0  # round-robin cursor
+        self._threads = [
+            threading.Thread(target=self._worker, name=f"batch-worker-{i}",
+                             daemon=True)
+            for i in range(num_threads)
+        ]
+        for t in self._threads:
+            t.start()
+
+    def add_queue(self, name: str, options: QueueOptions,
+                  process: Callable[[list[BatchTask]], None]) -> BatchQueue:
+        queue = BatchQueue(name, options, process)
+        with self._lock:
+            self._queues.append(queue)
+            self._lock.notify_all()
+        return queue
+
+    def remove_queue(self, queue: BatchQueue) -> None:
+        stranded = queue.close()
+        with self._lock:
+            if queue in self._queues:
+                self._queues.remove(queue)
+        for task in stranded:
+            task.error = ServingError.unavailable(
+                "servable unloaded while batch was queued")
+            task.done.set()
+
+    def schedule(self, queue: BatchQueue, task: BatchTask) -> None:
+        queue.schedule(task)
+        with self._lock:
+            self._lock.notify()
+
+    def _worker(self) -> None:
+        while True:
+            batch = None
+            queue = None
+            with self._lock:
+                while not self._stop:
+                    now = time.monotonic()
+                    batch, queue = self._find_mature(now)
+                    if batch is not None:
+                        break
+                    timeout = self._nearest_deadline(now)
+                    self._lock.wait(timeout=timeout)
+                if self._stop:
+                    return
+            try:
+                queue.process(batch)
+            except Exception as exc:  # noqa: BLE001 - propagate to waiters
+                for task in batch:
+                    task.error = exc
+            finally:
+                for task in batch:
+                    task.done.set()
+
+    def _find_mature(self, now: float):
+        n = len(self._queues)
+        for i in range(n):
+            queue = self._queues[(self._rr + i) % n]
+            batch = queue._pop_mature(now)
+            if batch:
+                self._rr = (self._rr + i + 1) % max(1, n)
+                return batch, queue
+        return None, None
+
+    def _nearest_deadline(self, now: float) -> Optional[float]:
+        deadlines = [q.next_deadline() for q in self._queues]
+        deadlines = [d for d in deadlines if d is not None]
+        if not deadlines:
+            return None
+        return max(0.0, min(deadlines) - now)
+
+    def stop(self) -> None:
+        with self._lock:
+            self._stop = True
+            self._lock.notify_all()
+        for t in self._threads:
+            t.join(timeout=5.0)
+
+
+def _default_thread_count() -> int:
+    """~ number of accelerator units (shared_batch_scheduler.h:63-76 guidance:
+    batch threads ~= accelerators so batches execute back-to-back)."""
+    try:
+        import jax
+
+        return max(1, len(jax.local_devices()))
+    except Exception:  # pragma: no cover
+        return 2
+
+
+_global_scheduler: SharedBatchScheduler | None = None
+_global_lock = threading.Lock()
+
+
+def global_scheduler() -> SharedBatchScheduler:
+    """Process-wide scheduler — the analogue of the factory-owned scheduler
+    shared by all sessions (saved_model_bundle_factory.h:40-46)."""
+    global _global_scheduler
+    with _global_lock:
+        if _global_scheduler is None:
+            _global_scheduler = SharedBatchScheduler()
+        return _global_scheduler

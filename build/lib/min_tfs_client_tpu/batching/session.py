@@ -1,0 +1,267 @@
+"""Batching execution wrapper: merge -> pad -> execute once -> split.
+
+Parity with BatchingSession (batching/batching_session.{h,cc}):
+
+ * callers block on their task until the batch containing it completes;
+ * tasks merge along dim 0; the merged batch rounds UP to the smallest
+   allowed_batch_sizes entry >= total (batching_session.h:66-99) — on TPU
+   this is also the compile-bucket rule, so the jit cache holds exactly one
+   executable per allowed size;
+ * padding rows repeat real data (first task's rows), not zeros (h:94-99);
+ * optional variable-length padding: ragged non-batch dims pad to the
+   per-batch max with the tensor's pad value (h:100-132 semantics);
+ * oversized requests split into chunks (RunOptions-free equivalent of
+   enable_large_batch_splitting).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from min_tfs_client_tpu.batching.scheduler import (
+    BatchQueue,
+    BatchTask,
+    QueueOptions,
+    SharedBatchScheduler,
+)
+from min_tfs_client_tpu.protos import tfs_config_pb2
+from min_tfs_client_tpu.servables.servable import Signature
+from min_tfs_client_tpu.utils.status import ServingError
+
+BatchingParameters = tfs_config_pb2.BatchingParameters
+
+
+def params_from_proto(proto: BatchingParameters) -> dict:
+    return {
+        "max_batch_size": proto.max_batch_size.value or 32,
+        "batch_timeout_s": (proto.batch_timeout_micros.value or 0) / 1e6,
+        "max_enqueued_batches": proto.max_enqueued_batches.value or 64,
+        "allowed_batch_sizes": list(proto.allowed_batch_sizes),
+        "pad_variable_length_inputs": proto.pad_variable_length_inputs,
+    }
+
+
+def resolve_allowed_batch_sizes(
+    signature: Signature, params: dict) -> tuple[int, ...]:
+    """The allowed-sizes rule shared by the runner and pre-warmup bucket
+    setup: explicit allowed_batch_sizes (last entry must equal
+    max_batch_size, main.cc rule), else the signature's default buckets
+    clipped to max_batch_size."""
+    max_batch_size = params.get("max_batch_size", 32)
+    allowed_batch_sizes = params.get("allowed_batch_sizes")
+    if allowed_batch_sizes:
+        allowed = sorted(int(v) for v in allowed_batch_sizes)
+        if allowed[-1] != max_batch_size:
+            raise ServingError.invalid_argument(
+                f"allowed_batch_sizes last entry {allowed[-1]} must equal "
+                f"max_batch_size {max_batch_size}")
+    else:
+        allowed = [s for s in signature.batch_buckets
+                   if s <= max_batch_size] or [max_batch_size]
+        if allowed[-1] != max_batch_size:
+            allowed.append(max_batch_size)
+    return tuple(allowed)
+
+
+def apply_batch_buckets(servable, params: BatchingParameters | dict) -> dict:
+    """Set every batched device signature's compile buckets from the
+    batching config. Runs BEFORE warmup so warmup primes exactly the
+    executables that will serve (not the default power-of-two ladder).
+    Returns the normalized params dict for maybe_wrap_servable."""
+    if isinstance(params, BatchingParameters):
+        params = params_from_proto(params)
+    for signature in servable.signatures.values():
+        if signature.batched and not signature.on_host:
+            signature.batch_buckets = resolve_allowed_batch_sizes(
+                signature, params)
+    return params
+
+
+def pad_ragged(arrays: list[np.ndarray]) -> list[np.ndarray]:
+    """Pad non-batch dims to the per-batch max (batching_util.cc semantics:
+    rank 1-6, pad value = tensor's first element)."""
+    ranks = {a.ndim for a in arrays}
+    if len(ranks) != 1:
+        raise ServingError.invalid_argument(
+            f"cannot merge tensors of different ranks {sorted(ranks)}")
+    rank = ranks.pop()
+    if rank < 1:
+        raise ServingError.invalid_argument("cannot batch rank-0 tensors")
+    max_dims = [max(a.shape[d] for a in arrays) for d in range(rank)]
+    out = []
+    for a in arrays:
+        pad = [(0, 0)] + [(0, max_dims[d] - a.shape[d]) for d in range(1, rank)]
+        if any(p[1] for p in pad):
+            fill = a.reshape(-1)[0] if a.size else 0
+            a = np.pad(a, pad, constant_values=fill)
+        out.append(a)
+    return out
+
+
+class BatchedSignatureRunner:
+    """Drop-in .run() for a Signature, coalescing concurrent callers."""
+
+    def __init__(
+        self,
+        signature: Signature,
+        scheduler: SharedBatchScheduler,
+        *,
+        name: str = "signature",
+        max_batch_size: int = 32,
+        batch_timeout_s: float = 0.0,
+        max_enqueued_batches: int = 64,
+        allowed_batch_sizes: list[int] | None = None,
+        pad_variable_length_inputs: bool = False,
+    ):
+        allowed = list(resolve_allowed_batch_sizes(signature, {
+            "max_batch_size": max_batch_size,
+            "allowed_batch_sizes": allowed_batch_sizes,
+        }))
+        self.signature = signature
+        # Captured BEFORE maybe_wrap_servable replaces signature.run with
+        # runner.run — _process must execute the real signature, not re-enter
+        # the queue.
+        self._inner_run = signature.run
+        # Bucket the jit cache exactly on the allowed sizes.
+        signature.batch_buckets = tuple(allowed)
+        self._allowed = allowed
+        self._pad_ragged = pad_variable_length_inputs
+        self._scheduler = scheduler
+        self._max_batch_size = max_batch_size
+        self._queue: BatchQueue = scheduler.add_queue(
+            name,
+            QueueOptions(max_batch_size=max_batch_size,
+                         batch_timeout_s=batch_timeout_s,
+                         max_enqueued_batches=max_enqueued_batches),
+            self._process,
+        )
+
+    # -- caller side ---------------------------------------------------------
+
+    def run(self, inputs, output_filter=()) -> dict[str, np.ndarray]:
+        if not self.signature.batched or self.signature.on_host:
+            return self._inner_run(inputs, output_filter)
+        # Reject bad requests BEFORE they join a batch: a malformed request
+        # must fail alone with INVALID_ARGUMENT, never its batch-mates.
+        arrays = self.signature.validate(inputs, output_filter)
+        sizes = {a.shape[0] for a in arrays.values() if a.ndim}
+        if len(sizes) != 1:
+            raise ServingError.invalid_argument(
+                "inconsistent batch dims across inputs")
+        n = sizes.pop()
+        if n == 0:
+            raise ServingError.invalid_argument("empty batch")
+        if n >= self._max_batch_size:
+            return self._run_oversized(arrays, output_filter, n)
+        task = BatchTask(inputs=arrays, size=n)
+        self._scheduler.schedule(self._queue, task)
+        task.done.wait()
+        if task.error is not None:
+            raise task.error
+        keys = list(output_filter) if output_filter else list(self.signature.outputs)
+        return {k: task.outputs[k] for k in keys}
+
+    def _run_oversized(self, arrays, output_filter, n):
+        """Split a large request into max-size chunks run directly."""
+        outs: list[dict] = []
+        for start in range(0, n, self._max_batch_size):
+            chunk = {k: a[start:start + self._max_batch_size]
+                     for k, a in arrays.items()}
+            outs.append(self._inner_run(chunk, output_filter))
+        return {k: np.concatenate([o[k] for o in outs]) for k in outs[0]}
+
+    # -- scheduler side ------------------------------------------------------
+
+    def _process(self, batch: list[BatchTask]) -> None:
+        from min_tfs_client_tpu.server.profiler import trace
+
+        sizes = [t.size for t in batch]
+        total = sum(sizes)
+        merged = {}
+        with trace("batching/merge"):
+            for alias in batch[0].inputs:
+                columns = [t.inputs[alias] for t in batch]
+                if self._pad_ragged:
+                    columns = pad_ragged(columns)
+                else:
+                    shapes = {c.shape[1:] for c in columns}
+                    if len(shapes) != 1:
+                        raise ServingError.invalid_argument(
+                            f"input {alias!r}: ragged non-batch dims "
+                            f"{sorted(shapes)} need "
+                            "pad_variable_length_inputs=true")
+                merged[alias] = np.concatenate(columns, axis=0)
+
+        # Execute once; the inner run rounds total up to the allowed bucket
+        # and pads with repeated real rows.
+        with trace("batching/execute"):
+            outputs = self._inner_run(merged)
+
+        try:
+            from min_tfs_client_tpu.server import metrics
+
+            bucket = self.signature.round_up_batch(total)
+            metrics.batch_padding_ratio.observe(
+                bucket / max(1, total), self._queue.name)
+        except Exception:  # pragma: no cover - metrics must not break serving
+            pass
+
+        offset = 0
+        for task, size in zip(batch, sizes):
+            task.outputs = {k: v[offset:offset + size]
+                            for k, v in outputs.items()}
+            offset += size
+
+    def close(self) -> None:
+        self._scheduler.remove_queue(self._queue)
+
+
+def maybe_wrap_servable(servable, params: BatchingParameters | dict | None,
+                        scheduler: SharedBatchScheduler | None = None):
+    """Wrap every batched device signature of a servable with a batching
+    runner (the WrapSessionForBatching step of bundle creation,
+    saved_model_bundle_factory.cc:119-181). Returns the servable, mutated."""
+    if params is None:
+        return servable
+    if isinstance(params, BatchingParameters):
+        params = params_from_proto(params)
+    scheduler = scheduler or _default_scheduler()
+    for key, signature in servable.signatures.items():
+        if not signature.batched or signature.on_host:
+            continue
+        runner = BatchedSignatureRunner(
+            signature, scheduler,
+            name=f"{servable.name}:{servable.version}:{key}",
+            max_batch_size=params.get("max_batch_size", 32),
+            batch_timeout_s=params.get("batch_timeout_s", 0.0),
+            max_enqueued_batches=params.get("max_enqueued_batches", 64),
+            allowed_batch_sizes=params.get("allowed_batch_sizes"),
+            pad_variable_length_inputs=params.get(
+                "pad_variable_length_inputs", False),
+        )
+        # Replace the signature's run with the batched path, keep a handle
+        # for unload-time queue removal.
+        signature.run = runner.run  # type: ignore[method-assign]
+        runners = getattr(servable, "_batch_runners", [])
+        runners.append(runner)
+        servable._batch_runners = runners
+    _chain_unload(servable)
+    return servable
+
+
+def _default_scheduler() -> SharedBatchScheduler:
+    from min_tfs_client_tpu.batching.scheduler import global_scheduler
+
+    return global_scheduler()
+
+
+def _chain_unload(servable) -> None:
+    original_unload = servable.unload
+
+    def unload():
+        for runner in getattr(servable, "_batch_runners", []):
+            runner.close()
+        servable._batch_runners = []
+        original_unload()
+
+    servable.unload = unload  # type: ignore[method-assign]

@@ -1,0 +1,273 @@
+"""Batch scheduler variants: retrier, streaming, adaptive.
+
+TPU-native counterparts of the reference's alternative schedulers
+(SURVEY.md §2.5):
+
+ * BatchSchedulerRetrier  (batching/batch_scheduler_retrier.h) — retries
+   Schedule() on UNAVAILABLE queue-full up to a wall-clock budget.
+ * StreamingBatchScheduler (batching/streaming_batch_scheduler.{h,cc}) —
+   low-latency mode: a batch never waits behind another batch; each batch
+   is claimed by a worker the moment it opens and closes on full/timeout.
+ * AdaptiveSharedBatchScheduler
+   (batching_util/adaptive_shared_batch_scheduler.h) — the number of
+   concurrently-processed batches is tuned online by latency feedback
+   (hill-climbing instead of the reference's gradient steps; same
+   bounded [1, num_threads] walk).
+
+All take an injectable `clock` so tests drive time deterministically —
+the FakeClockEnv pattern (batching_util/fake_clock_env.h).
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from min_tfs_client_tpu.batching.scheduler import BatchTask, QueueOptions
+from min_tfs_client_tpu.utils.status import Code, ServingError
+
+
+# -- retrier -----------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class RetrierOptions:
+    max_time_s: float = 10e-3          # retry budget (h: max_time_micros)
+    retry_delay_s: float = 1e-3        # sleep between attempts
+
+
+class BatchSchedulerRetrier:
+    """Wraps any schedule callable; retries queue-full UNAVAILABLE."""
+
+    def __init__(self, schedule: Callable[[BatchTask], None],
+                 options: RetrierOptions = RetrierOptions(),
+                 *, clock: Callable[[], float] = time.monotonic,
+                 sleep: Callable[[float], None] = time.sleep):
+        self._schedule = schedule
+        self._options = options
+        self._clock = clock
+        self._sleep = sleep
+
+    def schedule(self, task: BatchTask) -> None:
+        deadline = self._clock() + self._options.max_time_s
+        while True:
+            try:
+                self._schedule(task)
+                return
+            except ServingError as exc:
+                if exc.code != Code.UNAVAILABLE or self._clock() >= deadline:
+                    raise
+            self._sleep(self._options.retry_delay_s)
+
+
+# -- streaming ---------------------------------------------------------------
+
+
+class _OpenBatch:
+    def __init__(self, deadline: float):
+        self.tasks: list[BatchTask] = []
+        self.size = 0
+        self.deadline = deadline
+        self.sealed = threading.Condition()
+        self.closed = False
+
+
+class StreamingBatchScheduler:
+    """Each batch is claimed by a dedicated worker at open time; tasks
+    stream into it until full or timeout — a formed batch never queues
+    behind another (streaming_batch_scheduler.h class comment)."""
+
+    def __init__(self, options: QueueOptions,
+                 process: Callable[[list[BatchTask]], None],
+                 *, num_threads: int = 2,
+                 clock: Callable[[], float] = time.monotonic):
+        self._options = options
+        self._process = process
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._open: Optional[_OpenBatch] = None
+        self._in_flight = 0
+        self._num_threads = num_threads
+        self._stopped = False
+
+    def schedule(self, task: BatchTask) -> None:
+        if task.size > self._options.max_batch_size:
+            raise ServingError.invalid_argument(
+                f"task size {task.size} exceeds max_batch_size "
+                f"{self._options.max_batch_size}")
+        with self._lock:
+            if self._stopped:
+                raise ServingError.unavailable("scheduler stopped")
+            batch = self._open
+            if batch is None or \
+                    batch.size + task.size > self._options.max_batch_size:
+                # Check capacity BEFORE sealing: a task we are about to
+                # reject must not also close the open batch other callers
+                # could still join.
+                if self._in_flight >= self._num_threads:
+                    raise ServingError.unavailable(
+                        "all streaming batch threads are busy")
+                if batch is not None:
+                    self._seal(batch)  # full by overflow: close early
+                batch = _OpenBatch(self._clock() + self._options.batch_timeout_s)
+                self._open = batch
+                self._in_flight += 1
+                threading.Thread(target=self._drive, args=(batch,),
+                                 daemon=True).start()
+            batch.tasks.append(task)
+            batch.size += task.size
+            if batch.size >= self._options.max_batch_size:
+                self._seal(batch)
+
+    def _seal(self, batch: _OpenBatch) -> None:
+        # caller holds self._lock
+        if self._open is batch:
+            self._open = None
+        with batch.sealed:
+            batch.closed = True
+            batch.sealed.notify_all()
+
+    def _drive(self, batch: _OpenBatch) -> None:
+        with batch.sealed:
+            while not batch.closed:
+                remaining = batch.deadline - self._clock()
+                if remaining <= 0:
+                    break
+                batch.sealed.wait(timeout=min(remaining, 5e-3))
+        with self._lock:
+            if self._open is batch:
+                self._open = None
+            batch.closed = True
+        try:
+            self._process(batch.tasks)
+        except Exception as exc:  # noqa: BLE001 — propagate to waiters
+            for t in batch.tasks:
+                t.error = exc
+        finally:
+            for t in batch.tasks:
+                t.done.set()
+            with self._lock:
+                self._in_flight -= 1
+
+    def stop(self) -> None:
+        with self._lock:
+            self._stopped = True
+            if self._open is not None:
+                self._seal(self._open)
+
+
+# -- adaptive ----------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class AdaptiveOptions:
+    num_threads: int = 4
+    initial_in_flight_limit: int = 2
+    batches_to_average_over: int = 8
+    max_enqueued_batches: int = 64
+
+
+class AdaptiveSharedBatchScheduler:
+    """Single-queue scheduler whose in-flight batch concurrency walks
+    [1, num_threads] by latency feedback: after each averaging window, keep
+    stepping in the direction that lowered mean batch latency, reverse
+    otherwise."""
+
+    def __init__(self, options: AdaptiveOptions,
+                 process: Callable[[list[BatchTask]], None],
+                 *, max_batch_size: int = 32,
+                 clock: Callable[[], float] = time.monotonic):
+        self._options = options
+        self._process = process
+        self._max_batch_size = max_batch_size
+        self._clock = clock
+        self._cv = threading.Condition()
+        self._batches: collections.deque[list[BatchTask]] = collections.deque()
+        self._open_size = 0
+        self._in_flight = 0
+        self._limit = max(1, min(options.initial_in_flight_limit,
+                                 options.num_threads))
+        self._direction = 1
+        self._window: list[float] = []
+        self._prev_window_mean: Optional[float] = None
+        self._stop = False
+        self._threads = [
+            threading.Thread(target=self._worker, daemon=True,
+                             name=f"adaptive-batch-{i}")
+            for i in range(options.num_threads)]
+        for t in self._threads:
+            t.start()
+
+    @property
+    def in_flight_limit(self) -> int:
+        return self._limit
+
+    def schedule(self, task: BatchTask) -> None:
+        with self._cv:
+            if self._stop:
+                raise ServingError.unavailable("scheduler stopped")
+            if not self._batches or \
+                    self._open_size + task.size > self._max_batch_size:
+                if len(self._batches) >= self._options.max_enqueued_batches:
+                    raise ServingError.unavailable("batch queue is full")
+                self._batches.append([])
+                self._open_size = 0
+            self._batches[-1].append(task)
+            self._open_size += task.size
+            self._cv.notify()
+
+    def _worker(self) -> None:
+        while True:
+            with self._cv:
+                while not self._stop and (
+                        not self._batches or self._in_flight >= self._limit):
+                    self._cv.wait(timeout=10e-3)
+                if self._stop:
+                    return
+                batch = self._batches.popleft()
+                if not self._batches:
+                    self._open_size = 0
+                self._in_flight += 1
+            t0 = self._clock()
+            try:
+                self._process(batch)
+            except Exception as exc:  # noqa: BLE001
+                for t in batch:
+                    t.error = exc
+            finally:
+                for t in batch:
+                    t.done.set()
+                elapsed = self._clock() - t0
+                with self._cv:
+                    self._in_flight -= 1
+                    self._feedback(elapsed)
+                    self._cv.notify()
+
+    def _feedback(self, elapsed: float) -> None:
+        # caller holds self._cv
+        self._window.append(elapsed)
+        if len(self._window) < self._options.batches_to_average_over:
+            return
+        mean = sum(self._window) / len(self._window)
+        self._window.clear()
+        if self._prev_window_mean is not None and \
+                mean > self._prev_window_mean:
+            self._direction = -self._direction
+        self._prev_window_mean = mean
+        self._limit = max(1, min(self._options.num_threads,
+                                 self._limit + self._direction))
+
+    def stop(self) -> None:
+        with self._cv:
+            self._stop = True
+            stranded = [t for b in self._batches for t in b]
+            self._batches.clear()
+            self._cv.notify_all()
+        for task in stranded:
+            task.error = ServingError.unavailable("scheduler stopped")
+            task.done.set()
+        for t in self._threads:
+            t.join(timeout=5.0)

@@ -1,0 +1,3 @@
+from min_tfs_client_tpu.client.requests import TensorServingClient
+
+__all__ = ["TensorServingClient"]

@@ -1,0 +1,101 @@
+"""tpu:// in-process channel: the north-star transport.
+
+A PredictRequest served here never crosses a process or HTTP/2 boundary —
+the stub's method call lands directly on the local server core (same protos,
+zero serialization), which executes on the TPU. Implements just enough of
+the grpc.Channel unary-unary surface for the hand-written stubs in
+protos/grpc_service.py; the reference's equivalent boundary is the gRPC
+loopback its client must always pay (reference requests.py:49).
+
+Targets:  tpu://<model_base_path>   e.g. tpu:///models/resnet
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Optional
+
+import grpc
+
+TPU_SCHEME = "tpu://"
+
+_registry_lock = threading.Lock()
+_registry: dict[str, "LocalInvoker"] = {}
+
+
+class LocalInvoker:
+    """Anything that can answer a unary call: invoke(method, request, timeout)."""
+
+    def invoke(self, method: str, request, timeout: Optional[float]):
+        raise NotImplementedError
+
+
+class InProcessRpcError(grpc.RpcError):
+    """RpcError carrying a status code, raised by in-process handlers."""
+
+    def __init__(self, status_code: grpc.StatusCode, details: str = ""):
+        super().__init__()
+        self._code = status_code
+        self._details = details
+
+    def code(self) -> grpc.StatusCode:
+        return self._code
+
+    def details(self) -> str:
+        return self._details
+
+    def __str__(self):
+        return f"InProcessRpcError({self._code}, {self._details!r})"
+
+
+def register_server(target: str, invoker: LocalInvoker) -> None:
+    with _registry_lock:
+        _registry[_normalize(target)] = invoker
+
+
+def unregister_server(target: str) -> None:
+    with _registry_lock:
+        _registry.pop(_normalize(target), None)
+
+
+def _normalize(target: str) -> str:
+    if target.startswith(TPU_SCHEME):
+        target = target[len(TPU_SCHEME):]
+    return target.rstrip("/")
+
+
+class _UnaryUnary:
+    def __init__(self, invoker: LocalInvoker, method: str):
+        self._invoker = invoker
+        self._method = method
+
+    def __call__(self, request, timeout: Optional[float] = None, **kwargs):
+        return self._invoker.invoke(self._method, request, timeout)
+
+
+class InProcessChannel:
+    """Minimal channel: routes stub calls straight into a LocalInvoker."""
+
+    def __init__(self, invoker: LocalInvoker):
+        self._invoker = invoker
+
+    @classmethod
+    def for_target(cls, target: str) -> "InProcessChannel":
+        key = _normalize(target)
+        with _registry_lock:
+            invoker = _registry.get(key)
+        if invoker is None:
+            # Lazily boot a local server core serving this base path.
+            from min_tfs_client_tpu.server.local import boot_local_server
+
+            invoker = boot_local_server(key)
+            register_server(key, invoker)
+        return cls(invoker)
+
+    def unary_unary(self, method: str, request_serializer=None,
+                    response_deserializer=None, **kwargs) -> Callable:
+        # In-process: protos are passed by reference; no (de)serialization.
+        return _UnaryUnary(self._invoker, method)
+
+    def close(self) -> None:
+        pass

@@ -1,0 +1,169 @@
+"""FileSystemStoragePathSource: version discovery by polling base paths.
+
+Parity with sources/storage_path/file_system_storage_path_source.{h,cc}:
+numeric child directories of base_path are versions; the aspired set is
+chosen by ServableVersionPolicy (Latest{n} default n=1 / All / Specific);
+poll interval semantics from the config proto (0 = poll once, negative =
+disabled); servable_versions_always_present guards against unloading
+everything when a poll sees an empty/missing base path.
+"""
+
+from __future__ import annotations
+
+import pathlib
+import threading
+from dataclasses import dataclass, field
+from typing import Callable, Optional, Sequence
+
+from min_tfs_client_tpu.protos import tfs_config_pb2
+
+PolicyProto = tfs_config_pb2.FileSystemStoragePathSourceConfig.ServableVersionPolicy
+
+# aspired callback: (servable_name, [(version, path), ...])
+AspiredCallback = Callable[[str, Sequence[tuple[int, str]]], None]
+
+
+@dataclass(frozen=True)
+class VersionPolicy:
+    kind: str = "latest"             # latest | all | specific
+    num_versions: int = 1
+    specific: tuple[int, ...] = ()
+
+    @classmethod
+    def from_proto(cls, proto: PolicyProto) -> "VersionPolicy":
+        choice = proto.WhichOneof("policy_choice")
+        if choice == "all":
+            return cls("all")
+        if choice == "specific":
+            return cls("specific", specific=tuple(proto.specific.versions))
+        if choice == "latest":
+            return cls("latest", num_versions=proto.latest.num_versions or 1)
+        return cls("latest", 1)
+
+    def select(self, versions: Sequence[int]) -> list[int]:
+        versions = sorted(versions)
+        if self.kind == "all":
+            return versions
+        if self.kind == "specific":
+            return [v for v in versions if v in set(self.specific)]
+        return versions[-self.num_versions:]
+
+
+@dataclass
+class MonitoredServable:
+    name: str
+    base_path: str
+    policy: VersionPolicy = field(default_factory=VersionPolicy)
+
+
+def list_version_dirs(base_path: str) -> list[tuple[int, str]]:
+    """Numeric children of base_path, as (version, absolute path)."""
+    base = pathlib.Path(base_path)
+    if not base.is_dir():
+        return []
+    out = []
+    for child in base.iterdir():
+        if child.is_dir() and child.name.isdigit():
+            out.append((int(child.name), str(child)))
+    return sorted(out)
+
+
+class StaticStoragePathSource:
+    """Emits one fixed (version, path) exactly once when connected —
+    sources/storage_path/static_storage_path_source.{h,cc} parity, used for
+    test fixtures and frozen deployments."""
+
+    def __init__(self, servable_name: str, version: int, path: str):
+        self._name = servable_name
+        self._version = version
+        self._path = path
+
+    def set_aspired_versions_callback(self, callback: AspiredCallback) -> None:
+        callback(self._name, [(self._version, self._path)])
+
+    def stop(self) -> None:  # Source interface symmetry
+        pass
+
+
+class FileSystemStoragePathSource:
+    def __init__(
+        self,
+        servables: Sequence[MonitoredServable],
+        *,
+        poll_wait_seconds: float = 1.0,
+        servable_versions_always_present: bool = False,
+    ):
+        self._lock = threading.RLock()
+        self._servables = list(servables)
+        self._poll_wait_seconds = poll_wait_seconds
+        self._always_present = servable_versions_always_present
+        self._callback: Optional[AspiredCallback] = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    @classmethod
+    def from_proto(
+        cls, config: tfs_config_pb2.FileSystemStoragePathSourceConfig
+    ) -> "FileSystemStoragePathSource":
+        servables = [
+            MonitoredServable(s.servable_name, s.base_path,
+                              VersionPolicy.from_proto(s.servable_version_policy))
+            for s in config.servables
+        ]
+        if config.servable_name:  # legacy single-servable form
+            servables.append(
+                MonitoredServable(config.servable_name, config.base_path))
+        return cls(
+            servables,
+            poll_wait_seconds=config.file_system_poll_wait_seconds,
+            servable_versions_always_present=config.servable_versions_always_present,
+        )
+
+    def set_aspired_versions_callback(self, callback: AspiredCallback) -> None:
+        """Wire the target and start polling per the configured interval
+        (source.h:64-84: callback set exactly once, then source goes live)."""
+        with self._lock:
+            self._callback = callback
+        if self._poll_wait_seconds < 0:
+            return  # polling disabled (tests drive poll_once manually)
+        self.poll_once()
+        if self._poll_wait_seconds > 0:
+            self._thread = threading.Thread(
+                target=self._poll_loop, name="fs-source-poll", daemon=True)
+            self._thread.start()
+
+    def update_config(self, servables: Sequence[MonitoredServable]) -> None:
+        """Live reconfiguration (ReloadConfig path). Streams removed from the
+        config aspire zero versions exactly once, triggering unload."""
+        with self._lock:
+            removed = {s.name for s in self._servables} - {
+                s.name for s in servables}
+            self._servables = list(servables)
+            callback = self._callback
+        if callback is not None:
+            for name in sorted(removed):
+                callback(name, [])
+            self.poll_once()
+
+    def poll_once(self) -> None:
+        with self._lock:
+            servables = list(self._servables)
+            callback = self._callback
+        if callback is None:
+            return
+        for servable in servables:
+            found = list_version_dirs(servable.base_path)
+            if not found and self._always_present:
+                continue  # don't unload the world on a transiently-empty dir
+            chosen = set(servable.policy.select([v for v, _ in found]))
+            aspired = [(v, p) for v, p in found if v in chosen]
+            callback(servable.name, aspired)
+
+    def _poll_loop(self) -> None:
+        while not self._stop.wait(self._poll_wait_seconds):
+            self.poll_once()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)

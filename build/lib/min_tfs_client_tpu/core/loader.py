@@ -1,0 +1,211 @@
+"""Loader interface and harness: the per-version lifecycle unit.
+
+Loader parity: EstimateResources/Load/Unload/servable() (core/loader.h:55-120)
+with TPU semantics — resources are HBM bytes, and the estimate must be an
+upper bound that never increases after load (loader.h:55-75 contract).
+
+LoaderHarness parity: the transactional state machine of
+core/loader_harness.{h,cc} with the same observable states, retry-on-load
+(util/retrier.{h,cc} semantics; flag plumbing main.cc:107-116) and
+cancellation of queued retries on unload request.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional
+
+from min_tfs_client_tpu.core.states import (
+    HARNESS_TO_MANAGER,
+    LEGAL_TRANSITIONS,
+    HarnessState,
+    ManagerState,
+    ServableId,
+    ServableState,
+)
+from min_tfs_client_tpu.utils.event_bus import EventBus
+from min_tfs_client_tpu.utils.status import ServingError, error_from_exception
+
+
+class Loader:
+    """Loads one servable version. Subclass or use SimpleLoader."""
+
+    def estimate_resources(self) -> int:
+        """Upper-bound HBM bytes this servable will occupy once loaded."""
+        return 0
+
+    def load(self) -> None:
+        raise NotImplementedError
+
+    def unload(self) -> None:
+        raise NotImplementedError
+
+    def servable(self):
+        """The loaded servable object. Valid only between load() and unload()."""
+        raise NotImplementedError
+
+
+class SimpleLoader(Loader):
+    """Loader from a creator callable + static resource estimate
+    (core/simple_loader.h pattern, including estimate memoization)."""
+
+    def __init__(self, creator: Callable[[], object], resource_estimate: int = 0):
+        self._creator = creator
+        self._estimate = resource_estimate
+        self._servable: object | None = None
+
+    def estimate_resources(self) -> int:
+        return self._estimate
+
+    def load(self) -> None:
+        self._servable = self._creator()
+
+    def unload(self) -> None:
+        servable = self._servable
+        self._servable = None
+        unloader = getattr(servable, "unload", None)
+        if callable(unloader):
+            unloader()
+
+    def servable(self):
+        if self._servable is None:
+            raise ServingError.failed_precondition("servable is not loaded")
+        return self._servable
+
+
+class LoaderHarness:
+    """State machine + refcount around one (servable, version) Loader."""
+
+    def __init__(
+        self,
+        servable_id: ServableId,
+        loader: Loader,
+        event_bus: EventBus,
+        *,
+        max_load_retries: int = 5,
+        load_retry_interval_s: float = 60.0,
+    ):
+        self.id = servable_id
+        self.loader = loader
+        self._bus = event_bus
+        self._max_load_retries = max_load_retries
+        self._load_retry_interval_s = load_retry_interval_s
+        self._lock = threading.RLock()
+        self._state = HarnessState.NEW
+        self._error: Optional[ServingError] = None
+        self._refs = 0
+        self._drained = threading.Condition(self._lock)
+        self._retry_cancelled = False
+
+    # -- state inspection ----------------------------------------------------
+
+    @property
+    def state(self) -> HarnessState:
+        with self._lock:
+            return self._state
+
+    @property
+    def error(self) -> Optional[ServingError]:
+        with self._lock:
+            return self._error
+
+    def is_serving(self) -> bool:
+        with self._lock:
+            return self._state == HarnessState.READY
+
+    # -- refcounting (ServableHandle pinning) --------------------------------
+
+    def acquire(self):
+        """Pin the servable for one request; returns the servable object."""
+        with self._lock:
+            if self._state != HarnessState.READY:
+                raise ServingError.unavailable(
+                    f"servable {self.id} is not available for serving "
+                    f"(state: {self._state.value})")
+            self._refs += 1
+            return self.loader.servable()
+
+    def release(self) -> None:
+        with self._lock:
+            self._refs -= 1
+            if self._refs == 0:
+                self._drained.notify_all()
+
+    # -- transitions ---------------------------------------------------------
+
+    def _transition(self, new_state: HarnessState) -> None:
+        with self._lock:
+            if new_state not in LEGAL_TRANSITIONS[self._state]:
+                raise ServingError.failed_precondition(
+                    f"illegal transition {self._state.value} -> {new_state.value} "
+                    f"for {self.id}")
+            self._state = new_state
+        self._publish()
+
+    def _fail(self, err: ServingError) -> None:
+        with self._lock:
+            self._state = HarnessState.ERROR
+            self._error = err
+        self._publish()
+
+    def _publish(self) -> None:
+        with self._lock:
+            mgr = HARNESS_TO_MANAGER[self._state]
+            err = self._error
+        self._bus.publish(ServableState(self.id, mgr, err))
+
+    def request_load(self) -> None:
+        self._transition(HarnessState.LOAD_REQUESTED)
+
+    def approve_load(self) -> None:
+        self._transition(HarnessState.LOAD_APPROVED)
+
+    def load(self) -> None:
+        """Run the loader with retries. Called on a load-pool thread."""
+        self._transition(HarnessState.LOADING)
+        attempts = 1 + max(0, self._max_load_retries)
+        last_exc: Exception | None = None
+        for attempt in range(attempts):
+            with self._lock:
+                if self._retry_cancelled:
+                    self._fail(ServingError.unavailable(
+                        f"load of {self.id} cancelled before completion"))
+                    return
+            try:
+                self.loader.load()
+                self._transition(HarnessState.READY)
+                return
+            except Exception as exc:  # noqa: BLE001 - converted to status
+                last_exc = exc
+                if attempt + 1 < attempts:
+                    time.sleep(self._load_retry_interval_s)
+        self._fail(error_from_exception(last_exc))
+
+    def cancel_load_retries(self) -> None:
+        with self._lock:
+            self._retry_cancelled = True
+
+    def request_unload(self) -> None:
+        self._transition(HarnessState.UNLOAD_REQUESTED)
+
+    def unload(self, *, drain_timeout_s: float | None = None) -> None:
+        """Quiesce (wait for in-flight requests), then unload.
+
+        Called on an unload-pool thread after request_unload().
+        """
+        self._transition(HarnessState.QUIESCING)
+        with self._lock:
+            deadline = None if drain_timeout_s is None else (
+                time.monotonic() + drain_timeout_s)
+            while self._refs > 0:
+                remaining = None if deadline is None else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    break
+                self._drained.wait(timeout=remaining)
+        self._transition(HarnessState.QUIESCED)
+        self._transition(HarnessState.UNLOADING)
+        try:
+            self.loader.unload()
+        finally:
+            self._transition(HarnessState.DISABLED)

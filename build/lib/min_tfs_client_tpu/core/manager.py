@@ -1,0 +1,271 @@
+"""AspiredVersionsManager: the heart of the model lifecycle.
+
+Combines the reference's AspiredVersionsManager + BasicManager + version
+policies into one idiomatic unit with the same observable behavior:
+
+ * aspired-versions callback semantics — each call is the FULL set for a
+   servable stream; omission of a loaded version means "unload it"
+   (aspired_versions_manager.h:85-100);
+ * a periodic reconciliation tick (default 100ms, h:70-72) that pumps
+   pending aspirations and executes at most one lifecycle action per
+   servable stream per tick (InvokePolicyAndExecuteAction, .cc:403-430);
+ * AvailabilityPreserving (default) vs ResourcePreserving policies
+ * (availability_preserving_policy.h / resource_preserving_policy.h);
+ * load/unload on dedicated thread pools with retry
+ * (basic_manager.h:65-118); HBM gating via ResourceTracker;
+ * GetServableHandle pinning the version for the request's duration
+ *   (core/manager.h:36-76, servable_handle.h).
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, Optional, Sequence
+
+from min_tfs_client_tpu.core.loader import Loader, LoaderHarness
+from min_tfs_client_tpu.core.resource import ResourceTracker
+from min_tfs_client_tpu.core.states import (
+    HarnessState,
+    ServableId,
+)
+from min_tfs_client_tpu.utils.event_bus import EventBus
+from min_tfs_client_tpu.utils.status import ServingError
+
+AVAILABILITY_PRESERVING = "availability_preserving"
+RESOURCE_PRESERVING = "resource_preserving"
+
+# Harness states that still hold (or may come to hold) resources.
+_LIVE_STATES = {
+    HarnessState.LOAD_REQUESTED, HarnessState.LOAD_APPROVED,
+    HarnessState.LOADING, HarnessState.READY,
+}
+
+
+class ServableHandle:
+    """Pins one loaded servable version while a request uses it."""
+
+    def __init__(self, harness: LoaderHarness):
+        self._harness = harness
+        self.servable = harness.acquire()
+        self.id = harness.id
+
+    def release(self) -> None:
+        if self._harness is not None:
+            self._harness.release()
+            self._harness = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+
+
+class AspiredVersionsManager:
+    def __init__(
+        self,
+        *,
+        event_bus: EventBus | None = None,
+        resource_tracker: ResourceTracker | None = None,
+        policy: str = AVAILABILITY_PRESERVING,
+        tick_interval_s: float = 0.1,
+        num_load_threads: int = 2,
+        num_unload_threads: int = 2,
+        max_load_retries: int = 5,
+        load_retry_interval_s: float = 60.0,
+        start_thread: bool = True,
+    ):
+        if policy not in (AVAILABILITY_PRESERVING, RESOURCE_PRESERVING):
+            raise ValueError(f"unknown aspired-version policy {policy!r}")
+        self.event_bus = event_bus or EventBus()
+        self.resources = resource_tracker or ResourceTracker()
+        self._policy = policy
+        self._max_load_retries = max_load_retries
+        self._load_retry_interval_s = load_retry_interval_s
+        self._lock = threading.RLock()
+        # servable name -> version -> harness (current generation)
+        self._harnesses: dict[str, dict[int, LoaderHarness]] = {}
+        # servable name -> version -> Loader, staged by set_aspired_versions
+        self._pending: dict[str, dict[int, Loader]] = {}
+        # versions currently aspired per stream (None until first callback)
+        self._aspired: dict[str, set[int]] = {}
+        self._load_pool = ThreadPoolExecutor(
+            num_load_threads, thread_name_prefix="servable-load")
+        self._unload_pool = ThreadPoolExecutor(
+            num_unload_threads, thread_name_prefix="servable-unload")
+        self._stop = threading.Event()
+        self._ticker: threading.Thread | None = None
+        if start_thread:
+            self._ticker = threading.Thread(
+                target=self._tick_loop, args=(tick_interval_s,),
+                name="avmanager-tick", daemon=True)
+            self._ticker.start()
+
+    # -- Target<Loader> surface ---------------------------------------------
+
+    def set_aspired_versions(
+        self, servable_name: str, versions: Sequence[tuple[int, Loader]]
+    ) -> None:
+        """Full-set aspiration for one servable stream (omission = unload)."""
+        with self._lock:
+            self._pending[servable_name] = {v: loader for v, loader in versions}
+
+    def aspired_versions_callback(self) -> Callable:
+        return self.set_aspired_versions
+
+    # -- reconciliation ------------------------------------------------------
+
+    def _tick_loop(self, interval_s: float) -> None:
+        while not self._stop.wait(interval_s):
+            try:
+                self.tick()
+            except Exception:  # pragma: no cover - keep the pump alive
+                import traceback
+
+                traceback.print_exc()
+
+    def tick(self) -> None:
+        """One reconciliation pass. Thread-safe; also callable from tests."""
+        with self._lock:
+            self._absorb_pending()
+            names = set(self._harnesses) | set(self._aspired)
+            for name in names:
+                self._reconcile_stream(name)
+
+    def _absorb_pending(self) -> None:
+        for name, versions in self._pending.items():
+            self._aspired[name] = set(versions)
+            streams = self._harnesses.setdefault(name, {})
+            for version, loader in versions.items():
+                sid = ServableId(name, version)
+                existing = streams.get(version)
+                if existing is not None and existing.state not in (
+                        HarnessState.DISABLED, HarnessState.ERROR):
+                    continue  # already tracked (or re-aspired after error: keep error visible)
+                if existing is not None and existing.state == HarnessState.ERROR:
+                    continue  # do not silently retry an errored version
+                streams[version] = LoaderHarness(
+                    sid, loader, self.event_bus,
+                    max_load_retries=self._max_load_retries,
+                    load_retry_interval_s=self._load_retry_interval_s)
+                streams[version].request_load()
+        self._pending.clear()
+
+    def _reconcile_stream(self, name: str) -> None:
+        streams = self._harnesses.get(name, {})
+        aspired = self._aspired.get(name, set())
+
+        # Flush terminal harnesses that are no longer aspired.
+        for version in [v for v, h in streams.items()
+                        if h.state in (HarnessState.DISABLED,)
+                        and v not in aspired]:
+            del streams[version]
+            self.resources.release(ServableId(name, version))
+
+        ready = {v for v, h in streams.items() if h.state == HarnessState.READY}
+        unaspired_ready = ready - aspired
+        aspired_not_ready = {
+            v for v in aspired
+            if v in streams and streams[v].state in (
+                HarnessState.LOAD_REQUESTED, HarnessState.LOAD_APPROVED,
+                HarnessState.LOADING)
+        }
+
+        # Unload decisions.
+        for version in sorted(unaspired_ready):
+            if self._policy == AVAILABILITY_PRESERVING and aspired_not_ready \
+                    and ready == unaspired_ready:
+                # Keep the last old version serving until a replacement is
+                # READY — unless HBM pressure forces the swap (handled below).
+                if self._reservation_fits_all(name, aspired_not_ready):
+                    continue
+            self._start_unload(streams[version])
+
+        # Load approvals (resource-gated).
+        for version in sorted(aspired_not_ready):
+            harness = streams[version]
+            if harness.state != HarnessState.LOAD_REQUESTED:
+                continue
+            sid = ServableId(name, version)
+            estimate = harness.loader.estimate_resources()
+            if not self.resources.try_reserve(sid, estimate):
+                continue  # retry next tick (old versions may free HBM first)
+            harness.approve_load()
+            self._load_pool.submit(self._run_load, harness)
+
+    def _reservation_fits_all(self, name: str, versions: set[int]) -> bool:
+        streams = self._harnesses[name]
+        total = sum(streams[v].loader.estimate_resources() for v in versions)
+        free = self.resources.pool_bytes - self.resources.reserved_bytes()
+        return total <= free
+
+    def _start_unload(self, harness: LoaderHarness) -> None:
+        if harness.state != HarnessState.READY:
+            return
+        harness.request_unload()
+        self._unload_pool.submit(self._run_unload, harness)
+
+    def _run_load(self, harness: LoaderHarness) -> None:
+        harness.load()
+        if harness.state != HarnessState.READY:
+            self.resources.release(harness.id)
+
+    def _run_unload(self, harness: LoaderHarness) -> None:
+        try:
+            harness.unload()
+        finally:
+            self.resources.release(harness.id)
+
+    # -- Manager surface -----------------------------------------------------
+
+    def list_available(self) -> list[ServableId]:
+        with self._lock:
+            return sorted(
+                ServableId(name, v)
+                for name, streams in self._harnesses.items()
+                for v, h in streams.items() if h.is_serving())
+
+    def states(self, name: str) -> dict[int, tuple]:
+        """Snapshot of one stream: {version: (state, error-or-None)}.
+        The public read API for boot/monitoring helpers (the
+        ServableStateMonitor equivalent of BasicManager's
+        GetManagedServableStateSnapshots)."""
+        with self._lock:
+            return {v: (h.state, h.error)
+                    for v, h in self._harnesses.get(name, {}).items()}
+
+    def get_servable_handle(
+        self, name: str, version: Optional[int] = None, *, earliest: bool = False
+    ) -> ServableHandle:
+        """Pin a servable version. None = latest READY (manager.h:47-55)."""
+        with self._lock:
+            streams = self._harnesses.get(name)
+            if not streams:
+                raise ServingError.not_found(
+                    f"Servable not found for request: {name}")
+            if version is not None:
+                harness = streams.get(version)
+                if harness is None:
+                    raise ServingError.not_found(
+                        f"Servable not found for request: {name} version {version}")
+                return ServableHandle(harness)
+            ready = sorted(v for v, h in streams.items() if h.is_serving())
+            if not ready:
+                raise ServingError.unavailable(
+                    f"Servable {name} has no available versions")
+            pick = ready[0] if earliest else ready[-1]
+            return ServableHandle(streams[pick])
+
+    def stop(self, *, unload_all: bool = False, timeout_s: float = 30.0) -> None:
+        self._stop.set()
+        if self._ticker is not None:
+            self._ticker.join(timeout=timeout_s)
+        if unload_all:
+            with self._lock:
+                harnesses = [h for s in self._harnesses.values()
+                             for h in s.values() if h.is_serving()]
+            for h in harnesses:
+                self._start_unload(h)
+        self._load_pool.shutdown(wait=True)
+        self._unload_pool.shutdown(wait=True)

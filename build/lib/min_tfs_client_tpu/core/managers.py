@@ -1,0 +1,233 @@
+"""Alternative managers: static set, load-on-first-request cache, fast boot.
+
+Parity with the reference's misc managers (SURVEY.md §2.4):
+
+ * StaticManager      (core/static_manager.{h,cc}) — a fixed, pre-loaded
+   set of servables; no lifecycle, no threads. Build once, serve forever.
+ * CachingManager     (core/caching_manager.{h,cc}) — versions are loaded
+   on first GetServableHandle miss through a LoaderFactory; concurrent
+   requests for the same id coalesce onto one load.
+ * load_servables_fast (core/load_servables_fast.{h,cc}) — drive an
+   AspiredVersionsManager's reconciliation eagerly at boot so the initial
+   fleet of models loads with maximum parallelism, then wait for every
+   stream to reach AVAILABLE (or surface the first error).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional
+
+from min_tfs_client_tpu.core.loader import Loader, LoaderHarness, SimpleLoader
+from min_tfs_client_tpu.core.manager import (
+    AspiredVersionsManager,
+    ServableHandle,
+)
+from min_tfs_client_tpu.core.states import HarnessState, ServableId
+from min_tfs_client_tpu.utils.event_bus import EventBus
+from min_tfs_client_tpu.utils.status import ServingError
+
+
+class StaticManager:
+    """Immutable manager over a pre-built set of servables."""
+
+    class Builder:
+        def __init__(self, *, event_bus: Optional[EventBus] = None):
+            self._bus = event_bus or EventBus()
+            self._harnesses: dict[str, dict[int, LoaderHarness]] = {}
+
+        def add_servable(self, servable) -> "StaticManager.Builder":
+            """Register an already-constructed servable (has .name/.version)."""
+            return self.add_loader(
+                servable.name, servable.version,
+                SimpleLoader(lambda s=servable: s))
+
+        def add_loader(self, name: str, version: int,
+                       loader: Loader) -> "StaticManager.Builder":
+            sid = ServableId(name, version)
+            streams = self._harnesses.setdefault(name, {})
+            if version in streams:
+                raise ServingError.invalid_argument(
+                    f"duplicate servable {sid}")
+            harness = LoaderHarness(sid, loader, self._bus,
+                                    max_load_retries=0)
+            harness.request_load()
+            harness.approve_load()
+            harness.load()  # synchronous: builder surfaces errors eagerly
+            if harness.state != HarnessState.READY:
+                raise harness.error or ServingError.internal(
+                    f"load failed for {sid}")
+            streams[version] = harness
+            return self
+
+        def build(self) -> "StaticManager":
+            return StaticManager(self._harnesses)
+
+    def __init__(self, harnesses: dict[str, dict[int, LoaderHarness]]):
+        self._harnesses = harnesses
+
+    def list_available(self) -> list[ServableId]:
+        return sorted(ServableId(n, v)
+                      for n, streams in self._harnesses.items()
+                      for v, h in streams.items() if h.is_serving())
+
+    def get_servable_handle(
+        self, name: str, version: Optional[int] = None, *,
+        earliest: bool = False,
+    ) -> ServableHandle:
+        streams = self._harnesses.get(name)
+        if not streams:
+            raise ServingError.not_found(
+                f"Servable not found for request: {name}")
+        if version is not None:
+            harness = streams.get(version)
+            if harness is None:
+                raise ServingError.not_found(
+                    f"Servable not found for request: {name} "
+                    f"version {version}")
+            return ServableHandle(harness)
+        ready = sorted(v for v, h in streams.items() if h.is_serving())
+        if not ready:
+            raise ServingError.unavailable(
+                f"Servable {name} has no available versions")
+        return ServableHandle(streams[ready[0] if earliest else ready[-1]])
+
+
+# LoaderFactory: (name, version | None) -> (resolved_version, Loader).
+# version None means "the factory's notion of latest" (caching_manager.h
+# LoaderFactory::GetServableVersion semantics).
+LoaderFactory = Callable[[str, Optional[int]], tuple[int, Loader]]
+
+
+class CachingManager:
+    """Manager that materializes servables on first request."""
+
+    def __init__(self, loader_factory: LoaderFactory, *,
+                 event_bus: Optional[EventBus] = None,
+                 max_load_retries: int = 0,
+                 load_retry_interval_s: float = 0.0):
+        self._factory = loader_factory
+        self._bus = event_bus or EventBus()
+        self._max_load_retries = max_load_retries
+        self._load_retry_interval_s = load_retry_interval_s
+        self._lock = threading.Lock()
+        self._harnesses: dict[str, dict[int, LoaderHarness]] = {}
+        # Coalesce concurrent first-requests per servable id
+        # (caching_manager.h "merge parallel requests" contract).
+        self._inflight: dict[ServableId, threading.Event] = {}
+
+    def list_available(self) -> list[ServableId]:
+        with self._lock:
+            return sorted(ServableId(n, v)
+                          for n, streams in self._harnesses.items()
+                          for v, h in streams.items() if h.is_serving())
+
+    def get_servable_handle(
+        self, name: str, version: Optional[int] = None,
+    ) -> ServableHandle:
+        harness = self._lookup_or_load(name, version)
+        if not harness.is_serving():
+            raise harness.error or ServingError.unavailable(
+                f"Servable {harness.id} is not available")
+        return ServableHandle(harness)
+
+    def _lookup_or_load(self, name: str,
+                        version: Optional[int]) -> LoaderHarness:
+        while True:
+            with self._lock:
+                streams = self._harnesses.get(name, {})
+                if version is not None:
+                    if version in streams:
+                        return streams[version]
+                    sid = ServableId(name, version)
+                elif streams:
+                    ready = sorted(streams)
+                    return streams[ready[-1]]
+                else:
+                    sid = ServableId(name, -1)  # resolved by the factory
+                waiter = self._inflight.get(sid)
+                if waiter is None:
+                    self._inflight[sid] = threading.Event()
+                    break
+            waiter.wait()
+        try:
+            resolved, loader = self._factory(name, version)
+            harness = LoaderHarness(
+                ServableId(name, resolved), loader, self._bus,
+                max_load_retries=self._max_load_retries,
+                load_retry_interval_s=self._load_retry_interval_s)
+            harness.request_load()
+            harness.approve_load()
+            harness.load()
+            with self._lock:
+                streams = self._harnesses.setdefault(name, {})
+                existing = streams.get(resolved)
+                if existing is None:
+                    streams[resolved] = harness
+            if existing is not None:
+                # A None-version request and an explicit-version request
+                # raced to the same resolved id (their _inflight keys
+                # differ): keep the first-stored harness, drop ours so the
+                # duplicate servable's resources are released.
+                if harness.is_serving():
+                    harness.request_unload()
+                    harness.unload()
+                return existing
+            return harness
+        except ServingError:
+            raise
+        except Exception as exc:
+            raise ServingError.internal(
+                f"loader factory failed for {name}: {exc}")
+        finally:
+            with self._lock:
+                done = self._inflight.pop(sid, None)
+            if done is not None:
+                done.set()
+
+
+class ManagerWrapper:
+    """Forwarding base for managers (core/manager_wrapper.{h,cc}): subclass
+    and override selectively (e.g. to add per-request policy or metrics)."""
+
+    def __init__(self, wrapped):
+        self._wrapped = wrapped
+
+    def list_available(self):
+        return self._wrapped.list_available()
+
+    def get_servable_handle(self, name, version=None, **kwargs):
+        return self._wrapped.get_servable_handle(name, version, **kwargs)
+
+
+def load_servables_fast(
+    manager: AspiredVersionsManager,
+    names: list[str],
+    *,
+    timeout_s: float = 60.0,
+    tick_interval_s: float = 0.01,
+) -> None:
+    """Eagerly pump reconciliation until every named stream has a READY
+    version; raise the first load error encountered. The parallelism comes
+    from the manager's load pool — this just removes the 100ms tick latency
+    from the boot path (load_servables_fast.h intent)."""
+    deadline = time.monotonic() + timeout_s
+    pending = set(names)
+    while pending:
+        manager.tick()
+        for name in list(pending):
+            snapshot = manager.states(name)
+            errors = [err for state, err in snapshot.values()
+                      if state == HarnessState.ERROR and err]
+            if errors:
+                raise errors[0]
+            if any(state == HarnessState.READY
+                   for state, _ in snapshot.values()):
+                pending.discard(name)
+        if pending and time.monotonic() > deadline:
+            raise ServingError.deadline_exceeded(
+                f"servables not available after {timeout_s}s: "
+                f"{sorted(pending)}")
+        if pending:
+            time.sleep(tick_interval_s)

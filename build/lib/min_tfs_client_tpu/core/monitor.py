@@ -1,0 +1,94 @@
+"""ServableStateMonitor: bus subscriber answering "what state is X in?".
+
+Parity with core/servable_state_monitor.{h,cc}: keeps the latest state per
+(servable, version), a bounded event log, and condition-variable waits for
+target states (WaitUntilServablesReachState semantics, h:45-97).
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Iterable, Optional
+
+from min_tfs_client_tpu.core.states import ManagerState, ServableId, ServableState
+from min_tfs_client_tpu.utils.event_bus import EventBus
+
+
+class ServableStateMonitor:
+    def __init__(self, bus: EventBus, *, max_log_events: int = 1000):
+        self._lock = threading.Condition()
+        # name -> version -> (ServableState, wall time)
+        self._states: dict[str, dict[int, tuple[ServableState, float]]] = {}
+        self._log = collections.deque(maxlen=max_log_events)
+        self._sub = bus.subscribe(self._on_event, with_time=True)
+
+    def _on_event(self, event: ServableState, when: float) -> None:
+        with self._lock:
+            self._states.setdefault(event.id.name, {})[event.id.version] = (
+                event, when)
+            self._log.append((event, when))
+            self._lock.notify_all()
+
+    # -- queries -------------------------------------------------------------
+
+    def get_state(self, sid: ServableId) -> Optional[ServableState]:
+        with self._lock:
+            entry = self._states.get(sid.name, {}).get(sid.version)
+            return entry[0] if entry else None
+
+    def versions_of(self, name: str) -> dict[int, ServableState]:
+        with self._lock:
+            return {v: s for v, (s, _) in self._states.get(name, {}).items()}
+
+    def all_states(self) -> dict[str, dict[int, ServableState]]:
+        with self._lock:
+            return {
+                name: {v: s for v, (s, _) in versions.items()}
+                for name, versions in self._states.items()
+            }
+
+    def bounded_log(self) -> list[tuple[ServableState, float]]:
+        with self._lock:
+            return list(self._log)
+
+    # -- waits ---------------------------------------------------------------
+
+    def wait_until_in_state(
+        self,
+        sid: ServableId,
+        goal: ManagerState,
+        *,
+        timeout_s: float | None = None,
+    ) -> ServableState:
+        """Block until `sid` reaches `goal` or END (error terminal).
+
+        Returns the reached state; raises TimeoutError on deadline.
+        """
+        deadline = None if timeout_s is None else time.monotonic() + timeout_s
+        with self._lock:
+            while True:
+                entry = self._states.get(sid.name, {}).get(sid.version)
+                if entry is not None:
+                    state = entry[0]
+                    if state.manager_state == goal or (
+                            state.manager_state == ManagerState.END):
+                        return state
+                remaining = None if deadline is None else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    raise TimeoutError(
+                        f"timed out waiting for {sid} to reach {goal.name}")
+                self._lock.wait(timeout=remaining)
+
+    def wait_until_available(
+        self, ids: Iterable[ServableId], *, timeout_s: float | None = None
+    ) -> dict[ServableId, ServableState]:
+        return {
+            sid: self.wait_until_in_state(
+                sid, ManagerState.AVAILABLE, timeout_s=timeout_s)
+            for sid in ids
+        }
+
+    def close(self) -> None:
+        self._sub.cancel()

@@ -1,0 +1,69 @@
+"""HBM resource accounting: load-gating against device memory.
+
+The reference's ResourceUtil/ResourceTracker (resources/resource_util.cc,
+resource_tracker.cc) gates loads on a declared resource pool; the survey's
+TPU mapping note (SURVEY.md §2.7) repurposes that for per-chip HBM. Loaders
+declare an upper-bound HBM estimate; reservations are approved only while
+the sum of estimates fits the pool.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from min_tfs_client_tpu.core.states import ServableId
+from min_tfs_client_tpu.utils.status import ServingError
+
+
+def detect_hbm_pool_bytes() -> int:
+    """Total HBM across local devices, from PJRT memory stats; generous
+    fallback for CPU test meshes."""
+    try:
+        import jax
+
+        total = 0
+        for d in jax.local_devices():
+            stats = getattr(d, "memory_stats", lambda: None)()
+            if stats and "bytes_limit" in stats:
+                total += int(stats["bytes_limit"])
+        if total:
+            return total
+    except Exception:  # pragma: no cover - device probing best-effort
+        pass
+    return 1 << 40  # virtual pool for CPU/test runs
+
+
+class ResourceTracker:
+    def __init__(self, pool_bytes: int | None = None):
+        self._pool = detect_hbm_pool_bytes() if pool_bytes is None else pool_bytes
+        self._lock = threading.Lock()
+        self._reserved: dict[ServableId, int] = {}
+
+    @property
+    def pool_bytes(self) -> int:
+        return self._pool
+
+    def reserved_bytes(self) -> int:
+        with self._lock:
+            return sum(self._reserved.values())
+
+    def try_reserve(self, sid: ServableId, estimate_bytes: int) -> bool:
+        with self._lock:
+            if sid in self._reserved:
+                return True
+            if sum(self._reserved.values()) + estimate_bytes > self._pool:
+                return False
+            self._reserved[sid] = estimate_bytes
+            return True
+
+    def reserve_or_raise(self, sid: ServableId, estimate_bytes: int) -> None:
+        if not self.try_reserve(sid, estimate_bytes):
+            with self._lock:
+                used = sum(self._reserved.values())
+            raise ServingError.resource_exhausted(
+                f"cannot load {sid}: estimate {estimate_bytes}B exceeds free HBM "
+                f"({used}B of {self._pool}B reserved)")
+
+    def release(self, sid: ServableId) -> None:
+        with self._lock:
+            self._reserved.pop(sid, None)

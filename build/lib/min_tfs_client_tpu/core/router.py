@@ -1,0 +1,96 @@
+"""Source routers: fan aspired-version streams out to per-platform targets.
+
+Parity with core/source_router.h + static_source_router.h +
+dynamic_source_router.h: a router IS a target (it exposes an
+aspired-versions callback) and owns N output ports, each wired to a
+downstream callback — ServerCore uses one port per platform source adapter
+("one adapter per platform, not per model", server_core.h:319-331).
+
+ * StaticSourceRouter: route chosen by substring match against a fixed
+   list; stream matching route[i] goes to port i, everything else to the
+   last (default) port.
+ * DynamicSourceRouter: exact name -> port map, reconfigurable at runtime
+   (the ReloadConfig path); unmapped streams go to the default port.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Mapping, Sequence
+
+AspiredCallback = Callable[[str, Sequence[tuple]], None]
+
+
+class SourceRouter:
+    """Base: subclasses implement route(name) -> port index."""
+
+    def __init__(self, num_ports: int):
+        if num_ports < 1:
+            raise ValueError("router needs at least one output port")
+        self._num_ports = num_ports
+        self._outputs: list[AspiredCallback | None] = [None] * num_ports
+
+    @property
+    def num_ports(self) -> int:
+        return self._num_ports
+
+    def set_output_callback(self, port: int, callback: AspiredCallback) -> None:
+        self._outputs[port] = callback
+
+    def route(self, servable_name: str) -> int:
+        raise NotImplementedError
+
+    def aspired_versions_callback(self) -> AspiredCallback:
+        return self._on_aspired
+
+    def _on_aspired(self, servable_name: str, versions: Sequence[tuple]) -> None:
+        port = self.route(servable_name)
+        if not 0 <= port < self._num_ports:
+            port = self._num_ports - 1
+        callback = self._outputs[port]
+        if callback is not None:
+            callback(servable_name, versions)
+
+
+class StaticSourceRouter(SourceRouter):
+    """Port i serves names containing route_substrings[i]; the implicit
+    last port is the default route (static_source_router.h semantics)."""
+
+    def __init__(self, route_substrings: Sequence[str]):
+        super().__init__(len(route_substrings) + 1)
+        self._substrings = list(route_substrings)
+
+    def route(self, servable_name: str) -> int:
+        for i, sub in enumerate(self._substrings):
+            if sub in servable_name:
+                return i
+        return self._num_ports - 1
+
+
+class DynamicSourceRouter(SourceRouter):
+    """Exact-name routes, swappable at runtime (dynamic_source_router.h:
+    UpdateRoutes); the last port is the default."""
+
+    def __init__(self, num_ports: int, routes: Mapping[str, int] | None = None):
+        super().__init__(num_ports)
+        self._lock = threading.Lock()
+        self._routes: dict[str, int] = {}
+        if routes:
+            self.update_routes(routes)
+
+    def update_routes(self, routes: Mapping[str, int]) -> None:
+        for name, port in routes.items():
+            if not 0 <= port < self._num_ports - 1:
+                raise ValueError(
+                    f"route {name!r} -> {port}: ports 0..{self._num_ports - 2} "
+                    "are routable; the last port is the default")
+        with self._lock:
+            self._routes = dict(routes)
+
+    def routes(self) -> dict[str, int]:
+        with self._lock:
+            return dict(self._routes)
+
+    def route(self, servable_name: str) -> int:
+        with self._lock:
+            return self._routes.get(servable_name, self._num_ports - 1)

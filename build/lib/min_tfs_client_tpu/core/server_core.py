@@ -1,0 +1,239 @@
+"""ServerCore: the facade tying config -> sources -> manager -> handles.
+
+Parity with model_servers/server_core.{h,cc}: owns the event bus, state
+monitor, aspired-versions manager and filesystem source; builds the
+per-platform adapter wiring from ModelServerConfig; ReloadConfig diffs model
+lists and waits for availability (server_core.h:199-307); resolves
+ModelSpec.version_label through the per-model label map (h:230-232, 414-416);
+GetServableHandle pins a version for a request (h:233-249).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional, Sequence
+
+from min_tfs_client_tpu.core.fs_source import (
+    FileSystemStoragePathSource,
+    MonitoredServable,
+    VersionPolicy,
+    list_version_dirs,
+)
+from min_tfs_client_tpu.core.manager import AspiredVersionsManager, ServableHandle
+from min_tfs_client_tpu.core.monitor import ServableStateMonitor
+from min_tfs_client_tpu.core.request_logger import ServerRequestLogger
+from min_tfs_client_tpu.core.resource import ResourceTracker
+from min_tfs_client_tpu.core.states import ManagerState, ServableId
+from min_tfs_client_tpu.protos import tfs_apis_pb2, tfs_config_pb2
+from min_tfs_client_tpu.servables import platforms
+from min_tfs_client_tpu.utils.event_bus import EventBus
+from min_tfs_client_tpu.utils.status import ServingError
+
+ModelConfig = tfs_config_pb2.ModelConfig
+ModelServerConfig = tfs_config_pb2.ModelServerConfig
+
+
+class ServerCore:
+    def __init__(
+        self,
+        config: ModelServerConfig,
+        *,
+        file_system_poll_wait_seconds: float = 1.0,
+        max_load_retries: int = 5,
+        load_retry_interval_s: float = 60.0,
+        num_load_threads: int = 2,
+        num_unload_threads: int = 2,
+        resource_tracker: ResourceTracker | None = None,
+        aspired_version_policy: str = "availability_preserving",
+        platform_configs: Optional[dict] = None,
+        wait_for_models_timeout_s: float = 120.0,
+    ):
+        self._lock = threading.RLock()
+        self._poll_wait = file_system_poll_wait_seconds
+        self._platform_configs = platform_configs or {}
+        self._wait_timeout = wait_for_models_timeout_s
+        self.event_bus: EventBus = EventBus()
+        self.monitor = ServableStateMonitor(self.event_bus)
+        self.manager = AspiredVersionsManager(
+            event_bus=self.event_bus,
+            resource_tracker=resource_tracker,
+            policy=aspired_version_policy,
+            max_load_retries=max_load_retries,
+            load_retry_interval_s=load_retry_interval_s,
+            num_load_threads=num_load_threads,
+            num_unload_threads=num_unload_threads,
+        )
+        self.request_logger = ServerRequestLogger()
+        # model name -> ModelConfig (current generation)
+        self._model_configs: dict[str, ModelConfig] = {}
+        self._source: FileSystemStoragePathSource | None = None
+        self._apply_config(config, initial=True)
+
+    # -- config plumbing -----------------------------------------------------
+
+    @staticmethod
+    def _validate(config: ModelServerConfig) -> list[ModelConfig]:
+        if config.WhichOneof("config") == "custom_model_config":
+            raise ServingError.invalid_argument(
+                "custom_model_config is not supported; use model_config_list")
+        models = list(config.model_config_list.config)
+        seen = set()
+        for m in models:
+            if not m.name or not m.base_path:
+                raise ServingError.invalid_argument(
+                    "ModelConfig requires name and base_path")
+            if m.name in seen:
+                raise ServingError.invalid_argument(
+                    f"duplicate model name {m.name!r} in config")
+            seen.add(m.name)
+            platform = m.model_platform or platforms.DEFAULT_PLATFORM
+            if not platforms.platform_exists(platform):
+                raise ServingError.invalid_argument(
+                    f"model {m.name!r}: unknown platform {platform!r}")
+        return models
+
+    def _monitored(self, models: Sequence[ModelConfig]) -> list[MonitoredServable]:
+        return [
+            MonitoredServable(
+                m.name, m.base_path,
+                VersionPolicy.from_proto(m.model_version_policy))
+            for m in models
+        ]
+
+    def _aspired_callback(self, name: str, versions) -> None:
+        """(version, path) pairs -> Loaders via the model's platform."""
+        with self._lock:
+            model = self._model_configs.get(name)
+        if model is None:
+            self.manager.set_aspired_versions(name, [])
+            return
+        platform = model.model_platform or platforms.DEFAULT_PLATFORM
+        loaders = [
+            (version, platforms.make_loader(
+                platform, name, version, path,
+                self._platform_configs.get(platform)))
+            for version, path in versions
+        ]
+        self.manager.set_aspired_versions(name, loaders)
+
+    def _apply_config(self, config: ModelServerConfig, *, initial: bool) -> None:
+        models = self._validate(config)
+        with self._lock:
+            self._model_configs = {m.name: ModelConfig() for m in models}
+            for m in models:
+                self._model_configs[m.name].CopyFrom(m)
+        self.request_logger.update(
+            {m.name: m.logging_config for m in models
+             if m.HasField("logging_config")})
+        if initial:
+            self._source = FileSystemStoragePathSource(
+                self._monitored(models), poll_wait_seconds=self._poll_wait)
+            self._source.set_aspired_versions_callback(self._aspired_callback)
+        else:
+            self._source.update_config(self._monitored(models))
+        self.manager.tick()
+        self._wait_for_models([m.name for m in models])
+
+    def _wait_for_models(self, names: Sequence[str]) -> None:
+        """Block until each named model is AVAILABLE, errored (raises), or
+        demonstrably has no versions on disk (ConnectAdaptersToManagerAndAwait
+        semantics, server_core.h:344)."""
+        import time
+
+        deadline = time.monotonic() + self._wait_timeout
+        for name in names:
+            with self._lock:
+                model = self._model_configs.get(name)
+            if model is None:
+                continue
+            expected = list_version_dirs(model.base_path)
+            if not expected:
+                continue
+            policy = VersionPolicy.from_proto(model.model_version_policy)
+            wanted = policy.select([v for v, _ in expected])
+            for version in wanted:
+                sid = ServableId(name, version)
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise ServingError.deadline_exceeded(
+                        f"timed out waiting for {sid} to become available")
+                state = self.monitor.wait_until_in_state(
+                    sid, ManagerState.AVAILABLE, timeout_s=remaining)
+                if state.manager_state == ManagerState.END:
+                    err = state.error
+                    raise err if err is not None else ServingError.internal(
+                        f"{sid} reached END without serving")
+
+    def reload_config(self, config: ModelServerConfig) -> None:
+        """Live reconfiguration (ServerCore::ReloadConfig, server_core.h:214)."""
+        self._apply_config(config, initial=False)
+
+    # -- request-path surface ------------------------------------------------
+
+    def resolve_version(self, model_spec: tfs_apis_pb2.ModelSpec) -> Optional[int]:
+        choice = model_spec.WhichOneof("version_choice")
+        if choice == "version":
+            return model_spec.version.value
+        if choice == "version_label":
+            label = model_spec.version_label
+            with self._lock:
+                model = self._model_configs.get(model_spec.name)
+            if model is None or label not in model.version_labels:
+                raise ServingError.invalid_argument(
+                    f"Requested version label: {label} for model: "
+                    f"{model_spec.name} does not exist")
+            return model.version_labels[label]
+        return None
+
+    def servable_handle(self, model_spec: tfs_apis_pb2.ModelSpec) -> ServableHandle:
+        if not model_spec.name:
+            raise ServingError.invalid_argument("Missing ModelSpec.name")
+        version = self.resolve_version(model_spec)
+        return self.manager.get_servable_handle(model_spec.name, version)
+
+    def model_version_states(
+        self, name: str, version: Optional[int] = None
+    ) -> list[tfs_apis_pb2.ModelVersionStatus]:
+        """All (or one) version states for GetModelStatus
+        (get_model_status_impl.cc:65-75)."""
+        from min_tfs_client_tpu.core.states import MANAGER_TO_WIRE
+
+        versions = self.monitor.versions_of(name)
+        if not versions:
+            raise ServingError.not_found(f"Could not find any versions of model {name}")
+        if version is not None:
+            if version not in versions:
+                raise ServingError.not_found(
+                    f"Could not find version {version} of model {name}")
+            versions = {version: versions[version]}
+        out = []
+        for v, state in sorted(versions.items()):
+            status = tfs_apis_pb2.ModelVersionStatus(
+                version=v, state=MANAGER_TO_WIRE[state.manager_state])
+            if state.error is not None:
+                status.status.CopyFrom(state.error.to_proto())
+            out.append(status)
+        return out
+
+    def model_exists(self, name: str) -> bool:
+        with self._lock:
+            return name in self._model_configs
+
+    def stop(self) -> None:
+        if self._source is not None:
+            self._source.stop()
+        self.manager.stop()
+        self.monitor.close()
+
+
+def single_model_config(
+    name: str, base_path: str, *, platform: str = platforms.DEFAULT_PLATFORM,
+) -> ModelServerConfig:
+    """The --model_name/--model_base_path single-model synthesis
+    (server.cc:83-96)."""
+    config = ModelServerConfig()
+    m = config.model_config_list.config.add()
+    m.name = name
+    m.base_path = base_path
+    m.model_platform = platform
+    return config

@@ -1,0 +1,97 @@
+"""Servable identity and lifecycle states.
+
+The LoaderHarness state machine reproduces the reference's observable
+states and legal transitions (core/loader_harness.h:56-92); ManagerState and
+its wire mapping reproduce servable_state.h via get_model_status_impl.cc:30-49
+— the wire enum (get_model_status.proto:25-60) is frozen contract.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass
+
+from min_tfs_client_tpu.protos import tfs_apis_pb2
+
+
+@dataclass(frozen=True, order=True)
+class ServableId:
+    name: str
+    version: int
+
+    def __str__(self):
+        return f"{self.name}:{self.version}"
+
+
+class HarnessState(enum.Enum):
+    NEW = "new"
+    LOAD_REQUESTED = "load_requested"
+    LOAD_APPROVED = "load_approved"
+    LOADING = "loading"
+    READY = "ready"
+    UNLOAD_REQUESTED = "unload_requested"
+    QUIESCING = "quiescing"
+    QUIESCED = "quiesced"
+    UNLOADING = "unloading"
+    DISABLED = "disabled"
+    ERROR = "error"
+
+
+# state -> states reachable from it (ERROR reachable from any non-terminal)
+LEGAL_TRANSITIONS: dict[HarnessState, set[HarnessState]] = {
+    HarnessState.NEW: {HarnessState.LOAD_REQUESTED},
+    HarnessState.LOAD_REQUESTED: {HarnessState.LOAD_APPROVED},
+    HarnessState.LOAD_APPROVED: {HarnessState.LOADING},
+    HarnessState.LOADING: {HarnessState.READY},
+    HarnessState.READY: {HarnessState.UNLOAD_REQUESTED},
+    HarnessState.UNLOAD_REQUESTED: {HarnessState.QUIESCING},
+    HarnessState.QUIESCING: {HarnessState.QUIESCED},
+    HarnessState.QUIESCED: {HarnessState.UNLOADING},
+    HarnessState.UNLOADING: {HarnessState.DISABLED},
+    HarnessState.DISABLED: set(),
+    HarnessState.ERROR: set(),
+}
+
+
+class ManagerState(enum.IntEnum):
+    """Coarse public state broadcast on the event bus (servable_state.h)."""
+
+    START = 10
+    LOADING = 20
+    AVAILABLE = 30
+    UNLOADING = 40
+    END = 50
+
+
+_WIRE = tfs_apis_pb2.ModelVersionStatus.State
+
+MANAGER_TO_WIRE = {
+    ManagerState.START: _WIRE.START,
+    ManagerState.LOADING: _WIRE.LOADING,
+    ManagerState.AVAILABLE: _WIRE.AVAILABLE,
+    ManagerState.UNLOADING: _WIRE.UNLOADING,
+    ManagerState.END: _WIRE.END,
+}
+
+HARNESS_TO_MANAGER = {
+    HarnessState.NEW: ManagerState.START,
+    HarnessState.LOAD_REQUESTED: ManagerState.START,
+    HarnessState.LOAD_APPROVED: ManagerState.LOADING,
+    HarnessState.LOADING: ManagerState.LOADING,
+    HarnessState.READY: ManagerState.AVAILABLE,
+    HarnessState.UNLOAD_REQUESTED: ManagerState.UNLOADING,
+    HarnessState.QUIESCING: ManagerState.UNLOADING,
+    HarnessState.QUIESCED: ManagerState.UNLOADING,
+    HarnessState.UNLOADING: ManagerState.UNLOADING,
+    HarnessState.DISABLED: ManagerState.END,
+    HarnessState.ERROR: ManagerState.END,
+}
+
+
+@dataclass(frozen=True)
+class ServableState:
+    """Event published on the bus at every harness transition."""
+
+    id: ServableId
+    manager_state: ManagerState
+    error: object | None = None  # ServingError when state is END-with-error

@@ -1,0 +1,11 @@
+"""Native TPU model families served by the framework.
+
+bert   — BERT-base encoder (Predict/Classify/Regress)  BASELINE config 3
+t5     — T5 seq2seq with on-chip KV-cache greedy decode BASELINE config 5
+resnet — ResNet50-v1.5 image classifier                 BASELINE config 2
+use    — sentence encoder, string input, ragged batch   BASELINE config 4
+
+Each family: Config dataclass (.tiny() for tests), init_params(rng, config),
+pure forward fns, and build_signatures(...) -> serving signatures. Export
+to a watchable version dir via models.export.export_servable.
+"""

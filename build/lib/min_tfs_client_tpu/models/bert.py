@@ -1,0 +1,198 @@
+"""BERT encoder family (BASELINE.md config 3: BERT-base, batch 1/32).
+
+TPU-first re-design of the capability the reference serves as an opaque
+SavedModel graph (servables/tensorflow/ runs it through Session::Run):
+here the encoder is a pure-JAX function built from models/layers.py blocks
+— bf16 on the MXU, flash attention, static shapes per batch bucket — and
+exposed through the same Predict/Classify/Regress signature contract
+(predict_util.cc:188-206; classifier.h:16-90 scores/classes outputs).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from min_tfs_client_tpu.models import layers as nn
+from min_tfs_client_tpu.tensor.example_codec import FeatureSpec
+
+
+@dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: int = 3072
+    max_position: int = 512
+    type_vocab_size: int = 2
+    num_labels: int = 2
+    layer_norm_eps: float = 1e-12
+
+    @staticmethod
+    def base(**kw) -> "BertConfig":
+        return BertConfig(**kw)
+
+    @staticmethod
+    def tiny(**kw) -> "BertConfig":
+        """Test-scale config: same code paths, toy dimensions."""
+        kw.setdefault("vocab_size", 128)
+        kw.setdefault("hidden_size", 32)
+        kw.setdefault("num_layers", 2)
+        kw.setdefault("num_heads", 2)
+        kw.setdefault("intermediate_size", 64)
+        kw.setdefault("max_position", 64)
+        return BertConfig(**kw)
+
+
+def init_params(rng: jax.Array, config: BertConfig) -> dict:
+    keys = iter(jax.random.split(rng, 5 + 2 * config.num_layers))
+    params = {
+        "embeddings": {
+            "word": nn.embed_init(next(keys), config.vocab_size,
+                                  config.hidden_size),
+            "position": nn.embed_init(next(keys), config.max_position,
+                                      config.hidden_size),
+            "token_type": nn.embed_init(next(keys), config.type_vocab_size,
+                                        config.hidden_size),
+            "norm": nn.layer_norm_init(config.hidden_size),
+        },
+        "layers": [],
+        "pooler": nn.dense_init(next(keys), config.hidden_size,
+                                config.hidden_size),
+        "head": nn.dense_init(next(keys), config.hidden_size,
+                              config.num_labels),
+    }
+    for _ in range(config.num_layers):
+        params["layers"].append({
+            "attention": nn.mha_init(next(keys), config.hidden_size,
+                                     config.num_heads),
+            "attention_norm": nn.layer_norm_init(config.hidden_size),
+            "mlp": nn.mlp_init(next(keys), config.hidden_size,
+                               config.intermediate_size),
+            "mlp_norm": nn.layer_norm_init(config.hidden_size),
+        })
+    return params
+
+
+def encode(params: dict, config: BertConfig, input_ids: jax.Array,
+           attention_mask: jax.Array,
+           token_type_ids: jax.Array | None = None) -> jax.Array:
+    """(B, S) ids -> (B, S, H) contextual embeddings. Post-LN transformer."""
+    b, s = input_ids.shape
+    emb = params["embeddings"]
+    x = nn.embed(emb["word"], input_ids)
+    x = x + nn.embed(emb["position"], jnp.arange(s)[None, :])
+    if token_type_ids is None:
+        token_type_ids = jnp.zeros_like(input_ids)
+    x = x + nn.embed(emb["token_type"], token_type_ids)
+    x = nn.layer_norm(emb["norm"], x, eps=config.layer_norm_eps)
+
+    lengths = nn.lengths_from_mask(attention_mask)
+    for layer in params["layers"]:
+        attn, _ = nn.mha(layer["attention"], x, num_heads=config.num_heads,
+                         lengths=lengths)
+        x = nn.layer_norm(layer["attention_norm"], x + attn,
+                          eps=config.layer_norm_eps)
+        x = nn.layer_norm(layer["mlp_norm"], x + nn.mlp(layer["mlp"], x),
+                          eps=config.layer_norm_eps)
+    return x
+
+
+def pooled(params: dict, config: BertConfig, input_ids, attention_mask,
+           token_type_ids=None) -> jax.Array:
+    """[CLS] vector through the tanh pooler -> (B, H) f32."""
+    x = encode(params, config, input_ids, attention_mask, token_type_ids)
+    return jnp.tanh(nn.dense(params["pooler"], x[:, 0])).astype(jnp.float32)
+
+
+def logits_fn(params: dict, config: BertConfig, input_ids, attention_mask,
+              token_type_ids=None) -> jax.Array:
+    h = pooled(params, config, input_ids, attention_mask, token_type_ids)
+    return nn.dense(params["head"], h.astype(nn.COMPUTE_DTYPE)).astype(
+        jnp.float32)
+
+
+# -- servable construction ---------------------------------------------------
+
+
+def build_signatures(params: dict, config: BertConfig, *, seq_len: int,
+                     class_labels: list[bytes] | None = None) -> dict:
+    """The model family's serving surface:
+
+      serving_default / predict: ids+mask -> logits, probabilities
+      classify: Example path -> scores (+classes when labels given)
+      regress:  Example path -> outputs (label-0 logit as the value)
+    """
+    from min_tfs_client_tpu.servables.servable import (
+        CLASSIFY_METHOD_NAME,
+        CLASSIFY_OUTPUT_CLASSES,
+        CLASSIFY_OUTPUT_SCORES,
+        REGRESS_METHOD_NAME,
+        REGRESS_OUTPUTS,
+        Signature,
+        TensorSpec,
+    )
+
+    def predict(params, inputs):
+        logits = logits_fn(params, config,
+                           jnp.asarray(inputs["input_ids"]),
+                           jnp.asarray(inputs["attention_mask"]))
+        return {"logits": logits,
+                "probabilities": jax.nn.softmax(logits, axis=-1)}
+
+    predict_sig = Signature(
+        fn=predict,
+        params=params,
+        inputs={"input_ids": TensorSpec(np.int32, (None, seq_len)),
+                "attention_mask": TensorSpec(np.int32, (None, seq_len))},
+        outputs={"logits": TensorSpec(np.float32, (None, config.num_labels)),
+                 "probabilities": TensorSpec(np.float32,
+                                             (None, config.num_labels))},
+    )
+
+    feature_specs = {
+        "input_ids": FeatureSpec(np.int64, (seq_len,)),
+        "attention_mask": FeatureSpec(np.int64, (seq_len,),
+                                      default=np.ones(seq_len, np.int64)),
+    }
+
+    def classify(params, inputs):
+        logits = logits_fn(params, config,
+                           jnp.asarray(inputs["input_ids"], jnp.int32),
+                           jnp.asarray(inputs["attention_mask"], jnp.int32))
+        return {CLASSIFY_OUTPUT_SCORES: jax.nn.softmax(logits, axis=-1)}
+
+    classify_sig = Signature(
+        fn=classify,
+        params=params,
+        inputs={"input_ids": TensorSpec(np.int64, (None, seq_len)),
+                "attention_mask": TensorSpec(np.int64, (None, seq_len))},
+        outputs={CLASSIFY_OUTPUT_SCORES: TensorSpec(
+            np.float32, (None, config.num_labels))},
+        method_name=CLASSIFY_METHOD_NAME,
+        feature_specs=feature_specs,
+        class_labels=class_labels,
+    )
+
+    def regress(params, inputs):
+        logits = logits_fn(params, config,
+                           jnp.asarray(inputs["input_ids"], jnp.int32),
+                           jnp.asarray(inputs["attention_mask"], jnp.int32))
+        return {REGRESS_OUTPUTS: logits[:, 0]}
+
+    regress_sig = Signature(
+        fn=regress,
+        params=params,
+        inputs={"input_ids": TensorSpec(np.int64, (None, seq_len)),
+                "attention_mask": TensorSpec(np.int64, (None, seq_len))},
+        outputs={REGRESS_OUTPUTS: TensorSpec(np.float32, (None,))},
+        method_name=REGRESS_METHOD_NAME,
+        feature_specs=feature_specs,
+    )
+
+    return {"serving_default": predict_sig, "predict": predict_sig,
+            "classify": classify_sig, "regress": regress_sig}

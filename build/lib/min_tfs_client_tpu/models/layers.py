@@ -1,0 +1,197 @@
+"""Shared neural-net building blocks for the served model families.
+
+Pure-JAX pytree modules (params are nested dicts of jax.Array), designed
+for the MXU: matmuls stay large and batched, compute dtype is bfloat16 with
+float32 accumulation/normalisation, and every function is jit/pjit-safe
+(no Python control flow on traced values). Attention dispatches to the
+Pallas flash kernel (ops/attention.py) on TPU.
+
+The reference serves opaque GraphDefs (SURVEY.md §2.6); this framework
+additionally ships first-class model families (BERT, T5, ResNet, USE) built
+from these blocks, exported as "jax"-platform servables.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from min_tfs_client_tpu.ops.attention import attention
+
+COMPUTE_DTYPE = jnp.bfloat16
+
+
+def _split(rng, n):
+    return jax.random.split(rng, n)
+
+
+# -- primitive layers --------------------------------------------------------
+
+
+def dense_init(rng, d_in: int, d_out: int, *, use_bias: bool = True,
+               stddev: Optional[float] = None) -> dict:
+    if stddev is None:
+        stddev = 1.0 / np.sqrt(d_in)
+    params = {"kernel": (jax.random.normal(rng, (d_in, d_out), jnp.float32)
+                         * stddev)}
+    if use_bias:
+        params["bias"] = jnp.zeros((d_out,), jnp.float32)
+    return params
+
+
+def dense(params: dict, x: jax.Array) -> jax.Array:
+    y = x.astype(COMPUTE_DTYPE) @ params["kernel"].astype(COMPUTE_DTYPE)
+    if "bias" in params:
+        y = y + params["bias"].astype(COMPUTE_DTYPE)
+    return y
+
+
+def layer_norm_init(dim: int) -> dict:
+    return {"scale": jnp.ones((dim,), jnp.float32),
+            "bias": jnp.zeros((dim,), jnp.float32)}
+
+
+def layer_norm(params: dict, x: jax.Array, *, eps: float = 1e-12) -> jax.Array:
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mean), axis=-1, keepdims=True)
+    y = (xf - mean) * jax.lax.rsqrt(var + eps)
+    return (y * params["scale"] + params["bias"]).astype(x.dtype)
+
+
+def rms_norm_init(dim: int) -> dict:
+    return {"scale": jnp.ones((dim,), jnp.float32)}
+
+
+def rms_norm(params: dict, x: jax.Array, *, eps: float = 1e-6) -> jax.Array:
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+    return (xf * jax.lax.rsqrt(var + eps) * params["scale"]).astype(x.dtype)
+
+
+def embed_init(rng, vocab: int, dim: int, *, stddev: float = 0.02) -> dict:
+    return {"embedding": jax.random.normal(rng, (vocab, dim), jnp.float32)
+            * stddev}
+
+
+def embed(params: dict, ids: jax.Array) -> jax.Array:
+    return params["embedding"].astype(COMPUTE_DTYPE)[ids]
+
+
+# -- multi-head attention ----------------------------------------------------
+
+
+def mha_init(rng, d_model: int, num_heads: int, *, d_kv: Optional[int] = None,
+             use_bias: bool = True) -> dict:
+    d_head = (d_kv or d_model // num_heads)
+    d_inner = num_heads * d_head
+    rq, rk, rv, ro = _split(rng, 4)
+    return {
+        "query": dense_init(rq, d_model, d_inner, use_bias=use_bias),
+        "key": dense_init(rk, d_model, d_inner, use_bias=use_bias),
+        "value": dense_init(rv, d_model, d_inner, use_bias=use_bias),
+        "out": dense_init(ro, d_inner, d_model, use_bias=use_bias),
+    }
+
+
+def _heads(x: jax.Array, num_heads: int) -> jax.Array:
+    b, s, d = x.shape
+    return x.reshape(b, s, num_heads, d // num_heads).transpose(0, 2, 1, 3)
+
+
+def _unheads(x: jax.Array) -> jax.Array:
+    b, h, s, d = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b, s, h * d)
+
+
+def mha(
+    params: dict,
+    x: jax.Array,
+    *,
+    num_heads: int,
+    kv: Optional[jax.Array] = None,
+    lengths: Optional[jax.Array] = None,
+    causal: bool = False,
+    bias: Optional[jax.Array] = None,
+    cache: Optional[dict] = None,
+    cache_index: Optional[jax.Array] = None,
+    scale: Optional[float] = None,
+) -> tuple[jax.Array, Optional[dict]]:
+    """Multi-head attention over x (self) or x->kv (cross).
+
+    With `cache` ({"k","v"} of (B, H, S_max, D)) and `cache_index`, the new
+    K/V rows are written at cache_index and attention runs over the whole
+    cache with unwritten slots masked via lengths. Two cache modes, both
+    jit-safe:
+     * prefill: x is the prompt, cache_index must be 0 — the full causal
+       prompt attention runs with queries at absolute positions 0..S;
+     * decode: x is one token (S=1), cache_index is its absolute position —
+       the single query is the newest position, so masking unwritten slots
+       subsumes causality.
+    Returns (output, updated_cache).
+    """
+    q = _heads(dense(params["query"], x), num_heads)
+    src = x if kv is None else kv
+    k = _heads(dense(params["key"], src), num_heads)
+    v = _heads(dense(params["value"], src), num_heads)
+
+    causal_offset = None
+    if cache is not None:
+        assert cache_index is not None
+        k = jax.lax.dynamic_update_slice(
+            cache["k"], k.astype(cache["k"].dtype), (0, 0, cache_index, 0))
+        v = jax.lax.dynamic_update_slice(
+            cache["v"], v.astype(cache["v"].dtype), (0, 0, cache_index, 0))
+        cache = {"k": k, "v": v}
+        written = cache_index + x.shape[1]
+        if lengths is None:
+            lengths = jnp.full((x.shape[0],), written, jnp.int32)
+        else:
+            lengths = jnp.minimum(lengths, written)
+        if x.shape[1] > 1:
+            causal_offset = 0  # prefill: queries sit at absolute 0..S
+        else:
+            causal = False  # decode: lengths masking subsumes causality
+
+    out = attention(q, k, v, causal=causal, lengths=lengths, bias=bias,
+                    scale=scale, causal_offset=causal_offset)
+    return dense(params["out"], _unheads(out)), cache
+
+
+def init_cache(batch: int, num_heads: int, max_len: int, d_head: int,
+               dtype=COMPUTE_DTYPE) -> dict:
+    return {"k": jnp.zeros((batch, num_heads, max_len, d_head), dtype),
+            "v": jnp.zeros((batch, num_heads, max_len, d_head), dtype)}
+
+
+# -- feed-forward ------------------------------------------------------------
+
+
+def mlp_init(rng, d_model: int, d_ff: int, *, use_bias: bool = True,
+             gated: bool = False) -> dict:
+    r1, r2, r3 = _split(rng, 3)
+    params = {"wi": dense_init(r1, d_model, d_ff, use_bias=use_bias),
+              "wo": dense_init(r2, d_ff, d_model, use_bias=use_bias)}
+    if gated:
+        params["wg"] = dense_init(r3, d_model, d_ff, use_bias=use_bias)
+    return params
+
+
+def mlp(params: dict, x: jax.Array, *, activation=jax.nn.gelu) -> jax.Array:
+    h = activation(dense(params["wi"], x))
+    if "wg" in params:
+        h = h * dense(params["wg"], x)
+    return dense(params["wo"], h)
+
+
+def lengths_from_mask(mask: jax.Array) -> jax.Array:
+    """(B, S) 0/1 attention mask -> (B,) valid lengths. Serving batches are
+    right-padded, so a row sum is exact; the flash kernel takes lengths."""
+    return jnp.sum(mask.astype(jnp.int32), axis=-1)
+
+
+def count_params(params) -> int:
+    return sum(int(np.prod(p.shape)) for p in jax.tree_util.tree_leaves(params))

@@ -1,0 +1,275 @@
+"""T5 encoder-decoder family (BASELINE.md config 5: seq2seq decode).
+
+The reference is stateless request/response (SURVEY.md §7 step 9); this
+family goes beyond it: autoregressive greedy decode with the KV cache held
+as device state *inside one jitted call* — encode, decoder prefill, and a
+lax.scan over decode steps compile to a single XLA program, so a serving
+Predict("decode") does the full generation on-chip with zero host round
+trips per token.
+
+Architecture: T5 v1.0 (relative position bias shared from layer 0,
+pre-RMSNorm, ReLU MLP, no biases in dense layers, tied softmax scaled by
+1/sqrt(d_model)).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from min_tfs_client_tpu.models import layers as nn
+
+
+@dataclass(frozen=True)
+class T5Config:
+    vocab_size: int = 32128
+    d_model: int = 512
+    d_kv: int = 64
+    num_heads: int = 8
+    d_ff: int = 2048
+    num_encoder_layers: int = 6
+    num_decoder_layers: int = 6
+    rel_pos_buckets: int = 32
+    rel_pos_max_distance: int = 128
+    pad_id: int = 0
+    eos_id: int = 1
+    decoder_start_id: int = 0
+
+    @staticmethod
+    def small(**kw) -> "T5Config":
+        return T5Config(**kw)
+
+    @staticmethod
+    def tiny(**kw) -> "T5Config":
+        kw.setdefault("vocab_size", 64)
+        kw.setdefault("d_model", 32)
+        kw.setdefault("d_kv", 8)
+        kw.setdefault("num_heads", 2)
+        kw.setdefault("d_ff", 64)
+        kw.setdefault("num_encoder_layers", 2)
+        kw.setdefault("num_decoder_layers", 2)
+        kw.setdefault("rel_pos_buckets", 8)
+        kw.setdefault("rel_pos_max_distance", 16)
+        return T5Config(**kw)
+
+
+# -- relative position bias (t5 bucketing) -----------------------------------
+
+
+def _relative_bucket(relative_position: jax.Array, *, bidirectional: bool,
+                     num_buckets: int, max_distance: int) -> jax.Array:
+    rel = relative_position
+    bucket = 0
+    if bidirectional:
+        num_buckets //= 2
+        bucket += jnp.where(rel > 0, num_buckets, 0)
+        rel = jnp.abs(rel)
+    else:
+        rel = -jnp.minimum(rel, 0)
+    max_exact = num_buckets // 2
+    is_small = rel < max_exact
+    log_ratio = (jnp.log(rel.astype(jnp.float32) / max_exact + 1e-9)
+                 / np.log(max_distance / max_exact))
+    large = max_exact + (log_ratio * (num_buckets - max_exact)).astype(
+        jnp.int32)
+    large = jnp.minimum(large, num_buckets - 1)
+    return bucket + jnp.where(is_small, rel, large)
+
+
+def relative_bias(params: dict, config: T5Config, qlen: int, klen: int, *,
+                  bidirectional: bool, q_offset: jax.Array | int = 0
+                  ) -> jax.Array:
+    """(1, H, qlen, klen) additive bias. q_offset positions the query rows
+    absolutely (decode step i attends from position i)."""
+    ctx = jnp.arange(qlen)[:, None] + q_offset
+    mem = jnp.arange(klen)[None, :]
+    buckets = _relative_bucket(
+        mem - ctx, bidirectional=bidirectional,
+        num_buckets=config.rel_pos_buckets,
+        max_distance=config.rel_pos_max_distance)
+    # embedding table (num_buckets, H) -> (1, H, q, k)
+    table = params["embedding"].astype(jnp.float32)
+    return table[buckets].transpose(2, 0, 1)[None]
+
+
+# -- parameters --------------------------------------------------------------
+
+
+def _block_init(rng, config: T5Config, *, cross: bool) -> dict:
+    n = 6 if cross else 4
+    keys = iter(jax.random.split(rng, n))
+    block = {
+        "self_attention": nn.mha_init(next(keys), config.d_model,
+                                      config.num_heads, d_kv=config.d_kv,
+                                      use_bias=False),
+        "self_norm": nn.rms_norm_init(config.d_model),
+        "mlp": nn.mlp_init(next(keys), config.d_model, config.d_ff,
+                           use_bias=False),
+        "mlp_norm": nn.rms_norm_init(config.d_model),
+    }
+    if cross:
+        block["cross_attention"] = nn.mha_init(
+            next(keys), config.d_model, config.num_heads, d_kv=config.d_kv,
+            use_bias=False)
+        block["cross_norm"] = nn.rms_norm_init(config.d_model)
+    return block
+
+
+def init_params(rng: jax.Array, config: T5Config) -> dict:
+    total = 3 + config.num_encoder_layers + config.num_decoder_layers
+    keys = iter(jax.random.split(rng, total))
+    return {
+        "shared_embedding": nn.embed_init(next(keys), config.vocab_size,
+                                          config.d_model, stddev=1.0),
+        "encoder": {
+            "rel_bias": {"embedding": jax.random.normal(
+                next(keys), (config.rel_pos_buckets, config.num_heads),
+                jnp.float32) * 0.1},
+            "layers": [_block_init(k, config, cross=False) for k in
+                       [next(keys) for _ in range(config.num_encoder_layers)]],
+            "final_norm": nn.rms_norm_init(config.d_model),
+        },
+        "decoder": {
+            "rel_bias": {"embedding": jax.random.normal(
+                next(keys), (config.rel_pos_buckets, config.num_heads),
+                jnp.float32) * 0.1},
+            "layers": [_block_init(k, config, cross=True) for k in
+                       [next(keys) for _ in range(config.num_decoder_layers)]],
+            "final_norm": nn.rms_norm_init(config.d_model),
+        },
+    }
+
+
+# -- encoder -----------------------------------------------------------------
+
+
+def encode(params: dict, config: T5Config, input_ids: jax.Array,
+           lengths: jax.Array) -> jax.Array:
+    x = nn.embed(params["shared_embedding"], input_ids)
+    enc = params["encoder"]
+    s = input_ids.shape[1]
+    bias = relative_bias(enc["rel_bias"], config, s, s, bidirectional=True)
+    # T5 attention is unscaled (scale folded into init): scale=1.0.
+    for layer in enc["layers"]:
+        h = nn.rms_norm(layer["self_norm"], x)
+        attn, _ = nn.mha(layer["self_attention"], h,
+                         num_heads=config.num_heads, lengths=lengths,
+                         bias=bias, scale=1.0)
+        x = x + attn
+        h = nn.rms_norm(layer["mlp_norm"], x)
+        x = x + nn.mlp(layer["mlp"], h, activation=jax.nn.relu)
+    return nn.rms_norm(params["encoder"]["final_norm"], x)
+
+
+# -- decoder -----------------------------------------------------------------
+
+
+def _decoder_step(params: dict, config: T5Config, token: jax.Array,
+                  step: jax.Array, caches: list[dict], encoded: jax.Array,
+                  enc_lengths: jax.Array) -> tuple[jax.Array, list[dict]]:
+    """One decode position: token (B, 1) at absolute position `step`.
+    Returns (logits (B, vocab), updated caches)."""
+    dec = params["decoder"]
+    x = nn.embed(params["shared_embedding"], token)
+    max_len = caches[0]["self"]["k"].shape[2]
+    bias = relative_bias(dec["rel_bias"], config, 1, max_len,
+                         bidirectional=False, q_offset=step)
+    new_caches = []
+    for layer, cache in zip(dec["layers"], caches):
+        h = nn.rms_norm(layer["self_norm"], x)
+        attn, self_cache = nn.mha(
+            layer["self_attention"], h, num_heads=config.num_heads,
+            causal=True, bias=bias, cache=cache["self"], cache_index=step,
+            scale=1.0)
+        x = x + attn
+        h = nn.rms_norm(layer["cross_norm"], x)
+        cross, _ = nn.mha(
+            layer["cross_attention"], h, num_heads=config.num_heads,
+            kv=encoded, lengths=enc_lengths, scale=1.0)
+        x = x + cross
+        h = nn.rms_norm(layer["mlp_norm"], x)
+        x = x + nn.mlp(layer["mlp"], h, activation=jax.nn.relu)
+        new_caches.append({"self": self_cache})
+    x = nn.rms_norm(dec["final_norm"], x)
+    # Tied output embedding, T5-style 1/sqrt(d) rescale.
+    logits = jnp.einsum(
+        "bld,vd->blv", x.astype(jnp.float32) / np.sqrt(config.d_model),
+        params["shared_embedding"]["embedding"])
+    return logits[:, 0], new_caches
+
+
+def greedy_decode(params: dict, config: T5Config, input_ids: jax.Array,
+                  lengths: jax.Array, *, max_decode_len: int
+                  ) -> tuple[jax.Array, jax.Array]:
+    """Full generation in one traced program. Returns (output_ids
+    (B, max_decode_len) padded with pad_id after EOS, output_lengths (B,))."""
+    b = input_ids.shape[0]
+    encoded = encode(params, config, input_ids, lengths)
+    d_head = config.d_kv
+    caches = [{"self": nn.init_cache(b, config.num_heads, max_decode_len,
+                                     d_head)}
+              for _ in range(config.num_decoder_layers)]
+    token0 = jnp.full((b, 1), config.decoder_start_id, jnp.int32)
+
+    def step_fn(carry, step):
+        token, caches, finished = carry
+        logits, caches = _decoder_step(params, config, token, step, caches,
+                                       encoded, lengths)
+        next_token = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        next_token = jnp.where(finished, config.pad_id, next_token)
+        finished = jnp.logical_or(finished, next_token == config.eos_id)
+        return (next_token[:, None], caches, finished), next_token
+
+    (_, _, finished), tokens = jax.lax.scan(
+        step_fn, (token0, caches, jnp.zeros((b,), bool)),
+        jnp.arange(max_decode_len))
+    output_ids = tokens.T  # (B, max_decode_len)
+    out_lengths = jnp.sum(
+        (output_ids != config.pad_id).astype(jnp.int32), axis=-1)
+    return output_ids, out_lengths
+
+
+# -- servable construction ---------------------------------------------------
+
+
+def build_signatures(params: dict, config: T5Config, *, seq_len: int,
+                     max_decode_len: int) -> dict:
+    from min_tfs_client_tpu.servables.servable import Signature, TensorSpec
+
+    def decode_fn(params, inputs):
+        ids = jnp.asarray(inputs["input_ids"], jnp.int32)
+        lengths = jnp.sum((ids != config.pad_id).astype(jnp.int32), axis=-1)
+        output_ids, out_lengths = greedy_decode(
+            params, config, ids, lengths, max_decode_len=max_decode_len)
+        return {"output_ids": output_ids, "output_lengths": out_lengths}
+
+    decode_sig = Signature(
+        fn=decode_fn,
+        params=params,
+        inputs={"input_ids": TensorSpec(np.int32, (None, seq_len))},
+        outputs={"output_ids": TensorSpec(np.int32, (None, max_decode_len)),
+                 "output_lengths": TensorSpec(np.int32, (None,))},
+        # Decode compiles are expensive: serve a small bucket ladder.
+        batch_buckets=(1, 4, 16, 32),
+    )
+
+    def encode_sig_fn(params, inputs):
+        ids = jnp.asarray(inputs["input_ids"], jnp.int32)
+        lengths = jnp.sum((ids != config.pad_id).astype(jnp.int32), axis=-1)
+        return {"encodings": encode(params, config, ids, lengths).astype(
+            jnp.float32)}
+
+    encode_sig = Signature(
+        fn=encode_sig_fn,
+        params=params,
+        inputs={"input_ids": TensorSpec(np.int32, (None, seq_len))},
+        outputs={"encodings": TensorSpec(
+            np.float32, (None, seq_len, config.d_model))},
+        batch_buckets=(1, 4, 16, 32),
+    )
+
+    return {"serving_default": decode_sig, "decode": decode_sig,
+            "encode": encode_sig}

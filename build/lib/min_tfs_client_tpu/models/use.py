@@ -1,0 +1,161 @@
+"""Universal-Sentence-Encoder-style family (BASELINE.md config 4:
+string input, ragged batching).
+
+The hard part the survey flags (§7 hard-parts (a),(d)): XLA has no string
+kernels, so the string path runs on host exactly where the reference runs
+string ops on CPU. Design: a host signature tokenizes (stable crc32-hash
+vocabulary, no lookup tables to ship), pads the ragged token batch to
+(batch bucket, seq bucket), then calls the jitted device encoder — so the
+device side stays static-shaped and the compile cache is bounded by
+|batch buckets| x |seq buckets|.
+"""
+
+from __future__ import annotations
+
+import re
+import zlib
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from min_tfs_client_tpu.models import layers as nn
+
+_TOKEN_RE = re.compile(rb"[a-z0-9']+")
+
+PAD_ID = 0
+OOV_OFFSET = 1  # hash ids start at 1; 0 is padding
+
+
+@dataclass(frozen=True)
+class USEConfig:
+    vocab_size: int = 8192        # hash-bucket count
+    hidden_size: int = 128
+    num_layers: int = 4
+    num_heads: int = 8
+    intermediate_size: int = 512
+    embed_dim: int = 512          # output embedding width
+    max_tokens: int = 128
+    seq_buckets: tuple = (16, 32, 64, 128)
+
+    @staticmethod
+    def v4(**kw) -> "USEConfig":
+        return USEConfig(**kw)
+
+    @staticmethod
+    def tiny(**kw) -> "USEConfig":
+        kw.setdefault("vocab_size", 256)
+        kw.setdefault("hidden_size", 16)
+        kw.setdefault("num_layers", 1)
+        kw.setdefault("num_heads", 2)
+        kw.setdefault("intermediate_size", 32)
+        kw.setdefault("embed_dim", 32)
+        kw.setdefault("max_tokens", 16)
+        kw.setdefault("seq_buckets", (8, 16))
+        return USEConfig(**kw)
+
+
+def tokenize(text: bytes | str, config: USEConfig) -> list[int]:
+    """Deterministic hash tokenizer: lowercase word pieces -> stable ids via
+    crc32 (process-independent, unlike Python's hash)."""
+    if isinstance(text, str):
+        text = text.encode("utf-8", "replace")
+    tokens = _TOKEN_RE.findall(text.lower())
+    return [OOV_OFFSET + (zlib.crc32(t) % (config.vocab_size - OOV_OFFSET))
+            for t in tokens[:config.max_tokens]]
+
+
+def tokenize_batch(texts: np.ndarray, config: USEConfig
+                   ) -> tuple[np.ndarray, np.ndarray]:
+    """(B,) strings -> ids (B, seq_bucket) + lengths (B,). The sequence dim
+    pads to the smallest bucket >= the ragged max (static-shape rule)."""
+    token_lists = [tokenize(t, config) for t in texts.reshape(-1)]
+    max_len = max((len(t) for t in token_lists), default=1) or 1
+    seq = next((s for s in config.seq_buckets if s >= max_len),
+               config.max_tokens)
+    ids = np.full((len(token_lists), seq), PAD_ID, np.int32)
+    lengths = np.zeros((len(token_lists),), np.int32)
+    for i, toks in enumerate(token_lists):
+        ids[i, :len(toks)] = toks
+        lengths[i] = len(toks)
+    return ids, lengths
+
+
+def init_params(rng: jax.Array, config: USEConfig) -> dict:
+    keys = iter(jax.random.split(rng, 3 + 2 * config.num_layers))
+    params = {
+        "embedding": nn.embed_init(next(keys), config.vocab_size,
+                                   config.hidden_size),
+        "position": nn.embed_init(next(keys), config.max_tokens,
+                                  config.hidden_size),
+        "layers": [],
+        "projection": nn.dense_init(next(keys), config.hidden_size,
+                                    config.embed_dim),
+    }
+    for _ in range(config.num_layers):
+        params["layers"].append({
+            "attention": nn.mha_init(next(keys), config.hidden_size,
+                                     config.num_heads),
+            "attention_norm": nn.layer_norm_init(config.hidden_size),
+            "mlp": nn.mlp_init(next(keys), config.hidden_size,
+                               config.intermediate_size),
+            "mlp_norm": nn.layer_norm_init(config.hidden_size),
+        })
+    return params
+
+
+def encode(params: dict, config: USEConfig, ids: jax.Array,
+           lengths: jax.Array) -> jax.Array:
+    """(B, S) ids -> (B, embed_dim) L2-normalised sentence embeddings."""
+    s = ids.shape[1]
+    x = nn.embed(params["embedding"], ids)
+    x = x + nn.embed(params["position"], jnp.arange(s)[None, :])
+    for layer in params["layers"]:
+        attn, _ = nn.mha(layer["attention"], x, num_heads=config.num_heads,
+                         lengths=lengths)
+        x = nn.layer_norm(layer["attention_norm"], x + attn)
+        x = nn.layer_norm(layer["mlp_norm"], x + nn.mlp(layer["mlp"], x))
+    # sqrt-N masked mean pooling (USE's DAN-style pooling).
+    mask = (jnp.arange(s)[None, :] < lengths[:, None])
+    xf = x.astype(jnp.float32) * mask[:, :, None]
+    pooled = jnp.sum(xf, axis=1) / jnp.sqrt(
+        jnp.maximum(lengths[:, None].astype(jnp.float32), 1.0))
+    emb = nn.dense(params["projection"], pooled.astype(nn.COMPUTE_DTYPE))
+    emb = emb.astype(jnp.float32)
+    return emb / jnp.maximum(jnp.linalg.norm(emb, axis=-1, keepdims=True),
+                             1e-9)
+
+
+def build_signatures(params: dict, config: USEConfig, *,
+                     batch_buckets=(1, 2, 4, 8, 16, 32)) -> dict:
+    from min_tfs_client_tpu.servables.servable import Signature, TensorSpec
+
+    # params ride as a jit argument (not a closure) so TP/DP placements on
+    # the leaves survive partitioning — see servable.Signature.params.
+    device_fn = jax.jit(
+        lambda params, ids, lengths: encode(params, config, ids, lengths))
+
+    def host_fn(params, inputs):
+        texts = np.asarray(inputs["text"], object).reshape(-1)
+        n = len(texts)
+        ids, lengths = tokenize_batch(texts, config)
+        # Batch-dim bucketing happens here (host signatures bypass the
+        # device bucketing in Signature._run_device).
+        padded = next((b for b in batch_buckets if b >= n), n)
+        if padded != n:
+            ids = np.concatenate([ids, np.repeat(ids[:1], padded - n, 0)])
+            lengths = np.concatenate(
+                [lengths, np.repeat(lengths[:1], padded - n)])
+        emb = np.asarray(device_fn(params, ids, lengths))[:n]
+        return {"embeddings": emb}
+
+    sig = Signature(
+        fn=host_fn,
+        params=params,
+        inputs={"text": TensorSpec(object, (None,))},
+        outputs={"embeddings": TensorSpec(
+            np.float32, (None, config.embed_dim))},
+        on_host=True,
+    )
+    return {"serving_default": sig, "predict": sig}

@@ -1,0 +1,59 @@
+"""ctypes bindings for libtpuserve.so, with pure-Python fallbacks.
+
+load() returns the bound library or None; callers (utils/tfrecord.py) fall
+back to Python implementations when the toolchain is unavailable.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import threading
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_tried = False
+
+
+def load() -> ctypes.CDLL | None:
+    global _lib, _tried
+    with _lock:
+        if _tried:
+            return _lib
+        _tried = True
+        try:
+            from min_tfs_client_tpu.native.build import build
+
+            so_path = build()
+            if so_path is None:
+                return None
+            lib = ctypes.CDLL(str(so_path))
+        except OSError:
+            return None
+        lib.tpuserve_crc32c.restype = ctypes.c_uint32
+        lib.tpuserve_crc32c.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
+        lib.tpuserve_masked_crc32c.restype = ctypes.c_uint32
+        lib.tpuserve_masked_crc32c.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
+        lib.tpuserve_scan_tfrecords.restype = ctypes.c_long
+        lib.tpuserve_scan_tfrecords.argtypes = [
+            ctypes.c_char_p, ctypes.c_size_t,
+            ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_uint64),
+            ctypes.c_long, ctypes.c_int,
+        ]
+        lib.tpuserve_frame_tfrecord.restype = None
+        lib.tpuserve_frame_tfrecord.argtypes = [
+            ctypes.c_char_p, ctypes.c_uint64, ctypes.c_char_p, ctypes.c_char_p,
+        ]
+        lib.tpuserve_parse_examples_dense.restype = ctypes.c_long
+        lib.tpuserve_parse_examples_dense.argtypes = [
+            ctypes.c_char_p,                      # concatenated examples
+            ctypes.POINTER(ctypes.c_uint64),      # offsets
+            ctypes.POINTER(ctypes.c_uint64),      # lengths
+            ctypes.c_long,                        # n examples
+            ctypes.c_char_p, ctypes.c_uint64,     # feature name
+            ctypes.c_int,                         # mode: 0 f32, 1 i64
+            ctypes.c_void_p,                      # out column
+            ctypes.c_uint64,                      # per-example value count
+            ctypes.POINTER(ctypes.c_int64),       # per-example found counts
+        ]
+        _lib = lib
+        return _lib

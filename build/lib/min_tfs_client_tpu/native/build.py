@@ -1,0 +1,36 @@
+"""Build libtpuserve.so with the system compiler.
+
+Invoked lazily at import by native/__init__.py (cached), or manually:
+    python -m min_tfs_client_tpu.native.build
+"""
+
+from __future__ import annotations
+
+import pathlib
+import shutil
+import subprocess
+
+NATIVE_DIR = pathlib.Path(__file__).resolve().parent
+SO_PATH = NATIVE_DIR / "libtpuserve.so"
+SRC = NATIVE_DIR / "tpuserve.cpp"
+
+
+def build(force: bool = False) -> pathlib.Path | None:
+    if SO_PATH.exists() and not force and \
+            SO_PATH.stat().st_mtime >= SRC.stat().st_mtime:
+        return SO_PATH
+    cxx = shutil.which("g++") or shutil.which("c++") or shutil.which("clang++")
+    if cxx is None:
+        return None
+    cmd = [cxx, "-O3", "-shared", "-fPIC", "-std=c++17",
+           "-o", str(SO_PATH), str(SRC)]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True)
+    except subprocess.CalledProcessError:
+        return None
+    return SO_PATH
+
+
+if __name__ == "__main__":
+    path = build(force=True)
+    print(f"built: {path}")

@@ -1,0 +1,393 @@
+// Native runtime support library.
+//
+// The reference's record I/O and checksumming live in C++
+// (tensorflow/core/lib/io/record_reader.cc, lib/hash/crc32c.cc); this
+// library is their equivalent for the TPU serving stack, exposed to Python
+// via ctypes (no pybind11 in this image). Python fallbacks exist for every
+// entry point, so the .so is an accelerator, not a hard dependency.
+//
+// Contents:
+//   crc32c            Castagnoli CRC, slice-by-8 software implementation
+//   masked crc        TFRecord's rotated+offset masking
+//   tfrecord framing  batch scan of [len][lencrc][data][datacrc] records
+//   pad_rows          batched row-padding memcpy kernel (batch assembly)
+//   example parsing   protobuf wire-format scan of tensorflow.Example
+//                     batches into dense numeric columns (the reference
+//                     parses Examples with the in-graph ParseExample op,
+//                     servables/tensorflow/classifier.cc; XLA has no
+//                     string kernels, so this host path is the
+//                     Classify/Regress hot loop — SURVEY.md hard part (d))
+//
+// Build: cc -O3 -shared -fPIC -o libtpuserve.so tpuserve.cpp  (see build.py)
+
+#include <cstdint>
+#include <cstring>
+#include <cstddef>
+
+namespace {
+
+// ---------------------------------------------------------------------------
+// CRC32C (Castagnoli, polynomial 0x82f63b78), slice-by-8.
+
+uint32_t kCrcTable[8][256];
+bool table_init_done = false;
+
+void InitTables() {
+  if (table_init_done) return;
+  for (uint32_t i = 0; i < 256; i++) {
+    uint32_t crc = i;
+    for (int j = 0; j < 8; j++) {
+      crc = (crc >> 1) ^ ((crc & 1) ? 0x82f63b78u : 0);
+    }
+    kCrcTable[0][i] = crc;
+  }
+  for (int t = 1; t < 8; t++) {
+    for (uint32_t i = 0; i < 256; i++) {
+      kCrcTable[t][i] =
+          (kCrcTable[t - 1][i] >> 8) ^ kCrcTable[0][kCrcTable[t - 1][i] & 0xff];
+    }
+  }
+  table_init_done = true;
+}
+
+uint32_t Extend(uint32_t crc, const uint8_t* data, size_t n) {
+  InitTables();
+  crc = ~crc;
+  while (n >= 8) {
+    uint64_t word;
+    memcpy(&word, data, 8);
+    word ^= crc;
+    crc = kCrcTable[7][word & 0xff] ^ kCrcTable[6][(word >> 8) & 0xff] ^
+          kCrcTable[5][(word >> 16) & 0xff] ^ kCrcTable[4][(word >> 24) & 0xff] ^
+          kCrcTable[3][(word >> 32) & 0xff] ^ kCrcTable[2][(word >> 40) & 0xff] ^
+          kCrcTable[1][(word >> 48) & 0xff] ^ kCrcTable[0][(word >> 56) & 0xff];
+    data += 8;
+    n -= 8;
+  }
+  while (n--) {
+    crc = kCrcTable[0][(crc ^ *data++) & 0xff] ^ (crc >> 8);
+  }
+  return ~crc;
+}
+
+constexpr uint32_t kMaskDelta = 0xa282ead8u;
+
+uint32_t Mask(uint32_t crc) {
+  return ((crc >> 15) | (crc << 17)) + kMaskDelta;
+}
+
+uint32_t Unmask(uint32_t masked) {
+  uint32_t rot = masked - kMaskDelta;
+  return (rot >> 17) | (rot << 15);
+}
+
+// ---------------------------------------------------------------------------
+// tensorflow.Example wire-format parsing.
+//
+// Message layout (example.proto / feature.proto):
+//   Example   { Features features = 1; }
+//   Features  { map<string, Feature> feature = 1; }   map entry: key=1, value=2
+//   Feature   { oneof { BytesList=1; FloatList=2; Int64List=3; } }
+//   FloatList { repeated float value = 1 [packed]; }
+//   Int64List { repeated int64 value = 1 [packed]; }
+//
+// Error codes (per example, reported via counts[]): -1 malformed proto,
+// -2 feature kind does not match the requested numeric mode, -3 more
+// values than the dense spec holds. Callers fall back to the Python
+// decoder on any negative count, so these paths stay correctness-neutral.
+
+constexpr int kModeF32 = 0;
+constexpr int kModeI64 = 1;
+
+bool ReadVarint(const uint8_t** pp, const uint8_t* end, uint64_t* out) {
+  uint64_t result = 0;
+  int shift = 0;
+  const uint8_t* p = *pp;
+  while (p < end && shift < 64) {
+    uint8_t b = *p++;
+    result |= uint64_t(b & 0x7f) << shift;
+    if (!(b & 0x80)) {
+      *pp = p;
+      *out = result;
+      return true;
+    }
+    shift += 7;
+  }
+  return false;
+}
+
+bool SkipField(const uint8_t** pp, const uint8_t* end, uint32_t wire_type) {
+  const uint8_t* p = *pp;
+  uint64_t tmp;
+  switch (wire_type) {
+    case 0:
+      if (!ReadVarint(&p, end, &tmp)) return false;
+      break;
+    case 1:
+      if (end - p < 8) return false;
+      p += 8;
+      break;
+    case 2:
+      if (!ReadVarint(&p, end, &tmp) || uint64_t(end - p) < tmp) return false;
+      p += tmp;
+      break;
+    case 5:
+      if (end - p < 4) return false;
+      p += 4;
+      break;
+    default:
+      return false;
+  }
+  *pp = p;
+  return true;
+}
+
+long ParseFloatList(const uint8_t* p, const uint8_t* end, float* out,
+                    uint64_t cap, long base) {
+  long count = base;
+  while (p < end) {
+    uint64_t tag;
+    if (!ReadVarint(&p, end, &tag)) return -1;
+    uint32_t field = tag >> 3, wt = tag & 7;
+    if (field == 1 && wt == 2) {  // packed
+      uint64_t len;
+      if (!ReadVarint(&p, end, &len) || uint64_t(end - p) < len || len % 4)
+        return -1;
+      uint64_t m = len / 4;
+      if (uint64_t(count) + m > cap) return -3;
+      memcpy(out + count, p, len);
+      count += m;
+      p += len;
+    } else if (field == 1 && wt == 5) {  // unpacked
+      if (end - p < 4) return -1;
+      if (uint64_t(count) + 1 > cap) return -3;
+      memcpy(out + count, p, 4);
+      count++;
+      p += 4;
+    } else if (!SkipField(&p, end, wt)) {
+      return -1;
+    }
+  }
+  return count;
+}
+
+long ParseInt64List(const uint8_t* p, const uint8_t* end, int64_t* out,
+                    uint64_t cap, long base) {
+  long count = base;
+  while (p < end) {
+    uint64_t tag;
+    if (!ReadVarint(&p, end, &tag)) return -1;
+    uint32_t field = tag >> 3, wt = tag & 7;
+    if (field == 1 && wt == 2) {  // packed varints
+      uint64_t len;
+      if (!ReadVarint(&p, end, &len) || uint64_t(end - p) < len) return -1;
+      const uint8_t* lend = p + len;
+      while (p < lend) {
+        uint64_t v;
+        if (!ReadVarint(&p, lend, &v)) return -1;
+        if (uint64_t(count) + 1 > cap) return -3;
+        out[count++] = int64_t(v);
+      }
+    } else if (field == 1 && wt == 0) {  // unpacked
+      uint64_t v;
+      if (!ReadVarint(&p, end, &v)) return -1;
+      if (uint64_t(count) + 1 > cap) return -3;
+      out[count++] = int64_t(v);
+    } else if (!SkipField(&p, end, wt)) {
+      return -1;
+    }
+  }
+  return count;
+}
+
+// Parse one Feature submessage; returns the accumulated value count, or a
+// negative error. A list of the wrong kind that actually has payload is a
+// kind mismatch (-2); the matching-kind list may appear multiple times
+// (proto repeated-merge semantics).
+long ParseFeature(const uint8_t* p, const uint8_t* end, int mode, void* out,
+                  uint64_t cap, long base) {
+  long count = base;
+  while (p < end) {
+    uint64_t tag;
+    if (!ReadVarint(&p, end, &tag)) return -1;
+    uint32_t field = tag >> 3, wt = tag & 7;
+    if (wt == 2 && field >= 1 && field <= 3) {
+      uint64_t len;
+      if (!ReadVarint(&p, end, &len) || uint64_t(end - p) < len) return -1;
+      bool want = (mode == kModeF32 && field == 2) ||
+                  (mode == kModeI64 && field == 3);
+      if (want) {
+        long r = (mode == kModeF32)
+                     ? ParseFloatList(p, p + len, (float*)out, cap, count)
+                     : ParseInt64List(p, p + len, (int64_t*)out, cap, count);
+        if (r < 0) return r;
+        count = r;
+      } else if (len > 0) {
+        return -2;  // populated list of another kind
+      }
+      p += len;
+    } else if (!SkipField(&p, end, wt)) {
+      return -1;
+    }
+  }
+  return count;
+}
+
+// Scan one serialized Example for feature `name`; accumulate its numeric
+// values. Returns count or negative error.
+long ParseExampleFeature(const uint8_t* p, const uint8_t* end,
+                         const char* name, uint64_t name_len, int mode,
+                         void* out, uint64_t cap) {
+  long count = 0;
+  while (p < end) {
+    uint64_t tag;
+    if (!ReadVarint(&p, end, &tag)) return -1;
+    uint32_t field = tag >> 3, wt = tag & 7;
+    if (field == 1 && wt == 2) {  // Features
+      uint64_t flen;
+      if (!ReadVarint(&p, end, &flen) || uint64_t(end - p) < flen) return -1;
+      const uint8_t* fend = p + flen;
+      while (p < fend) {
+        uint64_t etag;
+        if (!ReadVarint(&p, fend, &etag)) return -1;
+        uint32_t efield = etag >> 3, ewt = etag & 7;
+        if (efield == 1 && ewt == 2) {  // map entry
+          uint64_t elen;
+          if (!ReadVarint(&p, fend, &elen) || uint64_t(fend - p) < elen)
+            return -1;
+          const uint8_t* eend = p + elen;
+          const uint8_t* key = nullptr;
+          uint64_t key_len = 0;
+          const uint8_t* val = nullptr;
+          uint64_t val_len = 0;
+          while (p < eend) {
+            uint64_t ktag;
+            if (!ReadVarint(&p, eend, &ktag)) return -1;
+            uint32_t kfield = ktag >> 3, kwt = ktag & 7;
+            if (kwt == 2 && (kfield == 1 || kfield == 2)) {
+              uint64_t klen;
+              if (!ReadVarint(&p, eend, &klen) || uint64_t(eend - p) < klen)
+                return -1;
+              if (kfield == 1) {
+                key = p;
+                key_len = klen;
+              } else {
+                val = p;
+                val_len = klen;
+              }
+              p += klen;
+            } else if (!SkipField(&p, eend, kwt)) {
+              return -1;
+            }
+          }
+          if (key != nullptr && key_len == name_len &&
+              memcmp(key, name, name_len) == 0 && val != nullptr) {
+            // Protobuf map semantics: a duplicate key REPLACES the earlier
+            // entry (last wins), so restart the count; only repeated lists
+            // WITHIN one Feature merge-concatenate (handled by
+            // ParseFeature's base accumulation).
+            long r = ParseFeature(val, val + val_len, mode, out, cap, 0);
+            if (r < 0) return r;
+            count = r;
+          }
+          p = eend;
+        } else if (!SkipField(&p, fend, ewt)) {
+          return -1;
+        }
+      }
+    } else if (!SkipField(&p, end, wt)) {
+      return -1;
+    }
+  }
+  return count;
+}
+
+}  // namespace
+
+extern "C" {
+
+uint32_t tpuserve_crc32c(const uint8_t* data, size_t n) {
+  return Extend(0, data, n);
+}
+
+uint32_t tpuserve_masked_crc32c(const uint8_t* data, size_t n) {
+  return Mask(Extend(0, data, n));
+}
+
+// Scan a TFRecord buffer; fill (offset, length) pairs for each record's
+// payload. Returns the record count, or -1-based negative error codes:
+//   -1 truncated header/payload, -2 length-crc mismatch, -3 data-crc
+//   mismatch. `verify` 0 skips crc checks. `max_records` caps output.
+long tpuserve_scan_tfrecords(const uint8_t* buf, size_t n, uint64_t* offsets,
+                             uint64_t* lengths, long max_records, int verify) {
+  size_t pos = 0;
+  long count = 0;
+  while (pos < n && count < max_records) {
+    if (pos + 12 > n) return -1;
+    uint64_t len;
+    memcpy(&len, buf + pos, 8);
+    uint32_t len_crc;
+    memcpy(&len_crc, buf + pos + 8, 4);
+    if (verify && Unmask(len_crc) != Extend(0, buf + pos, 8)) return -2;
+    // Overflow-safe bounds check: a corrupt u64 length must not wrap
+    // `pos + 12 + len + 4` back into range and read out of bounds.
+    size_t rem = n - pos - 12;  // bytes after the header; >= 0 by the check above
+    if (len > rem || rem - len < 4) return -1;
+    if (verify) {
+      uint32_t data_crc;
+      memcpy(&data_crc, buf + pos + 12 + len, 4);
+      if (Unmask(data_crc) != Extend(0, buf + pos + 12, len)) return -3;
+    }
+    offsets[count] = pos + 12;
+    lengths[count] = len;
+    count++;
+    pos += 12 + len + 4;
+  }
+  return count;
+}
+
+// Write the 12-byte header and 4-byte footer for one record of length n.
+void tpuserve_frame_tfrecord(const uint8_t* data, uint64_t n, uint8_t* header,
+                             uint8_t* footer) {
+  memcpy(header, &n, 8);
+  uint32_t len_crc = Mask(Extend(0, header, 8));
+  memcpy(header + 8, &len_crc, 4);
+  uint32_t data_crc = Mask(Extend(0, data, n));
+  memcpy(footer, &data_crc, 4);
+}
+
+// Copy `rows` rows of `row_bytes` each from src into dst, then fill dst up
+// to `total_rows` with copies of the first row (the batch-padding rule:
+// pad with valid data, batching_session.h:94-99). One call per tensor.
+void tpuserve_pad_rows(const uint8_t* src, uint64_t rows, uint64_t row_bytes,
+                       uint8_t* dst, uint64_t total_rows) {
+  memcpy(dst, src, rows * row_bytes);
+  for (uint64_t r = rows; r < total_rows; r++) {
+    memcpy(dst + r * row_bytes, src, row_bytes);
+  }
+}
+
+// Decode feature `name` from `n` serialized Examples (concatenated in buf,
+// located by offsets/lengths) into a dense column `out` of n * per_ex_n
+// values (float when mode==0, int64 when mode==1). counts[i] receives the
+// number of values found for example i (0 = feature missing), or a
+// negative per-example error (-1 malformed, -2 kind mismatch, -3 more
+// than per_ex_n values). Rows with counts[i] != per_ex_n are left
+// untouched for the caller's default/error handling. Always returns 0.
+long tpuserve_parse_examples_dense(const uint8_t* buf, const uint64_t* offsets,
+                                   const uint64_t* lengths, long n,
+                                   const char* name, uint64_t name_len,
+                                   int mode, void* out, uint64_t per_ex_n,
+                                   int64_t* counts) {
+  for (long i = 0; i < n; i++) {
+    const uint8_t* p = buf + offsets[i];
+    void* row = (mode == 0) ? (void*)((float*)out + i * per_ex_n)
+                            : (void*)((int64_t*)out + i * per_ex_n);
+    counts[i] =
+        ParseExampleFeature(p, p + lengths[i], name, name_len, mode, row,
+                            per_ex_n);
+  }
+  return 0;
+}
+
+}  // extern "C"

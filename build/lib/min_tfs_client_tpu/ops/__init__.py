@@ -1,0 +1,7 @@
+"""TPU compute ops: Pallas kernels + jnp references."""
+
+from min_tfs_client_tpu.ops.attention import (  # noqa: F401
+    attention,
+    attention_reference,
+    flash_attention,
+)

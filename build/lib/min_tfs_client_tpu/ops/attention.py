@@ -1,0 +1,253 @@
+"""Attention ops: Pallas TPU flash attention + pure-JAX reference.
+
+The reference serving stack has no attention anywhere (SURVEY.md §2.11 —
+its kernels layer is tensorflow/core/kernels/, CPU/CUDA); attention here is
+the hot op of the model families this framework serves (BERT, USE, T5), so
+it gets the framework's one hand-written TPU kernel:
+
+ * `flash_attention` — blocked online-softmax attention in a single Pallas
+   kernel: Q tiles stream through VMEM, K/V live in VMEM per (batch, head),
+   scores never materialise in HBM. Runs on the MXU in bf16/f32 with f32
+   accumulation. Supports causal masking (decoder) and per-example key
+   lengths (padded serving batches).
+ * `attention_reference` — the jnp semantics oracle: used on CPU backends,
+   for odd shapes, and when an additive bias is supplied (T5's relative
+   position bias).
+
+`attention()` picks the fast path automatically; all model code calls it.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+NEG_INF = -1e30  # finite -inf stand-in: keeps masked softmax NaN-free
+
+# Pallas block sizes. Q is tiled; K/V stream through in chunks of _BLOCK_KV.
+_BLOCK_Q = 128
+_BLOCK_KV = 128
+
+
+def attention_reference(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = False,
+    lengths: Optional[jax.Array] = None,
+    bias: Optional[jax.Array] = None,
+    scale: Optional[float] = None,
+    causal_offset: Optional[int] = None,
+) -> jax.Array:
+    """Plain softmax(q k^T / sqrt(d) + bias) v.
+
+    Shapes: q (B, H, Sq, D); k, v (B, H, Skv, D); lengths (B,) int32 valid
+    key counts; bias broadcastable to (B, H, Sq, Skv). Returns (B, H, Sq, D)
+    in q.dtype; softmax runs in f32. `causal_offset` is query row 0's
+    absolute key position (default Skv-Sq: right-aligned, the KV-cache
+    decode convention; pass 0 for cache prefill).
+    """
+    *_, sq, d = q.shape
+    skv = k.shape[-2]
+    if scale is None:
+        scale = 1.0 / float(np.sqrt(d))
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32)
+    s = s * scale
+    if bias is not None:
+        s = s + bias.astype(jnp.float32)
+    if causal:
+        offset = skv - sq if causal_offset is None else causal_offset
+        qi = jnp.arange(sq)[:, None] + offset
+        ki = jnp.arange(skv)[None, :]
+        s = jnp.where(qi >= ki, s, NEG_INF)
+    if lengths is not None:
+        ki = jnp.arange(skv)[None, None, None, :]
+        s = jnp.where(ki < lengths[:, None, None, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    if lengths is not None:
+        # Fully-masked rows -> zeros (not a uniform mean over masked V),
+        # matching the flash kernel's row_valid semantics.
+        all_masked = jnp.max(s, axis=-1, keepdims=True) <= NEG_INF * 0.5
+        p = jnp.where(all_masked, 0.0, p)
+    return jnp.einsum(
+        "bhqk,bhkd->bhqd", p.astype(v.dtype), v,
+        preferred_element_type=jnp.float32).astype(q.dtype)
+
+
+def _flash_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, *,
+                  scale: float, causal: bool, block_kv: int,
+                  kv_seq_len: int, q_offset: int):
+    """One (batch*head, q-block) grid cell.
+
+    Refs: len_ref (1,1) SMEM int32; q_ref (block_q, D); k_ref/v_ref
+    (kv_seq_len, D); o_ref (block_q, D). Online softmax over KV chunks with
+    f32 running (max, denom, acc) carried through a fori_loop.
+    """
+    block_q, d = q_ref.shape
+    q = q_ref[...].astype(jnp.float32) * scale
+    valid_len = len_ref[pl.program_id(0)]
+    q_block_start = pl.program_id(1) * block_q
+
+    n_kv = kv_seq_len // block_kv
+
+    def body(i, carry):
+        m_prev, l_prev, acc = carry
+        kv_start = i * block_kv
+        k_blk = k_ref[pl.ds(kv_start, block_kv), :].astype(jnp.float32)
+        v_blk = v_ref[pl.ds(kv_start, block_kv), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)  # (block_q, block_kv)
+
+        ki = kv_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        mask = ki < valid_len
+        if causal:
+            qi = (q_offset + q_block_start
+                  + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0))
+            mask = jnp.logical_and(mask, qi >= ki)
+        s = jnp.where(mask, s, NEG_INF)
+
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)
+        correction = jnp.exp(m_prev - m_new)
+        l_new = correction * l_prev + jnp.sum(p, axis=1, keepdims=True)
+        acc = acc * correction + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return m_new, l_new, acc
+
+    m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+    if causal:
+        # Skip KV blocks strictly above this Q block's diagonal.
+        q_end = q_offset + q_block_start + block_q  # exclusive global row end
+        n_run = jnp.minimum(n_kv, (q_end + block_kv - 1) // block_kv)
+    else:
+        n_run = n_kv
+    m, l, acc = jax.lax.fori_loop(0, n_run, body, (m0, l0, acc0))
+    # Fully-masked rows (valid_len 0, or causal skip ran zero blocks) must
+    # return zeros: m never left NEG_INF there (exp(s-m)=1 would otherwise
+    # leak a mean over masked V rows into acc).
+    row_valid = m > NEG_INF * 0.5
+    l = jnp.where(l == 0.0, 1.0, l)
+    o_ref[...] = jnp.where(row_valid, acc / l, 0.0).astype(o_ref.dtype)
+
+
+try:  # Pallas import is deferred-safe: CPU-only envs still get reference.
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    _HAVE_PALLAS = True
+except Exception:  # pragma: no cover
+    _HAVE_PALLAS = False
+
+
+def _pad_to(x: jax.Array, axis: int, multiple: int) -> jax.Array:
+    size = x.shape[axis]
+    pad = (-size) % multiple
+    if pad == 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("causal", "scale", "interpret", "causal_offset"))
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = False,
+    lengths: Optional[jax.Array] = None,
+    scale: Optional[float] = None,
+    interpret: bool = False,
+    causal_offset: Optional[int] = None,
+) -> jax.Array:
+    """Pallas flash attention. Same contract as attention_reference
+    (minus bias). Sequence dims are padded to block multiples internally;
+    padded keys are masked via `lengths`, padded queries sliced off."""
+    b, h, sq, d = q.shape
+    skv = k.shape[-2]
+    if scale is None:
+        scale = 1.0 / float(np.sqrt(d))
+    if lengths is None:
+        lengths = jnp.full((b,), skv, jnp.int32)
+
+    block_q = min(_BLOCK_Q, max(8, 1 << (sq - 1).bit_length()))
+    q_p = _pad_to(q, 2, block_q)
+    k_p = _pad_to(k, 2, _BLOCK_KV)
+    v_p = _pad_to(v, 2, _BLOCK_KV)
+    sq_p, skv_p = q_p.shape[2], k_p.shape[2]
+
+    # Fold heads into the batch grid dim; lengths replicate per head.
+    q_f = q_p.reshape(b * h, sq_p, d)
+    k_f = k_p.reshape(b * h, skv_p, d)
+    v_f = v_p.reshape(b * h, skv_p, d)
+    len_f = jnp.repeat(lengths.astype(jnp.int32), h)  # (b*h,) in SMEM
+
+    grid = (b * h, sq_p // block_q)
+    kernel = functools.partial(
+        _flash_kernel, scale=scale, causal=causal, block_kv=_BLOCK_KV,
+        kv_seq_len=skv_p,
+        # Right-align causal masking when decoding with a KV cache, unless
+        # the caller pins query row 0's absolute position (cache prefill).
+        q_offset=(skv - sq if causal_offset is None else causal_offset)
+        if causal else 0)
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),  # full lengths vector
+            pl.BlockSpec((None, block_q, d), lambda bh, i: (bh, i, 0)),
+            pl.BlockSpec((None, skv_p, d), lambda bh, i: (bh, 0, 0)),
+            pl.BlockSpec((None, skv_p, d), lambda bh, i: (bh, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, block_q, d), lambda bh, i: (bh, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, sq_p, d), q.dtype),
+        interpret=interpret,
+    )(len_f, q_f, k_f, v_f)
+    return out.reshape(b, h, sq_p, d)[:, :, :sq, :]
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:  # pragma: no cover
+        return False
+
+
+def attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = False,
+    lengths: Optional[jax.Array] = None,
+    bias: Optional[jax.Array] = None,
+    scale: Optional[float] = None,
+    causal_offset: Optional[int] = None,
+) -> jax.Array:
+    """Dispatch: Pallas kernel on TPU when it applies (no additive bias,
+    MXU-friendly head dim), jnp reference otherwise. Semantics identical."""
+    use_pallas = (
+        _HAVE_PALLAS
+        and _on_tpu()
+        and bias is None
+        and q.shape[-1] % 8 == 0
+        and q.shape[-2] >= 8
+    )
+    if use_pallas:
+        return flash_attention(
+            q, k, v, causal=causal, lengths=lengths, scale=scale,
+            causal_offset=causal_offset)
+    return attention_reference(
+        q, k, v, causal=causal, lengths=lengths, bias=bias, scale=scale,
+        causal_offset=causal_offset)

@@ -1,0 +1,29 @@
+"""Parallelism: device meshes, TP sharding rules, ring attention, multi-host.
+
+The distributed backbone (SURVEY.md §2.10-2.11): XLA collectives over
+ICI/DCN replace the reference's distributed_runtime/NCCL stack; serving
+parallelism is sharding over a named Mesh.
+"""
+
+from min_tfs_client_tpu.parallel.mesh import (  # noqa: F401
+    DATA_AXIS,
+    EXPERT_AXIS,
+    MODEL_AXIS,
+    SEQ_AXIS,
+    data_parallel_sharding,
+    make_mesh,
+    replicated,
+    shard_batch,
+)
+from min_tfs_client_tpu.parallel.sharding import (  # noqa: F401
+    DEFAULT_RULES,
+    batch_spec,
+    infer_transformer_specs,
+    logical_spec,
+    shard_params,
+    shardings_tree,
+)
+from min_tfs_client_tpu.parallel.ring_attention import (  # noqa: F401
+    ring_attention,
+)
+from min_tfs_client_tpu.parallel import distributed  # noqa: F401

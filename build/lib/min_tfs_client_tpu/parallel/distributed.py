@@ -1,0 +1,114 @@
+"""Multi-host distributed runtime: coordination, hybrid ICI x DCN meshes,
+device health.
+
+The reference's multi-process story is the TF distributed_runtime — gRPC
+master/worker graph partitioning with rendezvous tensor transport
+(SURVEY.md §2.10: rpc/grpc_server_lib.cc, base_rendezvous_mgr.cc). The
+TPU-native replacement keeps gRPC strictly on the *control* plane (JAX's
+coordination service, initialized here) and moves every tensor byte onto
+ICI within a slice and DCN across slices via XLA collectives — there is no
+user-level tensor transport to write at all.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Mapping, Optional, Sequence
+
+import jax
+import numpy as np
+
+from min_tfs_client_tpu.parallel.mesh import Mesh, make_mesh
+
+_initialized = False
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Join the JAX distributed coordination service (control plane only).
+
+    No-op when single-process (the common serving deployment: SURVEY.md §5
+    — scale-out is replica-per-process behind a load balancer) or when
+    already initialized. Arguments default to the standard env vars
+    (JAX_COORDINATOR_ADDRESS / NUM_PROCESSES / PROCESS_ID).
+    """
+    global _initialized
+    if _initialized:
+        return
+    coordinator_address = coordinator_address or os.environ.get(
+        "JAX_COORDINATOR_ADDRESS")
+    if not coordinator_address:
+        return  # single-process
+    if num_processes is None and os.environ.get("NUM_PROCESSES"):
+        num_processes = int(os.environ["NUM_PROCESSES"])
+    if process_id is None and os.environ.get("PROCESS_ID"):
+        process_id = int(os.environ["PROCESS_ID"])
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id)
+    _initialized = True
+
+
+def hybrid_mesh(
+    ici_axes: Mapping[str, int],
+    dcn_axes: Optional[Mapping[str, int]] = None,
+) -> Mesh:
+    """Mesh whose inner axes ride ICI and outer axes span slices over DCN.
+
+    Collective layout rule (the scaling-book recipe): put the
+    bandwidth-hungry axes (model/tensor) innermost so their collectives
+    stay on ICI; only the data axis should cross DCN. Falls back to a flat
+    mesh when all devices are in one slice.
+    """
+    dcn_axes = dict(dcn_axes or {})
+    if not dcn_axes or all(s == 1 for s in dcn_axes.values()):
+        return make_mesh(dict(ici_axes))
+    from jax.experimental import mesh_utils
+
+    # create_hybrid_device_mesh needs same-rank shapes whose elementwise
+    # product is the final grid: pad each side with 1s on the other's axes
+    # (DCN axes outermost so only they cross slice boundaries).
+    names = list(dcn_axes) + list(ici_axes)
+    mesh_shape = [1] * len(dcn_axes) + [ici_axes[n] for n in ici_axes]
+    dcn_shape = [dcn_axes[n] for n in dcn_axes] + [1] * len(ici_axes)
+    devices = mesh_utils.create_hybrid_device_mesh(
+        mesh_shape=mesh_shape, dcn_mesh_shape=dcn_shape)
+    return Mesh(devices, names)
+
+
+# -- device health (SURVEY.md §5 failure detection: "PJRT device health
+# probe, re-compile-on-restart") ---------------------------------------------
+
+
+@dataclass(frozen=True)
+class DeviceHealth:
+    device: str
+    ok: bool
+    error: str = ""
+
+
+def probe_devices(
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> list[DeviceHealth]:
+    """Run a tiny computation on every device; a hung/failed chip surfaces
+    as an exception rather than wedging a serving request later."""
+    out = []
+    for dev in devices if devices is not None else jax.devices():
+        try:
+            x = jax.device_put(np.ones((8,), np.float32), dev)
+            got = float(jax.jit(lambda a: a.sum())(x).block_until_ready())
+            ok = abs(got - 8.0) < 1e-6
+            out.append(DeviceHealth(str(dev), ok,
+                                    "" if ok else f"bad result {got}"))
+        except Exception as exc:  # noqa: BLE001 — health probe must not raise
+            out.append(DeviceHealth(str(dev), False, repr(exc)))
+    return out
+
+
+def healthy() -> bool:
+    return all(h.ok for h in probe_devices())

@@ -1,0 +1,80 @@
+"""Device mesh construction and sharding helpers.
+
+The distributed backbone of the framework: serving parallelism is expressed
+as jax.sharding over a named Mesh (axes "data", "model", "seq", "expert"),
+with XLA emitting the ICI collectives — replacing the reference's
+distributed_runtime/gRPC tensor transport and ring collectives wholesale
+(SURVEY.md §2.10-2.11: grpc_tensor_coding.cc, ring_reducer.cc -> none).
+"""
+
+from __future__ import annotations
+
+from typing import Mapping, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+SEQ_AXIS = "seq"
+EXPERT_AXIS = "expert"
+
+
+def make_mesh(
+    axis_sizes: Mapping[str, int] | None = None,
+    *,
+    devices: Sequence[jax.Device] | None = None,
+) -> Mesh:
+    """Build a Mesh from {axis: size}. Sizes must multiply to <= #devices;
+    a trailing -1 axis absorbs the remainder (np.reshape convention)."""
+    devices = list(devices if devices is not None else jax.devices())
+    if not axis_sizes:
+        axis_sizes = {DATA_AXIS: len(devices)}
+    names = list(axis_sizes)
+    sizes = [int(s) for s in axis_sizes.values()]
+    n_needed = int(np.prod([s for s in sizes if s > 0]))
+    if -1 in sizes:
+        rest = len(devices) // max(1, n_needed)
+        sizes = [rest if s == -1 else s for s in sizes]
+    total = int(np.prod(sizes))
+    if total > len(devices):
+        raise ValueError(
+            f"mesh {dict(zip(names, sizes))} needs {total} devices, "
+            f"have {len(devices)}")
+    grid = np.array(devices[:total]).reshape(sizes)
+    return Mesh(grid, names)
+
+
+def from_proto(config, devices=None) -> Mesh:
+    """MeshConfig proto (tpu_platform.proto) -> Mesh."""
+    axes = {axis.name: axis.size for axis in config.axes}
+    return make_mesh(axes, devices=devices)
+
+
+def data_parallel_sharding(mesh: Mesh) -> NamedSharding:
+    """Batch-dim sharding: dim 0 split across the data axis."""
+    return NamedSharding(mesh, PartitionSpec(DATA_AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def shard_batch(mesh: Mesh, arrays: Mapping[str, np.ndarray]) -> dict:
+    """Place a host batch onto the mesh, batch-dim sharded over "data".
+
+    Pads the batch up to a multiple of the data-axis size if needed (static
+    shapes per shard); caller slices outputs back to true batch.
+    """
+    ndata = mesh.shape[DATA_AXIS]
+    sharding = data_parallel_sharding(mesh)
+    out = {}
+    for name, arr in arrays.items():
+        batch = arr.shape[0]
+        padded = -(-batch // ndata) * ndata
+        if padded != batch:
+            arr = np.concatenate(
+                [arr, np.repeat(arr[:1], padded - batch, axis=0)])
+        out[name] = jax.device_put(arr, sharding)
+    return out

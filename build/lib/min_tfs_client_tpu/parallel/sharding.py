@@ -1,0 +1,136 @@
+"""Tensor-parallel parameter sharding: logical axes -> mesh PartitionSpecs.
+
+The reference has no tensor parallelism at all (SURVEY.md §2.11: TP row
+"Absent"); models bigger than one chip's HBM are out of its reach. Here TP
+is first-class: every served model family's parameter pytree gets a
+matching pytree of PartitionSpecs (Megatron-style column/row sharding of
+the transformer blocks), `jax.jit` + GSPMD then emit the ICI collectives —
+no hand-written communication, unlike the reference's ring_reducer.cc /
+grpc_tensor_coding.cc stack (SURVEY.md §2.10).
+
+Design: *logical* axis names ("embed", "mlp", "heads", "vocab", "batch",
+"length") are mapped to physical mesh axes by a rules table, so the same
+spec tree serves a data-only mesh (rules drop the "model" axis -> fully
+replicated params) and a data x model mesh (true TP) without touching the
+model code.
+"""
+
+from __future__ import annotations
+
+from typing import Mapping, Optional, Sequence
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from min_tfs_client_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+
+# logical axis -> preferred physical mesh axis. A rule whose physical axis
+# is missing from the mesh resolves to None (replicated on that dim).
+DEFAULT_RULES: dict[str, Optional[str]] = {
+    "batch": DATA_AXIS,
+    "vocab": None,       # embeddings replicated (gather stays local)
+    "embed": None,       # d_model dim replicated
+    "heads": MODEL_AXIS,  # attention heads / qkv output dim sharded
+    "mlp": MODEL_AXIS,    # feed-forward hidden dim sharded
+    "length": None,
+}
+
+
+def logical_spec(*axes: Optional[str],
+                 rules: Mapping[str, Optional[str]] = DEFAULT_RULES,
+                 mesh: Optional[Mesh] = None) -> PartitionSpec:
+    """Logical axis names -> PartitionSpec, dropping axes absent from mesh."""
+    phys = []
+    for ax in axes:
+        p = rules.get(ax) if ax is not None else None
+        if p is not None and mesh is not None and p not in mesh.shape:
+            p = None
+        phys.append(p)
+    while phys and phys[-1] is None:
+        phys.pop()
+    return PartitionSpec(*phys)
+
+
+# -- spec inference for the framework's model-family pytrees -----------------
+
+# Column-parallel dense layers: kernel (embed, mlp-sharded-out). The qkv
+# projections count as column-parallel with the head dim sharded.
+_COLUMN_KEYS = frozenset({"query", "key", "value", "wi", "wg"})
+# Row-parallel dense layers: kernel (mlp-sharded-in, embed); GSPMD inserts
+# the all-reduce after the matmul.
+_ROW_KEYS = frozenset({"out", "wo"})
+
+
+def infer_transformer_specs(
+    params,
+    *,
+    rules: Mapping[str, Optional[str]] = DEFAULT_RULES,
+    mesh: Optional[Mesh] = None,
+):
+    """Walk a model-family parameter pytree (models/bert.py, models/t5.py,
+    models/use.py structure: nested dicts/lists with dense {kernel, bias},
+    embed {embedding}, norm {scale, bias} leaves) and build the matching
+    PartitionSpec pytree.
+
+    Any leaf not recognized as part of a column/row-parallel dense layer is
+    replicated — always correct, just not memory-saving.
+    """
+
+    def sp(*axes):
+        return logical_spec(*axes, rules=rules, mesh=mesh)
+
+    def walk(node, path):
+        if isinstance(node, (list, tuple)):
+            out = [walk(x, path) for x in node]
+            return type(node)(out) if isinstance(node, tuple) else out
+        if not isinstance(node, dict):
+            return _leaf_spec(path, sp)
+        return {k: walk(v, path + (k,)) for k, v in node.items()}
+
+    return walk(params, ())
+
+
+def _leaf_spec(path: tuple, sp) -> PartitionSpec:
+    leaf = path[-1] if path else ""
+    parent = path[-2] if len(path) >= 2 else ""
+    if leaf == "embedding":
+        return sp("vocab", "embed")
+    if leaf == "kernel":
+        if parent in _COLUMN_KEYS:
+            return sp("embed", "heads" if parent in
+                      ("query", "key", "value") else "mlp")
+        if parent in _ROW_KEYS:
+            return sp("heads" if parent == "out" else "mlp", "embed")
+        return sp()  # pooler / head / conv etc.: replicated
+    if leaf == "bias":
+        if parent in _COLUMN_KEYS:
+            return sp("heads" if parent in ("query", "key", "value")
+                      else "mlp")
+        return sp("embed") if parent in _ROW_KEYS else sp()
+    return sp()  # norms, scales, anything else
+
+
+# -- placement ---------------------------------------------------------------
+
+
+def shard_params(params, specs, mesh: Mesh):
+    """device_put every leaf with its NamedSharding over `mesh`."""
+    return jax.tree_util.tree_map(
+        lambda p, s: jax.device_put(p, NamedSharding(mesh, s)),
+        params, specs,
+        is_leaf=lambda x: isinstance(x, PartitionSpec))
+
+
+def shardings_tree(specs, mesh: Mesh):
+    """PartitionSpec pytree -> NamedSharding pytree (for jit in/out specs)."""
+    return jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), specs,
+        is_leaf=lambda x: isinstance(x, PartitionSpec))
+
+
+def batch_spec(mesh: Optional[Mesh] = None,
+               rules: Mapping[str, Optional[str]] = DEFAULT_RULES,
+               extra_dims: int = 0) -> PartitionSpec:
+    """Activation sharding: batch dim over "data", rest replicated."""
+    return logical_spec("batch", *([None] * extra_dims), rules=rules,
+                        mesh=mesh)

@@ -1,0 +1,46 @@
+"""Compile the vendored .proto sources with protoc.
+
+Mirrors the reference's install-time codegen step (reference setup.py:28-49
+runs `protoc -I=protobuf_srcs --python_out=...` over its vendored tree) but
+over this package's consolidated proto set. gRPC stubs are NOT generated here;
+they are hand-maintained in grpc_service.py (grpcio-tools is not a dep, same
+constraint that made the reference check in its *_pb2_grpc.py files).
+
+Run from anywhere:  python -m min_tfs_client_tpu.protos.build_protos
+"""
+
+from __future__ import annotations
+
+import pathlib
+import shutil
+import subprocess
+import sys
+
+PROTO_DIR = pathlib.Path(__file__).resolve().parent
+REPO_ROOT = PROTO_DIR.parent.parent
+
+PROTO_FILES = [
+    "tf_tensor.proto",
+    "tf_example.proto",
+    "tf_error.proto",
+    "tf_graph.proto",
+    "tf_bundle.proto",
+    "tf_config.proto",
+    "tfs_config.proto",
+    "tfs_apis.proto",
+    "tfs_services.proto",
+    "tpu_platform.proto",
+]
+
+
+def compile_protos(protoc: str | None = None) -> None:
+    protoc = protoc or shutil.which("protoc")
+    if protoc is None:
+        raise RuntimeError("protoc not found on PATH; cannot build protos")
+    rel = [f"min_tfs_client_tpu/protos/{f}" for f in PROTO_FILES]
+    cmd = [protoc, f"-I{REPO_ROOT}", f"--python_out={REPO_ROOT}", *rel]
+    subprocess.run(cmd, check=True, cwd=REPO_ROOT)
+
+
+if __name__ == "__main__":
+    compile_protos(sys.argv[1] if len(sys.argv) > 1 else None)

@@ -1,0 +1,94 @@
+"""Hand-maintained gRPC stubs/servicers for the three serving services.
+
+The reference checks in its grpc-generated modules because plain protoc can't
+emit them (reference setup.py:52-73, apis/prediction_service_pb2_grpc.py);
+this module plays that role here, written against the stable grpc.* API
+rather than generated. Method paths match the reference wire surface
+exactly: /tensorflow.serving.<Service>/<Method>.
+"""
+
+from __future__ import annotations
+
+import grpc
+
+from min_tfs_client_tpu.protos import tfs_apis_pb2 as apis
+
+_PKG = "tensorflow.serving"
+
+# service name -> method name -> (request class, response class)
+SERVICE_SCHEMAS = {
+    "PredictionService": {
+        "Classify": (apis.ClassificationRequest, apis.ClassificationResponse),
+        "Regress": (apis.RegressionRequest, apis.RegressionResponse),
+        "Predict": (apis.PredictRequest, apis.PredictResponse),
+        "MultiInference": (apis.MultiInferenceRequest, apis.MultiInferenceResponse),
+        "GetModelMetadata": (apis.GetModelMetadataRequest, apis.GetModelMetadataResponse),
+    },
+    "ModelService": {
+        "GetModelStatus": (apis.GetModelStatusRequest, apis.GetModelStatusResponse),
+        "HandleReloadConfigRequest": (apis.ReloadConfigRequest, apis.ReloadConfigResponse),
+    },
+    "SessionService": {
+        "SessionRun": (apis.SessionRunRequest, apis.SessionRunResponse),
+    },
+}
+
+
+def _make_stub_class(service: str, methods: dict):
+    class Stub:
+        def __init__(self, channel: grpc.Channel):
+            for name, (req_cls, resp_cls) in methods.items():
+                setattr(
+                    self,
+                    name,
+                    channel.unary_unary(
+                        f"/{_PKG}.{service}/{name}",
+                        request_serializer=req_cls.SerializeToString,
+                        response_deserializer=resp_cls.FromString,
+                    ),
+                )
+
+    Stub.__name__ = Stub.__qualname__ = f"{service}Stub"
+    return Stub
+
+
+def _make_servicer_class(service: str, methods: dict):
+    def _unimplemented(self, request, context):
+        context.set_code(grpc.StatusCode.UNIMPLEMENTED)
+        context.set_details("Method not implemented!")
+        raise NotImplementedError("Method not implemented!")
+
+    ns = {name: _unimplemented for name in methods}
+    cls = type(f"{service}Servicer", (object,), ns)
+    return cls
+
+
+def _make_registrar(service: str, methods: dict):
+    def add_to_server(servicer, server):
+        handlers = {
+            name: grpc.unary_unary_rpc_method_handler(
+                getattr(servicer, name),
+                request_deserializer=req_cls.FromString,
+                response_serializer=resp_cls.SerializeToString,
+            )
+            for name, (req_cls, resp_cls) in methods.items()
+        }
+        server.add_generic_rpc_handlers(
+            (grpc.method_handlers_generic_handler(f"{_PKG}.{service}", handlers),)
+        )
+
+    add_to_server.__name__ = f"add_{service}Servicer_to_server"
+    return add_to_server
+
+
+PredictionServiceStub = _make_stub_class("PredictionService", SERVICE_SCHEMAS["PredictionService"])
+ModelServiceStub = _make_stub_class("ModelService", SERVICE_SCHEMAS["ModelService"])
+SessionServiceStub = _make_stub_class("SessionService", SERVICE_SCHEMAS["SessionService"])
+
+PredictionServiceServicer = _make_servicer_class("PredictionService", SERVICE_SCHEMAS["PredictionService"])
+ModelServiceServicer = _make_servicer_class("ModelService", SERVICE_SCHEMAS["ModelService"])
+SessionServiceServicer = _make_servicer_class("SessionService", SERVICE_SCHEMAS["SessionService"])
+
+add_PredictionServiceServicer_to_server = _make_registrar("PredictionService", SERVICE_SCHEMAS["PredictionService"])
+add_ModelServiceServicer_to_server = _make_registrar("ModelService", SERVICE_SCHEMAS["ModelService"])
+add_SessionServiceServicer_to_server = _make_registrar("SessionService", SERVICE_SCHEMAS["SessionService"])

@@ -1,0 +1,41 @@
+"""Curried signatures: pre-bound fixed input tensors.
+
+Parity with servables/tensorflow/curried_session.{h,cc}
+(experimental_fixed_input_tensors): a Signature wrapper that injects fixed
+input values into every run, removing them from the request surface —
+e.g. a shared embedding table or a constant config tensor bound at load
+time.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Mapping
+
+import numpy as np
+
+from min_tfs_client_tpu.servables.servable import Signature
+from min_tfs_client_tpu.utils.status import ServingError
+
+
+def curry_signature(signature: Signature,
+                    fixed_inputs: Mapping[str, object]) -> Signature:
+    """New Signature with `fixed_inputs` bound; callers supply the rest."""
+    unknown = set(fixed_inputs) - set(signature.inputs)
+    if unknown:
+        raise ServingError.invalid_argument(
+            f"fixed inputs not in signature: {sorted(unknown)}")
+    fixed = {k: np.asarray(v) for k, v in fixed_inputs.items()}
+    remaining = {k: v for k, v in signature.inputs.items() if k not in fixed}
+    inner_fn = signature.fn
+
+    def fn(inputs: Mapping[str, object]) -> dict[str, object]:
+        merged = dict(inputs)
+        for k, v in fixed.items():
+            merged[k] = v
+        return inner_fn(merged)
+
+    # Fixed inputs are usually unbatched constants, so the curried
+    # signature loses the shared-leading-batch-dim property.
+    return dataclasses.replace(
+        signature, fn=fn, inputs=remaining, batched=False, _jitted=None)

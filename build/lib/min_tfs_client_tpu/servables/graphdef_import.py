@@ -567,8 +567,6 @@ class _FunctionEvaluator:
         self._consts: dict[str, np.ndarray] = {}
         self.has_string = False
         self._scanned = False
-        self._scanning = False
-        self._scan_error: GraphImportError | None = None
 
     @property
     def name(self) -> str:
@@ -576,39 +574,27 @@ class _FunctionEvaluator:
 
     def scan(self) -> bool:
         """Validate ops + decode consts once; returns has_string. Runs
-        under the owning _FuncLib's lock. A failed scan is remembered and
-        re-raised — _scanned is only set on success, so a shared funclib
-        never serves a half-scanned (poisoned) evaluator on retry."""
-        if self._scan_error is not None:
-            raise self._scan_error
-        if self._scanned or self._scanning:
-            # _scanning: same-thread recursion (self/mutually-recursive
-            # functions) — return the flags accumulated so far.
+        under the owning _FuncLib's lock; the early _scanned flag only
+        guards same-thread recursion (self/mutually-recursive functions)."""
+        if self._scanned:
             return self.has_string
-        self._scanning = True
-        try:
-            for node in self._fdef.node_def:
-                for key in ("dtype", "T"):
-                    a = _attr(node, key)
-                    if a is not None and a.type == DT_STRING:
-                        self.has_string = True
-                if node.op == "Const":
-                    self._consts[node.name] = tensor_proto_to_ndarray(
-                        node.attr["value"].tensor)
-                    continue
-                called = _scan_node_functions(node, self._funclib)
-                if called is not None:
-                    self.has_string |= called
-                elif node.op not in OPS:
-                    raise GraphImportError(
-                        f"unsupported op {node.op!r} (node {node.name!r} in "
-                        f"function {self.name!r})")
-            self._scanned = True
-        except GraphImportError as exc:
-            self._scan_error = exc
-            raise
-        finally:
-            self._scanning = False
+        self._scanned = True
+        for node in self._fdef.node_def:
+            for key in ("dtype", "T"):
+                a = _attr(node, key)
+                if a is not None and a.type == DT_STRING:
+                    self.has_string = True
+            if node.op == "Const":
+                self._consts[node.name] = tensor_proto_to_ndarray(
+                    node.attr["value"].tensor)
+                continue
+            called = _scan_node_functions(node, self._funclib)
+            if called is not None:
+                self.has_string |= called
+            elif node.op not in OPS:
+                raise GraphImportError(
+                    f"unsupported op {node.op!r} (node {node.name!r} in "
+                    f"function {self.name!r})")
         return self.has_string
 
     def __call__(self, args: Sequence[object], lib) -> list[object]:

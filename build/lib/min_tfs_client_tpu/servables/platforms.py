@@ -1,0 +1,154 @@
+"""Platform registry: model_platform string -> loader factory.
+
+Parity with the reference's class-registration of source adapters keyed by
+PlatformConfigMap entries (util/class_registration.h;
+model_servers/platform_config_util.cc; "one adapter per platform, not per
+model", server_core.h:319-331). Two built-in platforms:
+
+  "tensorflow" — SavedModel import via graphdef_import (no TF dependency)
+  "jax" / "tpu" — native servables: a version dir containing servable.py
+                  with build(path) -> Servable | {sig_name: Signature}
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import pathlib
+import sys
+from typing import Callable, Mapping
+
+from min_tfs_client_tpu.core.loader import Loader, SimpleLoader
+from min_tfs_client_tpu.servables.servable import Servable, Signature
+from min_tfs_client_tpu.utils.status import ServingError
+
+DEFAULT_PLATFORM = "tensorflow"
+
+# factory(name, version, path, platform_config) -> Servable
+ServableFactory = Callable[[str, int, str, Mapping], Servable]
+
+_REGISTRY: dict[str, ServableFactory] = {}
+
+
+def register_platform(platform: str, factory: ServableFactory) -> None:
+    _REGISTRY[platform] = factory
+
+
+def platform_exists(platform: str) -> bool:
+    return platform in _REGISTRY
+
+
+def make_loader(
+    platform: str, name: str, version: int, path: str,
+    platform_config: Mapping | None = None,
+) -> Loader:
+    factory = _REGISTRY.get(platform)
+    if factory is None:
+        raise ServingError.invalid_argument(
+            f"unknown model_platform {platform!r}; registered: "
+            f"{sorted(_REGISTRY)}")
+    estimate = _dir_size_bytes(path)
+
+    def create() -> Servable:
+        servable = factory(name, version, path, platform_config or {})
+        servable.name = name
+        servable.version = version
+        config = platform_config or {}
+        # Server-level mesh ("mesh_axes": {"data": -1, ...}): every batched
+        # device signature serves data-parallel over it. Exports with their
+        # own TP sharding config already attached a mesh at build; the
+        # server mesh fills in for servables without one (incl. imported
+        # GraphDefs, whose consts GSPMD replicates across the mesh).
+        mesh_axes = config.get("mesh_axes")
+        if mesh_axes:
+            from min_tfs_client_tpu.parallel.mesh import make_mesh
+            from min_tfs_client_tpu.servables.servable import attach_mesh
+
+            try:
+                mesh = make_mesh({k: int(v) for k, v in mesh_axes.items()})
+            except ValueError:
+                mesh = None  # fewer devices than the mesh asks: single-chip
+            attach_mesh(servable, mesh, only_if_absent=True)
+        batching = config.get("batching_parameters")
+        if batching is not None:
+            from min_tfs_client_tpu.batching.session import apply_batch_buckets
+
+            # Compile buckets must be final BEFORE warmup, or warmup primes
+            # shapes that will never serve.
+            batching = apply_batch_buckets(servable, batching)
+        # Warmup runs against the bare signatures, BEFORE the batching
+        # wrapper: replaying through the batch queue would stall each record
+        # up to batch_timeout (the reference replays directly against the
+        # session, saved_model_warmup.cc:94-146).
+        if config.get("enable_model_warmup", True):
+            from min_tfs_client_tpu.servables.warmup import (
+                run_warmup,
+                synthesize_warmup,
+            )
+
+            replayed = run_warmup(
+                servable, path,
+                num_iterations=config.get("warmup_iterations", 1))
+            if replayed == 0 and config.get("synthesize_warmup", False):
+                synthesize_warmup(servable)
+        if batching is not None:
+            from min_tfs_client_tpu.batching.session import maybe_wrap_servable
+
+            servable = maybe_wrap_servable(servable, batching)
+        return servable
+
+    return SimpleLoader(create, resource_estimate=estimate)
+
+
+def _dir_size_bytes(path: str) -> int:
+    """Resource estimate from on-disk footprint — the reference's
+    EstimateResourceFromPath heuristic (saved_model_bundle_factory.cc:105)."""
+    p = pathlib.Path(path)
+    if not p.exists():
+        return 0
+    return sum(f.stat().st_size for f in p.rglob("*") if f.is_file())
+
+
+# -- built-in platforms ------------------------------------------------------
+
+
+def _tensorflow_factory(name, version, path, config) -> Servable:
+    from min_tfs_client_tpu.servables.graphdef_import import load_saved_model
+
+    return load_saved_model(path, name, version, **{
+        k: config[k] for k in ("tags", "batch_buckets") if k in config})
+
+
+SERVABLE_MODULE_FILENAME = "servable.py"
+
+
+def _jax_factory(name, version, path, config) -> Servable:
+    module_path = pathlib.Path(path) / SERVABLE_MODULE_FILENAME
+    if not module_path.is_file():
+        raise ServingError.not_found(
+            f"jax servable at {path} has no {SERVABLE_MODULE_FILENAME}")
+    module_name = f"_tpu_servable_{name}_{version}_{abs(hash(path)) % 10**8}"
+    spec = importlib.util.spec_from_file_location(module_name, module_path)
+    module = importlib.util.module_from_spec(spec)
+    sys.modules[module_name] = module
+    try:
+        spec.loader.exec_module(module)
+        build = getattr(module, "build", None)
+        if build is None:
+            raise ServingError.failed_precondition(
+                f"{module_path} does not define build(path)")
+        result = build(str(path))
+    finally:
+        sys.modules.pop(module_name, None)
+    if isinstance(result, Servable):
+        return result
+    if isinstance(result, Mapping) and all(
+            isinstance(v, Signature) for v in result.values()):
+        return Servable(name, version, result)
+    raise ServingError.failed_precondition(
+        f"build() in {module_path} must return a Servable or a dict of "
+        f"Signatures, got {type(result).__name__}")
+
+
+register_platform("tensorflow", _tensorflow_factory)
+register_platform("jax", _jax_factory)
+register_platform("tpu", _jax_factory)

@@ -1,0 +1,321 @@
+"""Servable: a loaded model version exposing named signatures.
+
+Execution parity with the reference's Predict path
+(servables/tensorflow/predict_util.cc:89-215): signature lookup with
+"serving_default" default, alias-keyed inputs, output_filter validation, and
+alias-keyed outputs. The execution engine is TPU-first rather than a Session
+port:
+
+ * every signature is a pure, jittable function dict->dict;
+ * XLA needs static shapes, so batched signatures pad the leading dim up to
+   a bucket (powers of two by default, or BatchingParameters
+   allowed_batch_sizes — the batching_session.h:66-99 round-up rule) and
+   jax.jit's shape-keyed compile cache holds one executable per bucket;
+ * string/host signatures (XLA has no string kernels) run eagerly on numpy,
+   exactly where the reference runs string ops on CPU;
+ * results slice back to the true batch before marshalling.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field as dc_field
+from typing import Callable, Mapping, Optional, Sequence
+
+import numpy as np
+
+from min_tfs_client_tpu.protos import tf_graph_pb2, tfs_apis_pb2
+from min_tfs_client_tpu.tensor.dtypes import DataType
+from min_tfs_client_tpu.tensor.example_codec import FeatureSpec
+from min_tfs_client_tpu.utils.status import ServingError
+
+DEFAULT_SERVING_SIGNATURE_DEF_KEY = "serving_default"
+
+PREDICT_METHOD_NAME = "tensorflow/serving/predict"
+CLASSIFY_METHOD_NAME = "tensorflow/serving/classify"
+REGRESS_METHOD_NAME = "tensorflow/serving/regress"
+
+# Classification signature contract (signature_constants; classifier.cc
+# validation): inputs alias "inputs", outputs "classes" and/or "scores".
+CLASSIFY_INPUTS = "inputs"
+CLASSIFY_OUTPUT_CLASSES = "classes"
+CLASSIFY_OUTPUT_SCORES = "scores"
+REGRESS_INPUTS = "inputs"
+REGRESS_OUTPUTS = "outputs"
+
+DEFAULT_BATCH_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256)
+
+
+@dataclass(frozen=True)
+class TensorSpec:
+    """Dtype + shape template; None dims are polymorphic (batch / sequence)."""
+
+    dtype: object
+    shape: tuple[Optional[int], ...] = ()
+
+    def __post_init__(self):
+        object.__setattr__(self, "dtype", DataType(self.dtype))
+
+    def validate(self, arr: np.ndarray, alias: str) -> None:
+        if len(arr.shape) != len(self.shape):
+            raise ServingError.invalid_argument(
+                f"input {alias!r}: expected rank {len(self.shape)}, "
+                f"got shape {arr.shape}")
+        for i, (want, got) in enumerate(zip(self.shape, arr.shape)):
+            if want is not None and want != got:
+                raise ServingError.invalid_argument(
+                    f"input {alias!r}: dim {i} expected {want}, got {got}")
+
+
+@dataclass
+class Signature:
+    """One named entry point of a servable.
+
+    When `params` is set, `fn(params, inputs)` and the param pytree is
+    passed as a jit ARGUMENT — mandatory for sharded serving: a pytree
+    merely closed over is inlined into the jaxpr as compile-time
+    constants, which GSPMD is then free to replicate per shard, silently
+    discarding the tensor-parallel placement (and baking a full copy of
+    the weights into the executable). As arguments, the leaves'
+    NamedShardings constrain the partitioner and the ICI collectives are
+    emitted. `params=None` keeps the plain `fn(inputs)` closure contract
+    (GraphDef-imported consts, host signatures, toy fixtures).
+    """
+
+    fn: Callable[..., dict[str, object]]
+    inputs: dict[str, TensorSpec]
+    outputs: dict[str, TensorSpec]
+    params: Optional[object] = dc_field(default=None, repr=False,
+                                        compare=False)
+    method_name: str = PREDICT_METHOD_NAME
+    # Example parsing spec for Classify/Regress/MultiInference surfaces.
+    feature_specs: Optional[dict[str, FeatureSpec]] = None
+    # Host signatures run eagerly on numpy (string ops). Device signatures
+    # are jitted with bucketed static shapes.
+    on_host: bool = False
+    # Leading dim of every input is a shared batch dim, paddable.
+    batched: bool = True
+    batch_buckets: Sequence[int] = DEFAULT_BATCH_BUCKETS
+    # Optional class-id -> label vocabulary for classification outputs.
+    class_labels: Optional[Sequence[bytes]] = None
+    # Optional jax.sharding.Mesh: formed batches are device_put with the
+    # batch dim sharded over the mesh's "data" axis before execution
+    # (TP'd params carry their own shardings; GSPMD emits the ICI
+    # collectives). This is the batching->mesh handoff the reference's
+    # batching_session.h:178-215 hands to Session::Run — here it lands on
+    # the mesh (SURVEY.md §7.6).
+    mesh: Optional[object] = dc_field(default=None, repr=False,
+                                      compare=False)
+
+    _jitted: Callable | None = dc_field(default=None, repr=False, compare=False)
+
+    def jitted(self) -> Callable:
+        if self._jitted is None:
+            import jax
+
+            self._jitted = jax.jit(self.fn)
+        return self._jitted
+
+    def _execute(self, arrays: dict) -> dict:
+        if self.params is not None:
+            return self.jitted()(self.params, arrays)
+        return self.jitted()(arrays)
+
+    def _data_axis_size(self) -> int:
+        if self.mesh is None:
+            return 1
+        from min_tfs_client_tpu.parallel.mesh import DATA_AXIS
+
+        return int(dict(self.mesh.shape).get(DATA_AXIS, 1))
+
+    # -- execution -----------------------------------------------------------
+
+    def validate(
+        self,
+        inputs: Mapping[str, np.ndarray],
+        output_filter: Sequence[str] = (),
+    ) -> dict[str, np.ndarray]:
+        """Per-request checks, shared by the direct and batched paths (the
+        batched path must reject a bad request BEFORE it joins a batch, or
+        one caller's mistake fails every co-batched caller)."""
+        missing = set(self.inputs) - set(inputs)
+        if missing:
+            raise ServingError.invalid_argument(
+                "Request inputs do not match required inputs for the "
+                f"signature. Missing: {sorted(missing)}")
+        extra = set(inputs) - set(self.inputs)
+        if extra:
+            raise ServingError.invalid_argument(
+                f"inputs contain aliases not in the signature: {sorted(extra)}")
+        for name in output_filter:
+            if name not in self.outputs:
+                raise ServingError.invalid_argument(
+                    f"output_filter name {name!r} is not in the signature "
+                    f"outputs {sorted(self.outputs)}")
+        arrays = {}
+        for alias, spec in self.inputs.items():
+            arr = np.asarray(inputs[alias])
+            if spec.dtype.is_string:
+                if arr.dtype.kind not in ("O", "S", "U"):
+                    raise ServingError.invalid_argument(
+                        f"input {alias!r}: expected string tensor, got {arr.dtype}")
+            else:
+                try:
+                    arr = arr.astype(spec.dtype.numpy_dtype, copy=False)
+                except (ValueError, TypeError) as exc:
+                    raise ServingError.invalid_argument(
+                        f"input {alias!r}: {exc}")
+            spec.validate(arr, alias)
+            arrays[alias] = arr
+        return arrays
+
+    def run(
+        self,
+        inputs: Mapping[str, np.ndarray],
+        output_filter: Sequence[str] = (),
+    ) -> dict[str, np.ndarray]:
+        """Validate, pad, execute, slice, return alias-keyed outputs."""
+        arrays = self.validate(inputs, output_filter)
+
+        if self.on_host:
+            outputs = (self.fn(self.params, arrays)
+                       if self.params is not None else self.fn(arrays))
+        else:
+            outputs = self._run_device(arrays)
+
+        keys = list(output_filter) if output_filter else list(self.outputs)
+        result = {}
+        for key in keys:
+            if key not in outputs:
+                raise ServingError.internal(
+                    f"signature fn did not produce declared output {key!r}")
+            result[key] = np.asarray(outputs[key])
+        return result
+
+    def _run_device(self, arrays: dict[str, np.ndarray]) -> dict[str, object]:
+        if not self.batched or not arrays:
+            return self._execute(arrays)
+        batch = next(iter(arrays.values())).shape[0]
+        for alias, arr in arrays.items():
+            if arr.shape[0] != batch:
+                raise ServingError.invalid_argument(
+                    f"input {alias!r}: inconsistent batch dim "
+                    f"{arr.shape[0]} != {batch}")
+        padded_batch = self.round_up_batch(batch)
+        if padded_batch != batch:
+            arrays = {
+                alias: np.concatenate(
+                    # Pad with a repeat of row 0 (valid data keeps XLA out of
+                    # NaN paths — the batching_session.h:94-99 trick).
+                    [arr, np.repeat(arr[:1], padded_batch - batch, axis=0)])
+                for alias, arr in arrays.items()
+            }
+        if self.mesh is not None:
+            arrays = self._shard_inputs(arrays)
+        outputs = self._execute(arrays)
+        return {k: np.asarray(v)[:batch] for k, v in outputs.items()}
+
+    def _shard_inputs(self, arrays: dict[str, np.ndarray]) -> dict:
+        """Place the padded batch on the mesh, dim 0 over the data axis
+        (parallel.mesh.shard_batch; its pad-to-multiple is a no-op here
+        since round_up_batch already chose an ndata-divisible bucket).
+        GSPMD then propagates through the jit: TP'd params keep their
+        load-time shardings, activations follow the data."""
+        from min_tfs_client_tpu.parallel.mesh import shard_batch
+
+        return shard_batch(self.mesh, arrays)
+
+    def round_up_batch(self, batch: int) -> int:
+        """Smallest allowed bucket >= batch; with a mesh, the bucket must
+        also split evenly over the data axis (static per-shard shapes)."""
+        ndata = self._data_axis_size()
+        for bucket in self.batch_buckets:
+            if bucket >= batch and bucket % ndata == 0:
+                return bucket
+        return -(-batch // ndata) * ndata  # next multiple of ndata
+
+    # -- metadata ------------------------------------------------------------
+
+    def to_signature_def(self) -> tf_graph_pb2.SignatureDef:
+        sig = tf_graph_pb2.SignatureDef(method_name=self.method_name)
+        for alias, spec in self.inputs.items():
+            info = sig.inputs[alias]
+            info.name = f"{alias}:0"
+            info.dtype = spec.dtype.enum
+            for d in spec.shape:
+                info.tensor_shape.dim.add(size=-1 if d is None else d)
+        for alias, spec in self.outputs.items():
+            info = sig.outputs[alias]
+            info.name = f"{alias}:0"
+            info.dtype = spec.dtype.enum
+            for d in spec.shape:
+                info.tensor_shape.dim.add(size=-1 if d is None else d)
+        return sig
+
+
+class Servable:
+    """One loaded model version: named signatures + metadata."""
+
+    def __init__(
+        self,
+        name: str,
+        version: int,
+        signatures: Mapping[str, Signature],
+        *,
+        hbm_estimate_bytes: int = 0,
+        warmup_records: Sequence[object] = (),
+    ):
+        if not signatures:
+            raise ValueError("servable must expose at least one signature")
+        self.name = name
+        self.version = version
+        self.signatures = dict(signatures)
+        self.hbm_estimate_bytes = hbm_estimate_bytes
+        self.warmup_records = list(warmup_records)
+
+    def signature(self, name: str = "") -> Signature:
+        key = name or DEFAULT_SERVING_SIGNATURE_DEF_KEY
+        sig = self.signatures.get(key)
+        if sig is None:
+            raise ServingError.invalid_argument(
+                f"Serving signature key \"{key}\" not found.")
+        return sig
+
+    def signature_def_map(self) -> tfs_apis_pb2.SignatureDefMap:
+        out = tfs_apis_pb2.SignatureDefMap()
+        for key, sig in self.signatures.items():
+            out.signature_def[key].CopyFrom(sig.to_signature_def())
+        return out
+
+    def unload(self) -> None:
+        """Drop jit caches so XLA executables free their HBM."""
+        for sig in self.signatures.values():
+            sig._jitted = None
+
+
+def attach_mesh(signatures, mesh, *, only_if_absent: bool = False):
+    """Attach a device mesh to every batched device signature so formed
+    batches execute data-parallel over it. Host (string) signatures and
+    unbatched signatures are untouched.
+
+    `signatures` may be a Servable, a name->Signature mapping, or an
+    iterable of Signatures (the single attach rule for platforms.py and
+    models/export.py). only_if_absent keeps a mesh already chosen at
+    export time (TP geometry) over a server-level default. Drops the jit
+    cache on change; idempotent; returns its argument."""
+    if mesh is None:
+        return signatures
+    if isinstance(signatures, Servable):
+        sigs = list(signatures.signatures.values())
+    elif isinstance(signatures, Mapping):
+        sigs = list(signatures.values())
+    else:
+        sigs = list(signatures)
+    for sig in sigs:
+        if sig.on_host or not sig.batched:
+            continue
+        if only_if_absent and sig.mesh is not None:
+            continue
+        if sig.mesh is not mesh:
+            sig.mesh = mesh
+            sig._jitted = None  # re-trace with the new placement
+    return signatures

@@ -1,0 +1,417 @@
+"""TensorFlow checkpoint (tensor_bundle) reader/writer — no TF dependency.
+
+The reference restores variables at SavedModel load by running the graph's
+restore op against `variables/variables.*` (cc/saved_model/loader.cc:198
+RunRestore; format impl tensorflow/core/util/tensor_bundle/). This module
+reads that format directly:
+
+ * `<prefix>.index` — an immutable leveldb-style table
+   (tensorflow/core/lib/io/table_format.txt): delta-encoded key blocks
+   with restart arrays, an index block of BlockHandles, a 48-byte footer
+   ending in the leveldb magic. Values are serialized BundleEntryProtos;
+   key "" holds the BundleHeaderProto.
+ * `<prefix>.data-NNNNN-of-MMMMM` — raw little-endian tensor bytes at
+   (shard_id, offset, size) per entry.
+
+The writer emits the same format (single shard, uncompressed blocks) so
+tests round-trip and exports stay TF-loadable. CRCs use the shared
+crc32c/masking from utils.tfrecord (leveldb and TFRecord share the
+masking constant).
+"""
+
+from __future__ import annotations
+
+import pathlib
+import struct
+from typing import Mapping
+
+import numpy as np
+
+from min_tfs_client_tpu.protos import tf_bundle_pb2
+from min_tfs_client_tpu.tensor.dtypes import DataType
+from min_tfs_client_tpu.utils import tfrecord
+from min_tfs_client_tpu.utils.status import ServingError
+
+TABLE_MAGIC = 0xDB4775248B80FB57
+FOOTER_SIZE = 48
+BLOCK_TRAILER_SIZE = 5  # 1-byte compression type + 4-byte masked crc32c
+_NO_COMPRESSION = 0
+_SNAPPY = 1
+
+
+class BundleError(ServingError):
+    def __init__(self, msg: str):
+        super().__init__(13, msg)  # INTERNAL
+
+
+# ---------------------------------------------------------------------------
+# varint helpers
+
+
+def _read_varint(buf: bytes, pos: int) -> tuple[int, int]:
+    result = shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+
+
+def _write_varint(value: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = value & 0x7F
+        value >>= 7
+        out.append(b | (0x80 if value else 0))
+        if not value:
+            return bytes(out)
+
+
+# ---------------------------------------------------------------------------
+# table (SSTable) reading
+
+
+def _parse_block(raw: bytes) -> list[tuple[bytes, bytes]]:
+    """Decode one table block into (key, value) pairs."""
+    if len(raw) < 4:
+        raise BundleError("table block too short")
+    (num_restarts,) = struct.unpack("<I", raw[-4:])
+    data_end = len(raw) - 4 - 4 * num_restarts
+    if data_end < 0:
+        raise BundleError("table block restart array overruns block")
+    out: list[tuple[bytes, bytes]] = []
+    pos = 0
+    key = b""
+    while pos < data_end:
+        shared, pos = _read_varint(raw, pos)
+        non_shared, pos = _read_varint(raw, pos)
+        value_len, pos = _read_varint(raw, pos)
+        key = key[:shared] + raw[pos:pos + non_shared]
+        pos += non_shared
+        out.append((key, raw[pos:pos + value_len]))
+        pos += value_len
+    return out
+
+
+def _read_block(data: bytes, offset: int, size: int, *, verify: bool) -> bytes:
+    end = offset + size
+    if end + BLOCK_TRAILER_SIZE > len(data):
+        raise BundleError("block handle out of range")
+    block = data[offset:end]
+    ctype = data[end]
+    if verify:
+        (stored,) = struct.unpack("<I", data[end + 1:end + 5])
+        actual = tfrecord.masked_crc32c(block + bytes([ctype]))
+        if stored != actual:
+            raise BundleError("table block checksum mismatch")
+    if ctype == _NO_COMPRESSION:
+        return block
+    if ctype == _SNAPPY:
+        try:
+            import snappy  # type: ignore
+
+            return snappy.decompress(block)
+        except ImportError:
+            raise BundleError(
+                "checkpoint index block is snappy-compressed and no snappy "
+                "codec is available")
+    raise BundleError(f"unknown block compression type {ctype}")
+
+
+def read_table(path: str | pathlib.Path, *, verify: bool = True
+               ) -> dict[bytes, bytes]:
+    """Read every key/value pair of an immutable table file."""
+    data = pathlib.Path(path).read_bytes()
+    if len(data) < FOOTER_SIZE:
+        raise BundleError(f"{path}: too short to be a table file")
+    footer = data[-FOOTER_SIZE:]
+    (magic,) = struct.unpack("<Q", footer[-8:])
+    if magic != TABLE_MAGIC:
+        raise BundleError(f"{path}: bad table magic {magic:#x}")
+    pos = 0
+    _meta_off, pos = _read_varint(footer, pos)
+    _meta_size, pos = _read_varint(footer, pos)
+    index_off, pos = _read_varint(footer, pos)
+    index_size, pos = _read_varint(footer, pos)
+
+    out: dict[bytes, bytes] = {}
+    index = _parse_block(_read_block(data, index_off, index_size,
+                                     verify=verify))
+    for _short_key, handle in index:
+        hpos = 0
+        block_off, hpos = _read_varint(handle, hpos)
+        block_size, hpos = _read_varint(handle, hpos)
+        for key, value in _parse_block(
+                _read_block(data, block_off, block_size, verify=verify)):
+            out[key] = value
+    return out
+
+
+# ---------------------------------------------------------------------------
+# table writing (single data block, uncompressed — enough for exports/tests)
+
+_RESTART_INTERVAL = 16
+
+
+def _encode_block(pairs: list[tuple[bytes, bytes]]) -> bytes:
+    out = bytearray()
+    restarts = []
+    prev = b""
+    for i, (key, value) in enumerate(pairs):
+        if i % _RESTART_INTERVAL == 0:
+            restarts.append(len(out))
+            shared = 0
+        else:
+            shared = 0
+            for a, b in zip(prev, key):
+                if a != b:
+                    break
+                shared += 1
+        out += _write_varint(shared)
+        out += _write_varint(len(key) - shared)
+        out += _write_varint(len(value))
+        out += key[shared:]
+        out += value
+        prev = key
+    if not restarts:
+        restarts = [0]
+    for r in restarts:
+        out += struct.pack("<I", r)
+    out += struct.pack("<I", len(restarts))
+    return bytes(out)
+
+
+class _TableWriter:
+    def __init__(self):
+        self._buf = bytearray()
+
+    def _append_block(self, block: bytes) -> bytes:
+        """Write block + trailer; return its BlockHandle encoding."""
+        offset = len(self._buf)
+        self._buf += block
+        trailer_type = bytes([_NO_COMPRESSION])
+        crc = tfrecord.masked_crc32c(block + trailer_type)
+        self._buf += trailer_type + struct.pack("<I", crc)
+        return _write_varint(offset) + _write_varint(len(block))
+
+    def finish(self, pairs: list[tuple[bytes, bytes]]) -> bytes:
+        data_handle = self._append_block(_encode_block(pairs))
+        last_key = pairs[-1][0] if pairs else b""
+        meta_handle = self._append_block(_encode_block([]))
+        index_handle = self._append_block(
+            _encode_block([(last_key + b"\x00", data_handle)]))
+        footer = meta_handle + index_handle
+        footer += b"\x00" * (FOOTER_SIZE - 8 - len(footer))
+        footer += struct.pack("<Q", TABLE_MAGIC)
+        self._buf += footer
+        return bytes(self._buf)
+
+
+# ---------------------------------------------------------------------------
+# bundle API
+
+
+def _data_path(prefix: pathlib.Path, shard: int, num_shards: int
+               ) -> pathlib.Path:
+    return prefix.parent / (
+        f"{prefix.name}.data-{shard:05d}-of-{num_shards:05d}")
+
+
+OBJECT_GRAPH_KEY = "_CHECKPOINTABLE_OBJECT_GRAPH"
+
+
+def read_bundle(prefix: str | pathlib.Path, *, verify: bool = True
+                ) -> dict[str, np.ndarray]:
+    """Load every tensor of a checkpoint bundle into host arrays.
+
+    TF2 object-graph checkpoints additionally index each tensor under its
+    variable name (SerializedTensor.full_name) so graph VarHandleOp nodes
+    resolve — the BundleReader + object-graph walk the reference does in
+    restore ops, done once at load. Data shards are memory-mapped; each
+    tensor is copied out individually (no whole-shard duplicate in RSS).
+    """
+    import mmap
+
+    prefix = pathlib.Path(prefix)
+    index_path = prefix.parent / f"{prefix.name}.index"
+    if not index_path.is_file():
+        raise ServingError.not_found(f"no checkpoint index at {index_path}")
+    table = read_table(index_path, verify=verify)
+
+    header = tf_bundle_pb2.BundleHeaderProto()
+    if b"" in table:
+        header.ParseFromString(table[b""])
+    num_shards = header.num_shards or 1
+    if header.endianness == tf_bundle_pb2.BundleHeaderProto.BIG:
+        raise BundleError("big-endian checkpoints are not supported")
+
+    shards: dict[int, mmap.mmap] = {}
+    files = []
+    out: dict[str, np.ndarray] = {}
+    try:
+        for key, value in table.items():
+            if key == b"":
+                continue
+            entry = tf_bundle_pb2.BundleEntryProto()
+            entry.ParseFromString(value)
+            if entry.slices:
+                raise BundleError(
+                    f"tensor {key.decode()!r} is stored as slices; "
+                    "partitioned variables are not supported")
+            shard = entry.shard_id
+            if shard not in shards:
+                f = open(_data_path(prefix, shard, num_shards), "rb")
+                files.append(f)
+                shards[shard] = mmap.mmap(f.fileno(), 0,
+                                          access=mmap.ACCESS_READ)
+            raw = shards[shard][entry.offset:entry.offset + entry.size]
+            if len(raw) != entry.size:
+                raise BundleError(
+                    f"tensor {key.decode()!r}: data out of range")
+            dt = DataType(int(entry.dtype))
+            shape = tuple(int(d.size) for d in entry.shape.dim)
+            if dt.is_string:
+                # String tensors have their own crc recipe (over the
+                # fixed-width length values, not the stored varints) —
+                # verified inside the decoder.
+                out[key.decode()] = _decode_string_tensor(
+                    raw, shape, key, verify=verify,
+                    expected_crc=entry.crc32c if verify else 0)
+            else:
+                if verify and entry.crc32c:
+                    if tfrecord.masked_crc32c(raw) != entry.crc32c:
+                        raise BundleError(
+                            f"tensor {key.decode()!r}: data checksum "
+                            "mismatch")
+                arr = np.frombuffer(raw, dtype=dt.numpy_dtype)
+                out[key.decode()] = arr.reshape(shape)
+    finally:
+        for m in shards.values():
+            m.close()
+        for f in files:
+            f.close()
+    _index_by_variable_name(out)
+    return out
+
+
+def _index_by_variable_name(tensors: dict[str, np.ndarray]) -> None:
+    """Add full_name aliases from the TF2 object graph, in place. Keras
+    exports key tensors by object path ('layer_with_weights-0/kernel/
+    .ATTRIBUTES/VARIABLE_VALUE'); the object graph's SerializedTensor
+    records map each checkpoint_key to the variable's full_name
+    ('dense/kernel') — the name graph nodes carry."""
+    og = tensors.get(OBJECT_GRAPH_KEY)
+    if og is None:
+        return
+    try:
+        raw = og.reshape(-1)[0]
+        graph = tf_bundle_pb2.TrackableObjectGraph()
+        graph.ParseFromString(raw if isinstance(raw, bytes) else bytes(raw))
+    except Exception:
+        return  # malformed/newer object graph: keep raw keys only
+    for node in graph.nodes:
+        for attr in node.attributes:
+            if attr.full_name and attr.checkpoint_key in tensors:
+                tensors.setdefault(attr.full_name,
+                                   tensors[attr.checkpoint_key])
+
+
+def _fixed_width_lengths(lengths: list[int]) -> bytes:
+    """The crc32c for string tensors covers the *fixed-width* length
+    values, not their stored varint encoding: uint32 LE per element when
+    it fits, uint64 LE otherwise (tensor_bundle.cc WriteStringTensor's
+    crc32c::Extend calls)."""
+    out = bytearray()
+    for ln in lengths:
+        out += struct.pack("<I", ln) if ln <= 0xFFFFFFFF else struct.pack(
+            "<Q", ln)
+    return bytes(out)
+
+
+def _decode_string_tensor(raw: bytes, shape: tuple, key: bytes, *,
+                          verify: bool, expected_crc: int) -> np.ndarray:
+    """Bundle string tensors (tensor_bundle.cc WriteStringTensor):
+
+        [varint64 len_0]..[varint64 len_{N-1}]
+        [4-byte masked crc32c over the fixed-width length values]
+        [string_0 bytes]..[string_{N-1} bytes]
+
+    The entry-level crc32c covers fixed-width lengths + the 4 masked
+    length-checksum bytes + the string bytes (NOT the raw stored bytes).
+    """
+    n = int(np.prod(shape, dtype=np.int64)) if shape else 1
+    lengths = []
+    pos = 0
+    for _ in range(n):
+        ln, pos = _read_varint(raw, pos)
+        lengths.append(ln)
+    if pos + 4 > len(raw):
+        raise BundleError(
+            f"tensor {key.decode()!r}: truncated length checksum")
+    cksum_bytes = raw[pos:pos + 4]
+    pos += 4
+    fixed = _fixed_width_lengths(lengths)
+    if verify:
+        (stored_len_crc,) = struct.unpack("<I", cksum_bytes)
+        if stored_len_crc != tfrecord.masked_crc32c(fixed):
+            raise BundleError(
+                f"tensor {key.decode()!r}: length checksum mismatch")
+        if expected_crc and tfrecord.masked_crc32c(
+                fixed + cksum_bytes + raw[pos:]) != expected_crc:
+            raise BundleError(
+                f"tensor {key.decode()!r}: data checksum mismatch")
+    out = np.empty((n,), dtype=object)
+    for i, ln in enumerate(lengths):
+        out[i] = raw[pos:pos + ln]
+        pos += ln
+    return out.reshape(shape)
+
+
+def write_bundle(prefix: str | pathlib.Path,
+                 tensors: Mapping[str, np.ndarray]) -> None:
+    """Write a single-shard checkpoint bundle readable by this module and
+    by TensorFlow's own BundleReader."""
+    prefix = pathlib.Path(prefix)
+    prefix.parent.mkdir(parents=True, exist_ok=True)
+
+    data = bytearray()
+    pairs: list[tuple[bytes, bytes]] = []
+
+    header = tf_bundle_pb2.BundleHeaderProto(
+        num_shards=1,
+        endianness=tf_bundle_pb2.BundleHeaderProto.LITTLE)
+    header.version.producer = 1
+    pairs.append((b"", header.SerializeToString()))
+
+    for name in sorted(tensors):
+        arr = np.ascontiguousarray(tensors[name])
+        if arr.dtype == object or arr.dtype.kind in ("U", "S"):
+            flat = [v if isinstance(v, bytes) else str(v).encode()
+                    for v in arr.reshape(-1).tolist()]
+            fixed = _fixed_width_lengths([len(s) for s in flat])
+            len_cksum = struct.pack("<I", tfrecord.masked_crc32c(fixed))
+            payload = b"".join(flat)
+            raw = (b"".join(_write_varint(len(s)) for s in flat) +
+                   len_cksum + payload)
+            crc = tfrecord.masked_crc32c(fixed + len_cksum + payload)
+            dtype_enum = DataType("DT_STRING").enum
+        else:
+            raw = arr.tobytes()
+            crc = tfrecord.masked_crc32c(raw)
+            dtype_enum = DataType(arr.dtype.type).enum
+        entry = tf_bundle_pb2.BundleEntryProto(
+            dtype=dtype_enum,
+            shard_id=0,
+            offset=len(data),
+            size=len(raw),
+            crc32c=crc)
+        for dim in arr.shape:
+            entry.shape.dim.add(size=dim)
+        data += raw
+        pairs.append((name.encode(), entry.SerializeToString()))
+
+    _data_path(prefix, 0, 1).write_bytes(bytes(data))
+    index_path = prefix.parent / f"{prefix.name}.index"
+    index_path.write_bytes(_TableWriter().finish(pairs))

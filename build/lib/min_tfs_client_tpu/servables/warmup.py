@@ -1,0 +1,110 @@
+"""Model warmup: replay recorded requests at load time.
+
+Parity with servables/tensorflow/saved_model_warmup.{h,cc}: reads
+PredictionLog TFRecords from <version>/assets.extra/tf_serving_warmup_requests,
+caps at 1000 records (.h:38-40), replays each num_request_iterations times
+(.cc:94-146), and fails the LOAD on unsupported log types — a model with a
+bad warmup file never becomes AVAILABLE.
+
+On TPU, warmup doubles as XLA compile-cache priming: a warmup file covering
+each (batch bucket x sequence bucket) shape means zero compiles at serve
+time. synthesize_warmup() generates exactly that when no file exists.
+"""
+
+from __future__ import annotations
+
+import pathlib
+
+import numpy as np
+
+from min_tfs_client_tpu.protos import tfs_apis_pb2 as apis
+from min_tfs_client_tpu.servables.servable import Servable
+from min_tfs_client_tpu.tensor.codec import tensor_proto_to_ndarray
+from min_tfs_client_tpu.tensor.example_codec import decode_input
+from min_tfs_client_tpu.utils import tfrecord
+from min_tfs_client_tpu.utils.status import ServingError
+
+WARMUP_ASSET_DIR = "assets.extra"
+WARMUP_FILENAME = "tf_serving_warmup_requests"
+MAX_WARMUP_RECORDS = 1000
+
+
+def warmup_file(version_path) -> pathlib.Path:
+    return pathlib.Path(version_path) / WARMUP_ASSET_DIR / WARMUP_FILENAME
+
+
+def run_warmup(servable: Servable, version_path,
+               num_iterations: int = 1) -> int:
+    """Replay the warmup log if present. Returns records replayed."""
+    path = warmup_file(version_path)
+    if not path.is_file():
+        return 0
+    count = 0
+    for raw in tfrecord.read_records(path, max_records=MAX_WARMUP_RECORDS + 1):
+        if count >= MAX_WARMUP_RECORDS:
+            raise ServingError.invalid_argument(
+                f"Number of warmup records exceeds the maximum "
+                f"({MAX_WARMUP_RECORDS})")
+        log = apis.PredictionLog.FromString(raw)
+        for _ in range(max(1, num_iterations)):
+            _replay(servable, log)
+        count += 1
+    return count
+
+
+def _replay(servable: Servable, log: apis.PredictionLog) -> None:
+    kind = log.WhichOneof("log_type")
+    if kind == "predict_log":
+        request = log.predict_log.request
+        signature = servable.signature(request.model_spec.signature_name)
+        inputs = {k: tensor_proto_to_ndarray(v, writable=False)
+                  for k, v in request.inputs.items()}
+        signature.run(inputs, tuple(request.output_filter))
+    elif kind == "classify_log":
+        request = log.classify_log.request
+        signature = servable.signature(request.model_spec.signature_name)
+        if signature.feature_specs is None:
+            raise ServingError.failed_precondition(
+                "classify warmup against a signature without feature specs")
+        features, _ = decode_input(request.input, signature.feature_specs)
+        signature.run(features)
+    elif kind == "regress_log":
+        request = log.regress_log.request
+        signature = servable.signature(request.model_spec.signature_name)
+        if signature.feature_specs is None:
+            raise ServingError.failed_precondition(
+                "regress warmup against a signature without feature specs")
+        features, _ = decode_input(request.input, signature.feature_specs)
+        signature.run(features)
+    elif kind == "multi_inference_log":
+        request = log.multi_inference_log.request
+        for task in request.tasks:
+            signature = servable.signature(task.model_spec.signature_name)
+            if signature.feature_specs is None:
+                continue
+            features, _ = decode_input(request.input, signature.feature_specs)
+            signature.run(features)
+    else:
+        raise ServingError.unimplemented(
+            f"Unsupported log_type for warmup: {kind or '(none)'}")
+
+
+def synthesize_warmup(servable: Servable) -> int:
+    """No warmup file: prime each batched device signature's jit cache over
+    its batch buckets with zero-filled inputs. Returns executions run."""
+    runs = 0
+    for signature in servable.signatures.values():
+        if signature.on_host or not signature.batched:
+            continue
+        for bucket in signature.batch_buckets:
+            inputs = {}
+            for alias, spec in signature.inputs.items():
+                dims = [bucket] + [d if d is not None else 1
+                                   for d in spec.shape[1:]]
+                if spec.dtype.is_string:
+                    inputs[alias] = np.full(dims, b"", dtype=object)
+                else:
+                    inputs[alias] = np.zeros(dims, spec.dtype.numpy_dtype)
+            signature.run(inputs)
+            runs += 1
+    return runs

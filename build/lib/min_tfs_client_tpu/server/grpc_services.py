@@ -1,0 +1,65 @@
+"""gRPC servicers: thin shims from transport to Handlers.
+
+Parity with model_servers/prediction_service_impl.cc and
+model_service_impl.cc — the servicers only translate deadline/metadata and
+map ServingError codes onto the gRPC trailer (ToGRPCStatus,
+grpc_status_util.cc:23).
+"""
+
+from __future__ import annotations
+
+import grpc
+
+from min_tfs_client_tpu.protos import grpc_service as gs
+from min_tfs_client_tpu.server.handlers import Handlers
+from min_tfs_client_tpu.utils.status import (
+    error_from_exception,
+    to_grpc_code,
+)
+
+
+def _guard(handler_fn, request, context):
+    try:
+        return handler_fn(request)
+    except Exception as exc:  # noqa: BLE001 - mapped onto the wire
+        err = error_from_exception(exc)
+        context.abort(to_grpc_code(err.code), err.message)
+
+
+class PredictionServiceImpl(gs.PredictionServiceServicer):
+    def __init__(self, handlers: Handlers):
+        self._handlers = handlers
+
+    def Predict(self, request, context):
+        return _guard(self._handlers.predict, request, context)
+
+    def Classify(self, request, context):
+        return _guard(self._handlers.classify, request, context)
+
+    def Regress(self, request, context):
+        return _guard(self._handlers.regress, request, context)
+
+    def MultiInference(self, request, context):
+        return _guard(self._handlers.multi_inference, request, context)
+
+    def GetModelMetadata(self, request, context):
+        return _guard(self._handlers.get_model_metadata, request, context)
+
+
+class SessionServiceImpl(gs.SessionServiceServicer):
+    def __init__(self, handlers: Handlers):
+        self._handlers = handlers
+
+    def SessionRun(self, request, context):
+        return _guard(self._handlers.session_run, request, context)
+
+
+class ModelServiceImpl(gs.ModelServiceServicer):
+    def __init__(self, handlers: Handlers):
+        self._handlers = handlers
+
+    def GetModelStatus(self, request, context):
+        return _guard(self._handlers.get_model_status, request, context)
+
+    def HandleReloadConfigRequest(self, request, context):
+        return _guard(self._handlers.handle_reload_config, request, context)

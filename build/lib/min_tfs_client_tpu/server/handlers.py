@@ -1,0 +1,348 @@
+"""Transport-independent request handlers.
+
+One implementation of the five PredictionService methods + two ModelService
+methods, shared by the gRPC servicers, the tpu:// in-process channel, and
+the REST front-end. Semantics follow the reference implementations:
+
+  Predict        predict_util.cc:89-215 (signature lookup, alias resolution,
+                 output_filter, effective model_spec in response)
+  Classify       classifier.cc (scores/classes outputs, per-example assembly)
+  Regress        regressor.cc
+  MultiInference multi_inference.cc:31-77 (validation rules)
+  GetModelMetadata get_model_metadata_impl.cc (signature_def only)
+  GetModelStatus get_model_status_impl.cc:30-75
+  ReloadConfig   model_service_impl.cc:41-69
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+
+import numpy as np
+
+from min_tfs_client_tpu.core.server_core import ServerCore
+from min_tfs_client_tpu.protos import tfs_apis_pb2 as apis
+from min_tfs_client_tpu.servables.servable import (
+    CLASSIFY_METHOD_NAME,
+    CLASSIFY_OUTPUT_CLASSES,
+    CLASSIFY_OUTPUT_SCORES,
+    REGRESS_METHOD_NAME,
+    REGRESS_OUTPUTS,
+    Signature,
+)
+from min_tfs_client_tpu.tensor.codec import (
+    ndarray_to_tensor_proto,
+    tensor_proto_to_ndarray,
+)
+from min_tfs_client_tpu.tensor.example_codec import decode_input
+from min_tfs_client_tpu.utils.status import ServingError
+
+SIGNATURE_DEF_METADATA_FIELD = "signature_def"
+
+
+def _effective_spec(target, model_spec, version: int, signature_name: str) -> None:
+    target.name = model_spec.name
+    target.version.value = version
+    if signature_name:
+        target.signature_name = signature_name
+
+
+def _instrumented(api: str):
+    """Request count/latency instrumentation (the serving-path metrics the
+    reference records in servables/tensorflow/util.cc:36-71)."""
+
+    def wrap(fn):
+        @functools.wraps(fn)
+        def inner(self, request):
+            from min_tfs_client_tpu.server import metrics
+            from min_tfs_client_tpu.server.profiler import trace
+
+            start = time.perf_counter()
+            try:
+                with trace(f"serving/{api}"):
+                    response = fn(self, request)
+            except Exception as exc:
+                err = ServingError if isinstance(exc, ServingError) else None
+                code = exc.code if err else 2
+                metrics.request_count.increment(api, str(code))
+                raise
+            metrics.request_count.increment(api, "0")
+            metrics.request_latency.observe(
+                (time.perf_counter() - start) * 1e6, api)
+            return response
+        return inner
+    return wrap
+
+
+class Handlers:
+    def __init__(self, core: ServerCore, *,
+                 response_tensors_as_content: bool = False):
+        self.core = core
+        # False = typed fields (the reference server's default serialization,
+        # server_core.h:186-188 kAsProtoField); True = tensor_content.
+        self._as_content = response_tensors_as_content
+
+    # -- PredictionService ---------------------------------------------------
+
+    @_instrumented("predict")
+    def predict(self, request: apis.PredictRequest) -> apis.PredictResponse:
+        with self.core.servable_handle(request.model_spec) as handle:
+            servable = handle.servable
+            sig_name = request.model_spec.signature_name
+            signature = servable.signature(sig_name)
+            inputs = {k: tensor_proto_to_ndarray(v, writable=False)
+                      for k, v in request.inputs.items()}
+            outputs = signature.run(inputs, tuple(request.output_filter))
+            response = apis.PredictResponse()
+            _effective_spec(response.model_spec, request.model_spec,
+                            handle.id.version,
+                            request.model_spec.signature_name)
+            for alias, arr in outputs.items():
+                response.outputs[alias].CopyFrom(ndarray_to_tensor_proto(
+                    arr, use_tensor_content=self._as_content))
+            self.core.request_logger.maybe_log(
+                request.model_spec.name,
+                lambda: _predict_log(request, response),
+                response.model_spec)
+            return response
+
+    def _example_signature(self, servable, model_spec, want_method: str) -> Signature:
+        signature = servable.signature(model_spec.signature_name)
+        if signature.method_name != want_method:
+            raise ServingError.invalid_argument(
+                f"Expected {want_method} signature method_name but got "
+                f"{signature.method_name!r}")
+        if signature.feature_specs is None:
+            raise ServingError.failed_precondition(
+                f"signature has no feature specs; cannot parse Examples")
+        return signature
+
+    def _run_examples(self, signature: Signature, request_input: apis.Input,
+                      model_name: str = ""):
+        from min_tfs_client_tpu.server import metrics
+
+        features, n = decode_input(request_input, signature.feature_specs)
+        if n == 0:
+            raise ServingError.invalid_argument("Input is empty")
+        if model_name:
+            metrics.request_example_counts.observe(n, model_name)
+        return signature.run(features), n
+
+    @_instrumented("classify")
+    def classify(
+        self, request: apis.ClassificationRequest
+    ) -> apis.ClassificationResponse:
+        with self.core.servable_handle(request.model_spec) as handle:
+            signature = self._example_signature(
+                handle.servable, request.model_spec, CLASSIFY_METHOD_NAME)
+            outputs, n = self._run_examples(signature, request.input,
+                                            request.model_spec.name)
+            response = apis.ClassificationResponse()
+            _effective_spec(response.model_spec, request.model_spec,
+                            handle.id.version,
+                            request.model_spec.signature_name)
+            _assemble_classifications(
+                response.result, outputs, n, signature.class_labels)
+            self.core.request_logger.maybe_log(
+                request.model_spec.name,
+                lambda: _classify_log(request, response),
+                response.model_spec)
+            return response
+
+    @_instrumented("regress")
+    def regress(self, request: apis.RegressionRequest) -> apis.RegressionResponse:
+        with self.core.servable_handle(request.model_spec) as handle:
+            signature = self._example_signature(
+                handle.servable, request.model_spec, REGRESS_METHOD_NAME)
+            outputs, n = self._run_examples(signature, request.input,
+                                            request.model_spec.name)
+            response = apis.RegressionResponse()
+            _effective_spec(response.model_spec, request.model_spec,
+                            handle.id.version,
+                            request.model_spec.signature_name)
+            _assemble_regressions(response.result, outputs, n)
+            self.core.request_logger.maybe_log(
+                request.model_spec.name,
+                lambda: _regress_log(request, response),
+                response.model_spec)
+            return response
+
+    @_instrumented("multi_inference")
+    def multi_inference(
+        self, request: apis.MultiInferenceRequest
+    ) -> apis.MultiInferenceResponse:
+        # Validation rules from multi_inference.cc:44-77.
+        if not request.tasks:
+            raise ServingError.invalid_argument("Inference request is empty")
+        names = {t.model_spec.name for t in request.tasks}
+        if len(names) != 1:
+            raise ServingError.invalid_argument(
+                "All ModelSpecs in a MultiInferenceRequest must access the "
+                f"same model name; got {sorted(names)}")
+        seen_signatures = set()
+        for task in request.tasks:
+            key = task.model_spec.signature_name or "serving_default"
+            if key in seen_signatures:
+                raise ServingError.invalid_argument(
+                    f"Duplicate evaluation of signature: {key}")
+            seen_signatures.add(key)
+            if task.method_name not in (CLASSIFY_METHOD_NAME,
+                                        REGRESS_METHOD_NAME):
+                raise ServingError.unimplemented(
+                    f"Unsupported signature method_name: {task.method_name}")
+
+        response = apis.MultiInferenceResponse()
+        spec0 = request.tasks[0].model_spec
+        with self.core.servable_handle(spec0) as handle:
+            for task in request.tasks:
+                signature = self._example_signature(
+                    handle.servable, task.model_spec, task.method_name)
+                outputs, n = self._run_examples(signature, request.input)
+                result = response.results.add()
+                _effective_spec(result.model_spec, task.model_spec,
+                                handle.id.version,
+                                task.model_spec.signature_name)
+                if task.method_name == CLASSIFY_METHOD_NAME:
+                    _assemble_classifications(
+                        result.classification_result, outputs, n,
+                        signature.class_labels)
+                else:
+                    _assemble_regressions(result.regression_result, outputs, n)
+        return response
+
+    def get_model_metadata(
+        self, request: apis.GetModelMetadataRequest
+    ) -> apis.GetModelMetadataResponse:
+        if not request.metadata_field:
+            raise ServingError.invalid_argument(
+                "GetModelMetadataRequest must specify at least one metadata_field")
+        for field in request.metadata_field:
+            if field != SIGNATURE_DEF_METADATA_FIELD:
+                raise ServingError.invalid_argument(
+                    f"Metadata field {field} is not supported")
+        with self.core.servable_handle(request.model_spec) as handle:
+            response = apis.GetModelMetadataResponse()
+            response.model_spec.name = request.model_spec.name
+            response.model_spec.version.value = handle.id.version
+            response.metadata[SIGNATURE_DEF_METADATA_FIELD].Pack(
+                handle.servable.signature_def_map())
+            return response
+
+    @_instrumented("session_run")
+    def session_run(self, request: apis.SessionRunRequest) -> apis.SessionRunResponse:
+        """Raw feeds/fetches on the imported graph (session_service.proto:11-44;
+        RunOptions are carried but ignored, matching the proto's own note)."""
+        with self.core.servable_handle(request.model_spec) as handle:
+            runner = getattr(handle.servable, "session_runner", None)
+            if runner is None:
+                raise ServingError.unimplemented(
+                    f"model {request.model_spec.name!r} does not support raw "
+                    "SessionRun (no imported graph)")
+            feeds = {nt.name: tensor_proto_to_ndarray(nt.tensor, writable=False)
+                     for nt in request.feed}
+            outs = runner.run(feeds, list(request.fetch), list(request.target))
+            response = apis.SessionRunResponse()
+            _effective_spec(response.model_spec, request.model_spec,
+                            handle.id.version, "")
+            for name, value in zip(request.fetch, outs):
+                nt = response.tensor.add()
+                nt.name = name
+                nt.tensor.CopyFrom(ndarray_to_tensor_proto(
+                    value, use_tensor_content=self._as_content))
+            return response
+
+    # -- ModelService --------------------------------------------------------
+
+    def get_model_status(
+        self, request: apis.GetModelStatusRequest
+    ) -> apis.GetModelStatusResponse:
+        if not request.model_spec.name:
+            raise ServingError.invalid_argument("Missing ModelSpec.name")
+        version = self.core.resolve_version(request.model_spec)
+        response = apis.GetModelStatusResponse()
+        response.model_version_status.extend(
+            self.core.model_version_states(request.model_spec.name, version))
+        return response
+
+    def handle_reload_config(
+        self, request: apis.ReloadConfigRequest
+    ) -> apis.ReloadConfigResponse:
+        response = apis.ReloadConfigResponse()
+        try:
+            self.core.reload_config(request.config)
+        except ServingError as err:
+            response.status.CopyFrom(err.to_proto())
+        return response
+
+
+def _assemble_classifications(result, outputs, n: int, class_labels) -> None:
+    """Per-example Classifications from 'scores'/'classes' outputs
+    (classifier.cc semantics: at least one of the two must exist; both must
+    be [batch, k])."""
+    scores = outputs.get(CLASSIFY_OUTPUT_SCORES)
+    classes = outputs.get(CLASSIFY_OUTPUT_CLASSES)
+    if scores is None and classes is None:
+        raise ServingError.failed_precondition(
+            "Classification signature produced neither scores nor classes")
+    k = None
+    for arr in (scores, classes):
+        if arr is None:
+            continue
+        if arr.ndim == 1:
+            arr = arr.reshape(n, -1)
+        if arr.shape[0] != n:
+            raise ServingError.internal(
+                f"classification output batch {arr.shape[0]} != examples {n}")
+        k = arr.shape[1] if k is None else k
+    scores2 = None if scores is None else np.asarray(scores).reshape(n, -1)
+    classes2 = None if classes is None else np.asarray(classes).reshape(n, -1)
+    for i in range(n):
+        classifications = result.classifications.add()
+        width = (scores2 if scores2 is not None else classes2).shape[1]
+        for j in range(width):
+            cls = classifications.classes.add()
+            if classes2 is not None:
+                label = classes2[i, j]
+                cls.label = label.decode() if isinstance(label, bytes) else str(label)
+            elif class_labels is not None and j < len(class_labels):
+                raw = class_labels[j]
+                cls.label = raw.decode() if isinstance(raw, bytes) else str(raw)
+            else:
+                cls.label = str(j)
+            if scores2 is not None:
+                cls.score = float(scores2[i, j])
+
+
+def _assemble_regressions(result, outputs, n: int) -> None:
+    values = outputs.get(REGRESS_OUTPUTS)
+    if values is None:
+        raise ServingError.failed_precondition(
+            "Regression signature produced no 'outputs' tensor")
+    values = np.asarray(values).reshape(-1)
+    if values.shape[0] != n:
+        raise ServingError.internal(
+            f"regression output count {values.shape[0]} != examples {n}")
+    for i in range(n):
+        result.regressions.add().value = float(values[i])
+
+
+def _predict_log(request, response) -> apis.PredictionLog:
+    log = apis.PredictionLog()
+    log.predict_log.request.CopyFrom(request)
+    log.predict_log.response.CopyFrom(response)
+    return log
+
+
+def _classify_log(request, response) -> apis.PredictionLog:
+    log = apis.PredictionLog()
+    log.classify_log.request.CopyFrom(request)
+    log.classify_log.response.CopyFrom(response)
+    return log
+
+
+def _regress_log(request, response) -> apis.PredictionLog:
+    log = apis.PredictionLog()
+    log.regress_log.request.CopyFrom(request)
+    log.regress_log.response.CopyFrom(response)
+    return log

@@ -1,0 +1,117 @@
+"""CLI entry point — flag surface mirrors the reference model server
+(model_servers/main.cc:59-195) where the flags are meaningful on TPU.
+
+    python -m min_tfs_client_tpu.server.main --port=8500 \
+        --model_name=resnet --model_base_path=/models/resnet
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from min_tfs_client_tpu.server.server import Server, ServerOptions
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser("tpu_model_server")
+    p.add_argument("--port", type=int, default=8500,
+                   help="gRPC port to listen on")
+    p.add_argument("--rest_api_port", type=int, default=0,
+                   help="HTTP/REST port; 0 disables")
+    p.add_argument("--model_name", default="default")
+    p.add_argument("--model_base_path", default="")
+    p.add_argument("--model_platform", default="tensorflow",
+                   help='"tensorflow" (SavedModel) or "jax" (native)')
+    p.add_argument("--model_config_file", default="")
+    p.add_argument("--model_config_file_poll_wait_seconds", type=float,
+                   default=0)
+    p.add_argument("--file_system_poll_wait_seconds", type=float, default=1.0)
+    p.add_argument("--enable_batching", action="store_true")
+    p.add_argument("--batching_parameters_file", default="")
+    p.add_argument("--monitoring_config_file", default="")
+    p.add_argument("--ssl_config_file", default="")
+    p.add_argument("--max_num_load_retries", type=int, default=5)
+    p.add_argument("--load_retry_interval_micros", type=int,
+                   default=60 * 1000 * 1000)
+    p.add_argument("--num_load_threads", type=int, default=2)
+    p.add_argument("--num_unload_threads", type=int, default=2)
+    p.add_argument("--grpc_max_threads", type=int, default=16)
+    p.add_argument("--enable_model_warmup", type=lambda v: v != "false",
+                   default=True)
+    p.add_argument("--num_request_iterations_for_warmup", type=int, default=1,
+                   help="replay count per warmup record (ModelWarmupOptions."
+                        "num_request_iterations)")
+    p.add_argument("--synthesize_warmup", action="store_true",
+                   help="synthesize compile-priming requests for models "
+                        "that ship no warmup file")
+    p.add_argument("--mesh_axes", default="",
+                   help='serving device mesh, e.g. "data:-1" or '
+                        '"data:4,model:2"; batched signatures execute '
+                        'data-parallel over it ("" = single device)')
+    p.add_argument("--response_tensors_as_content", action="store_true",
+                   help="serialize response tensors as tensor_content "
+                        "instead of typed fields")
+    p.add_argument("--profiler_port", type=int, default=0,
+                   help="jax.profiler server port for on-demand trace "
+                        "capture; 0 disables")
+    p.add_argument("--grpc_socket_path", default="",
+                   help="also listen on this UNIX-domain socket path")
+    p.add_argument("--grpc_channel_arguments", default="",
+                   help='extra gRPC server args, "key=value,key=value"')
+    p.add_argument("--version", action="store_true",
+                   help="print the server version and exit")
+    return p
+
+
+def options_from_args(args) -> ServerOptions:
+    return ServerOptions(
+        grpc_port=args.port,
+        rest_api_port=args.rest_api_port,
+        model_name=args.model_name,
+        model_base_path=args.model_base_path,
+        model_platform=args.model_platform,
+        model_config_file=args.model_config_file,
+        model_config_file_poll_wait_seconds=args.model_config_file_poll_wait_seconds,
+        file_system_poll_wait_seconds=args.file_system_poll_wait_seconds,
+        enable_batching=args.enable_batching,
+        batching_parameters_file=args.batching_parameters_file,
+        monitoring_config_file=args.monitoring_config_file,
+        ssl_config_file=args.ssl_config_file,
+        max_num_load_retries=args.max_num_load_retries,
+        load_retry_interval_micros=args.load_retry_interval_micros,
+        num_load_threads=args.num_load_threads,
+        num_unload_threads=args.num_unload_threads,
+        grpc_max_threads=args.grpc_max_threads,
+        enable_model_warmup=args.enable_model_warmup,
+        warmup_iterations=args.num_request_iterations_for_warmup,
+        synthesize_warmup=args.synthesize_warmup,
+        mesh_axes=args.mesh_axes,
+        response_tensors_as_content=args.response_tensors_as_content,
+        profiler_port=args.profiler_port,
+        grpc_socket_path=args.grpc_socket_path,
+        grpc_channel_arguments=args.grpc_channel_arguments,
+    )
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.version:
+        from min_tfs_client_tpu.server.version import version_string
+
+        print(version_string())
+        return 0
+    server = Server(options_from_args(args)).build_and_start()
+    ports = f"gRPC on {server.grpc_port}"
+    if getattr(server, "rest_port", None):
+        ports += f", REST on {server.rest_port}"
+    print(f"[tpu_model_server] serving: {ports}", flush=True)
+    try:
+        server.wait_for_termination()
+    except KeyboardInterrupt:
+        server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,159 @@
+"""Process-global metrics registry + Prometheus text exporter.
+
+Parity with tensorflow/core/lib/monitoring (counter.h, gauge.h, sampler.h
+exponential buckets, collection_registry.cc) and the exporter that walks the
+registry into Prometheus text format (util/prometheus_exporter.cc:62-159).
+Metric names keep the TF-Serving style (":tensorflow/serving/...") and are
+sanitized for Prometheus exactly like the reference does (non-alphanumeric
+-> '_').
+"""
+
+from __future__ import annotations
+
+import bisect
+import re
+import threading
+from typing import Sequence
+
+_registry_lock = threading.Lock()
+_registry: dict[str, "_Metric"] = {}
+
+
+class _Metric:
+    kind = "untyped"
+
+    def __init__(self, name: str, description: str, label_names: Sequence[str]):
+        self.name = name
+        self.description = description
+        self.label_names = tuple(label_names)
+        self._lock = threading.Lock()
+        self._cells: dict[tuple, object] = {}
+        with _registry_lock:
+            existing = _registry.get(name)
+            if existing is not None:
+                # Same-name re-creation returns the same metric (TF allows
+                # only one registration; we tolerate idempotent re-use).
+                self.__dict__ = existing.__dict__
+                return
+            _registry[name] = self
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def increment(self, *labels, by: float = 1.0) -> None:
+        with self._lock:
+            self._cells[labels] = self._cells.get(labels, 0.0) + by
+
+    def value(self, *labels) -> float:
+        with self._lock:
+            return self._cells.get(labels, 0.0)
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def set(self, value: float, *labels) -> None:
+        with self._lock:
+            self._cells[labels] = value
+
+    def value(self, *labels) -> float:
+        with self._lock:
+            return self._cells.get(labels, 0.0)
+
+
+def exponential_buckets(scale: float, growth: float, count: int) -> list[float]:
+    """Same shape as monitoring::Buckets::Exponential (sampler.h)."""
+    out, value = [], scale
+    for _ in range(count):
+        out.append(value)
+        value *= growth
+    return out
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+
+    def __init__(self, name, description, label_names=(),
+                 buckets: Sequence[float] | None = None):
+        super().__init__(name, description, label_names)
+        if "buckets" not in self.__dict__:
+            self.buckets = list(buckets or exponential_buckets(10, 1.8, 33))
+
+    def observe(self, value: float, *labels) -> None:
+        with self._lock:
+            cell = self._cells.get(labels)
+            if cell is None:
+                cell = {"counts": [0] * (len(self.buckets) + 1),
+                        "sum": 0.0, "count": 0}
+                self._cells[labels] = cell
+            idx = bisect.bisect_left(self.buckets, value)
+            cell["counts"][idx] += 1
+            cell["sum"] += value
+            cell["count"] += 1
+
+
+# ---------------------------------------------------------------------------
+# Serving-path metrics (parity: servables/tensorflow/util.cc:36-71 +
+# request latency; extended with TPU compile/padding visibility)
+
+request_count = Counter(
+    ":tensorflow/serving/request_count",
+    "Number of requests, by API and status.", ("api", "status"))
+request_latency = Histogram(
+    ":tensorflow/serving/request_latency",
+    "Request latency in microseconds, by API.", ("api",),
+    buckets=exponential_buckets(10, 1.8, 33))
+request_example_counts = Histogram(
+    ":tensorflow/serving/request_example_counts",
+    "Number of examples per request.", ("model",),
+    buckets=exponential_buckets(1, 2, 20))
+batch_padding_ratio = Histogram(
+    ":tpu/serving/batch_padding_ratio",
+    "Padded-to-real batch size ratio per executed batch.", ("model",),
+    buckets=[1.0, 1.25, 1.5, 2.0, 3.0, 4.0, 8.0])
+compilation_count = Counter(
+    ":tpu/serving/compilation_count",
+    "XLA compilations triggered by serving, by model.", ("model",))
+model_load_latency = Histogram(
+    ":tensorflow/serving/load_latency",
+    "Servable load latency in microseconds.", ("model",),
+    buckets=exponential_buckets(100, 2.0, 24))
+
+
+def _sanitize(name: str) -> str:
+    return re.sub(r"[^a-zA-Z0-9_]", "_", name.lstrip(":"))
+
+
+def prometheus_text() -> str:
+    """Serialize every registered metric (prometheus_exporter.cc:153-159)."""
+    lines: list[str] = []
+    with _registry_lock:
+        metrics = list(_registry.values())
+    for metric in metrics:
+        pname = _sanitize(metric.name)
+        lines.append(f"# TYPE {pname} {metric.kind}")
+        with metric._lock:
+            cells = dict(metric._cells)
+        for labels, value in sorted(cells.items(), key=lambda kv: kv[0]):
+            label_str = ""
+            if metric.label_names:
+                pairs = ",".join(
+                    f'{k}="{v}"' for k, v in zip(metric.label_names, labels))
+                label_str = "{" + pairs + "}"
+            if metric.kind == "histogram":
+                cum = 0
+                for bound, count in zip(metric.buckets, value["counts"]):
+                    cum += count
+                    le = (f'{{le="{bound}"}}' if not metric.label_names else
+                          label_str[:-1] + f',le="{bound}"}}')
+                    lines.append(f"{pname}_bucket{le} {cum}")
+                cum += value["counts"][-1]
+                le_inf = ('{le="+Inf"}' if not metric.label_names else
+                          label_str[:-1] + ',le="+Inf"}')
+                lines.append(f"{pname}_bucket{le_inf} {cum}")
+                lines.append(f"{pname}_sum{label_str} {value['sum']}")
+                lines.append(f"{pname}_count{label_str} {value['count']}")
+            else:
+                lines.append(f"{pname}{label_str} {value}")
+    return "\n".join(lines) + "\n"

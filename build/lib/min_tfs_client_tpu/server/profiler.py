@@ -1,0 +1,92 @@
+"""On-demand profiling: JAX profiler server + TraceMe-style annotations.
+
+Parity with the reference's profiler subsystem (SURVEY.md §5): it registers
+a profiler RPC service on the main gRPC server (server.cc:324,339 ->
+profiler/rpc/profiler_service_impl.cc) so external tooling can pull traces
+from a production server, and wraps hot sections in `profiler::TraceMe`
+annotations (shared_batch_scheduler.h:39).
+
+TPU-native equivalents:
+ * `start_profiler_server(port)` — jax.profiler.start_server: TensorBoard /
+   xprof connect to this port and capture XPlane traces on demand (the
+   Profile RPC parity path).
+ * `trace(name)` — jax.profiler.TraceAnnotation context manager; a no-op
+   fallback keeps the serving path alive if the profiler is unavailable.
+ * `annotate(fn, name)` / @traced — decorator form for hot functions
+   (batch formation, device execute, marshalling).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+import threading
+from typing import Optional
+
+_lock = threading.Lock()
+_server = None
+_server_port: Optional[int] = None
+
+
+def start_profiler_server(port: int) -> bool:
+    """Start the in-process profiler gRPC server (idempotent). Returns True
+    when the server is (already) running on `port`."""
+    global _server, _server_port
+    with _lock:
+        if _server is not None:
+            return _server_port == port
+        try:
+            import jax
+
+            _server = jax.profiler.start_server(port)
+            _server_port = port
+            return True
+        except Exception:  # pragma: no cover - profiler lib unavailable
+            _server = None
+            _server_port = None
+            return False
+
+
+def profiler_port() -> Optional[int]:
+    with _lock:
+        return _server_port
+
+
+def trace(name: str, **kwargs):
+    """Context manager annotating a host-side region in profiler traces."""
+    try:
+        import jax
+
+        return jax.profiler.TraceAnnotation(name, **kwargs)
+    except Exception:  # pragma: no cover
+        return contextlib.nullcontext()
+
+
+def traced(name: Optional[str] = None):
+    """Decorator: wrap a function in a trace annotation."""
+
+    def deco(fn):
+        label = name or f"{fn.__module__}.{fn.__qualname__}"
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with trace(label):
+                return fn(*args, **kwargs)
+
+        return wrapper
+
+    return deco
+
+
+def start_trace_capture(log_dir: str) -> None:
+    """Programmatic capture start (jax.profiler.start_trace): traces land
+    in `log_dir` as XPlane/TensorBoard data."""
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+
+
+def stop_trace_capture() -> None:
+    import jax
+
+    jax.profiler.stop_trace()

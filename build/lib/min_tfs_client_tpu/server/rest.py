@@ -1,0 +1,270 @@
+"""HTTP/REST front-end: the /v1/... JSON surface + Prometheus metrics.
+
+Parity with model_servers/http_rest_api_handler.{h,cc} routes
+(kPathRegex "/v1/.*", dispatch .cc:106-123) and util/json_tensor formats:
+row ("instances") and columnar ("inputs") requests, "predictions"/"outputs"
+responses, base64 {"b64": ...} bytes encoding. Backed by Python's threaded
+http.server rather than a C++ libevent loop (util/net_http/) — the REST path
+is a debug/ops surface; the performance path is gRPC and tpu://.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+import numpy as np
+
+from google.protobuf import json_format
+
+from min_tfs_client_tpu.protos import tfs_apis_pb2 as apis
+from min_tfs_client_tpu.server.handlers import Handlers
+from min_tfs_client_tpu.tensor.codec import ndarray_to_tensor_proto
+from min_tfs_client_tpu.utils.status import ServingError, error_from_exception
+
+_MODEL_PATH = re.compile(
+    r"(?i)^/v1/models/(?P<model>[^/:]+)"
+    r"(?:/versions/(?P<version>\d+)|/labels/(?P<label>[^/:]+))?"
+    r"(?::(?P<verb>classify|regress|predict))?$")
+_METADATA_PATH = re.compile(
+    r"(?i)^/v1/models/(?P<model>[^/:]+)"
+    r"(?:/versions/(?P<version>\d+)|/labels/(?P<label>[^/:]+))?/metadata$")
+
+PROMETHEUS_DEFAULT_PATH = "/monitoring/prometheus/metrics"
+
+
+def _fill_spec(spec: apis.ModelSpec, m: re.Match) -> None:
+    spec.name = m.group("model")
+    if m.group("version"):
+        spec.version.value = int(m.group("version"))
+    elif m.group("label"):
+        spec.version_label = m.group("label")
+
+
+def _json_value_to_array(value) -> np.ndarray:
+    """JSON -> ndarray with b64 bytes handling (json_tensor semantics)."""
+    def convert(v):
+        if isinstance(v, dict) and set(v) == {"b64"}:
+            return base64.b64decode(v["b64"])
+        if isinstance(v, list):
+            return [convert(x) for x in v]
+        return v
+
+    converted = convert(value)
+    arr = np.asarray(converted)
+    if arr.dtype.kind in ("U", "S"):
+        arr = arr.astype(object)
+        flat = arr.reshape(-1)
+        flat[:] = [x.encode() if isinstance(x, str) else x for x in flat.tolist()]
+    elif arr.dtype == np.float64:
+        arr = arr.astype(np.float32)
+    elif arr.dtype == np.int64 and np.all(np.abs(arr) < 2**31):
+        arr = arr.astype(np.int32)
+    return arr
+
+
+def _array_to_json(arr: np.ndarray):
+    if arr.dtype == object or arr.dtype.kind in ("S", "U"):
+        def enc(v):
+            if isinstance(v, (bytes, np.bytes_)):
+                try:
+                    return bytes(v).decode("utf-8")
+                except UnicodeDecodeError:
+                    return {"b64": base64.b64encode(bytes(v)).decode()}
+            return v
+        return np.vectorize(enc, otypes=[object])(arr).tolist()
+    if arr.dtype == np.dtype("float16") or str(arr.dtype) == "bfloat16":
+        arr = arr.astype(np.float32)
+    return arr.tolist()
+
+
+def build_predict_request(body: dict, spec_match: re.Match) -> tuple[apis.PredictRequest, bool]:
+    request = apis.PredictRequest()
+    _fill_spec(request.model_spec, spec_match)
+    if "signature_name" in body:
+        request.model_spec.signature_name = body["signature_name"]
+    if "instances" in body:
+        instances = body["instances"]
+        if not isinstance(instances, list) or not instances:
+            raise ServingError.invalid_argument(
+                "JSON 'instances' must be a non-empty list")
+        if isinstance(instances[0], dict) and not set(instances[0]) == {"b64"}:
+            names = set(instances[0])
+            columns = {name: [] for name in names}
+            for row in instances:
+                if set(row) != names:
+                    raise ServingError.invalid_argument(
+                        "All instances must carry the same input names")
+                for name in names:
+                    columns[name].append(row[name])
+            for name, col in columns.items():
+                request.inputs[name].CopyFrom(
+                    ndarray_to_tensor_proto(_json_value_to_array(col)))
+        else:
+            request.inputs["inputs"].CopyFrom(
+                ndarray_to_tensor_proto(_json_value_to_array(instances)))
+    elif "inputs" in body:
+        inputs = body["inputs"]
+        if isinstance(inputs, dict):
+            for name, col in inputs.items():
+                request.inputs[name].CopyFrom(
+                    ndarray_to_tensor_proto(_json_value_to_array(col)))
+        else:
+            request.inputs["inputs"].CopyFrom(
+                ndarray_to_tensor_proto(_json_value_to_array(inputs)))
+    else:
+        raise ServingError.invalid_argument(
+            "Missing 'instances' or 'inputs' key in JSON body")
+    return request, "instances" in body
+
+
+def predict_response_to_json(response: apis.PredictResponse, row_format: bool):
+    from min_tfs_client_tpu.tensor.codec import tensor_proto_to_ndarray
+
+    outputs = {k: tensor_proto_to_ndarray(v)
+               for k, v in response.outputs.items()}
+    if row_format:
+        n = next(iter(outputs.values())).shape[0] if outputs else 0
+        if len(outputs) == 1:
+            arr = next(iter(outputs.values()))
+            return {"predictions": _array_to_json(arr)}
+        rows = []
+        for i in range(n):
+            rows.append({k: _array_to_json(v[i]) for k, v in outputs.items()})
+        return {"predictions": rows}
+    if len(outputs) == 1:
+        return {"outputs": _array_to_json(next(iter(outputs.values())))}
+    return {"outputs": {k: _array_to_json(v) for k, v in outputs.items()}}
+
+
+class _RestHandler(BaseHTTPRequestHandler):
+    handlers: Handlers = None
+    prometheus_path: Optional[str] = None
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, *args):  # quiet
+        pass
+
+    def _send_json(self, code: int, payload: dict) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_error_status(self, exc: Exception) -> None:
+        err = error_from_exception(exc)
+        http_code = {3: 400, 5: 404, 12: 501, 14: 503, 4: 504}.get(err.code, 500)
+        self._send_json(http_code, {"error": err.message})
+
+    def do_GET(self):  # noqa: N802 - http.server API
+        try:
+            if self.prometheus_path and self.path == self.prometheus_path:
+                from min_tfs_client_tpu.server.metrics import prometheus_text
+
+                body = prometheus_text().encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return
+            m = _METADATA_PATH.match(self.path)
+            if m:
+                request = apis.GetModelMetadataRequest()
+                _fill_spec(request.model_spec, m)
+                request.metadata_field.append("signature_def")
+                response = self.handlers.get_model_metadata(request)
+                self._send_json(200, json_format.MessageToDict(
+                    response, preserving_proto_field_name=True))
+                return
+            m = _MODEL_PATH.match(self.path)
+            if m and not m.group("verb"):
+                request = apis.GetModelStatusRequest()
+                _fill_spec(request.model_spec, m)
+                response = self.handlers.get_model_status(request)
+                self._send_json(200, json_format.MessageToDict(
+                    response, preserving_proto_field_name=True))
+                return
+            self._send_json(404, {"error": f"Malformed request: GET {self.path}"})
+        except Exception as exc:  # noqa: BLE001
+            self._send_error_status(exc)
+
+    def do_POST(self):  # noqa: N802 - http.server API
+        try:
+            m = _MODEL_PATH.match(self.path)
+            if not m or not m.group("verb"):
+                self._send_json(
+                    404, {"error": f"Malformed request: POST {self.path}"})
+                return
+            length = int(self.headers.get("Content-Length", "0"))
+            body = json.loads(self.rfile.read(length) or b"{}")
+            verb = m.group("verb").lower()
+            if verb == "predict":
+                request, row = build_predict_request(body, m)
+                response = self.handlers.predict(request)
+                self._send_json(200, predict_response_to_json(response, row))
+            elif verb in ("classify", "regress"):
+                response = self._classify_regress(verb, body, m)
+                self._send_json(200, response)
+            else:
+                self._send_json(400, {"error": f"unsupported verb {verb}"})
+        except Exception as exc:  # noqa: BLE001
+            self._send_error_status(exc)
+
+    def _classify_regress(self, verb: str, body: dict, m: re.Match):
+        from min_tfs_client_tpu.tensor.example_codec import build_input
+
+        examples = body.get("examples")
+        if not isinstance(examples, list) or not examples:
+            raise ServingError.invalid_argument(
+                "JSON body must carry a non-empty 'examples' list")
+        context = body.get("context")
+        decoded = []
+        for ex in examples:
+            decoded.append({
+                k: (base64.b64decode(v["b64"])
+                    if isinstance(v, dict) and set(v) == {"b64"} else v)
+                for k, v in ex.items()})
+        inp = build_input(decoded, context=context)
+        if verb == "classify":
+            request = apis.ClassificationRequest()
+            _fill_spec(request.model_spec, m)
+            if "signature_name" in body:
+                request.model_spec.signature_name = body["signature_name"]
+            request.input.CopyFrom(inp)
+            response = self.handlers.classify(request)
+            return {"results": [
+                [[c.label, c.score] for c in cl.classes]
+                for cl in response.result.classifications]}
+        request = apis.RegressionRequest()
+        _fill_spec(request.model_spec, m)
+        if "signature_name" in body:
+            request.model_spec.signature_name = body["signature_name"]
+        request.input.CopyFrom(inp)
+        response = self.handlers.regress(request)
+        return {"results": [r.value for r in response.result.regressions]}
+
+
+def start_rest_server(
+    handlers: Handlers,
+    port: int,
+    monitoring: Optional[object] = None,
+) -> tuple[ThreadingHTTPServer, int]:
+    handler_cls = type("BoundRestHandler", (_RestHandler,), {
+        "handlers": handlers,
+        "prometheus_path": (
+            (monitoring.prometheus_config.path or PROMETHEUS_DEFAULT_PATH)
+            if monitoring is not None and monitoring.prometheus_config.enable
+            else None),
+    })
+    server = ThreadingHTTPServer(("0.0.0.0", port), handler_cls)
+    thread = threading.Thread(
+        target=server.serve_forever, name="rest-server", daemon=True)
+    thread.start()
+    return server, server.server_address[1]

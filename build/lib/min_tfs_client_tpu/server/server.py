@@ -1,0 +1,274 @@
+"""Server assembly: options -> ServerCore -> gRPC services -> serving.
+
+Parity with model_servers/server.{h,cc} (BuildAndStart): synthesizes a
+single-model config from --model_name/--model_base_path (server.cc:83-96),
+parses text-format proto config files (ParseProtoTextFile, server.cc:59-73),
+builds ServerCore, registers Model/Prediction services on a grpc server with
+optional SSL, and optionally re-polls the model config file
+(PollFilesystemAndReloadConfig, server.cc:164-179).
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent import futures
+from dataclasses import dataclass, field
+from typing import Optional
+
+import grpc
+from google.protobuf import text_format
+
+from min_tfs_client_tpu.core.server_core import (
+    ServerCore,
+    single_model_config,
+)
+from min_tfs_client_tpu.protos import grpc_service as gs
+from min_tfs_client_tpu.protos import tfs_config_pb2
+from min_tfs_client_tpu.server.grpc_services import (
+    ModelServiceImpl,
+    PredictionServiceImpl,
+    SessionServiceImpl,
+)
+from min_tfs_client_tpu.server.handlers import Handlers
+from min_tfs_client_tpu.utils.status import ServingError
+
+
+@dataclass
+class ServerOptions:
+    """Mirrors the main.cc flag surface (main.cc:59-195) where applicable."""
+
+    grpc_port: int = 8500
+    rest_api_port: int = 0
+    model_name: str = "default"
+    model_base_path: str = ""
+    model_platform: str = "tensorflow"
+    model_config_file: str = ""
+    model_config_file_poll_wait_seconds: float = 0
+    file_system_poll_wait_seconds: float = 1.0
+    enable_batching: bool = False
+    batching_parameters_file: str = ""
+    monitoring_config_file: str = ""
+    ssl_config_file: str = ""
+    max_num_load_retries: int = 5
+    load_retry_interval_micros: int = 60 * 1000 * 1000
+    num_load_threads: int = 2
+    num_unload_threads: int = 2
+    grpc_max_threads: int = 16
+    enable_model_warmup: bool = True
+    # ModelWarmupOptions analogues (session_bundle_config.proto): replay
+    # count per record, and whether to synthesize compile-priming requests
+    # when a model ships no warmup file.
+    warmup_iterations: int = 1
+    synthesize_warmup: bool = False
+    response_tensors_as_content: bool = False
+    # Serving mesh: "data:-1" or "data:4,model:2" — batched device
+    # signatures execute data-parallel (x tensor-parallel for exports with
+    # a sharding config) over this device mesh. "" = single device. The
+    # reference has no in-server parallelism at all (SURVEY.md §2.11).
+    mesh_axes: str = ""
+    # On-demand profiling (reference registers a profiler service on the
+    # main server, server.cc:324,339); 0 disables.
+    profiler_port: int = 0
+    # Additional UNIX-domain listening socket (server.cc:330-336); "" off.
+    grpc_socket_path: str = ""
+    # "key=value,key=value" extra gRPC channel args (main.cc
+    # grpc_channel_arguments flag).
+    grpc_channel_arguments: str = ""
+
+
+def _parse_channel_arguments(spec: str) -> list[tuple[str, object]]:
+    """"grpc.max_send_message_length=4194304,..." -> grpc options list,
+    ints coerced (the main.cc grpc_channel_arguments format)."""
+    out: list[tuple[str, object]] = []
+    for part in (spec or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        key, sep, value = part.partition("=")
+        if not sep:
+            raise ServingError.invalid_argument(
+                f"malformed gRPC channel argument {part!r} (want key=value)")
+        out.append((key, int(value) if value.lstrip("-").isdigit() else value))
+    return out
+
+
+def _parse_text_proto(path: str, proto_cls):
+    msg = proto_cls()
+    with open(path, "r") as f:
+        text_format.Parse(f.read(), msg)
+    return msg
+
+
+class Server:
+    def __init__(self, options: ServerOptions):
+        self.options = options
+        self.core: Optional[ServerCore] = None
+        self._grpc_server: Optional[grpc.Server] = None
+        self._rest_server = None
+        self._config_poll_stop = threading.Event()
+        self._config_poll_thread: Optional[threading.Thread] = None
+
+    # -- assembly ------------------------------------------------------------
+
+    def build_and_start(self) -> "Server":
+        opts = self.options
+        if opts.model_config_file:
+            config = _parse_text_proto(
+                opts.model_config_file, tfs_config_pb2.ModelServerConfig)
+        elif opts.model_base_path:
+            config = single_model_config(
+                opts.model_name, opts.model_base_path,
+                platform=opts.model_platform)
+        else:
+            raise ServingError.invalid_argument(
+                "Both server_model_config_file and model_base_path are empty!")
+
+        batching = None
+        if opts.enable_batching:
+            if opts.batching_parameters_file:
+                batching = _parse_text_proto(
+                    opts.batching_parameters_file,
+                    tfs_config_pb2.BatchingParameters)
+            else:
+                # Reference behavior: the flag alone enables batching with
+                # default parameters (server.cc:208-273).
+                batching = tfs_config_pb2.BatchingParameters()
+
+        self.core = ServerCore(
+            config,
+            file_system_poll_wait_seconds=opts.file_system_poll_wait_seconds,
+            max_load_retries=opts.max_num_load_retries,
+            load_retry_interval_s=opts.load_retry_interval_micros / 1e6,
+            num_load_threads=opts.num_load_threads,
+            num_unload_threads=opts.num_unload_threads,
+            platform_configs=_platform_configs(opts, batching),
+        )
+
+        handlers = Handlers(
+            self.core,
+            response_tensors_as_content=opts.response_tensors_as_content)
+        self._grpc_server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=opts.grpc_max_threads),
+            options=_parse_channel_arguments(opts.grpc_channel_arguments))
+        gs.add_PredictionServiceServicer_to_server(
+            PredictionServiceImpl(handlers), self._grpc_server)
+        gs.add_ModelServiceServicer_to_server(
+            ModelServiceImpl(handlers), self._grpc_server)
+        gs.add_SessionServiceServicer_to_server(
+            SessionServiceImpl(handlers), self._grpc_server)
+        self.grpc_port = self._bind(self._grpc_server, opts.grpc_port)
+        if opts.grpc_socket_path:
+            if not self._grpc_server.add_insecure_port(
+                    f"unix:{opts.grpc_socket_path}"):
+                raise ServingError.unavailable(
+                    f"could not bind UNIX socket {opts.grpc_socket_path}")
+        self._grpc_server.start()
+
+        if opts.rest_api_port or opts.monitoring_config_file:
+            from min_tfs_client_tpu.server.rest import start_rest_server
+
+            monitoring = None
+            if opts.monitoring_config_file:
+                monitoring = _parse_text_proto(
+                    opts.monitoring_config_file, tfs_config_pb2.MonitoringConfig)
+            self._rest_server, self.rest_port = start_rest_server(
+                handlers, opts.rest_api_port, monitoring)
+
+        if opts.profiler_port:
+            from min_tfs_client_tpu.server.profiler import (
+                start_profiler_server,
+            )
+
+            if not start_profiler_server(opts.profiler_port):
+                import logging
+
+                logging.getLogger("min_tfs_client_tpu").warning(
+                    "profiler server failed to start on port %d; trace "
+                    "capture will be unavailable", opts.profiler_port)
+
+        if opts.model_config_file and opts.model_config_file_poll_wait_seconds > 0:
+            # Seed poll dedup with the config ServerCore ACTUALLY applied —
+            # re-reading the file here would silently swallow an edit made
+            # during model load/warmup.
+            self._applied_config_serialized = config.SerializeToString(
+                deterministic=True)
+            self._config_poll_thread = threading.Thread(
+                target=self._poll_config_file, name="config-file-poll",
+                daemon=True)
+            self._config_poll_thread.start()
+        return self
+
+    def _bind(self, server: grpc.Server, port: int) -> int:
+        opts = self.options
+        if opts.ssl_config_file:
+            ssl = _parse_text_proto(opts.ssl_config_file,
+                                    tfs_config_pb2.SSLConfig)
+            creds = grpc.ssl_server_credentials(
+                [(ssl.server_key.encode(), ssl.server_cert.encode())],
+                root_certificates=ssl.custom_ca.encode() or None,
+                require_client_auth=ssl.client_verify,
+            )
+            return server.add_secure_port(f"0.0.0.0:{port}", creds)
+        return server.add_insecure_port(f"0.0.0.0:{port}")
+
+    def _poll_config_file(self) -> None:
+        interval = self.options.model_config_file_poll_wait_seconds
+        last_applied = getattr(self, "_applied_config_serialized", None)
+        while not self._config_poll_stop.wait(interval):
+            try:
+                config = _parse_text_proto(
+                    self.options.model_config_file,
+                    tfs_config_pb2.ModelServerConfig)
+                serialized = config.SerializeToString(deterministic=True)
+                if serialized == last_applied:
+                    continue  # unchanged: no reload churn, no collector swap
+                self.core.reload_config(config)
+                last_applied = serialized
+            except Exception:  # pragma: no cover - poll must survive bad files
+                import traceback
+
+                traceback.print_exc()
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def wait_for_termination(self) -> None:
+        self._grpc_server.wait_for_termination()
+
+    def stop(self, grace: float = 5.0) -> None:
+        self._config_poll_stop.set()
+        if self._grpc_server is not None:
+            self._grpc_server.stop(grace).wait()
+        if self._rest_server is not None:
+            self._rest_server.shutdown()
+        if self.core is not None:
+            self.core.stop()
+
+
+def _parse_mesh_axes(spec: str) -> dict[str, int]:
+    """"data:4,model:2" -> {"data": 4, "model": 2} (-1 = absorb rest)."""
+    out: dict[str, int] = {}
+    for part in (spec or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, sep, size = part.partition(":")
+        try:
+            out[name] = int(size) if sep else int("")
+        except ValueError:
+            raise ServingError.invalid_argument(
+                f"malformed mesh_axes entry {part!r} (want axis:size)")
+    return out
+
+
+def _platform_configs(opts: ServerOptions, batching) -> dict:
+    shared: dict = {
+        "enable_model_warmup": opts.enable_model_warmup,
+        "warmup_iterations": opts.warmup_iterations,
+        "synthesize_warmup": opts.synthesize_warmup,
+    }
+    if batching is not None:
+        shared["batching_parameters"] = batching
+    mesh_axes = _parse_mesh_axes(opts.mesh_axes)
+    if mesh_axes:
+        shared["mesh_axes"] = mesh_axes
+    return {platform: dict(shared) for platform in ("tensorflow", "jax", "tpu")}

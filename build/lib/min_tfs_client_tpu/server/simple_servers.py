@@ -1,0 +1,24 @@
+"""simple_servers: one call from a model directory to a serving manager.
+
+Parity with tensorflow_serving/simple_servers.{h,cc}
+(CreateSingleTFModelManagerFromBasePath): point it at a base path, get back
+a ServerCore already serving the latest version — the smallest way to embed
+the serving stack in-process without the gRPC front-end.
+"""
+
+from __future__ import annotations
+
+from min_tfs_client_tpu.core.server_core import ServerCore, single_model_config
+
+
+def create_single_model_manager(
+    base_path: str,
+    *,
+    name: str = "default",
+    platform: str = "tensorflow",
+    poll_wait_seconds: float = 1.0,
+) -> ServerCore:
+    """Serve the latest version under base_path; blocks until AVAILABLE."""
+    config = single_model_config(name, base_path, platform=platform)
+    return ServerCore(config,
+                      file_system_poll_wait_seconds=poll_wait_seconds)

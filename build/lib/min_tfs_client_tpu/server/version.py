@@ -1,0 +1,14 @@
+"""Version stamp (model_servers/version.{h,cc} parity: TF_Serving_Version).
+
+The reference stamps its build via a compile-time define; here the single
+source of truth is this module, surfaced by `--version` on the CLI and the
+`version` field REST /v1 status responses could carry.
+"""
+
+SERVING_VERSION = "0.2.0"
+COMPATIBLE_TF_SERVING_API = "2.1.0"  # wire-contract vintage (SURVEY.md §2.2)
+
+
+def version_string() -> str:
+    return (f"tpu_model_server {SERVING_VERSION} "
+            f"(tensorflow.serving API {COMPATIBLE_TF_SERVING_API})")

@@ -1,0 +1,155 @@
+"""Trilingual dtype system: numpy dtype <-> "DT_*" name <-> DataType enum.
+
+Capability parity with the reference's DataType class
+(tensor_serving_client/min_tfs_client/types.py:13-42 and the tables in
+constants.py:13-33), extended from its 15 dtypes to the full serving-relevant
+set — notably DT_BFLOAT16, which is the native MXU dtype on TPU and therefore
+first-class here (the reference has no bf16 entry at all).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import ml_dtypes
+import numpy as np
+
+from min_tfs_client_tpu.protos import tf_tensor_pb2
+
+DataTypeEnum = tf_tensor_pb2.DataType
+
+bfloat16 = np.dtype(ml_dtypes.bfloat16)
+
+
+@dataclass(frozen=True)
+class _Entry:
+    enum: int
+    tf_name: str
+    np_dtype: np.dtype          # canonical numpy dtype
+    field: str                  # authoritative TensorProto typed field
+    # numpy dtype the typed field's elements use on the wire (differs from
+    # np_dtype for the bit-packed 16-bit floats: half_val carries int32 bits).
+    wire_dtype: np.dtype
+
+
+def _e(enum, tf_name, np_dtype, field, wire_dtype=None):
+    np_dtype = np.dtype(np_dtype)
+    return _Entry(enum, tf_name, np_dtype, field,
+                  np.dtype(wire_dtype) if wire_dtype else np_dtype)
+
+
+_ENTRIES = [
+    _e(DataTypeEnum.DT_FLOAT, "DT_FLOAT", np.float32, "float_val"),
+    _e(DataTypeEnum.DT_DOUBLE, "DT_DOUBLE", np.float64, "double_val"),
+    _e(DataTypeEnum.DT_INT32, "DT_INT32", np.int32, "int_val"),
+    _e(DataTypeEnum.DT_UINT8, "DT_UINT8", np.uint8, "int_val", np.int32),
+    _e(DataTypeEnum.DT_INT16, "DT_INT16", np.int16, "int_val", np.int32),
+    _e(DataTypeEnum.DT_INT8, "DT_INT8", np.int8, "int_val", np.int32),
+    _e(DataTypeEnum.DT_STRING, "DT_STRING", np.object_, "string_val"),
+    _e(DataTypeEnum.DT_COMPLEX64, "DT_COMPLEX64", np.complex64, "scomplex_val",
+       np.float32),
+    _e(DataTypeEnum.DT_INT64, "DT_INT64", np.int64, "int64_val"),
+    _e(DataTypeEnum.DT_BOOL, "DT_BOOL", np.bool_, "bool_val"),
+    _e(DataTypeEnum.DT_BFLOAT16, "DT_BFLOAT16", bfloat16, "half_val", np.int32),
+    _e(DataTypeEnum.DT_UINT16, "DT_UINT16", np.uint16, "int_val", np.int32),
+    _e(DataTypeEnum.DT_COMPLEX128, "DT_COMPLEX128", np.complex128,
+       "dcomplex_val", np.float64),
+    _e(DataTypeEnum.DT_HALF, "DT_HALF", np.float16, "half_val", np.int32),
+    _e(DataTypeEnum.DT_UINT32, "DT_UINT32", np.uint32, "uint32_val"),
+    _e(DataTypeEnum.DT_UINT64, "DT_UINT64", np.uint64, "uint64_val"),
+]
+
+_BY_ENUM = {e.enum: e for e in _ENTRIES}
+_BY_NAME = {e.tf_name: e for e in _ENTRIES}
+# np.object_ maps to DT_STRING; np.str_ / bytes handled in resolve().
+_BY_NP = {e.np_dtype: e for e in reversed(_ENTRIES)}
+
+# Legacy TF1 "ref" dtype variants share wire semantics with the base dtype.
+_REF_OFFSET = 100
+
+
+class UnsupportedDtypeError(TypeError):
+    pass
+
+
+class DataType:
+    """One dtype, constructible from any of its three spellings.
+
+    >>> DataType(np.float32).enum == DataType("DT_FLOAT").enum == DataType(1).enum
+    True
+    """
+
+    __slots__ = ("_entry",)
+
+    def __init__(self, value):
+        self._entry = _resolve(value)
+
+    @property
+    def numpy_dtype(self) -> np.dtype:
+        return self._entry.np_dtype
+
+    @property
+    def tf_dtype(self) -> str:
+        return self._entry.tf_name
+
+    @property
+    def enum(self) -> int:
+        return self._entry.enum
+
+    @property
+    def proto_field_name(self) -> str:
+        return self._entry.field
+
+    @property
+    def wire_dtype(self) -> np.dtype:
+        return self._entry.wire_dtype
+
+    @property
+    def is_numeric(self) -> bool:
+        return self._entry.field != "string_val"
+
+    @property
+    def is_string(self) -> bool:
+        return self._entry.field == "string_val"
+
+    def __eq__(self, other):
+        return isinstance(other, DataType) and other.enum == self.enum
+
+    def __hash__(self):
+        return hash(self.enum)
+
+    def __repr__(self):
+        return f"DataType({self.tf_dtype})"
+
+
+def _resolve(value) -> _Entry:
+    if isinstance(value, DataType):
+        return value._entry
+    if isinstance(value, str):
+        try:
+            return _BY_NAME[value]
+        except KeyError:
+            raise UnsupportedDtypeError(f"unknown TF dtype name {value!r}")
+    if isinstance(value, (int, np.integer)) and not isinstance(value, bool):
+        enum = int(value)
+        if enum > _REF_OFFSET:
+            enum -= _REF_OFFSET
+        try:
+            return _BY_ENUM[enum]
+        except KeyError:
+            raise UnsupportedDtypeError(f"unsupported DataType enum {value}")
+    # numpy dtype-ish (dtype instance, scalar type, or python type)
+    try:
+        np_dtype = np.dtype(value)
+    except TypeError:
+        raise UnsupportedDtypeError(f"cannot interpret {value!r} as a dtype")
+    if np_dtype.kind in ("U", "S", "O"):
+        return _BY_NAME["DT_STRING"]
+    try:
+        return _BY_NP[np_dtype]
+    except KeyError:
+        raise UnsupportedDtypeError(f"unsupported numpy dtype {np_dtype}")
+
+
+def all_supported() -> list[str]:
+    return [e.tf_name for e in _ENTRIES]

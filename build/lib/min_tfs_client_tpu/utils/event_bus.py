@@ -1,0 +1,60 @@
+"""Typed pub/sub bus with serial synchronous delivery.
+
+Parity with the reference's EventBus<E> (util/event_bus.h:63-209): events are
+delivered to all subscribers inline on the publisher's thread, one event at a
+time across the whole bus, so subscribers observe a consistent total order.
+Subscriptions are context-managed (RAII equivalent).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Generic, TypeVar
+
+E = TypeVar("E")
+
+
+class Subscription:
+    def __init__(self, bus: "EventBus", callback: Callable):
+        self._bus = bus
+        self._callback = callback
+
+    def cancel(self) -> None:
+        self._bus._remove(self)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.cancel()
+
+
+class EventBus(Generic[E]):
+    def __init__(self):
+        # One delivery lock: serial, totally-ordered delivery (event_bus.h:53).
+        # Reentrant so a subscriber may publish follow-up events inline.
+        self._lock = threading.RLock()
+        self._subscribers: list[Subscription] = []
+
+    def subscribe(self, callback: Callable[[E, float], None] | Callable[[E], None],
+                  *, with_time: bool = False) -> Subscription:
+        sub = Subscription(self, (callback, with_time))
+        with self._lock:
+            self._subscribers = [*self._subscribers, sub]
+        return sub
+
+    def _remove(self, sub: Subscription) -> None:
+        with self._lock:
+            self._subscribers = [s for s in self._subscribers if s is not sub]
+
+    def publish(self, event: E) -> None:
+        now = time.time()
+        with self._lock:
+            subs = list(self._subscribers)
+            for sub in subs:
+                callback, with_time = sub._callback
+                if with_time:
+                    callback(event, now)
+                else:
+                    callback(event)

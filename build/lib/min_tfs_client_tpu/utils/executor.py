@@ -1,0 +1,33 @@
+"""Executors: where deferred work runs (util/executor.h parity).
+
+InlineExecutor runs the closure on the calling thread;
+ThreadPoolExecutor schedules onto a fixed pool. Used by EventBus-style
+fan-out and anywhere the reference takes an Executor option.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+from typing import Callable
+
+
+class Executor:
+    def schedule(self, fn: Callable[[], None]) -> None:
+        raise NotImplementedError
+
+
+class InlineExecutor(Executor):
+    def schedule(self, fn: Callable[[], None]) -> None:
+        fn()
+
+
+class ThreadPoolExecutor(Executor):
+    def __init__(self, num_threads: int, name: str = "executor"):
+        self._pool = concurrent.futures.ThreadPoolExecutor(
+            num_threads, thread_name_prefix=name)
+
+    def schedule(self, fn: Callable[[], None]) -> None:
+        self._pool.submit(fn)
+
+    def shutdown(self, wait: bool = True) -> None:
+        self._pool.shutdown(wait=wait)

@@ -1,0 +1,38 @@
+"""Observer: weak callback handles (util/observer.h parity).
+
+An Observer wraps a function; Notifier() hands out a callable that becomes
+a no-op once the Observer is destroyed/closed — so long-lived callers
+(periodic pollers, event buses) never invoke into a torn-down object.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Generic, TypeVar
+
+A = TypeVar("A")
+
+
+class Observer(Generic[A]):
+    def __init__(self, fn: Callable[..., None]):
+        self._lock = threading.Lock()
+        self._fn: Callable[..., None] | None = fn
+
+    def notifier(self) -> Callable[..., None]:
+        def notify(*args, **kwargs):
+            with self._lock:
+                fn = self._fn
+            if fn is not None:
+                fn(*args, **kwargs)
+
+        return notify
+
+    def close(self) -> None:
+        with self._lock:
+            self._fn = None
+
+    def __enter__(self) -> "Observer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

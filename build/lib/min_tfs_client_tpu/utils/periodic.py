@@ -1,0 +1,66 @@
+"""PeriodicFunction: a background thread invoking a closure at an interval.
+
+Parity with batching_util/periodic_function.{h,cc} — the primitive behind
+the reference's FS polling, manager reconciliation tick, and batching
+timers. Semantics match the header: the function runs every `interval_s`
+measured start-to-start (a slow invocation delays but never overlaps the
+next), an optional startup delay, and the destructor/stop joins the thread
+after the in-flight call finishes.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional
+
+
+class PeriodicFunction:
+    def __init__(
+        self,
+        fn: Callable[[], None],
+        interval_s: float,
+        *,
+        startup_delay_s: float = 0.0,
+        name: str = "periodic-function",
+        on_error: Optional[Callable[[Exception], None]] = None,
+    ):
+        if interval_s <= 0:
+            raise ValueError("interval_s must be positive")
+        self._fn = fn
+        self._interval_s = interval_s
+        self._startup_delay_s = startup_delay_s
+        self._on_error = on_error
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, name=name,
+                                        daemon=True)
+        self._thread.start()
+
+    def _loop(self) -> None:
+        if self._startup_delay_s and self._stop.wait(self._startup_delay_s):
+            return
+        while not self._stop.is_set():
+            started = time.monotonic()
+            try:
+                self._fn()
+            except Exception as exc:  # noqa: BLE001 — the pump must survive
+                if self._on_error is not None:
+                    self._on_error(exc)
+                else:
+                    import traceback
+
+                    traceback.print_exc()
+            # Start-to-start cadence: sleep whatever remains of the period.
+            remaining = self._interval_s - (time.monotonic() - started)
+            if remaining > 0 and self._stop.wait(remaining):
+                return
+
+    def stop(self, timeout_s: float = 5.0) -> None:
+        self._stop.set()
+        self._thread.join(timeout=timeout_s)
+
+    def __enter__(self) -> "PeriodicFunction":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

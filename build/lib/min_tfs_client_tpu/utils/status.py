@@ -1,0 +1,102 @@
+"""Canonical status/error propagation.
+
+One exception type carrying a canonical error code, mapped at the boundaries:
+gRPC trailer codes (the reference's ToGRPCStatus, grpc_status_util.cc:23) and
+StatusProto for GetModelStatus / ReloadConfig responses.
+"""
+
+from __future__ import annotations
+
+import grpc
+
+from min_tfs_client_tpu.protos import tf_error_pb2, tfs_apis_pb2
+
+Code = tf_error_pb2.Code
+
+
+class ServingError(Exception):
+    """Error with a canonical code, raised anywhere in the serving path."""
+
+    def __init__(self, code: int, message: str):
+        super().__init__(message)
+        self.code = code
+        self.message = message
+
+    @classmethod
+    def invalid_argument(cls, msg: str) -> "ServingError":
+        return cls(Code.INVALID_ARGUMENT, msg)
+
+    @classmethod
+    def not_found(cls, msg: str) -> "ServingError":
+        return cls(Code.NOT_FOUND, msg)
+
+    @classmethod
+    def failed_precondition(cls, msg: str) -> "ServingError":
+        return cls(Code.FAILED_PRECONDITION, msg)
+
+    @classmethod
+    def unavailable(cls, msg: str) -> "ServingError":
+        return cls(Code.UNAVAILABLE, msg)
+
+    @classmethod
+    def deadline_exceeded(cls, msg: str) -> "ServingError":
+        return cls(Code.DEADLINE_EXCEEDED, msg)
+
+    @classmethod
+    def internal(cls, msg: str) -> "ServingError":
+        return cls(Code.INTERNAL, msg)
+
+    @classmethod
+    def unimplemented(cls, msg: str) -> "ServingError":
+        return cls(Code.UNIMPLEMENTED, msg)
+
+    @classmethod
+    def resource_exhausted(cls, msg: str) -> "ServingError":
+        return cls(Code.RESOURCE_EXHAUSTED, msg)
+
+    def to_proto(self) -> tfs_apis_pb2.StatusProto:
+        return tfs_apis_pb2.StatusProto(error_code=self.code,
+                                        error_message=self.message)
+
+
+# canonical code -> grpc.StatusCode (same table as the reference's
+# grpc_status_util.cc — the numeric values line up with grpc's own)
+_GRPC_BY_CODE = {
+    Code.OK: grpc.StatusCode.OK,
+    Code.CANCELLED: grpc.StatusCode.CANCELLED,
+    Code.UNKNOWN: grpc.StatusCode.UNKNOWN,
+    Code.INVALID_ARGUMENT: grpc.StatusCode.INVALID_ARGUMENT,
+    Code.DEADLINE_EXCEEDED: grpc.StatusCode.DEADLINE_EXCEEDED,
+    Code.NOT_FOUND: grpc.StatusCode.NOT_FOUND,
+    Code.ALREADY_EXISTS: grpc.StatusCode.ALREADY_EXISTS,
+    Code.PERMISSION_DENIED: grpc.StatusCode.PERMISSION_DENIED,
+    Code.UNAUTHENTICATED: grpc.StatusCode.UNAUTHENTICATED,
+    Code.RESOURCE_EXHAUSTED: grpc.StatusCode.RESOURCE_EXHAUSTED,
+    Code.FAILED_PRECONDITION: grpc.StatusCode.FAILED_PRECONDITION,
+    Code.ABORTED: grpc.StatusCode.ABORTED,
+    Code.OUT_OF_RANGE: grpc.StatusCode.OUT_OF_RANGE,
+    Code.UNIMPLEMENTED: grpc.StatusCode.UNIMPLEMENTED,
+    Code.INTERNAL: grpc.StatusCode.INTERNAL,
+    Code.UNAVAILABLE: grpc.StatusCode.UNAVAILABLE,
+    Code.DATA_LOSS: grpc.StatusCode.DATA_LOSS,
+}
+
+
+def to_grpc_code(code: int) -> grpc.StatusCode:
+    return _GRPC_BY_CODE.get(code, grpc.StatusCode.UNKNOWN)
+
+
+def error_from_exception(exc: Exception) -> ServingError:
+    if isinstance(exc, ServingError):
+        return exc
+    if isinstance(exc, (ValueError, TypeError, KeyError)):
+        return ServingError(Code.INVALID_ARGUMENT, str(exc))
+    if isinstance(exc, TimeoutError):
+        return ServingError(Code.DEADLINE_EXCEEDED, str(exc))
+    if isinstance(exc, NotImplementedError):
+        return ServingError(Code.UNIMPLEMENTED, str(exc))
+    return ServingError(Code.INTERNAL, f"{type(exc).__name__}: {exc}")
+
+
+def ok_proto() -> tfs_apis_pb2.StatusProto:
+    return tfs_apis_pb2.StatusProto()

@@ -1,0 +1,144 @@
+"""TFRecord framing: [len u64][masked crc32c(len)][data][masked crc32c(data)].
+
+Byte-compatible with tensorflow/core/lib/io/record_{reader,writer}.cc and
+lib/hash/crc32c.h (the masked-CRC scheme). Used for warmup request logs
+(assets.extra/tf_serving_warmup_requests) and request-log sinks. The hot
+path runs in native C++ (native/tpuserve.cpp) with a pure-Python fallback.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import pathlib
+import struct
+from typing import Iterable, Iterator
+
+from min_tfs_client_tpu import native
+
+_MASK_DELTA = 0xA282EAD8
+_U32 = 0xFFFFFFFF
+
+# -- pure-Python crc32c fallback (table-driven) ------------------------------
+
+_py_table: list[int] | None = None
+
+
+def _py_table_init() -> list[int]:
+    global _py_table
+    if _py_table is None:
+        table = []
+        for i in range(256):
+            crc = i
+            for _ in range(8):
+                crc = (crc >> 1) ^ (0x82F63B78 if crc & 1 else 0)
+            table.append(crc)
+        _py_table = table
+    return _py_table
+
+
+def _py_crc32c(data: bytes) -> int:
+    table = _py_table_init()
+    crc = _U32
+    for b in data:
+        crc = table[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc ^ _U32
+
+
+def crc32c(data: bytes) -> int:
+    lib = native.load()
+    if lib is not None:
+        return lib.tpuserve_crc32c(data, len(data))
+    return _py_crc32c(data)
+
+
+def masked_crc32c(data: bytes) -> int:
+    crc = crc32c(data)
+    return (((crc >> 15) | (crc << 17)) + _MASK_DELTA) & _U32
+
+
+def _unmask(masked: int) -> int:
+    rot = (masked - _MASK_DELTA) & _U32
+    return ((rot >> 17) | (rot << 15)) & _U32
+
+
+class TFRecordError(ValueError):
+    pass
+
+
+def frame(data: bytes) -> bytes:
+    """One record's full wire framing [len][crc(len)][data][crc(data)] —
+    the single owner of the format for writers (files and log sinks)."""
+    lib = native.load()
+    if lib is not None:
+        header = ctypes.create_string_buffer(12)
+        footer = ctypes.create_string_buffer(4)
+        lib.tpuserve_frame_tfrecord(data, len(data), header, footer)
+        return header.raw + data + footer.raw
+    length = struct.pack("<Q", len(data))
+    return (length + struct.pack("<I", masked_crc32c(length)) +
+            data + struct.pack("<I", masked_crc32c(data)))
+
+
+def write_records(path, records: Iterable[bytes]) -> int:
+    """Write records to a TFRecord file; returns the count."""
+    count = 0
+    with open(path, "wb") as f:
+        for data in records:
+            f.write(frame(data))
+            count += 1
+    return count
+
+
+# Files up to this size use one native batch scan; larger files (or bounded
+# reads) stream record-by-record so memory tracks records consumed, not
+# file size (request logs replayed as warmup can be huge).
+_SLURP_LIMIT = 16 << 20
+
+
+def read_records(path, *, max_records: int | None = None,
+                 verify: bool = True) -> Iterator[bytes]:
+    """Yield record payloads from a TFRecord file."""
+    path = pathlib.Path(path)
+    limit = max_records if max_records is not None else (1 << 40)
+    lib = native.load()
+    if (lib is not None and max_records is None
+            and path.stat().st_size <= _SLURP_LIMIT):
+        data = path.read_bytes()
+        cap = max(1, len(data) // 16)
+        offsets = (ctypes.c_uint64 * cap)()
+        lengths = (ctypes.c_uint64 * cap)()
+        n = lib.tpuserve_scan_tfrecords(
+            data, len(data), offsets, lengths, cap, 1 if verify else 0)
+        if n < 0:
+            raise TFRecordError(
+                {-1: "truncated record", -2: "corrupt length crc",
+                 -3: "corrupt data crc"}.get(n, f"scan error {n}"))
+        for i in range(n):
+            yield data[offsets[i]:offsets[i] + lengths[i]]
+        return
+    # Streaming path (crc32c is still native-accelerated when available).
+    produced = 0
+    file_size = path.stat().st_size
+    with open(path, "rb") as f:
+        while produced < limit:
+            header = f.read(12)
+            if not header:
+                return
+            if len(header) < 12:
+                raise TFRecordError("truncated record")
+            (length,) = struct.unpack_from("<Q", header, 0)
+            (len_crc,) = struct.unpack_from("<I", header, 8)
+            if verify and _unmask(len_crc) != crc32c(header[:8]):
+                raise TFRecordError("corrupt length crc")
+            if length + 16 > file_size:
+                # Corrupt u64 length: refuse before trying to allocate it.
+                raise TFRecordError("truncated record")
+            body = f.read(length + 4)
+            if len(body) < length + 4:
+                raise TFRecordError("truncated record")
+            payload = body[:length]
+            (data_crc,) = struct.unpack_from("<I", body, length)
+            if verify and _unmask(data_crc) != crc32c(payload):
+                raise TFRecordError("corrupt data crc")
+            yield payload
+            produced += 1
