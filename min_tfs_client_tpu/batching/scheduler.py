@@ -34,6 +34,9 @@ class BatchTask:
     inputs: dict
     size: int
     enqueue_time: float = field(default_factory=time.monotonic)
+    # Which outputs this caller wants; () = all. The processor fetches the
+    # union across the batch.
+    output_filter: tuple = ()
     # filled by the processor:
     outputs: dict | None = None
     error: Exception | None = None

@@ -153,7 +153,8 @@ class BatchedSignatureRunner:
             raise ServingError.invalid_argument("empty batch")
         if n >= self._max_batch_size:
             return self._run_oversized(arrays, output_filter, n)
-        task = BatchTask(inputs=arrays, size=n)
+        task = BatchTask(inputs=arrays, size=n,
+                         output_filter=tuple(output_filter))
         self._scheduler.schedule(self._queue, task)
         task.done.wait()
         if task.error is not None:
@@ -193,9 +194,16 @@ class BatchedSignatureRunner:
                 merged[alias] = np.concatenate(columns, axis=0)
 
         # Execute once; the inner run rounds total up to the allowed bucket
-        # and pads with repeated real rows.
+        # and pads with repeated real rows. Fetch the union of the tasks'
+        # output_filters: outputs no caller asked for never cross the
+        # device->host link (any task without a filter wants everything).
+        filters = [t.output_filter for t in batch]
+        if any(not f for f in filters):
+            union: tuple = ()
+        else:
+            union = tuple(sorted({name for f in filters for name in f}))
         with trace("batching/execute"):
-            outputs = self._inner_run(merged)
+            outputs = self._inner_run(merged, union)
 
         try:
             from min_tfs_client_tpu.server import metrics

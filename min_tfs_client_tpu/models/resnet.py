@@ -139,5 +139,8 @@ def build_signatures(params: dict, config: ResNetConfig) -> dict:
                  "probabilities": TensorSpec(
                      np.float32, (None, config.num_classes))},
         batch_buckets=(1, 4, 8, 16, 32),
+        # First conv casts to COMPUTE_DTYPE anyway: cast on host, halve
+        # the DMA (same rounding either side of the link).
+        transfer_casts={"images": nn.COMPUTE_DTYPE},
     )
     return {"serving_default": sig, "predict": sig}

@@ -97,6 +97,11 @@ class Signature:
     batch_buckets: Sequence[int] = DEFAULT_BATCH_BUCKETS
     # Optional class-id -> label vocabulary for classification outputs.
     class_labels: Optional[Sequence[bytes]] = None
+    # Optional alias -> dtype map: cast these inputs on the HOST before the
+    # device transfer. For inputs the model immediately casts down anyway
+    # (f32 images -> bf16 convs), this halves host->HBM DMA bytes without
+    # changing results — the cast happens once either side of the link.
+    transfer_casts: Optional[dict[str, object]] = None
     # Optional jax.sharding.Mesh: formed batches are device_put with the
     # batch dim sharded over the mesh's "data" axis before execution
     # (TP'd params carry their own shardings; GSPMD emits the ICI
@@ -107,6 +112,25 @@ class Signature:
                                       compare=False)
 
     _jitted: Callable | None = dc_field(default=None, repr=False, compare=False)
+
+    def __post_init__(self):
+        if self.transfer_casts:
+            import jax.numpy as jnp
+
+            if self.on_host:
+                raise ValueError(
+                    "transfer_casts applies to device signatures only; "
+                    "an on_host signature never crosses the link")
+            unknown = set(self.transfer_casts) - set(self.inputs)
+            if unknown:
+                raise ValueError(
+                    f"transfer_casts aliases {sorted(unknown)} are not "
+                    f"signature inputs {sorted(self.inputs)}")
+            # Resolve dtype strings eagerly: a typo fails at build, not at
+            # the first request.
+            self.transfer_casts = {
+                alias: jnp.dtype(dt)
+                for alias, dt in self.transfer_casts.items()}
 
     def jitted(self) -> Callable:
         if self._jitted is None:
@@ -175,31 +199,46 @@ class Signature:
     ) -> dict[str, np.ndarray]:
         """Validate, pad, execute, slice, return alias-keyed outputs."""
         arrays = self.validate(inputs, output_filter)
+        keys = list(output_filter) if output_filter else list(self.outputs)
 
         if self.on_host:
             outputs = (self.fn(self.params, arrays)
                        if self.params is not None else self.fn(arrays))
-        else:
-            outputs = self._run_device(arrays)
+            self._check_produced(outputs, keys)
+            return {k: np.asarray(outputs[k]) for k in keys}
 
-        keys = list(output_filter) if output_filter else list(self.outputs)
-        result = {}
+        outputs, batch = self._run_device(arrays)
+        self._check_produced(outputs, keys)
+        # Fetch ONLY the requested outputs (the executable computes them
+        # all, but unfetched ones never cross the device->host link), in a
+        # single overlapped round: async-copy every output, then read. N
+        # sequential DMAs collapse to one round trip — on remote/tunneled
+        # PJRT transports each synchronous fetch costs a full RTT, and even
+        # locally the DMAs overlap.
+        return fetch_outputs({k: outputs[k] for k in keys}, batch)
+
+    def _check_produced(self, outputs, keys) -> None:
         for key in keys:
             if key not in outputs:
                 raise ServingError.internal(
                     f"signature fn did not produce declared output {key!r}")
-            result[key] = np.asarray(outputs[key])
-        return result
 
-    def _run_device(self, arrays: dict[str, np.ndarray]) -> dict[str, object]:
+    def _run_device(
+        self, arrays: dict[str, np.ndarray]
+    ) -> tuple[dict[str, object], Optional[int]]:
+        """Execute on device; returns (device outputs, true batch or None)."""
         if not self.batched or not arrays:
-            return self._execute(arrays)
+            return self._execute(
+                self._place(self._cast_transfers(arrays))), None
         batch = next(iter(arrays.values())).shape[0]
         for alias, arr in arrays.items():
             if arr.shape[0] != batch:
                 raise ServingError.invalid_argument(
                     f"input {alias!r}: inconsistent batch dim "
                     f"{arr.shape[0]} != {batch}")
+        # Cast BEFORE padding: the pad concat then moves half the bytes and
+        # no second full-bucket copy is made.
+        arrays = self._cast_transfers(arrays)
         padded_batch = self.round_up_batch(batch)
         if padded_batch != batch:
             arrays = {
@@ -211,8 +250,36 @@ class Signature:
             }
         if self.mesh is not None:
             arrays = self._shard_inputs(arrays)
-        outputs = self._execute(arrays)
-        return {k: np.asarray(v)[:batch] for k, v in outputs.items()}
+        else:
+            arrays = self._place(arrays)
+        return self._execute(arrays), batch
+
+    @staticmethod
+    def _place(arrays: dict[str, np.ndarray]) -> dict:
+        """Explicit batched host->device transfer before dispatch. Passing
+        ndarrays straight as jit args leaves the transfer to per-argument
+        conversion inside the call, which on remote PJRT transports takes a
+        slow chunked path (measured ~50x slower than device_put for a 9.5MB
+        conv input) and even locally serializes with dispatch; one batched
+        device_put of the whole input dict overlaps the DMAs."""
+        import jax
+
+        dense = {k: v for k, v in arrays.items()
+                 if getattr(v, "dtype", None) is not None
+                 and v.dtype.kind not in "OSU"}
+        if not dense:
+            return dict(arrays)
+        placed = jax.device_put(dense)
+        return {k: placed.get(k, arrays[k]) for k in arrays}
+
+    def _cast_transfers(self, arrays: dict[str, np.ndarray]) -> dict:
+        if not self.transfer_casts:
+            return arrays
+        return {
+            alias: (arr.astype(self.transfer_casts[alias])
+                    if alias in self.transfer_casts else arr)
+            for alias, arr in arrays.items()
+        }
 
     def _shard_inputs(self, arrays: dict[str, np.ndarray]) -> dict:
         """Place the padded batch on the mesh, dim 0 over the data axis
@@ -250,6 +317,31 @@ class Signature:
             for d in spec.shape:
                 info.tensor_shape.dim.add(size=-1 if d is None else d)
         return sig
+
+
+def fetch_outputs(outputs: Mapping[str, object],
+                  batch: Optional[int] = None) -> dict[str, np.ndarray]:
+    """Device->host for a dict of outputs as ONE overlapped round.
+
+    Issues copy_to_host_async on every jax.Array first, then materializes;
+    the transfers run concurrently, so the wall cost is max(transfer) plus
+    one link round trip instead of a sequential sum. `batch` slices padded
+    leading dims back to the true request size (host-side view, no copy).
+    """
+    for value in outputs.values():
+        start = getattr(value, "copy_to_host_async", None)
+        if start is not None:
+            try:
+                start()
+            except Exception:  # pragma: no cover - fall back to sync copy
+                pass
+    result = {}
+    for key, value in outputs.items():
+        arr = np.asarray(value)
+        if batch is not None and arr.ndim:
+            arr = arr[:batch]
+        result[key] = arr
+    return result
 
 
 class Servable:

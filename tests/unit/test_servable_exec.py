@@ -1,0 +1,128 @@
+"""Signature execution path: transfer casts, overlapped output fetch.
+
+Covers the serving-hot-path behaviors the reference leaves to
+Session::Run + Tensor conversion (predict_util.cc:89-215): host-side
+transfer-dtype casts, device placement of formed batches, and the
+single-round device->host fetch of requested outputs.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from min_tfs_client_tpu.servables.servable import (
+    Signature,
+    TensorSpec,
+    fetch_outputs,
+)
+
+
+def _echo_sig(**kw):
+    def fn(inputs):
+        x = jnp.asarray(inputs["x"])
+        return {"y": x * 2, "dtype_code": jnp.zeros((x.shape[0],), x.dtype)}
+
+    return Signature(
+        fn=fn,
+        inputs={"x": TensorSpec(np.float32, (None, 4))},
+        outputs={"y": TensorSpec(np.float32, (None, 4)),
+                 "dtype_code": TensorSpec(np.float32, (None,))},
+        batch_buckets=(2, 4, 8),
+        **kw,
+    )
+
+
+class TestTransferCasts:
+    def test_cast_applied_before_device(self):
+        sig = _echo_sig(transfer_casts={"x": "bfloat16"})
+        out = sig.run({"x": np.ones((2, 4), np.float32)})
+        # The fn saw bf16 inputs: its passthrough dtype output is bf16.
+        assert out["dtype_code"].dtype == jnp.bfloat16
+
+    def test_values_survive_cast_and_padding(self):
+        sig = _echo_sig(transfer_casts={"x": "bfloat16"})
+        x = np.arange(12, dtype=np.float32).reshape(3, 4)
+        out = sig.run({"x": x})  # batch 3 -> bucket 4, sliced back
+        assert out["y"].shape == (3, 4)
+        np.testing.assert_allclose(out["y"].astype(np.float32), x * 2,
+                                   rtol=2e-2)
+
+    def test_unknown_alias_rejected_at_build(self):
+        with pytest.raises(ValueError, match="not .*signature inputs"):
+            _echo_sig(transfer_casts={"nope": "bfloat16"})
+
+    def test_bad_dtype_rejected_at_build(self):
+        with pytest.raises(TypeError):
+            _echo_sig(transfer_casts={"x": "bfloat99"})
+
+
+class TestFetchOutputs:
+    def test_slices_padded_batch(self):
+        outs = {"a": jnp.ones((8, 3)), "b": jnp.zeros((8,))}
+        got = fetch_outputs(outs, batch=5)
+        assert got["a"].shape == (5, 3)
+        assert got["b"].shape == (5,)
+        assert isinstance(got["a"], np.ndarray)
+
+    def test_no_slice_when_batch_none(self):
+        got = fetch_outputs({"a": jnp.ones((8, 3))}, batch=None)
+        assert got["a"].shape == (8, 3)
+
+    def test_scalar_output_untouched(self):
+        got = fetch_outputs({"s": jnp.float32(3.5)}, batch=2)
+        assert got["s"].shape == ()
+        assert got["s"] == np.float32(3.5)
+
+    def test_plain_numpy_passthrough(self):
+        # Host signatures produce numpy; fetch must not require jax arrays.
+        got = fetch_outputs({"h": np.arange(6).reshape(3, 2)}, batch=2)
+        assert got["h"].shape == (2, 2)
+
+
+class TestBatchedFilterUnion:
+    def test_union_of_filters_reaches_signature(self):
+        from min_tfs_client_tpu.batching.scheduler import SharedBatchScheduler
+        from min_tfs_client_tpu.batching.session import BatchedSignatureRunner
+
+        seen = []
+        sig = _echo_sig()
+        inner_run = sig.run
+
+        def spy(inputs, output_filter=()):
+            seen.append(tuple(output_filter))
+            return inner_run(inputs, output_filter)
+
+        sig.run = spy
+        sched = SharedBatchScheduler(num_threads=1)
+        try:
+            runner = BatchedSignatureRunner(
+                sig, sched, name="t", max_batch_size=8, batch_timeout_s=0.0)
+            out = runner.run({"x": np.ones((2, 4), np.float32)},
+                             output_filter=("y",))
+            assert set(out) == {"y"}
+            # the device execution only fetched the filtered union
+            assert seen and seen[-1] == ("y",)
+            # a caller with no filter forces a full fetch
+            out2 = runner.run({"x": np.ones((2, 4), np.float32)})
+            assert set(out2) == {"y", "dtype_code"}
+            assert seen[-1] == ()
+        finally:
+            sched.stop()
+
+
+class TestPlacement:
+    def test_string_arrays_pass_through(self):
+        # 'O'/'S'/'U'-kind arrays must never reach jax.device_put (it
+        # rejects them); dense arrays come back device-resident.
+        arrays = {
+            "obj": np.array([b"a", b"bc"], object),
+            "bytes": np.array([b"ab", b"cdef"]),          # |S4
+            "uni": np.array(["x", "yz"]),                 # <U2
+            "x": np.arange(4, dtype=np.float32),
+        }
+        placed = Signature._place(arrays)
+        assert placed["obj"] is arrays["obj"]
+        assert placed["bytes"] is arrays["bytes"]
+        assert placed["uni"] is arrays["uni"]
+        np.testing.assert_array_equal(np.asarray(placed["x"]), arrays["x"])
+        assert not isinstance(placed["x"], np.ndarray)  # on device
