@@ -50,12 +50,15 @@ class SimpleLoader(Loader):
     """Loader from a creator callable + static resource estimate
     (core/simple_loader.h pattern, including estimate memoization)."""
 
-    def __init__(self, creator: Callable[[], object], resource_estimate: int = 0):
+    def __init__(self, creator: Callable[[], object],
+                 resource_estimate: "int | dict[int, int]" = 0):
         self._creator = creator
+        # int = unbound bytes; dict = per-device-id bound slices (a TP
+        # servable's per-chip parameter shards). See core/resource.py.
         self._estimate = resource_estimate
         self._servable: object | None = None
 
-    def estimate_resources(self) -> int:
+    def estimate_resources(self) -> "int | dict[int, int]":
         return self._estimate
 
     def load(self) -> None:

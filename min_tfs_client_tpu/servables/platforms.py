@@ -46,7 +46,15 @@ def make_loader(
         raise ServingError.invalid_argument(
             f"unknown model_platform {platform!r}; registered: "
             f"{sorted(_REGISTRY)}")
-    estimate = _dir_size_bytes(path)
+    estimate: object = _dir_size_bytes(path)
+    mesh_axes = (platform_config or {}).get("mesh_axes")
+    if mesh_axes:
+        # Sharded servable: declare per-chip HBM slices so the tracker
+        # gates on each chip, not the summed pool (resource_tracker.cc
+        # collapsed to device/hbm kinds).
+        from min_tfs_client_tpu.core.resource import estimate_for_mesh
+
+        estimate = estimate_for_mesh(int(estimate), mesh_axes)
 
     def create() -> Servable:
         servable = factory(name, version, path, platform_config or {})

@@ -363,6 +363,9 @@ class Servable:
         self.signatures = dict(signatures)
         self.hbm_estimate_bytes = hbm_estimate_bytes
         self.warmup_records = list(warmup_records)
+        # Compiled union executables for MultiInference, keyed by the
+        # sorted signature-key tuple.
+        self._union_jits: dict[tuple, Callable] = {}
 
     def signature(self, name: str = "") -> Signature:
         key = name or DEFAULT_SERVING_SIGNATURE_DEF_KEY
@@ -378,8 +381,82 @@ class Servable:
             out.signature_def[key].CopyFrom(sig.to_signature_def())
         return out
 
+    def can_run_union(self, keys: Sequence[str]) -> bool:
+        """True when the named signatures can evaluate in ONE device
+        execution: all device-side, batched, and agreeing on inputs (the
+        single-Session::Run precondition of multi_inference.cc:44-77 —
+        there, one graph; here, one fused jit)."""
+        try:
+            sigs = [self.signature(k) for k in keys]
+        except ServingError:
+            return False
+        first = sigs[0]
+        return all(
+            not s.on_host and s.batched
+            and s.inputs == first.inputs
+            and s.mesh is first.mesh
+            for s in sigs)
+
+    def run_union(self, keys: Sequence[str],
+                  inputs: Mapping[str, np.ndarray]) -> dict[str, dict]:
+        """Evaluate several signatures over shared inputs as ONE device
+        dispatch + ONE overlapped fetch; returns {key: {alias: ndarray}}.
+
+        The TPU-native equivalent of the reference's union Session::Run
+        (multi_inference.cc:31-77): instead of fetching the union of
+        tensor names from one graph, the signatures' pure functions fuse
+        into one jitted callable (XLA dedupes the shared trunk — e.g.
+        BERT classify+regress share every layer but the head)."""
+        keys = list(keys)
+        sigs = {k: self.signature(k) for k in keys}
+        first = sigs[keys[0]]
+        arrays = first.validate(inputs)
+        batch = next(iter(arrays.values())).shape[0] if arrays else None
+
+        union_key = tuple(sorted(keys))
+        fused = self._union_jits.get(union_key)
+        if fused is None:
+            import jax
+
+            fn_map = {k: s.fn for k, s in sigs.items()}
+
+            def union_fn(params_map, arrays):
+                return {
+                    k: (fn_map[k](params_map[k], arrays)
+                        if params_map[k] is not None else fn_map[k](arrays))
+                    for k in fn_map
+                }
+
+            fused = jax.jit(union_fn)
+            self._union_jits[union_key] = fused
+
+        arrays = first._cast_transfers(arrays)  # before pad: half the bytes
+        if batch is not None:
+            padded = first.round_up_batch(batch)
+            if padded != batch:
+                arrays = {
+                    alias: np.concatenate(
+                        [arr, np.repeat(arr[:1], padded - batch, axis=0)])
+                    for alias, arr in arrays.items()
+                }
+        if first.mesh is not None:
+            arrays = first._shard_inputs(arrays)
+        else:
+            arrays = Signature._place(arrays)
+        params_map = {k: s.params for k, s in sigs.items()}
+        nested = fused(params_map, arrays)
+        # Single overlapped fetch across every task's outputs.
+        flat = {(k, alias): v for k, outs in nested.items()
+                for alias, v in outs.items()}
+        fetched = fetch_outputs(flat, batch)
+        result: dict[str, dict] = {k: {} for k in keys}
+        for (k, alias), arr in fetched.items():
+            result[k][alias] = arr
+        return result
+
     def unload(self) -> None:
         """Drop jit caches so XLA executables free their HBM."""
+        self._union_jits.clear()
         for sig in self.signatures.values():
             sig._jitted = None
 

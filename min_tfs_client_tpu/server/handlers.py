@@ -27,6 +27,7 @@ from min_tfs_client_tpu.servables.servable import (
     CLASSIFY_METHOD_NAME,
     CLASSIFY_OUTPUT_CLASSES,
     CLASSIFY_OUTPUT_SCORES,
+    DEFAULT_SERVING_SIGNATURE_DEF_KEY,
     REGRESS_METHOD_NAME,
     REGRESS_OUTPUTS,
     Signature,
@@ -195,10 +196,35 @@ class Handlers:
         response = apis.MultiInferenceResponse()
         spec0 = request.tasks[0].model_spec
         with self.core.servable_handle(spec0) as handle:
-            for task in request.tasks:
-                signature = self._example_signature(
-                    handle.servable, task.model_spec, task.method_name)
-                outputs, n = self._run_examples(signature, request.input)
+            servable = handle.servable
+            sigs = [self._example_signature(
+                        servable, task.model_spec, task.method_name)
+                    for task in request.tasks]
+
+            # Single-execution union (multi_inference.cc:31-77's one
+            # Session::Run): eligible when every task's signature shares
+            # inputs + feature specs, so the shared Input decodes once and
+            # one fused executable evaluates all heads. Otherwise fall
+            # back to one dispatch per task (still correct).
+            first = sigs[0]
+            keys = [t.model_spec.signature_name or
+                    DEFAULT_SERVING_SIGNATURE_DEF_KEY for t in request.tasks]
+            fuse = (len(sigs) > 1
+                    and all(s.feature_specs is first.feature_specs
+                            for s in sigs)
+                    and servable.can_run_union(keys))
+            union_outputs = None
+            if fuse:
+                features, n = decode_input(request.input, first.feature_specs)
+                if n == 0:
+                    raise ServingError.invalid_argument("Input is empty")
+                union_outputs = servable.run_union(keys, features)
+
+            for task, key, signature in zip(request.tasks, keys, sigs):
+                if union_outputs is not None:
+                    outputs = union_outputs[key]
+                else:
+                    outputs, n = self._run_examples(signature, request.input)
                 result = response.results.add()
                 _effective_spec(result.model_spec, task.model_spec,
                                 handle.id.version,

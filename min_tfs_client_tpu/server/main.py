@@ -59,6 +59,16 @@ def build_parser() -> argparse.ArgumentParser:
                    help="also listen on this UNIX-domain socket path")
     p.add_argument("--grpc_channel_arguments", default="",
                    help='extra gRPC server args, "key=value,key=value"')
+    p.add_argument("--saved_model_tags", default="",
+                   help="comma-separated MetaGraphDef tags to load "
+                        '(default "serve")')
+    p.add_argument("--platform_config_file", default="",
+                   help="text-format PlatformConfigMap; mutually exclusive "
+                        "with --enable_batching")
+    p.add_argument("--allow_version_labels_for_unavailable_models",
+                   action="store_true",
+                   help="permit version labels pointing at versions that "
+                        "are not yet AVAILABLE")
     p.add_argument("--version", action="store_true",
                    help="print the server version and exit")
     return p
@@ -91,6 +101,10 @@ def options_from_args(args) -> ServerOptions:
         profiler_port=args.profiler_port,
         grpc_socket_path=args.grpc_socket_path,
         grpc_channel_arguments=args.grpc_channel_arguments,
+        saved_model_tags=args.saved_model_tags,
+        platform_config_file=args.platform_config_file,
+        allow_version_labels_for_unavailable_models=(
+            args.allow_version_labels_for_unavailable_models),
     )
 
 

@@ -74,6 +74,17 @@ class ServerOptions:
     # "key=value,key=value" extra gRPC channel args (main.cc
     # grpc_channel_arguments flag).
     grpc_channel_arguments: str = ""
+    # Comma-separated MetaGraphDef tags to select at SavedModel load
+    # (main.cc saved_model_tags; default "serve").
+    saved_model_tags: str = ""
+    # Text-format PlatformConfigMap file (main.cc platform_config_file).
+    # Mutually exclusive with enable_batching per the reference; entries
+    # carrying a tpu.serving.TpuServableConfig Any override the per-platform
+    # config assembled from the flags above.
+    platform_config_file: str = ""
+    # Labels may normally only point at AVAILABLE versions
+    # (server_core.cc UpdateModelVersionLabelMap; main.cc flag).
+    allow_version_labels_for_unavailable_models: bool = False
 
 
 def _parse_channel_arguments(spec: str) -> list[tuple[str, object]]:
@@ -142,6 +153,8 @@ class Server:
             num_load_threads=opts.num_load_threads,
             num_unload_threads=opts.num_unload_threads,
             platform_configs=_platform_configs(opts, batching),
+            allow_version_labels_for_unavailable_models=(
+                opts.allow_version_labels_for_unavailable_models),
         )
 
         handlers = Handlers(
@@ -271,4 +284,57 @@ def _platform_configs(opts: ServerOptions, batching) -> dict:
     mesh_axes = _parse_mesh_axes(opts.mesh_axes)
     if mesh_axes:
         shared["mesh_axes"] = mesh_axes
-    return {platform: dict(shared) for platform in ("tensorflow", "jax", "tpu")}
+    configs = {platform: dict(shared)
+               for platform in ("tensorflow", "jax", "tpu")}
+    if opts.saved_model_tags:
+        configs["tensorflow"]["tags"] = [
+            t.strip() for t in opts.saved_model_tags.split(",") if t.strip()]
+    if opts.platform_config_file:
+        if opts.enable_batching:
+            raise ServingError.invalid_argument(
+                "--enable_batching cannot be set with "
+                "--platform_config_file (main.cc rule: the platform config "
+                "carries its own batching parameters)")
+        for platform, overrides in _parse_platform_config_file(
+                opts.platform_config_file).items():
+            configs.setdefault(platform, {}).update(overrides)
+    return configs
+
+
+def _parse_platform_config_file(path: str) -> dict[str, dict]:
+    """Text-format PlatformConfigMap -> per-platform config dicts.
+
+    Reference parity: main.cc reads the file into PlatformConfigMap and
+    ServerCore builds one source adapter per entry from the Any-typed
+    source_adapter_config (platform_config_util.cc). Here the Any is
+    unpacked as tpu.serving.TpuServableConfig (our registered adapter
+    config, protos/tpu_platform.proto) and lowered to the factory's
+    config keys."""
+    from min_tfs_client_tpu.protos import tpu_platform_pb2
+
+    config_map = _parse_text_proto(path, tfs_config_pb2.PlatformConfigMap)
+    out: dict[str, dict] = {}
+    for platform, platform_config in config_map.platform_configs.items():
+        overrides: dict = {}
+        any_config = platform_config.source_adapter_config
+        tpu_config = tpu_platform_pb2.TpuServableConfig()
+        if any_config.Is(tpu_config.DESCRIPTOR):
+            any_config.Unpack(tpu_config)
+            if tpu_config.HasField("batching_parameters"):
+                overrides["batching_parameters"] = \
+                    tpu_config.batching_parameters
+            if tpu_config.mesh.axes:
+                overrides["mesh_axes"] = {
+                    axis.name: axis.size for axis in tpu_config.mesh.axes}
+            if tpu_config.warmup_iterations:
+                overrides["warmup_iterations"] = tpu_config.warmup_iterations
+            if tpu_config.HasField("sequence_bucketing"):
+                overrides["seq_buckets"] = list(
+                    tpu_config.sequence_bucketing.allowed_lengths)
+        elif any_config.type_url:
+            raise ServingError.invalid_argument(
+                f"platform {platform!r}: unsupported source_adapter_config "
+                f"type {any_config.type_url!r} (expected "
+                "tpu.serving.TpuServableConfig)")
+        out[platform] = overrides
+    return out

@@ -221,20 +221,111 @@ def test_missing_input_invalid(client):
 def test_hot_reload_new_version(client, server, model_root):
     """New version dir appears -> server picks it up -> serves it; old
     version unloads (Latest policy)."""
+    import shutil
     import time
 
     fixtures.write_half_plus_two(model_root / "half_plus_two", version=2)
-    deadline = time.monotonic() + 15
-    while time.monotonic() < deadline:
-        resp = client.model_status_request("half_plus_two")
-        states = {s.version: s.state for s in resp.model_version_status}
-        if states.get(2) == apis.ModelVersionStatus.AVAILABLE:
-            break
-        time.sleep(0.1)
-    assert states.get(2) == apis.ModelVersionStatus.AVAILABLE
-    resp = client.predict_request(
-        "half_plus_two", {"x": np.array([2.0], np.float32)})
-    assert resp.model_spec.version.value == 2
+    try:
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            resp = client.model_status_request("half_plus_two")
+            states = {s.version: s.state for s in resp.model_version_status}
+            if states.get(2) == apis.ModelVersionStatus.AVAILABLE:
+                break
+            time.sleep(0.1)
+        assert states.get(2) == apis.ModelVersionStatus.AVAILABLE
+        resp = client.predict_request(
+            "half_plus_two", {"x": np.array([2.0], np.float32)})
+        assert resp.model_spec.version.value == 2
+    finally:
+        # Restore the on-disk state: the shared config file labels
+        # half_plus_two "stable" -> 1, and with v2 present the Latest
+        # policy would make that label (correctly) fail the version-label
+        # guard in every later fresh ServerCore boot.
+        shutil.rmtree(model_root / "half_plus_two" / "2", ignore_errors=True)
+
+
+def test_version_label_guard_rejects_unavailable(config_file, model_root):
+    """Labels may only point at AVAILABLE versions (server_core.cc
+    UpdateModelVersionLabelMap): a typo'd label fails the reload loudly
+    instead of routing traffic to a dead version at request time."""
+    srv = Server(ServerOptions(
+        grpc_port=0, model_config_file=str(config_file),
+        file_system_poll_wait_seconds=0)).build_and_start()
+    try:
+        with TensorServingClient("127.0.0.1", srv.grpc_port) as c:
+            config = cfg.ModelServerConfig()
+            m = config.model_config_list.config.add()
+            m.name = "half_plus_two"
+            m.base_path = str(model_root / "half_plus_two")
+            m.model_platform = "tensorflow"
+            m.version_labels["canary"] = 99  # no such version
+            resp = c.reload_config_request(config)
+            assert resp.status.error_code != 0
+            assert "canary" in resp.status.error_message
+    finally:
+        srv.stop()
+
+
+def test_version_label_guard_escape_hatch(config_file, model_root):
+    """allow_version_labels_for_unavailable_models permits pre-assigning
+    labels to versions that are not (yet) loaded (main.cc flag)."""
+    srv = Server(ServerOptions(
+        grpc_port=0, model_config_file=str(config_file),
+        file_system_poll_wait_seconds=0,
+        allow_version_labels_for_unavailable_models=True)).build_and_start()
+    try:
+        with TensorServingClient("127.0.0.1", srv.grpc_port) as c:
+            config = cfg.ModelServerConfig()
+            m = config.model_config_list.config.add()
+            m.name = "half_plus_two"
+            m.base_path = str(model_root / "half_plus_two")
+            m.model_platform = "tensorflow"
+            m.version_labels["canary"] = 99
+            resp = c.reload_config_request(config)
+            assert resp.status.error_code == 0
+    finally:
+        srv.stop()
+
+
+def test_platform_config_file(config_file, tmp_path):
+    """PlatformConfigMap file -> per-platform factory config (main.cc
+    platform_config_file; Any-typed source_adapter_config unpacked as
+    tpu.serving.TpuServableConfig)."""
+    from min_tfs_client_tpu.protos import tpu_platform_pb2
+    from min_tfs_client_tpu.server.server import (
+        _parse_platform_config_file,
+        _platform_configs,
+    )
+    from google.protobuf import text_format
+
+    config_map = cfg.PlatformConfigMap()
+    tpu_config = tpu_platform_pb2.TpuServableConfig()
+    tpu_config.batching_parameters.max_batch_size.value = 16
+    tpu_config.batching_parameters.allowed_batch_sizes.extend([4, 8, 16])
+    axis = tpu_config.mesh.axes.add()
+    axis.name, axis.size = "data", 4
+    tpu_config.warmup_iterations = 2
+    config_map.platform_configs["jax"].source_adapter_config.Pack(tpu_config)
+    path = tmp_path / "platform.config"
+    path.write_text(text_format.MessageToString(config_map))
+
+    parsed = _parse_platform_config_file(str(path))
+    assert parsed["jax"]["mesh_axes"] == {"data": 4}
+    assert parsed["jax"]["warmup_iterations"] == 2
+    assert parsed["jax"]["batching_parameters"].max_batch_size.value == 16
+
+    merged = _platform_configs(
+        ServerOptions(platform_config_file=str(path)), None)
+    assert merged["jax"]["mesh_axes"] == {"data": 4}
+
+    # enable_batching conflicts with platform_config_file (main.cc rule)
+    import pytest as _pytest
+    from min_tfs_client_tpu.utils.status import ServingError
+
+    with _pytest.raises(ServingError):
+        _platform_configs(ServerOptions(
+            platform_config_file=str(path), enable_batching=True), None)
 
 
 def test_reload_config_removes_model(config_file, model_root):

@@ -110,6 +110,118 @@ class TestBatchedFilterUnion:
             sched.stop()
 
 
+class TestUnionRun:
+    def _servable(self):
+        from min_tfs_client_tpu.servables.servable import (
+            CLASSIFY_METHOD_NAME,
+            REGRESS_METHOD_NAME,
+            Servable,
+        )
+        from min_tfs_client_tpu.tensor.example_codec import FeatureSpec
+
+        specs = {"x": FeatureSpec(np.float32, (2,))}
+        inputs = {"x": TensorSpec(np.float32, (None, 2))}
+
+        def classify_fn(inputs):
+            s = jnp.sum(jnp.asarray(inputs["x"]), -1, keepdims=True)
+            return {"scores": jnp.concatenate([s, 1 - s], -1)}
+
+        def regress_fn(inputs):
+            return {"outputs": jnp.sum(jnp.asarray(inputs["x"]), -1) * 2}
+
+        sigs = {
+            "classify": Signature(
+                fn=classify_fn, inputs=inputs,
+                outputs={"scores": TensorSpec(np.float32, (None, 2))},
+                method_name=CLASSIFY_METHOD_NAME, feature_specs=specs,
+                batch_buckets=(2, 4)),
+            "regress": Signature(
+                fn=regress_fn, inputs=inputs,
+                outputs={"outputs": TensorSpec(np.float32, (None,))},
+                method_name=REGRESS_METHOD_NAME, feature_specs=specs,
+                batch_buckets=(2, 4)),
+        }
+        return Servable("m", 1, sigs)
+
+    def test_one_dispatch_for_signature_union(self, monkeypatch):
+        servable = self._servable()
+        assert servable.can_run_union(["classify", "regress"])
+        # The union path must never fall back to per-signature run().
+        monkeypatch.setattr(
+            Signature, "run",
+            lambda *a, **k: (_ for _ in ()).throw(AssertionError("run()")))
+        x = np.array([[1.0, 2.0], [3.0, 4.0], [5.0, 6.0]], np.float32)
+        out = servable.run_union(["classify", "regress"], {"x": x})
+        np.testing.assert_allclose(out["classify"]["scores"][:, 0],
+                                   [3.0, 7.0, 11.0])
+        np.testing.assert_allclose(out["regress"]["outputs"],
+                                   [6.0, 14.0, 22.0])
+        # padded to bucket 4, sliced back to the true batch
+        assert out["regress"]["outputs"].shape == (3,)
+        assert len(servable._union_jits) == 1
+
+    def test_union_ineligible_when_inputs_differ(self):
+        servable = self._servable()
+        servable.signatures["regress"].inputs = {
+            "other": TensorSpec(np.float32, (None, 2))}
+        assert not servable.can_run_union(["classify", "regress"])
+
+    def test_union_ineligible_for_host_signature(self):
+        servable = self._servable()
+        servable.signatures["classify"].on_host = True
+        assert not servable.can_run_union(["classify", "regress"])
+
+
+class TestUnionThroughHandlers:
+    def test_bert_tiny_multi_inference_fuses(self, tmp_path, monkeypatch):
+        """BERT's classify/regress share one feature_specs dict, so the
+        handler must take the fused single-dispatch path end to end."""
+        import jax
+
+        from min_tfs_client_tpu.client import TensorServingClient
+        from min_tfs_client_tpu.client.inprocess import unregister_server
+        from min_tfs_client_tpu.models import bert, export
+        from min_tfs_client_tpu.servables.servable import Servable
+
+        config = bert.BertConfig.tiny()
+        params = bert.init_params(jax.random.PRNGKey(0), config)
+        base = tmp_path / "bert_tiny"
+        export.export_servable(
+            base, 1, "bert",
+            {"vocab_size": config.vocab_size,
+             "hidden_size": config.hidden_size,
+             "num_layers": config.num_layers,
+             "num_heads": config.num_heads,
+             "intermediate_size": config.intermediate_size,
+             "max_position": config.max_position},
+            params, signature_kwargs={"seq_len": 8})
+
+        union_calls = []
+        real_union = Servable.run_union
+        monkeypatch.setattr(
+            Servable, "run_union",
+            lambda self, keys, inputs: (union_calls.append(tuple(keys)),
+                                        real_union(self, keys, inputs))[1])
+        client = TensorServingClient(f"tpu://{base}")
+        try:
+            examples = [{"input_ids": np.arange(8, dtype=np.int64)},
+                        {"input_ids": np.arange(8, dtype=np.int64) + 1}]
+            resp = client.multi_inference_request(
+                "bert_tiny", examples,
+                methods=[("classify", "tensorflow/serving/classify"),
+                         ("regress", "tensorflow/serving/regress")])
+        finally:
+            unregister_server(f"tpu://{base}")
+        assert union_calls == [("classify", "regress")]
+        assert len(resp.results) == 2
+        classes = resp.results[0].classification_result.classifications
+        assert len(classes) == 2 and len(classes[0].classes) == 2
+        scores0 = sorted(c.score for c in classes[0].classes)
+        assert 0.99 < sum(scores0) < 1.01  # softmax head
+        regs = resp.results[1].regression_result.regressions
+        assert len(regs) == 2
+
+
 class TestPlacement:
     def test_string_arrays_pass_through(self):
         # 'O'/'S'/'U'-kind arrays must never reach jax.device_put (it
