@@ -196,8 +196,11 @@ class AspiredVersionsManager:
 
     def _reservation_fits_all(self, name: str, versions: set[int]) -> bool:
         streams = self._harnesses[name]
+        # Keyed by sid so versions already holding a reservation
+        # (LOAD_APPROVED/LOADING) are not double-counted on later ticks.
         return self.resources.can_fit_all(
-            [streams[v].loader.estimate_resources() for v in versions])
+            [(ServableId(name, v), streams[v].loader.estimate_resources())
+             for v in versions])
 
     def _start_unload(self, harness: LoaderHarness) -> None:
         if harness.state != HarnessState.READY:

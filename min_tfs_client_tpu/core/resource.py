@@ -68,7 +68,15 @@ def estimate_for_mesh(total_bytes: int, mesh_axes: dict[str, int],
         if name != data_axis:
             tp *= int(size)
     per_device = -(-total_bytes // max(1, tp))
-    return {d.id: per_device for d in mesh.devices.flat}
+    # The tracker accounts this host's chips only (pools mirror
+    # jax.local_devices()); on a multi-host mesh each host gates its own
+    # slice, so remote device ids are dropped here.
+    import jax
+
+    local_ids = {d.id for d in jax.local_devices()}
+    alloc = {d.id: per_device for d in mesh.devices.flat
+             if d.id in local_ids}
+    return alloc if alloc else total_bytes
 
 
 class ResourceTracker:
@@ -139,14 +147,23 @@ class ResourceTracker:
             self._reserved[sid] = bound
             return True
 
-    def can_fit_all(self, estimates) -> bool:
+    def can_fit_all(self, items) -> bool:
         """Would all the given allocations fit on top of current usage?
         Simulates greedy placement without reserving (the availability-
-        preserving policy's keep-old-serving check)."""
+        preserving policy's keep-old-serving check). Items are
+        (sid, allocation) pairs or bare allocations; a sid that already
+        holds a reservation is counted once, not twice."""
         with self._lock:
             snapshot = dict(self._reserved)
             try:
-                for i, est in enumerate(estimates):
+                for i, item in enumerate(items):
+                    if (isinstance(item, tuple) and len(item) == 2
+                            and isinstance(item[0], ServableId)):
+                        sid, est = item
+                    else:
+                        sid, est = None, item
+                    if sid is not None and sid in self._reserved:
+                        continue  # already reserved: nothing more to place
                     bound = self._bind_locked(est)
                     if bound is None:
                         return False

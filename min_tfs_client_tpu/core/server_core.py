@@ -139,7 +139,7 @@ class ServerCore:
         self.manager.tick()
         self._wait_for_models([m.name for m in models])
         try:
-            self._check_version_labels(models)
+            self._check_version_labels(models, old_labels)
         except ServingError:
             # UpdateModelVersionLabelMap refuses the update but keeps the
             # previous label assignments serving (server_core.cc): every
@@ -152,18 +152,33 @@ class ServerCore:
                         old_labels.get(model.name, {}))
             raise
 
-    def _check_version_labels(self, models: Sequence[ModelConfig]) -> None:
+    def _check_version_labels(self, models: Sequence[ModelConfig],
+                              old_labels: dict[str, dict]) -> None:
         """Guard rail from the reference's UpdateModelVersionLabelMap
-        (server_core.cc): a version label may only point at an AVAILABLE
-        version, so a typo'd label config fails the (re)load loudly instead
-        of routing traffic to a dead version at request time. The
+        (server_core.cc): a version label may only be assigned or MOVED to
+        an AVAILABLE version, so a typo'd label config fails the (re)load
+        loudly instead of routing traffic to a dead version at request
+        time. An assignment carried over unchanged is tolerated even if
+        its version has since rotated out (Latest-policy turnover must not
+        brick a previously working config — the reference likewise checks
+        only new/changed assignments). The
         --allow_version_labels_for_unavailable_models escape hatch
-        (main.cc flag) permits pre-assigning labels to still-loading
-        versions."""
-        if self._allow_labels_unavailable:
-            return
+        (main.cc flag) permits pre-assigning NEW labels to still-loading
+        versions, but — like the reference (server_core.cc:503-512) —
+        never waives the check for a label MOVED to a different version.
+        Deliberate difference: the reference validates before the new
+        models load, so even boot-time labels need the flag; here the
+        check runs after the load wait, so labels on versions that just
+        loaded pass without it."""
         for m in models:
+            previous = old_labels.get(m.name, {})
             for label, version in m.version_labels.items():
+                prev = previous.get(label)
+                if prev == version:
+                    continue  # unchanged assignment: grandfathered
+                moved = prev is not None
+                if self._allow_labels_unavailable and not moved:
+                    continue
                 state = self.monitor.get_state(ServableId(m.name, version))
                 if state is None or state.manager_state != ManagerState.AVAILABLE:
                     raise ServingError.failed_precondition(

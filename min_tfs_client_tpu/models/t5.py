@@ -271,5 +271,148 @@ def build_signatures(params: dict, config: T5Config, *, seq_len: int,
         batch_buckets=(1, 4, 16, 32),
     )
 
-    return {"serving_default": decode_sig, "decode": decode_sig,
-            "encode": encode_sig}
+    signatures = {"serving_default": decode_sig, "decode": decode_sig,
+                  "encode": encode_sig}
+    signatures.update(build_session_signatures(
+        params, config, seq_len=seq_len, max_decode_len=max_decode_len))
+    return signatures
+
+
+# -- per-session incremental decode (repeated Predict() over the wire) -------
+
+
+def prefill_state(params: dict, config: T5Config, input_ids: jax.Array,
+                  *, max_decode_len: int) -> dict:
+    """Encode the prompt and build empty caches: the device state one
+    decode session carries between Predict("decode_step") calls."""
+    b = input_ids.shape[0]
+    lengths = jnp.sum((input_ids != config.pad_id).astype(jnp.int32), axis=-1)
+    encoded = encode(params, config, input_ids, lengths)
+    caches = [{"self": nn.init_cache(b, config.num_heads, max_decode_len,
+                                     config.d_kv)}
+              for _ in range(config.num_decoder_layers)]
+    return {
+        "encoded": encoded,
+        "enc_lengths": lengths,
+        "caches": caches,
+        "token": jnp.full((b, 1), config.decoder_start_id, jnp.int32),
+        "finished": jnp.zeros((b,), jnp.bool_),
+        "step": jnp.int32(0),
+    }
+
+
+def decode_step_state(params: dict, config: T5Config, state: dict
+                      ) -> tuple[dict, jax.Array]:
+    """Advance one token. Pure: (state) -> (state', token); jitted with
+    the state donated so the KV caches update in place in HBM."""
+    logits, caches = _decoder_step(
+        params, config, state["token"], state["step"], state["caches"],
+        state["encoded"], state["enc_lengths"])
+    next_token = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    next_token = jnp.where(state["finished"], config.pad_id, next_token)
+    finished = jnp.logical_or(state["finished"],
+                              next_token == config.eos_id)
+    new_state = {
+        "encoded": state["encoded"],
+        "enc_lengths": state["enc_lengths"],
+        "caches": caches,
+        "token": next_token[:, None],
+        "finished": finished,
+        "step": state["step"] + 1,
+    }
+    return new_state, next_token
+
+
+def build_session_signatures(params: dict, config: T5Config, *, seq_len: int,
+                             max_decode_len: int,
+                             max_sessions: int = 64,
+                             session_ttl_s: float = 600.0) -> dict:
+    """The repeated-Predict decode surface (BASELINE config 5):
+
+      decode_init:  session_id + input_ids -> prefill; KV cache parked in
+                    HBM under the session id
+      decode_step:  session_id -> one greedy token per call (donated
+                    buffers: caches update in place, one token crosses
+                    the wire each way)
+      decode_close: session_id -> free the session's HBM
+
+    Host signatures: the store lookup is Python, the math is jitted.
+    """
+    from min_tfs_client_tpu.servables.decode_sessions import (
+        DecodeSessionStore,
+    )
+    from min_tfs_client_tpu.servables.servable import Signature, TensorSpec
+    from min_tfs_client_tpu.utils.status import ServingError
+
+    store = DecodeSessionStore(max_sessions=max_sessions,
+                               ttl_s=session_ttl_s)
+    prefill_jit = jax.jit(
+        lambda p, ids: prefill_state(p, config, ids,
+                                     max_decode_len=max_decode_len))
+    step_jit = jax.jit(
+        lambda p, s: decode_step_state(p, config, s), donate_argnums=(1,))
+
+    def _session_id(inputs) -> bytes:
+        raw = np.asarray(inputs["session_id"]).reshape(-1)
+        if raw.size != 1:
+            raise ServingError.invalid_argument(
+                f"session_id must hold exactly one id, got {raw.size}")
+        value = raw[0]
+        return value if isinstance(value, bytes) else str(value).encode()
+
+    def init_fn(inputs):
+        sid = _session_id(inputs)
+        ids = np.asarray(inputs["input_ids"]).astype(np.int32)
+        state = prefill_jit(params, jax.device_put(ids))
+        store.put(sid, (state, 0))  # host-side step mirror: no fetch later
+        return {"session_id": np.asarray(sid, object),
+                "batch": np.asarray(ids.shape[0], np.int32)}
+
+    def step_fn(inputs):
+        from min_tfs_client_tpu.servables.servable import fetch_outputs
+
+        sid = _session_id(inputs)
+        state, host_step = store.take(sid)
+        state, token = step_jit(params, state)
+        host_step += 1
+        if host_step < max_decode_len:
+            store.put(sid, (state, host_step))
+        else:
+            store.close(sid)  # cache exhausted: session ends
+        # One overlapped fetch: the step's whole wire cost is one token
+        # row (+ the finished flags) each way.
+        fetched = fetch_outputs(
+            {"token": token, "finished": state["finished"]})
+        return {"token": fetched["token"],
+                "finished": fetched["finished"].astype(np.int32),
+                "step": np.asarray(host_step, np.int32)}
+
+    def close_fn(inputs):
+        closed = store.close(_session_id(inputs))
+        return {"closed": np.asarray(int(closed), np.int32)}
+
+    session_spec = TensorSpec("DT_STRING", ())
+    init_sig = Signature(
+        fn=init_fn,
+        inputs={"session_id": session_spec,
+                "input_ids": TensorSpec(np.int32, (None, seq_len))},
+        outputs={"session_id": TensorSpec("DT_STRING", ()),
+                 "batch": TensorSpec(np.int32, ())},
+        on_host=True, batched=False,
+    )
+    step_sig = Signature(
+        fn=step_fn,
+        inputs={"session_id": session_spec},
+        outputs={"token": TensorSpec(np.int32, (None,)),
+                 "finished": TensorSpec(np.int32, (None,)),
+                 "step": TensorSpec(np.int32, ())},
+        on_host=True, batched=False,
+    )
+    close_sig = Signature(
+        fn=close_fn,
+        inputs={"session_id": session_spec},
+        outputs={"closed": TensorSpec(np.int32, ())},
+        on_host=True, batched=False,
+    )
+    return {"decode_init": init_sig, "decode_step": step_sig,
+            "decode_close": close_sig}

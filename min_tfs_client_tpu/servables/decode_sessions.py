@@ -1,0 +1,83 @@
+"""Per-session device state for incremental autoregressive decode.
+
+BASELINE.md config 5 calls for "tokens/s autoregressive decode via
+repeated Predict()": each Predict("decode_step") advances one token and
+the KV cache lives in HBM between requests. The reference is stateless
+request/response (its Session holds no per-client state, SURVEY.md §7.9);
+this store is the TPU-native extension that makes the repeated-Predict
+surface possible without re-transferring or re-computing the cache.
+
+States are jax pytrees whose buffers stay device-resident; the step
+function donates them (jax.jit donate_argnums), so XLA updates caches in
+place — a decode step moves one token in and one token out over the link,
+nothing else.
+
+Capacity: each session pins HBM (encoded activations + caches) until
+closed, stepped to exhaustion, or idle past the TTL. Capacity pressure is
+backpressure — decode_init fails RESOURCE_EXHAUSTED when full — never a
+silent eviction of a live session mid-generation.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from min_tfs_client_tpu.utils.status import ServingError
+
+
+class DecodeSessionStore:
+    """session id (bytes) -> opaque device-state pytree; TTL + capacity."""
+
+    def __init__(self, *, max_sessions: int = 64, ttl_s: float = 600.0):
+        self._lock = threading.Lock()
+        self._states: dict[bytes, tuple[object, float]] = {}
+        self._max = max_sessions
+        self._ttl = ttl_s
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._states)
+
+    def put(self, session_id: bytes, state: object) -> None:
+        """Insert/refresh a session. A NEW session past capacity raises
+        RESOURCE_EXHAUSTED after TTL sweeping (backpressure at init time;
+        active sessions are never silently evicted mid-generation)."""
+        now = time.monotonic()
+        with self._lock:
+            self._sweep_locked(now)
+            if (session_id not in self._states
+                    and len(self._states) >= self._max):
+                raise ServingError.resource_exhausted(
+                    f"decode session capacity ({self._max}) reached; close "
+                    "idle sessions or raise max_sessions")
+            self._states[session_id] = (state, now)
+
+    def take(self, session_id: bytes) -> object:
+        """Remove and return the state (the caller owns it until it puts
+        an updated state back). Popping makes concurrent steps on one
+        session fail loudly instead of racing on donated buffers."""
+        with self._lock:
+            self._sweep_locked(time.monotonic())
+            entry = self._states.pop(session_id, None)
+        if entry is None:
+            raise ServingError.not_found(
+                f"decode session {session_id!r} does not exist (never "
+                "initialized, expired, closed, or a step is in flight)")
+        return entry[0]
+
+    def close(self, session_id: bytes) -> bool:
+        with self._lock:
+            return self._states.pop(session_id, None) is not None
+
+    def clear(self) -> None:
+        with self._lock:
+            self._states.clear()
+
+    def _sweep_locked(self, now: float) -> None:
+        """TTL sweep only: a session that stopped stepping frees its HBM
+        after ttl_s; live sessions are never evicted."""
+        expired = [sid for sid, (_, t) in self._states.items()
+                   if now - t > self._ttl]
+        for sid in expired:
+            del self._states[sid]

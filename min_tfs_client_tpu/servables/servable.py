@@ -395,6 +395,11 @@ class Servable:
             not s.on_host and s.batched
             and s.inputs == first.inputs
             and s.mesh is first.mesh
+            # run_union applies the FIRST signature's casts/buckets to the
+            # shared inputs, so fusion is only sound when they agree —
+            # otherwise fused vs per-task results could differ.
+            and s.transfer_casts == first.transfer_casts
+            and tuple(s.batch_buckets) == tuple(first.batch_buckets)
             for s in sigs)
 
     def run_union(self, keys: Sequence[str],

@@ -1,0 +1,91 @@
+"""On-hardware checks, executed in a fresh process with the REAL backend.
+
+Run by tests/tpu/test_on_device.py in a subprocess (the pytest process
+itself is pinned to a CPU mesh by tests/conftest.py, and jax cannot switch
+backends mid-process). Each check prints one JSON line
+{"check": name, "ok": bool, ...}; the wrapper asserts on them.
+"""
+
+import json
+import sys
+import time
+
+sys.path.insert(0, "/root/repo")
+
+
+def emit(check: str, ok: bool, **extra) -> None:
+    print(json.dumps({"check": check, "ok": ok, **extra}), flush=True)
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    dev = jax.devices()[0]
+    emit("backend", dev.platform != "cpu", platform=str(dev.platform),
+         kind=getattr(dev, "device_kind", ""))
+
+    # -- 1. flash attention on the MXU vs the jnp oracle -------------------
+    from min_tfs_client_tpu.ops.attention import (
+        attention_reference,
+        flash_attention,
+    )
+
+    rng = np.random.default_rng(0)
+    b, h, s, d = 2, 4, 256, 64
+    q = jnp.asarray(rng.standard_normal((b, h, s, d)), jnp.bfloat16)
+    k = jnp.asarray(rng.standard_normal((b, h, s, d)), jnp.bfloat16)
+    v = jnp.asarray(rng.standard_normal((b, h, s, d)), jnp.bfloat16)
+    lengths = jnp.asarray([s, s // 3], jnp.int32)
+    for name, kwargs in [("plain", {}), ("causal", {"causal": True}),
+                         ("lengths", {"lengths": lengths})]:
+        t0 = time.perf_counter()
+        got = np.asarray(flash_attention(q, k, v, **kwargs),
+                         np.float32)
+        dt = (time.perf_counter() - t0) * 1e3
+        want = np.asarray(attention_reference(q, k, v, **kwargs), np.float32)
+        # bf16 inputs: compare against the oracle at bf16 resolution.
+        err = float(np.max(np.abs(got - want)))
+        emit(f"flash_attention/{name}", err < 0.06, max_err=err,
+             ms=round(dt, 2))
+
+    # -- 2. bucketed Predict through the serving stack on device -----------
+    import pathlib
+    import tempfile
+
+    from tests import fixtures
+    from min_tfs_client_tpu.client import TensorServingClient
+    from min_tfs_client_tpu.tensor.codec import tensor_proto_to_ndarray
+
+    base = pathlib.Path(tempfile.mkdtemp(prefix="tpu_tier_")) / "matmul"
+    fixtures.write_matmul_model(base)
+    client = TensorServingClient(f"tpu://{base}")
+    x = rng.standard_normal((3, 8)).astype(np.float32)  # 3 -> bucket 4
+    resp = client.predict_request("matmul", {"x": x})
+    probs = tensor_proto_to_ndarray(resp.outputs["probs"])
+    ok = (probs.shape == (3, 4)
+          and np.allclose(probs.sum(-1), 1.0, atol=1e-3))
+    emit("bucketed_predict", bool(ok), shape=list(probs.shape))
+
+    # -- 3. mesh attach smoke (1-device data mesh on the chip) -------------
+    from min_tfs_client_tpu.parallel.mesh import make_mesh
+    from min_tfs_client_tpu.client.inprocess import _registry
+
+    server = _registry[str(base)]
+    from min_tfs_client_tpu.protos import tfs_apis_pb2 as apis
+    from min_tfs_client_tpu.servables.servable import attach_mesh
+
+    spec = apis.ModelSpec()
+    spec.name = "matmul"
+    with server.core.servable_handle(spec) as handle:
+        attach_mesh(handle.servable, make_mesh({"data": 1}))
+    resp2 = client.predict_request("matmul", {"x": x})
+    probs2 = tensor_proto_to_ndarray(resp2.outputs["probs"])
+    emit("mesh_attach_predict",
+         bool(np.allclose(probs, probs2, atol=1e-5)))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

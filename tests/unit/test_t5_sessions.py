@@ -1,0 +1,147 @@
+"""Per-session incremental decode (BASELINE config 5's repeated-Predict
+surface): decode_init / decode_step / decode_close with the KV cache held
+as device state between requests."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from min_tfs_client_tpu.models import t5
+from min_tfs_client_tpu.utils.status import ServingError
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    config = t5.T5Config.tiny()
+    params = t5.init_params(jax.random.PRNGKey(0), config)
+    sigs = t5.build_signatures(params, config, seq_len=12, max_decode_len=6)
+    return config, params, sigs
+
+
+def _ids(config, batch=2, seq=12, seed=1):
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(2, config.vocab_size, (batch, seq)).astype(np.int32)
+    ids[:, -3:] = config.pad_id  # ragged prompts
+    return ids
+
+
+class TestSessionDecode:
+    def test_matches_single_shot_generation(self, tiny):
+        config, params, sigs = tiny
+        ids = _ids(config)
+        whole = sigs["decode"].run({"input_ids": ids})
+
+        sid = np.asarray(b"sess-1", object)
+        init = sigs["decode_init"].run({"session_id": sid, "input_ids": ids})
+        assert init["batch"] == 2
+        tokens = []
+        for i in range(6):
+            out = sigs["decode_step"].run({"session_id": sid})
+            assert out["step"] == i + 1
+            tokens.append(out["token"])
+        got = np.stack(tokens, axis=1)
+        np.testing.assert_array_equal(got, whole["output_ids"])
+
+    def test_cache_exhaustion_ends_session(self, tiny):
+        config, params, sigs = tiny
+        sid = np.asarray(b"sess-exhaust", object)
+        sigs["decode_init"].run({"session_id": sid,
+                                 "input_ids": _ids(config)})
+        for _ in range(6):  # max_decode_len steps allowed
+            sigs["decode_step"].run({"session_id": sid})
+        with pytest.raises(ServingError, match="does not exist"):
+            sigs["decode_step"].run({"session_id": sid})
+
+    def test_unknown_session_not_found(self, tiny):
+        _, _, sigs = tiny
+        with pytest.raises(ServingError, match="does not exist"):
+            sigs["decode_step"].run(
+                {"session_id": np.asarray(b"ghost", object)})
+
+    def test_close_frees_session(self, tiny):
+        config, _, sigs = tiny
+        sid = np.asarray(b"sess-close", object)
+        sigs["decode_init"].run({"session_id": sid,
+                                 "input_ids": _ids(config)})
+        assert sigs["decode_close"].run({"session_id": sid})["closed"] == 1
+        assert sigs["decode_close"].run({"session_id": sid})["closed"] == 0
+        with pytest.raises(ServingError, match="does not exist"):
+            sigs["decode_step"].run({"session_id": sid})
+
+
+class TestSessionStore:
+    def test_capacity_backpressure_not_eviction(self):
+        from min_tfs_client_tpu.servables.decode_sessions import (
+            DecodeSessionStore,
+        )
+
+        store = DecodeSessionStore(max_sessions=2, ttl_s=60)
+        store.put(b"a", 1)
+        store.put(b"b", 2)
+        with pytest.raises(ServingError, match="capacity"):
+            store.put(b"c", 3)
+        # live sessions were not evicted
+        assert store.take(b"a") == 1
+        store.put(b"a", 1)  # refresh of existing id is always allowed
+        store.put(b"a", 11)
+
+    def test_ttl_frees_idle_sessions(self, monkeypatch):
+        import time as time_mod
+
+        from min_tfs_client_tpu.servables import decode_sessions
+
+        t = [0.0]
+        monkeypatch.setattr(decode_sessions.time, "monotonic",
+                            lambda: t[0])
+        store = decode_sessions.DecodeSessionStore(max_sessions=2, ttl_s=10)
+        store.put(b"old", 1)
+        t[0] = 11.0
+        store.put(b"new1", 2)
+        store.put(b"new2", 3)  # fits: "old" expired at the sweep
+        with pytest.raises(ServingError, match="does not exist"):
+            store.take(b"old")
+
+
+class TestSessionDecodeOverWire:
+    def test_repeated_predict_through_tpu_scheme(self, tiny, tmp_path):
+        """The full BASELINE-5 wire surface: repeated Predict() calls with
+        the session id carried in the request tensors."""
+        config, params, sigs = tiny
+        from min_tfs_client_tpu.client import TensorServingClient
+        from min_tfs_client_tpu.client.inprocess import unregister_server
+        from min_tfs_client_tpu.models import export
+        from min_tfs_client_tpu.tensor.codec import tensor_proto_to_ndarray
+
+        base = tmp_path / "t5_tiny"
+        export.export_servable(
+            base, 1, "t5",
+            {"vocab_size": config.vocab_size, "d_model": config.d_model,
+             "d_kv": config.d_kv, "num_heads": config.num_heads,
+             "d_ff": config.d_ff,
+             "num_encoder_layers": config.num_encoder_layers,
+             "num_decoder_layers": config.num_decoder_layers,
+             "rel_pos_buckets": config.rel_pos_buckets,
+             "rel_pos_max_distance": config.rel_pos_max_distance},
+            params, signature_kwargs={"seq_len": 12, "max_decode_len": 6})
+        client = TensorServingClient(f"tpu://{base}")
+        try:
+            ids = _ids(config)
+            whole = client.predict_request(
+                "t5_tiny", {"input_ids": ids}, signature_name="decode")
+            want = tensor_proto_to_ndarray(whole.outputs["output_ids"])
+
+            sid = np.asarray(b"wire-sess", object)
+            client.predict_request(
+                "t5_tiny", {"session_id": sid, "input_ids": ids},
+                signature_name="decode_init")
+            tokens = []
+            for _ in range(6):
+                resp = client.predict_request(
+                    "t5_tiny", {"session_id": sid},
+                    signature_name="decode_step")
+                tokens.append(tensor_proto_to_ndarray(resp.outputs["token"]))
+            got = np.stack(tokens, axis=1)
+            np.testing.assert_array_equal(got, want)
+        finally:
+            unregister_server(f"tpu://{base}")
