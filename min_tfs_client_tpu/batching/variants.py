@@ -13,6 +13,11 @@ TPU-native counterparts of the reference's alternative schedulers
    concurrently-processed batches is tuned online by latency feedback
    (hill-climbing instead of the reference's gradient steps; same
    bounded [1, num_threads] walk).
+ * SerialDeviceBatchScheduler
+   (batching_util/serial_device_batch_scheduler.h) — multi-queue,
+   oldest-request-first with a full-batch boost; the in-flight batch
+   limit tracks the number of batches piled directly on the serial
+   device toward `target_pending`.
 
 All take an injectable `clock` so tests drive time deterministically —
 the FakeClockEnv pattern (batching_util/fake_clock_env.h).
@@ -265,6 +270,200 @@ class AdaptiveSharedBatchScheduler:
             self._stop = True
             stranded = [t for b in self._batches for t in b]
             self._batches.clear()
+            self._cv.notify_all()
+        for task in stranded:
+            task.error = ServingError.unavailable("scheduler stopped")
+            task.done.set()
+        for t in self._threads:
+            t.join(timeout=5.0)
+
+
+# -- serial device -----------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class SerialDeviceOptions:
+    """serial_device_batch_scheduler.h Options, collapsed to what the TPU
+    path needs: batches feed ONE serial device; the concurrently-processed
+    batch limit tracks how many batches are piled up directly on it."""
+
+    num_batch_threads: int = 4
+    initial_in_flight_batches_limit: int = 3
+    # Current number of batches waiting on the serial device (the
+    # reference's get_pending_on_serial_device; tests inject a fake).
+    get_pending_on_serial_device: Callable[[], int] = lambda: 0
+    # Desired average pending batches; O(1) gives the best latency.
+    target_pending: float = 2.0
+    batches_to_average_over: int = 1000
+    # A FULL batch is preferred over an older partial batch when the age
+    # gap is below this boost (full_batch_scheduling_boost_micros).
+    full_batch_scheduling_boost_s: float = 0.0
+
+
+@dataclass(frozen=True)
+class SerialQueueOptions:
+    max_batch_size: int = 1000
+    max_enqueued_batches: int = 10
+
+
+class _SerialQueue:
+    """One model's queue: closed batches await a processing slot."""
+
+    def __init__(self, scheduler: "SerialDeviceBatchScheduler",
+                 options: SerialQueueOptions,
+                 process: Callable[[list[BatchTask]], None]):
+        self._scheduler = scheduler
+        self._options = options
+        self.process = process
+        self._open: list[BatchTask] = []
+        self._open_size = 0
+
+    def schedule(self, task: BatchTask) -> None:
+        """Called under the scheduler lock via scheduler.schedule()."""
+        if task.size > self._options.max_batch_size:
+            raise ServingError.invalid_argument(
+                f"task size {task.size} exceeds max_batch_size "
+                f"{self._options.max_batch_size}")
+        if self._open and (self._open_size + task.size
+                           > self._options.max_batch_size):
+            self._close()
+        # max_enqueued_batches is a PER-QUEUE bound (the reference's
+        # QueueOptions): count only this queue's closed batches.
+        if not self._open and \
+                self._scheduler.enqueued_batches(self) >= \
+                self._options.max_enqueued_batches:
+            raise ServingError.unavailable("batch queue is full")
+        self._open.append(task)
+        self._open_size += task.size
+        if self._open_size >= self._options.max_batch_size:
+            self._close()
+
+    def _close(self) -> None:
+        if self._open:
+            full = self._open_size >= self._options.max_batch_size
+            self._scheduler._add_batch(self, self._open, full)
+            self._open, self._open_size = [], 0
+
+    def flush(self) -> None:
+        self._close()
+
+
+class SerialDeviceBatchScheduler:
+    """Priority-by-age multi-queue scheduler whose in-flight batch limit
+    tracks device feedback (serial_device_batch_scheduler.h): every
+    `batches_to_average_over` processed batches, the limit moves by
+    round(target_pending - avg_pending_on_device), clamped to
+    [1, num_batch_threads]. Batch selection is oldest-request first, with
+    full batches boosted by full_batch_scheduling_boost_s."""
+
+    def __init__(self, options: SerialDeviceOptions = SerialDeviceOptions()):
+        # No injectable clock here: batch age keys come from each task's
+        # own enqueue_time, which tests can backdate directly.
+        self._options = options
+        self._cv = threading.Condition()
+        # (effective_age_key, queue, tasks)
+        self._batches: list[tuple[float, _SerialQueue, list[BatchTask]]] = []
+        self._queues: list[_SerialQueue] = []
+        self._in_flight = 0
+        self._limit = max(1, min(options.initial_in_flight_batches_limit,
+                                 options.num_batch_threads))
+        self._pending_samples: list[int] = []
+        self._stop = False
+        self._threads = [
+            threading.Thread(target=self._worker, daemon=True,
+                             name=f"serial-device-batch-{i}")
+            for i in range(options.num_batch_threads)]
+        for t in self._threads:
+            t.start()
+
+    @property
+    def in_flight_batches_limit(self) -> int:
+        with self._cv:
+            return self._limit
+
+    def add_queue(self, options: SerialQueueOptions,
+                  process: Callable[[list[BatchTask]], None]) -> _SerialQueue:
+        queue = _SerialQueue(self, options, process)
+        with self._cv:
+            self._queues.append(queue)
+        return queue
+
+    def schedule(self, queue: _SerialQueue, task: BatchTask) -> None:
+        with self._cv:
+            if self._stop:
+                raise ServingError.unavailable("scheduler stopped")
+            queue.schedule(task)
+            self._cv.notify()
+
+    def flush(self, queue: _SerialQueue) -> None:
+        """Close the queue's open batch (timeout surrogate: the reference
+        closes on its own timer; callers here flush explicitly or via the
+        front-end's periodic function)."""
+        with self._cv:
+            queue.flush()
+            self._cv.notify()
+
+    def enqueued_batches(self, queue: Optional[_SerialQueue] = None) -> int:
+        if queue is None:
+            return len(self._batches)
+        return sum(1 for _, q, _tasks in self._batches if q is queue)
+
+    def _add_batch(self, queue: _SerialQueue, tasks: list[BatchTask],
+                   full: bool) -> None:
+        # caller holds self._cv
+        oldest = min(t.enqueue_time for t in tasks)
+        boost = self._options.full_batch_scheduling_boost_s if full else 0.0
+        self._batches.append((oldest - boost, queue, tasks))
+
+    def _worker(self) -> None:
+        while True:
+            with self._cv:
+                while not self._stop and (
+                        not self._batches or self._in_flight >= self._limit):
+                    self._cv.wait(timeout=10e-3)
+                if self._stop:
+                    return
+                self._batches.sort(key=lambda b: b[0])
+                _, queue, tasks = self._batches.pop(0)
+                self._in_flight += 1
+            try:
+                queue.process(tasks)
+            except Exception as exc:  # noqa: BLE001
+                for t in tasks:
+                    t.error = exc
+            finally:
+                for t in tasks:
+                    t.done.set()
+                with self._cv:
+                    self._in_flight -= 1
+                    self._feedback()
+                    self._cv.notify()
+
+    def _feedback(self) -> None:
+        # caller holds self._cv
+        try:
+            pending = int(self._options.get_pending_on_serial_device())
+        except Exception:  # pragma: no cover - feedback must not kill serving
+            pending = 0
+        self._pending_samples.append(pending)
+        if len(self._pending_samples) < self._options.batches_to_average_over:
+            return
+        avg = sum(self._pending_samples) / len(self._pending_samples)
+        self._pending_samples.clear()
+        step = round(self._options.target_pending - avg)
+        self._limit = max(1, min(self._options.num_batch_threads,
+                                 self._limit + int(step)))
+
+    def stop(self) -> None:
+        with self._cv:
+            self._stop = True
+            stranded = [t for _, _, tasks in self._batches for t in tasks]
+            self._batches.clear()
+            # Tasks still sitting in queues' OPEN batches must be stranded
+            # too, or their waiters hang forever.
+            for queue in self._queues:
+                stranded.extend(queue._open)
+                queue._open, queue._open_size = [], 0
             self._cv.notify_all()
         for task in stranded:
             task.error = ServingError.unavailable("scheduler stopped")

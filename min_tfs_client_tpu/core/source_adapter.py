@@ -1,0 +1,123 @@
+"""Generic Source -> Source adapter chain (core/source_adapter.{h,cc}).
+
+A SourceAdapter is both a Target (it receives aspired-version lists from
+an upstream source) and a Source (it re-emits converted lists downstream).
+Chains compose: FS source -> path->loader adapter -> manager is the
+standard wiring the reference builds per platform (server_core.h:319-340);
+here ServerCore wires platforms directly, and this module provides the
+*generic* chain pieces the reference's test strategy leans on —
+UnarySourceAdapter for per-item conversion and ErrorInjectingSourceAdapter
+for fault-injection tests (the model_servers/test_util
+storage_path_error_injecting_source_adapter pattern).
+
+Item model: aspired lists are [(version, payload)] per servable name, the
+same shape FileSystemStoragePathSource emits. A conversion failure does
+NOT drop the version silently (that would read as "unload"): it converts
+into a loader that fails at load() with the original error, so the
+LoaderHarness surfaces kError through GetModelStatus exactly like any
+other load failure.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence
+
+from min_tfs_client_tpu.core.loader import Loader
+from min_tfs_client_tpu.utils.status import ServingError, error_from_exception
+
+AspiredCallback = Callable[[str, Sequence[tuple]], None]
+
+
+class ErrorLoader(Loader):
+    """Loader that fails its load() with a predetermined error — the
+    harness then runs its normal retry/kError path."""
+
+    def __init__(self, error: Exception):
+        self.error = error
+
+    def estimate_resources(self) -> int:
+        return 0
+
+    def load(self) -> None:
+        raise self.error
+
+    def unload(self) -> None:  # pragma: no cover - never loaded
+        pass
+
+    def servable(self):  # pragma: no cover - never loaded
+        raise ServingError.failed_precondition("ErrorLoader never loads")
+
+
+class SourceAdapter:
+    """Base: receive upstream aspired lists, emit adapted lists."""
+
+    def __init__(self):
+        self._callback: Optional[AspiredCallback] = None
+
+    # -- Source side ---------------------------------------------------------
+
+    def set_aspired_versions_callback(self, callback: AspiredCallback) -> None:
+        self._callback = callback
+
+    # -- Target side ---------------------------------------------------------
+
+    def set_aspired_versions(self, name: str,
+                             versions: Sequence[tuple]) -> None:
+        if self._callback is None:
+            raise ServingError.failed_precondition(
+                "SourceAdapter received aspired versions before its own "
+                "callback was set (connect the chain downstream-first)")
+        self._callback(name, self.adapt(name, versions))
+
+    # alias matching the FS source's callback signature, so an adapter can
+    # be passed wherever an AspiredCallback is expected
+    def __call__(self, name: str, versions: Sequence[tuple]) -> None:
+        self.set_aspired_versions(name, versions)
+
+    def adapt(self, name: str, versions: Sequence[tuple]) -> list[tuple]:
+        raise NotImplementedError
+
+
+class UnarySourceAdapter(SourceAdapter):
+    """Per-item conversion (core/source_adapter.h UnarySourceAdapter):
+    subclass `convert(name, version, payload) -> payload'`. A raising
+    convert yields an ErrorLoader for that version."""
+
+    def adapt(self, name: str, versions: Sequence[tuple]) -> list[tuple]:
+        out: list[tuple] = []
+        for version, payload in versions:
+            try:
+                out.append((version, self.convert(name, version, payload)))
+            except Exception as exc:  # noqa: BLE001 - surfaced via harness
+                out.append((version, ErrorLoader(error_from_exception(exc))))
+        return out
+
+    def convert(self, name: str, version: int, payload):
+        raise NotImplementedError
+
+
+class FunctionSourceAdapter(UnarySourceAdapter):
+    """UnarySourceAdapter from a plain callable."""
+
+    def __init__(self, fn: Callable[[str, int, object], object]):
+        super().__init__()
+        self._fn = fn
+
+    def convert(self, name: str, version: int, payload):
+        return self._fn(name, version, payload)
+
+
+class ErrorInjectingSourceAdapter(SourceAdapter):
+    """Emits an ErrorLoader for every aspired version (the reference's
+    error-injecting adapters, core/source_adapter.h ErrorInjectingSourceAdapter
+    and model_servers/test_util storage_path_error_injecting_source_adapter):
+    drives harnesses into kError deterministically for failure-path tests."""
+
+    def __init__(self, error: Exception | str):
+        super().__init__()
+        self._error = (ServingError.internal(error)
+                       if isinstance(error, str) else error)
+
+    def adapt(self, name: str, versions: Sequence[tuple]) -> list[tuple]:
+        return [(version, ErrorLoader(self._error))
+                for version, _ in versions]

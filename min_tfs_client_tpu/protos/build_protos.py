@@ -30,6 +30,7 @@ PROTO_FILES = [
     "tfs_apis.proto",
     "tfs_services.proto",
     "tpu_platform.proto",
+    "tf_profiler.proto",
 ]
 
 

@@ -34,7 +34,7 @@ SERVICE_SCHEMAS = {
 }
 
 
-def _make_stub_class(service: str, methods: dict):
+def _make_stub_class(service: str, methods: dict, pkg: str = _PKG):
     class Stub:
         def __init__(self, channel: grpc.Channel):
             for name, (req_cls, resp_cls) in methods.items():
@@ -42,7 +42,7 @@ def _make_stub_class(service: str, methods: dict):
                     self,
                     name,
                     channel.unary_unary(
-                        f"/{_PKG}.{service}/{name}",
+                        f"/{pkg}.{service}/{name}",
                         request_serializer=req_cls.SerializeToString,
                         response_deserializer=resp_cls.FromString,
                     ),
@@ -63,7 +63,7 @@ def _make_servicer_class(service: str, methods: dict):
     return cls
 
 
-def _make_registrar(service: str, methods: dict):
+def _make_registrar(service: str, methods: dict, pkg: str = _PKG):
     def add_to_server(servicer, server):
         handlers = {
             name: grpc.unary_unary_rpc_method_handler(
@@ -74,7 +74,7 @@ def _make_registrar(service: str, methods: dict):
             for name, (req_cls, resp_cls) in methods.items()
         }
         server.add_generic_rpc_handlers(
-            (grpc.method_handlers_generic_handler(f"{_PKG}.{service}", handlers),)
+            (grpc.method_handlers_generic_handler(f"{pkg}.{service}", handlers),)
         )
 
     add_to_server.__name__ = f"add_{service}Servicer_to_server"
@@ -92,3 +92,22 @@ SessionServiceServicer = _make_servicer_class("SessionService", SERVICE_SCHEMAS[
 add_PredictionServiceServicer_to_server = _make_registrar("PredictionService", SERVICE_SCHEMAS["PredictionService"])
 add_ModelServiceServicer_to_server = _make_registrar("ModelService", SERVICE_SCHEMAS["ModelService"])
 add_SessionServiceServicer_to_server = _make_registrar("SessionService", SERVICE_SCHEMAS["SessionService"])
+
+
+# -- ProfilerService (package tensorflow, not tensorflow.serving) ------------
+# The reference registers tensorflow.ProfilerService on the MAIN serving
+# port (model_servers/server.cc:324,339); same wire paths here.
+
+from min_tfs_client_tpu.protos import tf_profiler_pb2 as profiler_pb2  # noqa: E402
+
+PROFILER_SCHEMA = {
+    "Profile": (profiler_pb2.ProfileRequest, profiler_pb2.ProfileResponse),
+    "Monitor": (profiler_pb2.MonitorRequest, profiler_pb2.MonitorResponse),
+}
+
+ProfilerServiceStub = _make_stub_class(
+    "ProfilerService", PROFILER_SCHEMA, pkg="tensorflow")
+ProfilerServiceServicer = _make_servicer_class(
+    "ProfilerService", PROFILER_SCHEMA)
+add_ProfilerServiceServicer_to_server = _make_registrar(
+    "ProfilerService", PROFILER_SCHEMA, pkg="tensorflow")

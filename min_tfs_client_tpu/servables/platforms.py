@@ -120,6 +120,16 @@ def _dir_size_bytes(path: str) -> int:
 
 
 def _tensorflow_factory(name, version, path, config) -> Servable:
+    if config.get("use_tflite_model"):
+        # Alt backend: serve <version>/model.tflite (the reference's
+        # --use_tflite_model path, tflite_session.{h,cc}).
+        from min_tfs_client_tpu.servables.tflite_import import (
+            load_tflite_model,
+        )
+
+        return load_tflite_model(
+            path, name, version,
+            batch_buckets=config.get("batch_buckets"))
     from min_tfs_client_tpu.servables.graphdef_import import load_saved_model
 
     return load_saved_model(path, name, version, **{

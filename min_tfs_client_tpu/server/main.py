@@ -69,6 +69,9 @@ def build_parser() -> argparse.ArgumentParser:
                    action="store_true",
                    help="permit version labels pointing at versions that "
                         "are not yet AVAILABLE")
+    p.add_argument("--use_tflite_model", action="store_true",
+                   help="serve <version>/model.tflite via the TFLite "
+                        "importer")
     p.add_argument("--version", action="store_true",
                    help="print the server version and exit")
     return p
@@ -105,6 +108,7 @@ def options_from_args(args) -> ServerOptions:
         platform_config_file=args.platform_config_file,
         allow_version_labels_for_unavailable_models=(
             args.allow_version_labels_for_unavailable_models),
+        use_tflite_model=args.use_tflite_model,
     )
 
 

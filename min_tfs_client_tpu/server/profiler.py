@@ -90,3 +90,61 @@ def stop_trace_capture() -> None:
     import jax
 
     jax.profiler.stop_trace()
+
+
+# -- ProfilerService on the MAIN serving port --------------------------------
+
+
+class ProfilerServiceImpl:
+    """tensorflow.ProfilerService servicer backed by the JAX profiler.
+
+    The reference registers this service on the main gRPC server
+    (server.cc:324,339 -> profiler/rpc/profiler_service_impl.cc) so
+    production tooling pulls traces without a side port. Profile() captures
+    `duration_ms` of XPlane trace into a repository dir and returns every
+    produced file as ProfileToolData; Monitor() returns a text snapshot of
+    the serving metrics registry."""
+
+    def Profile(self, request, context=None):  # noqa: N802 - gRPC API
+        import pathlib
+        import tempfile
+        import time as time_mod
+
+        from min_tfs_client_tpu.protos import tf_profiler_pb2 as pb
+
+        response = pb.ProfileResponse()
+        root = request.repository_root or tempfile.mkdtemp(prefix="tpu_prof_")
+        duration_s = min(max(request.duration_ms, 1), 60_000) / 1e3
+        # Snapshot what already exists so the response carries ONLY this
+        # capture's files — never a prior run's traces or unrelated
+        # contents of a caller-supplied repository_root.
+        root_path = pathlib.Path(root)
+        preexisting = ({f for f in root_path.rglob("*") if f.is_file()}
+                       if root_path.exists() else set())
+        try:
+            import jax
+
+            with jax.profiler.trace(root):
+                time_mod.sleep(duration_s)
+        except Exception as exc:  # profiler unavailable: empty trace
+            response.empty_trace = True
+            if context is not None:
+                context.set_details(f"profiler capture failed: {exc}")
+            return response
+        files = [f for f in root_path.rglob("*")
+                 if f.is_file() and f not in preexisting]
+        for f in sorted(files):
+            data = f.read_bytes()
+            tool = response.tool_data.add()
+            tool.name = str(f.relative_to(root))
+            tool.data = data
+            if f.suffix == ".pb" and "xplane" in f.name:
+                response.encoded_trace = data
+        response.empty_trace = not files
+        return response
+
+    def Monitor(self, request, context=None):  # noqa: N802 - gRPC API
+        from min_tfs_client_tpu.protos import tf_profiler_pb2 as pb
+        from min_tfs_client_tpu.server.metrics import prometheus_text
+
+        return pb.MonitorResponse(data=prometheus_text())

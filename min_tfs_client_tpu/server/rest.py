@@ -153,9 +153,35 @@ class _RestHandler(BaseHTTPRequestHandler):
         body = json.dumps(payload).encode()
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
+        # Response compression when the client accepts it (the reference's
+        # net_http gzip support, evhttp_request.cc; worthwhile from ~1KB).
+        if (len(body) >= 1024 and "gzip" in
+                self.headers.get("Accept-Encoding", "").lower()):
+            import gzip as _gzip
+
+            body = _gzip.compress(body, compresslevel=5)
+            self.send_header("Content-Encoding", "gzip")
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)
+
+    def _read_body(self) -> bytes:
+        length = int(self.headers.get("Content-Length", "0"))
+        raw = self.rfile.read(length)
+        if (self.headers.get("Content-Encoding", "").lower().strip()
+                == "gzip"):
+            import gzip as _gzip
+            import zlib as _zlib
+
+            try:
+                raw = _gzip.decompress(raw)
+            except (OSError, EOFError, _zlib.error) as exc:
+                # corrupt deflate streams raise zlib.error / EOFError,
+                # not OSError — all are the client's fault: 400.
+                raise ServingError.invalid_argument(
+                    f"body declared Content-Encoding: gzip but did not "
+                    f"decompress: {exc}")
+        return raw
 
     def _send_error_status(self, exc: Exception) -> None:
         err = error_from_exception(exc)
@@ -202,8 +228,7 @@ class _RestHandler(BaseHTTPRequestHandler):
                 self._send_json(
                     404, {"error": f"Malformed request: POST {self.path}"})
                 return
-            length = int(self.headers.get("Content-Length", "0"))
-            body = json.loads(self.rfile.read(length) or b"{}")
+            body = json.loads(self._read_body() or b"{}")
             verb = m.group("verb").lower()
             if verb == "predict":
                 request, row = build_predict_request(body, m)

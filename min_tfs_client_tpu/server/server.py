@@ -85,6 +85,9 @@ class ServerOptions:
     # Labels may normally only point at AVAILABLE versions
     # (server_core.cc UpdateModelVersionLabelMap; main.cc flag).
     allow_version_labels_for_unavailable_models: bool = False
+    # Serve <version>/model.tflite through the TFLite importer instead of
+    # the SavedModel GraphDef (main.cc use_tflite_model).
+    use_tflite_model: bool = False
 
 
 def _parse_channel_arguments(spec: str) -> list[tuple[str, object]]:
@@ -169,6 +172,11 @@ class Server:
             ModelServiceImpl(handlers), self._grpc_server)
         gs.add_SessionServiceServicer_to_server(
             SessionServiceImpl(handlers), self._grpc_server)
+        # tensorflow.ProfilerService on the MAIN port (server.cc:324,339).
+        from min_tfs_client_tpu.server.profiler import ProfilerServiceImpl
+
+        gs.add_ProfilerServiceServicer_to_server(
+            ProfilerServiceImpl(), self._grpc_server)
         self.grpc_port = self._bind(self._grpc_server, opts.grpc_port)
         if opts.grpc_socket_path:
             if not self._grpc_server.add_insecure_port(
@@ -289,6 +297,8 @@ def _platform_configs(opts: ServerOptions, batching) -> dict:
     if opts.saved_model_tags:
         configs["tensorflow"]["tags"] = [
             t.strip() for t in opts.saved_model_tags.split(",") if t.strip()]
+    if opts.use_tflite_model:
+        configs["tensorflow"]["use_tflite_model"] = True
     if opts.platform_config_file:
         if opts.enable_batching:
             raise ServingError.invalid_argument(

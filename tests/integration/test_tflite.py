@@ -1,0 +1,151 @@
+"""TFLite alternative backend, cross-validated against the REAL TFLite
+interpreter (reference: servables/tensorflow/tflite_session.{h,cc}).
+
+Real TensorFlow converts two models to .tflite and computes golden outputs
+with tf.lite.Interpreter in a SUBPROCESS (TF and our generated protos must
+never share a process — duplicate descriptor-pool symbols); this test then
+serves the same flatbuffers through our from-scratch parser + JAX lowering
+and compares numerics.
+"""
+
+import json
+import pathlib
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.integration
+
+_GEN = r"""
+import json, sys, pathlib
+import numpy as np
+import tensorflow as tf
+
+out_dir = pathlib.Path(sys.argv[1])
+records = {}
+
+rng = np.random.default_rng(0)
+
+# Model 1: MLP — FULLY_CONNECTED x2 (one fused relu) + SOFTMAX.
+mlp = tf.keras.Sequential([
+    tf.keras.layers.Input((8,)),
+    tf.keras.layers.Dense(16, activation="relu"),
+    tf.keras.layers.Dense(4),
+    tf.keras.layers.Softmax(),
+])
+x = rng.standard_normal((3, 8)).astype(np.float32)
+records["mlp"] = {"inputs": {"x": x.tolist()}}
+
+# Model 2: small convnet — CONV_2D, DEPTHWISE_CONV_2D, MAX_POOL_2D,
+# AVERAGE_POOL (via GlobalAveragePooling -> MEAN), FULLY_CONNECTED.
+cnn = tf.keras.Sequential([
+    tf.keras.layers.Input((16, 16, 3)),
+    tf.keras.layers.Conv2D(8, 3, strides=2, padding="same",
+                           activation="relu"),
+    tf.keras.layers.DepthwiseConv2D(3, padding="valid"),
+    tf.keras.layers.MaxPooling2D(2),
+    tf.keras.layers.GlobalAveragePooling2D(),
+    tf.keras.layers.Dense(5),
+])
+img = rng.standard_normal((2, 16, 16, 3)).astype(np.float32)
+records["cnn"] = {"inputs": {"x": img.tolist()}}
+
+for name, model, arr in (("mlp", mlp, x), ("cnn", cnn, img)):
+    converter = tf.lite.TFLiteConverter.from_keras_model(model)
+    blob = converter.convert()
+    (out_dir / f"{name}.tflite").write_bytes(blob)
+    interp = tf.lite.Interpreter(model_content=blob)
+    inp = interp.get_input_details()[0]
+    interp.resize_tensor_input(inp["index"], arr.shape)
+    interp.allocate_tensors()
+    interp.set_tensor(inp["index"], arr)
+    interp.invoke()
+    out = interp.get_tensor(interp.get_output_details()[0]["index"])
+    records[name]["golden"] = out.tolist()
+    records[name]["input_name"] = inp["name"]
+
+print(json.dumps(records))
+"""
+
+
+@pytest.fixture(scope="module")
+def tflite_fixtures(tmp_path_factory):
+    out_dir = tmp_path_factory.mktemp("tflite")
+    env = {"PYTHONNOUSERSITE": "1", "PATH": "/usr/bin:/bin",
+           "HOME": "/root", "CUDA_VISIBLE_DEVICES": "-1",
+           "TF_CPP_MIN_LOG_LEVEL": "3"}
+    res = subprocess.run(
+        [sys.executable, "-c", _GEN, str(out_dir)],
+        capture_output=True, text=True, timeout=240, env=env)
+    if res.returncode != 0:
+        pytest.skip(f"tensorflow unavailable for fixture generation: "
+                    f"{res.stderr[-500:]}")
+    records = json.loads(res.stdout.strip().splitlines()[-1])
+    return out_dir, records
+
+
+def _serve_and_run(blob_path: pathlib.Path, inputs: dict) -> dict:
+    from min_tfs_client_tpu.servables.tflite_import import (
+        build_tflite_signature,
+    )
+    from min_tfs_client_tpu.servables.servable import Servable, Signature
+
+    fn, in_specs, out_specs, batched = build_tflite_signature(
+        blob_path.read_bytes())
+    sig = Signature(fn=fn, inputs=in_specs, outputs=out_specs,
+                    batched=batched)
+    servable = Servable("m", 1, {"serving_default": sig})
+    alias = next(iter(in_specs))
+    return servable.signature("").run({alias: next(iter(inputs.values()))})
+
+
+class TestTFLiteNumerics:
+    def test_mlp_matches_tflite_interpreter(self, tflite_fixtures):
+        out_dir, records = tflite_fixtures
+        rec = records["mlp"]
+        inputs = {k: np.asarray(v, np.float32)
+                  for k, v in rec["inputs"].items()}
+        got = _serve_and_run(out_dir / "mlp.tflite", inputs)
+        (out_arr,) = got.values()
+        np.testing.assert_allclose(
+            out_arr, np.asarray(rec["golden"], np.float32),
+            rtol=1e-4, atol=1e-5)
+
+    def test_cnn_matches_tflite_interpreter(self, tflite_fixtures):
+        out_dir, records = tflite_fixtures
+        rec = records["cnn"]
+        inputs = {k: np.asarray(v, np.float32)
+                  for k, v in rec["inputs"].items()}
+        got = _serve_and_run(out_dir / "cnn.tflite", inputs)
+        (out_arr,) = got.values()
+        np.testing.assert_allclose(
+            out_arr, np.asarray(rec["golden"], np.float32),
+            rtol=1e-3, atol=1e-4)
+
+    def test_served_through_server_with_flag(self, tflite_fixtures,
+                                             tmp_path):
+        """End to end: version dir with model.tflite served via the
+        tensorflow platform under use_tflite_model (main.cc flag)."""
+        from min_tfs_client_tpu.servables import platforms
+
+        out_dir, records = tflite_fixtures
+        vdir = tmp_path / "tfl_model" / "1"
+        vdir.mkdir(parents=True)
+        vdir.joinpath("model.tflite").write_bytes(
+            (out_dir / "mlp.tflite").read_bytes())
+        loader = platforms.make_loader(
+            "tensorflow", "tfl_model", 1, str(vdir),
+            {"use_tflite_model": True, "enable_model_warmup": False})
+        loader.load()
+        servable = loader.servable()
+        rec = records["mlp"]
+        x = np.asarray(rec["inputs"]["x"], np.float32)
+        sig = servable.signature("")
+        out = sig.run({next(iter(sig.inputs)): x})
+        (out_arr,) = out.values()
+        np.testing.assert_allclose(
+            out_arr, np.asarray(rec["golden"], np.float32),
+            rtol=1e-4, atol=1e-5)
+        loader.unload()

@@ -200,3 +200,157 @@ def test_adaptive_stop_strands_queued_tasks_with_unavailable():
     # queued either processed (worker got to it before stop) or stranded
     if queued.error is not None:
         assert isinstance(queued.error, ServingError)
+
+
+# -- serial device -----------------------------------------------------------
+
+
+def test_serial_device_processes_all_queues():
+    from min_tfs_client_tpu.batching.variants import (
+        SerialDeviceBatchScheduler,
+        SerialDeviceOptions,
+        SerialQueueOptions,
+    )
+
+    done_a, done_b = [], []
+    sched = SerialDeviceBatchScheduler(SerialDeviceOptions(
+        num_batch_threads=2, initial_in_flight_batches_limit=2,
+        batches_to_average_over=4))
+    qa = sched.add_queue(SerialQueueOptions(max_batch_size=4),
+                         lambda b: done_a.append(len(b)))
+    qb = sched.add_queue(SerialQueueOptions(max_batch_size=2),
+                         lambda b: done_b.append(len(b)))
+    tasks = []
+    for _ in range(8):
+        t = BatchTask(inputs={}, size=1)
+        sched.schedule(qa, t)
+        tasks.append(t)
+    for _ in range(4):
+        t = BatchTask(inputs={}, size=1)
+        sched.schedule(qb, t)
+        tasks.append(t)
+    sched.flush(qa)
+    sched.flush(qb)
+    for t in tasks:
+        assert t.done.wait(5.0)
+    assert sum(done_a) == 8 and sum(done_b) == 4
+    sched.stop()
+
+
+def test_serial_device_limit_tracks_pending_feedback():
+    from min_tfs_client_tpu.batching.variants import (
+        SerialDeviceBatchScheduler,
+        SerialDeviceOptions,
+        SerialQueueOptions,
+    )
+
+    # Device reports it is starved (0 pending) -> limit should grow
+    # toward target_pending; then piled up (5 pending) -> limit shrinks.
+    pending = [0]
+    sched = SerialDeviceBatchScheduler(SerialDeviceOptions(
+        num_batch_threads=4, initial_in_flight_batches_limit=1,
+        get_pending_on_serial_device=lambda: pending[0],
+        target_pending=2.0, batches_to_average_over=3))
+    q = sched.add_queue(SerialQueueOptions(max_batch_size=1),
+                        lambda b: None)
+
+    def run_batches(n):
+        tasks = [BatchTask(inputs={}, size=1) for _ in range(n)]
+        for t in tasks:
+            sched.schedule(q, t)
+        for t in tasks:
+            assert t.done.wait(5.0)
+
+    run_batches(3)
+    import time as _time
+
+    _time.sleep(0.05)
+    assert sched.in_flight_batches_limit >= 2  # grew by target - 0
+    pending[0] = 6
+    run_batches(6)
+    _time.sleep(0.05)
+    assert sched.in_flight_batches_limit == 1  # shrank, clamped at 1
+    sched.stop()
+
+
+def test_serial_device_full_batch_boost_orders_selection():
+    from min_tfs_client_tpu.batching.variants import (
+        SerialDeviceBatchScheduler,
+        SerialDeviceOptions,
+        SerialQueueOptions,
+    )
+
+    order = []
+    sched = SerialDeviceBatchScheduler(
+        SerialDeviceOptions(num_batch_threads=1,
+                            initial_in_flight_batches_limit=1,
+                            full_batch_scheduling_boost_s=100.0))
+    blocker = threading.Event()
+    q_slow = sched.add_queue(SerialQueueOptions(max_batch_size=1),
+                             lambda b: blocker.wait(5.0))
+    q_old = sched.add_queue(SerialQueueOptions(max_batch_size=4),
+                            lambda b: order.append("old_partial"))
+    q_full = sched.add_queue(SerialQueueOptions(max_batch_size=1),
+                             lambda b: order.append("full"))
+    # Occupy the single worker so later batches queue up.
+    t0 = BatchTask(inputs={}, size=1)
+    sched.schedule(q_slow, t0)
+    time.sleep(0.05)
+    older = BatchTask(inputs={}, size=1, enqueue_time=time.monotonic() - 50)
+    sched.schedule(q_old, older)
+    sched.flush(q_old)  # partial batch, 50s old
+    newer_full = BatchTask(inputs={}, size=1)
+    sched.schedule(q_full, newer_full)  # full batch, new, boost 100s
+    time.sleep(0.02)
+    blocker.set()
+    assert older.done.wait(5.0) and newer_full.done.wait(5.0)
+    assert order == ["full", "old_partial"]
+    sched.stop()
+
+
+def test_serial_device_stop_strands_open_batch_tasks():
+    from min_tfs_client_tpu.batching.variants import (
+        SerialDeviceBatchScheduler,
+        SerialDeviceOptions,
+        SerialQueueOptions,
+    )
+
+    sched = SerialDeviceBatchScheduler(SerialDeviceOptions(
+        num_batch_threads=1, initial_in_flight_batches_limit=1))
+    q = sched.add_queue(SerialQueueOptions(max_batch_size=8),
+                        lambda b: None)
+    open_task = BatchTask(inputs={}, size=1)  # partial: stays open
+    sched.schedule(q, open_task)
+    sched.stop()
+    assert open_task.done.is_set()
+    assert isinstance(open_task.error, ServingError)
+
+
+def test_serial_device_per_queue_enqueued_bound():
+    from min_tfs_client_tpu.batching.variants import (
+        SerialDeviceBatchScheduler,
+        SerialDeviceOptions,
+        SerialQueueOptions,
+    )
+
+    blocker = threading.Event()
+    sched = SerialDeviceBatchScheduler(SerialDeviceOptions(
+        num_batch_threads=1, initial_in_flight_batches_limit=1))
+    qa = sched.add_queue(SerialQueueOptions(max_batch_size=1,
+                                            max_enqueued_batches=2),
+                         lambda b: blocker.wait(5.0))
+    qb = sched.add_queue(SerialQueueOptions(max_batch_size=1,
+                                            max_enqueued_batches=2),
+                         lambda b: None)
+    sched.schedule(qa, BatchTask(inputs={}, size=1))  # occupies the worker
+    time.sleep(0.05)
+    sched.schedule(qa, BatchTask(inputs={}, size=1))
+    sched.schedule(qa, BatchTask(inputs={}, size=1))  # qa now at its bound
+    with pytest.raises(ServingError, match="full"):
+        sched.schedule(qa, BatchTask(inputs={}, size=1))
+    # A DIFFERENT queue is not starved by qa's backlog.
+    t = BatchTask(inputs={}, size=1)
+    sched.schedule(qb, t)
+    blocker.set()
+    assert t.done.wait(5.0)
+    sched.stop()

@@ -1,0 +1,88 @@
+"""Generic SourceAdapter chain + fault injection
+(core/source_adapter.{h,cc}; model_servers/test_util error injectors)."""
+
+import pytest
+
+from min_tfs_client_tpu.core.fs_source import StaticStoragePathSource
+from min_tfs_client_tpu.core.loader import SimpleLoader
+from min_tfs_client_tpu.core.manager import AspiredVersionsManager
+from min_tfs_client_tpu.core.monitor import ServableStateMonitor
+from min_tfs_client_tpu.core.source_adapter import (
+    ErrorInjectingSourceAdapter,
+    ErrorLoader,
+    FunctionSourceAdapter,
+    UnarySourceAdapter,
+)
+from min_tfs_client_tpu.core.states import ManagerState, ServableId
+from min_tfs_client_tpu.utils.event_bus import EventBus
+from min_tfs_client_tpu.utils.status import ServingError
+
+
+class TestUnaryAdapter:
+    def test_converts_each_item(self):
+        seen = []
+        adapter = FunctionSourceAdapter(
+            lambda name, version, path: f"{name}:{version}:{path}")
+        adapter.set_aspired_versions_callback(
+            lambda name, versions: seen.append((name, versions)))
+        adapter.set_aspired_versions("m", [(1, "/a"), (2, "/b")])
+        assert seen == [("m", [(1, "m:1:/a"), (2, "m:2:/b")])]
+
+    def test_conversion_error_becomes_error_loader(self):
+        def convert(name, version, path):
+            if version == 2:
+                raise ServingError.not_found("gone")
+            return path
+
+        seen = []
+        adapter = FunctionSourceAdapter(convert)
+        adapter.set_aspired_versions_callback(
+            lambda name, versions: seen.append(versions))
+        adapter.set_aspired_versions("m", [(1, "/a"), (2, "/b")])
+        (versions,) = seen
+        assert versions[0] == (1, "/a")
+        assert isinstance(versions[1][1], ErrorLoader)
+        with pytest.raises(ServingError, match="gone"):
+            versions[1][1].load()
+
+    def test_emitting_before_connect_fails(self):
+        adapter = FunctionSourceAdapter(lambda *a: a)
+        with pytest.raises(ServingError, match="downstream-first"):
+            adapter.set_aspired_versions("m", [(1, "/a")])
+
+    def test_chains_compose(self):
+        seen = []
+        double = FunctionSourceAdapter(lambda n, v, x: x * 2)
+        add = FunctionSourceAdapter(lambda n, v, x: x + 1)
+        add.set_aspired_versions_callback(
+            lambda name, versions: seen.append(versions))
+        double.set_aspired_versions_callback(add)  # adapter as callback
+        double.set_aspired_versions("m", [(1, 10)])
+        assert seen == [[(1, 21)]]
+
+
+class TestErrorInjection:
+    def test_drives_harness_to_error_state(self):
+        """The fault-injection path the reference exercises with
+        storage_path_error_injecting_source_adapter: every aspired version
+        reaches kError and the error is visible on the state monitor."""
+        bus = EventBus()
+        monitor = ServableStateMonitor(bus)
+        manager = AspiredVersionsManager(
+            event_bus=bus, max_load_retries=0, tick_interval_s=0.01)
+        try:
+            adapter = ErrorInjectingSourceAdapter(
+                ServingError.internal("injected boom"))
+            adapter.set_aspired_versions_callback(
+                manager.set_aspired_versions)
+            source = StaticStoragePathSource("broken", 1, "/nowhere")
+            source.set_aspired_versions_callback(adapter)
+
+            sid = ServableId("broken", 1)
+            state = monitor.wait_until_in_state(
+                sid, ManagerState.END, timeout_s=10)
+            assert state.error is not None
+            assert "injected boom" in state.error.message
+        finally:
+            manager.stop()
+            monitor.close()
