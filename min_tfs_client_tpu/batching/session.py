@@ -77,6 +77,22 @@ def apply_batch_buckets(servable, params: BatchingParameters | dict) -> dict:
     return params
 
 
+def pad_to_max(arrays: list[np.ndarray], axis: int,
+               pad_value) -> list[np.ndarray]:
+    """Pad one axis to the per-batch max with a FIXED pad value (the
+    sequence-bucketing merge rule; contrast pad_ragged's first-element
+    fill, which is wrong for attention masks)."""
+    target = max(a.shape[axis] for a in arrays)
+    out = []
+    for a in arrays:
+        if a.shape[axis] != target:
+            widths = [(0, 0)] * a.ndim
+            widths[axis] = (0, target - a.shape[axis])
+            a = np.pad(a, widths, constant_values=pad_value)
+        out.append(a)
+    return out
+
+
 def pad_ragged(arrays: list[np.ndarray]) -> list[np.ndarray]:
     """Pad non-batch dims to the per-batch max (batching_util.cc semantics:
     rank 1-6, pad value = tensor's first element)."""
@@ -144,6 +160,12 @@ class BatchedSignatureRunner:
         # Reject bad requests BEFORE they join a batch: a malformed request
         # must fail alone with INVALID_ARGUMENT, never its batch-mates.
         arrays = self.signature.validate(inputs, output_filter)
+        # Per-request sequence rounding happens CALLER-SIDE so every task
+        # in a batch is already at an allowed length with the signature's
+        # own pad values (mask padded with 0, not pad_ragged's
+        # first-element rule); the merge then only bridges bucket gaps.
+        true_seq = self.signature._true_seq_len(arrays)
+        arrays = self.signature._pad_seq(arrays)
         sizes = {a.shape[0] for a in arrays.values() if a.ndim}
         if len(sizes) != 1:
             raise ServingError.invalid_argument(
@@ -152,7 +174,8 @@ class BatchedSignatureRunner:
         if n == 0:
             raise ServingError.invalid_argument("empty batch")
         if n >= self._max_batch_size:
-            return self._run_oversized(arrays, output_filter, n)
+            return self.signature._slice_seq_outputs(
+                self._run_oversized(arrays, output_filter, n), true_seq)
         task = BatchTask(inputs=arrays, size=n,
                          output_filter=tuple(output_filter))
         self._scheduler.schedule(self._queue, task)
@@ -160,7 +183,10 @@ class BatchedSignatureRunner:
         if task.error is not None:
             raise task.error
         keys = list(output_filter) if output_filter else list(self.signature.outputs)
-        return {k: task.outputs[k] for k in keys}
+        result = {k: task.outputs[k] for k in keys}
+        # Slice seq-axis outputs back to THIS caller's true length (the
+        # batch may have executed at a larger co-batched bucket).
+        return self.signature._slice_seq_outputs(result, true_seq)
 
     def _run_oversized(self, arrays, output_filter, n):
         """Split a large request into max-size chunks run directly."""
@@ -179,10 +205,18 @@ class BatchedSignatureRunner:
         sizes = [t.size for t in batch]
         total = sum(sizes)
         merged = {}
+        sb = self.signature.sequence_bucketing
         with trace("batching/merge"):
             for alias in batch[0].inputs:
                 columns = [t.inputs[alias] for t in batch]
-                if self._pad_ragged:
+                if sb is not None and alias in sb.pad_values:
+                    # Tasks arrive at (different) allowed bucket lengths;
+                    # bridge to the batch max with the signature's OWN pad
+                    # value — a mask padded by pad_ragged's first-element
+                    # rule (1) would un-mask the padding.
+                    columns = pad_to_max(columns, sb.axis,
+                                         sb.pad_values[alias])
+                elif self._pad_ragged:
                     columns = pad_ragged(columns)
                 else:
                     shapes = {c.shape[1:] for c in columns}

@@ -120,12 +120,19 @@ def logits_fn(params: dict, config: BertConfig, input_ids, attention_mask,
 
 
 def build_signatures(params: dict, config: BertConfig, *, seq_len: int,
-                     class_labels: list[bytes] | None = None) -> dict:
+                     class_labels: list[bytes] | None = None,
+                     seq_buckets: tuple | list | None = None) -> dict:
     """The model family's serving surface:
 
       serving_default / predict: ids+mask -> logits, probabilities
       classify: Example path -> scores (+classes when labels given)
       regress:  Example path -> outputs (label-0 logit as the value)
+
+    With `seq_buckets`, the predict signature takes any sequence length
+    up to max(seq_buckets): requests round up to the nearest bucket, pad
+    ids with 0 and the mask with 0, and the attention-length masking makes
+    the padded positions invisible — classification outputs are exact (one
+    executable per batch x seq bucket; warmup primes the matrix).
     """
     from min_tfs_client_tpu.servables.servable import (
         CLASSIFY_METHOD_NAME,
@@ -133,6 +140,7 @@ def build_signatures(params: dict, config: BertConfig, *, seq_len: int,
         CLASSIFY_OUTPUT_SCORES,
         REGRESS_METHOD_NAME,
         REGRESS_OUTPUTS,
+        SequenceBucketing,
         Signature,
         TensorSpec,
     )
@@ -144,14 +152,27 @@ def build_signatures(params: dict, config: BertConfig, *, seq_len: int,
         return {"logits": logits,
                 "probabilities": jax.nn.softmax(logits, axis=-1)}
 
+    if seq_buckets:
+        predict_seq_dim = None
+        bucketing = SequenceBucketing(
+            buckets=tuple(seq_buckets),  # normalized by __post_init__
+            pad_values={"input_ids": 0, "attention_mask": 0})
+        # Example-path signatures keep a fixed decode width.
+        seq_len = seq_len or max(bucketing.buckets)
+    else:
+        predict_seq_dim = seq_len
+        bucketing = None
+
     predict_sig = Signature(
         fn=predict,
         params=params,
-        inputs={"input_ids": TensorSpec(np.int32, (None, seq_len)),
-                "attention_mask": TensorSpec(np.int32, (None, seq_len))},
+        inputs={"input_ids": TensorSpec(np.int32, (None, predict_seq_dim)),
+                "attention_mask": TensorSpec(np.int32,
+                                             (None, predict_seq_dim))},
         outputs={"logits": TensorSpec(np.float32, (None, config.num_labels)),
                  "probabilities": TensorSpec(np.float32,
                                              (None, config.num_labels))},
+        sequence_bucketing=bucketing,
     )
 
     feature_specs = {

@@ -83,6 +83,18 @@ def make_loader(
             # Compile buckets must be final BEFORE warmup, or warmup primes
             # shapes that will never serve.
             batching = apply_batch_buckets(servable, batching)
+        seq_buckets = config.get("seq_buckets")
+        if seq_buckets:
+            # PlatformConfigMap SequenceBucketing overrides the export's
+            # allowed lengths on signatures that bucket their seq axis.
+            import dataclasses
+
+            for sig in servable.signatures.values():
+                if getattr(sig, "sequence_bucketing", None) is not None:
+                    sig.sequence_bucketing = dataclasses.replace(
+                        sig.sequence_bucketing,
+                        buckets=tuple(seq_buckets))  # __post_init__ sorts
+                    sig._jitted = None
         # Warmup runs against the bare signatures, BEFORE the batching
         # wrapper: replaying through the batch queue would stall each record
         # up to batch_timeout (the reference replays directly against the

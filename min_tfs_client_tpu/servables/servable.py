@@ -66,6 +66,46 @@ class TensorSpec:
                     f"input {alias!r}: dim {i} expected {want}, got {got}")
 
 
+@dataclass(frozen=True)
+class SequenceBucketing:
+    """Sequence-length bucketing: the time-axis analogue of batch
+    buckets (SURVEY.md hard part (b); tpu_platform.proto
+    SequenceBucketing). XLA needs static shapes, so a request's sequence
+    dim rounds UP to the smallest allowed length and the jit cache holds
+    one executable per (batch bucket x seq bucket). Results stay exact
+    because padded positions carry mask/pad values the model already
+    ignores (attention lengths mask padded keys; CLS/pooling reads real
+    positions only)."""
+
+    buckets: tuple
+    # input alias -> pad scalar for the padded positions (ids -> pad id,
+    # attention masks -> 0). Inputs not listed don't have a seq axis.
+    pad_values: dict
+    # output alias -> axis holding the seq dim, sliced back after fetch.
+    output_seq_axes: dict = dc_field(default_factory=dict)
+    axis: int = 1
+
+    def __post_init__(self):
+        # round_up assumes ascending ints; normalize here so every
+        # constructor (exports, platform config, third-party build()
+        # modules) gets the same contract.
+        object.__setattr__(self, "buckets",
+                           tuple(sorted(int(b) for b in self.buckets)))
+        if not self.buckets:
+            raise ValueError("SequenceBucketing needs at least one bucket")
+
+    def round_up(self, length: int) -> int:
+        for bucket in self.buckets:
+            if bucket >= length:
+                return int(bucket)
+        # Over-max lengths are rejected, not compiled: each distinct
+        # length would JIT a fresh executable at serve time and grow the
+        # cache without bound.
+        raise ServingError.invalid_argument(
+            f"sequence length {length} exceeds the largest allowed "
+            f"bucket {self.buckets[-1]}")
+
+
 @dataclass
 class Signature:
     """One named entry point of a servable.
@@ -102,6 +142,8 @@ class Signature:
     # (f32 images -> bf16 convs), this halves host->HBM DMA bytes without
     # changing results — the cast happens once either side of the link.
     transfer_casts: Optional[dict[str, object]] = None
+    # Optional sequence-length bucketing (see SequenceBucketing).
+    sequence_bucketing: Optional[SequenceBucketing] = None
     # Optional jax.sharding.Mesh: formed batches are device_put with the
     # batch dim sharded over the mesh's "data" axis before execution
     # (TP'd params carry their own shardings; GSPMD emits the ICI
@@ -207,6 +249,7 @@ class Signature:
             self._check_produced(outputs, keys)
             return {k: np.asarray(outputs[k]) for k in keys}
 
+        true_seq = self._true_seq_len(arrays)
         outputs, batch = self._run_device(arrays)
         self._check_produced(outputs, keys)
         # Fetch ONLY the requested outputs (the executable computes them
@@ -215,7 +258,61 @@ class Signature:
         # sequential DMAs collapse to one round trip — on remote/tunneled
         # PJRT transports each synchronous fetch costs a full RTT, and even
         # locally the DMAs overlap.
-        return fetch_outputs({k: outputs[k] for k in keys}, batch)
+        result = fetch_outputs({k: outputs[k] for k in keys}, batch)
+        return self._slice_seq_outputs(result, true_seq)
+
+    def _true_seq_len(self, arrays: Mapping[str, np.ndarray]) -> Optional[int]:
+        sb = self.sequence_bucketing
+        if sb is None:
+            return None
+        for alias in sb.pad_values:
+            arr = arrays.get(alias)
+            if arr is not None and arr.ndim > sb.axis:
+                return arr.shape[sb.axis]
+        return None
+
+    def _slice_seq_outputs(self, result: dict[str, np.ndarray],
+                           true_seq: Optional[int]) -> dict[str, np.ndarray]:
+        sb = self.sequence_bucketing
+        if sb is None or true_seq is None:
+            return result
+        for alias, axis in sb.output_seq_axes.items():
+            arr = result.get(alias)
+            if arr is not None and arr.ndim > axis \
+                    and arr.shape[axis] != true_seq:
+                index = [slice(None)] * arr.ndim
+                index[axis] = slice(0, true_seq)
+                result[alias] = arr[tuple(index)]
+        return result
+
+    def _pad_seq(self, arrays: dict[str, np.ndarray]) -> dict:
+        sb = self.sequence_bucketing
+        if sb is None:
+            return arrays
+        true_seq = self._true_seq_len(arrays)
+        if true_seq is None:
+            return arrays
+        # Cross-input consistency FIRST: a mismatch must be
+        # INVALID_ARGUMENT whether or not padding happens.
+        for alias in sb.pad_values:
+            arr = arrays.get(alias)
+            if arr is not None and arr.ndim > sb.axis \
+                    and arr.shape[sb.axis] != true_seq:
+                raise ServingError.invalid_argument(
+                    f"input {alias!r}: inconsistent sequence dim "
+                    f"{arr.shape[sb.axis]} != {true_seq}")
+        padded_seq = sb.round_up(true_seq)
+        if padded_seq == true_seq:
+            return arrays
+        out = dict(arrays)
+        for alias, pad_value in sb.pad_values.items():
+            arr = out.get(alias)
+            if arr is None or arr.ndim <= sb.axis:
+                continue
+            widths = [(0, 0)] * arr.ndim
+            widths[sb.axis] = (0, padded_seq - true_seq)
+            out[alias] = np.pad(arr, widths, constant_values=pad_value)
+        return out
 
     def _check_produced(self, outputs, keys) -> None:
         for key in keys:
@@ -227,6 +324,7 @@ class Signature:
         self, arrays: dict[str, np.ndarray]
     ) -> tuple[dict[str, object], Optional[int]]:
         """Execute on device; returns (device outputs, true batch or None)."""
+        arrays = self._pad_seq(arrays)
         if not self.batched or not arrays:
             return self._execute(
                 self._place(self._cast_transfers(arrays))), None

@@ -93,18 +93,35 @@ def synthesize_warmup(servable: Servable) -> int:
     """No warmup file: prime each batched device signature's jit cache over
     its batch buckets with zero-filled inputs. Returns executions run."""
     runs = 0
+    seen: set[int] = set()
     for signature in servable.signatures.values():
         if signature.on_host or not signature.batched:
             continue
+        if id(signature) in seen:  # aliased keys share one Signature
+            continue
+        seen.add(id(signature))
+        # One executable per (batch bucket x seq bucket): prime the full
+        # compile matrix so steady state never compiles.
+        sb = signature.sequence_bucketing
+        seq_buckets = list(sb.buckets) if sb is not None else [None]
         for bucket in signature.batch_buckets:
-            inputs = {}
-            for alias, spec in signature.inputs.items():
-                dims = [bucket] + [d if d is not None else 1
-                                   for d in spec.shape[1:]]
-                if spec.dtype.is_string:
-                    inputs[alias] = np.full(dims, b"", dtype=object)
-                else:
-                    inputs[alias] = np.zeros(dims, spec.dtype.numpy_dtype)
-            signature.run(inputs)
-            runs += 1
+            for seq in seq_buckets:
+                inputs = {}
+                for alias, spec in signature.inputs.items():
+                    dims = [bucket]
+                    for axis, d in enumerate(spec.shape[1:], start=1):
+                        if d is not None:
+                            dims.append(d)
+                        elif (seq is not None and sb is not None
+                              and axis == sb.axis
+                              and alias in sb.pad_values):
+                            dims.append(seq)
+                        else:
+                            dims.append(1)
+                    if spec.dtype.is_string:
+                        inputs[alias] = np.full(dims, b"", dtype=object)
+                    else:
+                        inputs[alias] = np.zeros(dims, spec.dtype.numpy_dtype)
+                signature.run(inputs)
+                runs += 1
     return runs

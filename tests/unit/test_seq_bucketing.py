@@ -1,0 +1,185 @@
+"""Sequence-length bucketing (SURVEY hard part (b)): one executable per
+(batch bucket x seq bucket), exact results via attention masking."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from min_tfs_client_tpu.models import bert
+from min_tfs_client_tpu.servables.servable import (
+    SequenceBucketing,
+    Signature,
+    TensorSpec,
+)
+
+
+@pytest.fixture(scope="module")
+def tiny_bert():
+    config = bert.BertConfig.tiny()
+    params = bert.init_params(jax.random.PRNGKey(0), config)
+    return config, params
+
+
+def _request(config, batch, seq, seed=0):
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(1, config.vocab_size, (batch, seq)).astype(np.int32)
+    mask = np.ones((batch, seq), np.int32)
+    return ids, mask
+
+
+class TestSeqBucketing:
+    def test_bucketed_matches_exact_length(self, tiny_bert):
+        """Padding to the bucket must not change classification outputs:
+        padded keys are masked out of attention, CLS is position 0."""
+        config, params = tiny_bert
+        bucketed = bert.build_signatures(
+            params, config, seq_len=0, seq_buckets=(8, 16, 32))
+        for seq in (5, 8, 11, 32):
+            exact = bert.build_signatures(params, config, seq_len=seq)
+            ids, mask = _request(config, 2, seq, seed=seq)
+            got = bucketed["serving_default"].run(
+                {"input_ids": ids, "attention_mask": mask})
+            want = exact["serving_default"].run(
+                {"input_ids": ids, "attention_mask": mask})
+            np.testing.assert_allclose(got["probabilities"],
+                                       want["probabilities"],
+                                       rtol=1e-5, atol=1e-6)
+
+    def test_over_max_bucket_rejected(self, tiny_bert):
+        """Each over-max length would JIT a fresh executable at serve
+        time (unbounded cache growth): reject with INVALID_ARGUMENT."""
+        from min_tfs_client_tpu.utils.status import ServingError
+
+        config, params = tiny_bert
+        sigs = bert.build_signatures(params, config, seq_len=0,
+                                     seq_buckets=(8,))
+        ids, mask = _request(config, 2, 13)
+        with pytest.raises(ServingError, match="exceeds the largest"):
+            sigs["serving_default"].run(
+                {"input_ids": ids, "attention_mask": mask})
+
+    def test_unsorted_buckets_normalized(self):
+        sb = SequenceBucketing(buckets=(32, 8), pad_values={"ids": 0})
+        assert sb.buckets == (8, 32)
+        assert sb.round_up(5) == 8
+
+    def test_inconsistent_seq_dims_rejected_even_on_bucket(self, tiny_bert):
+        from min_tfs_client_tpu.utils.status import ServingError
+
+        config, params = tiny_bert
+        sigs = bert.build_signatures(params, config, seq_len=0,
+                                     seq_buckets=(8, 16))
+        ids, _ = _request(config, 2, 8)  # already a bucket length
+        mask = np.ones((2, 5), np.int32)
+        with pytest.raises(ServingError, match="inconsistent sequence"):
+            sigs["serving_default"].run(
+                {"input_ids": ids, "attention_mask": mask})
+
+    def test_mixed_lengths_through_batching_runner(self, tiny_bert):
+        """Co-batched callers at different lengths: the merge bridges
+        bucket gaps with the signature's pad values (mask padded 0), so
+        each caller's outputs equal its solo run."""
+        from min_tfs_client_tpu.batching.scheduler import (
+            SharedBatchScheduler,
+        )
+        from min_tfs_client_tpu.batching.session import (
+            BatchedSignatureRunner,
+        )
+
+        config, params = tiny_bert
+        sig = bert.build_signatures(
+            params, config, seq_len=0,
+            seq_buckets=(8, 16))["serving_default"]
+        solo5 = sig.run(dict(zip(("input_ids", "attention_mask"),
+                                 _request(config, 2, 5, seed=1))))
+        solo11 = sig.run(dict(zip(("input_ids", "attention_mask"),
+                                  _request(config, 2, 11, seed=2))))
+
+        sched = SharedBatchScheduler(num_threads=1)
+        try:
+            runner = BatchedSignatureRunner(
+                sig, sched, name="sb", max_batch_size=8,
+                batch_timeout_s=0.05)
+            import threading
+
+            results = {}
+
+            def call(key, seed, seq):
+                ids, mask = _request(config, 2, seq, seed=seed)
+                results[key] = runner.run(
+                    {"input_ids": ids, "attention_mask": mask})
+
+            threads = [threading.Thread(target=call, args=("a", 1, 5)),
+                       threading.Thread(target=call, args=("b", 2, 11))]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(10)
+            np.testing.assert_allclose(results["a"]["probabilities"],
+                                       solo5["probabilities"],
+                                       rtol=2e-2, atol=2e-3)
+            np.testing.assert_allclose(results["b"]["probabilities"],
+                                       solo11["probabilities"],
+                                       rtol=2e-2, atol=2e-3)
+        finally:
+            sched.stop()
+
+    def test_output_seq_axis_sliced_back(self):
+        def fn(inputs):
+            import jax.numpy as jnp
+
+            x = jnp.asarray(inputs["ids"]).astype(jnp.float32)
+            return {"emb": x[..., None] * 2}
+
+        sig = Signature(
+            fn=fn,
+            inputs={"ids": TensorSpec(np.int32, (None, None))},
+            outputs={"emb": TensorSpec(np.float32, (None, None, 1))},
+            batch_buckets=(2, 4),
+            sequence_bucketing=SequenceBucketing(
+                buckets=(8, 16), pad_values={"ids": 0},
+                output_seq_axes={"emb": 1}),
+        )
+        ids = np.arange(10, dtype=np.int32).reshape(2, 5)
+        out = sig.run({"ids": ids})
+        assert out["emb"].shape == (2, 5, 1)  # not (2, 8, 1)
+        np.testing.assert_allclose(out["emb"][..., 0], ids * 2.0)
+
+    def test_warmup_primes_compile_matrix(self, tiny_bert):
+        from min_tfs_client_tpu.servables.servable import Servable
+        from min_tfs_client_tpu.servables.warmup import synthesize_warmup
+
+        config, params = tiny_bert
+        sigs = bert.build_signatures(params, config, seq_len=0,
+                                     seq_buckets=(8, 16))
+        sig = sigs["serving_default"]
+        sig.batch_buckets = (1, 2)
+        servable = Servable("b", 1, {"serving_default": sig})
+        runs = synthesize_warmup(servable)
+        # serving_default/predict share the Signature object; classify and
+        # regress have fixed seq 0... count >= 2 batch x 2 seq for predict.
+        assert runs >= 4
+
+    def test_platform_config_overrides_buckets(self, tiny_bert, tmp_path):
+        from min_tfs_client_tpu.models import export
+        from min_tfs_client_tpu.servables import platforms
+
+        config, params = tiny_bert
+        base = tmp_path / "bert_sb"
+        export.export_servable(
+            base, 1, "bert",
+            {"vocab_size": config.vocab_size,
+             "hidden_size": config.hidden_size,
+             "num_layers": config.num_layers,
+             "num_heads": config.num_heads,
+             "intermediate_size": config.intermediate_size,
+             "max_position": config.max_position},
+            params, signature_kwargs={"seq_len": 0, "seq_buckets": [8, 16]})
+        loader = platforms.make_loader(
+            "jax", "bert_sb", 1, str(base / "1"),
+            {"seq_buckets": [4, 8], "enable_model_warmup": False})
+        loader.load()
+        sig = loader.servable().signature("")
+        assert sig.sequence_bucketing.buckets == (4, 8)
+        loader.unload()
