@@ -156,7 +156,11 @@ def build_signatures(params: dict, config: BertConfig, *, seq_len: int,
         predict_seq_dim = None
         bucketing = SequenceBucketing(
             buckets=tuple(seq_buckets),  # normalized by __post_init__
-            pad_values={"input_ids": 0, "attention_mask": 0})
+            pad_values={"input_ids": 0, "attention_mask": 0},
+            # Position embeddings bound every bucket: a longer bucket
+            # would clamp position gathers and silently corrupt outputs.
+            hard_max=config.max_position,
+            content_aliases=("input_ids",))
         # Example-path signatures keep a fixed decode width.
         seq_len = seq_len or max(bucketing.buckets)
     else:

@@ -23,6 +23,7 @@
 #include <cstdint>
 #include <cstring>
 #include <cstddef>
+#include <mutex>
 
 namespace {
 
@@ -30,10 +31,12 @@ namespace {
 // CRC32C (Castagnoli, polynomial 0x82f63b78), slice-by-8.
 
 uint32_t kCrcTable[8][256];
-bool table_init_done = false;
+// Table generation runs exactly once even under concurrent first calls
+// from gRPC worker threads (a plain bool flag here is a data race: a
+// second thread could read a half-built table).
+std::once_flag table_once;
 
-void InitTables() {
-  if (table_init_done) return;
+void InitTablesImpl() {
   for (uint32_t i = 0; i < 256; i++) {
     uint32_t crc = i;
     for (int j = 0; j < 8; j++) {
@@ -47,8 +50,9 @@ void InitTables() {
           (kCrcTable[t - 1][i] >> 8) ^ kCrcTable[0][kCrcTable[t - 1][i] & 0xff];
     }
   }
-  table_init_done = true;
 }
+
+void InitTables() { std::call_once(table_once, InitTablesImpl); }
 
 uint32_t Extend(uint32_t crc, const uint8_t* data, size_t n) {
   InitTables();

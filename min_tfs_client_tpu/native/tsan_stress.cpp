@@ -1,0 +1,93 @@
+// ThreadSanitizer stress harness for the native runtime library
+// (the TSAN CI tier SURVEY.md §5 prescribes; the reference relies on
+// clang thread-safety annotations + stress tests for the same purpose).
+//
+// Hammers every extern-C entry point from many threads at once —
+// including the cold-start path, where concurrent first calls race the
+// CRC table initialization if it is not once-guarded.
+//
+// Build: g++ -O1 -g -fsanitize=thread -pthread tsan_stress.cpp
+//            tpuserve.cpp -o tsan_stress && ./tsan_stress
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <thread>
+#include <unistd.h>
+#include <vector>
+
+extern "C" {
+uint32_t tpuserve_crc32c(const uint8_t* data, size_t n);
+uint32_t tpuserve_masked_crc32c(const uint8_t* data, size_t n);
+void tpuserve_frame_tfrecord(const uint8_t* data, uint64_t n,
+                             uint8_t* header, uint8_t* footer);
+long tpuserve_scan_tfrecords(const uint8_t* buf, size_t n,
+                             uint64_t* offsets, uint64_t* lengths,
+                             long max_records, int verify_crc);
+void tpuserve_pad_rows(const uint8_t* src, uint64_t rows,
+                       uint64_t row_bytes, uint8_t* dst,
+                       uint64_t total_rows);
+}
+
+int main() {
+  constexpr int kThreads = 16;
+  constexpr int kIters = 200;
+  std::vector<std::thread> threads;
+  std::vector<uint32_t> crcs(kThreads);
+
+  for (int t = 0; t < kThreads; t++) {
+    threads.emplace_back([t, &crcs] {
+      uint8_t payload[512];
+      for (size_t i = 0; i < sizeof(payload); i++) {
+        payload[i] = static_cast<uint8_t>(i * 31 + t);
+      }
+      uint8_t header[12], footer[4];
+      uint8_t record[12 + sizeof(payload) + 4];
+      uint8_t padded[8 * sizeof(payload)];
+      uint64_t offsets[4], lengths[4];
+      uint32_t acc = 0;
+      for (int i = 0; i < kIters; i++) {
+        // CHAIN the accumulator through the hash (never XOR of constant
+        // values, which cancels over an even iteration count and would
+        // make the final reproducibility check vacuous).
+        acc = tpuserve_crc32c(reinterpret_cast<const uint8_t*>(&acc), 4) ^
+              tpuserve_crc32c(payload, sizeof(payload));
+        acc ^= tpuserve_masked_crc32c(payload, sizeof(payload));
+        tpuserve_frame_tfrecord(payload, sizeof(payload), header, footer);
+        memcpy(record, header, 12);
+        memcpy(record + 12, payload, sizeof(payload));
+        memcpy(record + 12 + sizeof(payload), footer, 4);
+        long n = tpuserve_scan_tfrecords(record, sizeof(record), offsets,
+                                         lengths, 4, /*verify_crc=*/1);
+        if (n != 1 || lengths[0] != sizeof(payload)) {
+          fprintf(stderr, "scan_tfrecords failed: n=%ld\n", n);
+          _exit(1);
+        }
+        tpuserve_pad_rows(payload, 4, sizeof(payload) / 4, padded, 8);
+      }
+      crcs[t] = acc;
+    });
+  }
+  for (auto& t : threads) t.join();
+
+  // Every thread hashed different payloads, but thread 0's result must be
+  // reproducible against a fresh sequential run (tables fully built).
+  uint8_t payload[512];
+  for (size_t i = 0; i < sizeof(payload); i++) {
+    payload[i] = static_cast<uint8_t>(i * 31);
+  }
+  uint32_t expect = 0;
+  for (int i = 0; i < kIters; i++) {
+    expect = tpuserve_crc32c(reinterpret_cast<const uint8_t*>(&expect), 4) ^
+             tpuserve_crc32c(payload, sizeof(payload));
+    expect ^= tpuserve_masked_crc32c(payload, sizeof(payload));
+  }
+  if (crcs[0] != expect) {
+    fprintf(stderr, "concurrent CRC diverged: %08x != %08x\n", crcs[0],
+            expect);
+    return 1;
+  }
+  printf("tsan_stress: OK (%d threads x %d iters)\n", kThreads, kIters);
+  return 0;
+}

@@ -84,17 +84,30 @@ def make_loader(
             # shapes that will never serve.
             batching = apply_batch_buckets(servable, batching)
         seq_buckets = config.get("seq_buckets")
-        if seq_buckets:
+        seq_pad_value = config.get("seq_pad_value")
+        if seq_buckets or seq_pad_value is not None:
             # PlatformConfigMap SequenceBucketing overrides the export's
-            # allowed lengths on signatures that bucket their seq axis.
+            # allowed lengths and/or the content-token pad id on
+            # signatures that bucket their seq axis. hard_max survives the
+            # replace, so buckets beyond the model's supported length fail
+            # the LOAD here instead of corrupting outputs at serve time.
             import dataclasses
 
             for sig in servable.signatures.values():
-                if getattr(sig, "sequence_bucketing", None) is not None:
-                    sig.sequence_bucketing = dataclasses.replace(
-                        sig.sequence_bucketing,
-                        buckets=tuple(seq_buckets))  # __post_init__ sorts
-                    sig._jitted = None
+                sb = getattr(sig, "sequence_bucketing", None)
+                if sb is None:
+                    continue
+                changes: dict = {}
+                if seq_buckets:
+                    changes["buckets"] = tuple(seq_buckets)
+                if seq_pad_value is not None and sb.content_aliases:
+                    changes["pad_values"] = dict(
+                        sb.pad_values,
+                        **{alias: seq_pad_value
+                           for alias in sb.content_aliases
+                           if alias in sb.pad_values})
+                sig.sequence_bucketing = dataclasses.replace(sb, **changes)
+                sig._jitted = None
         # Warmup runs against the bare signatures, BEFORE the batching
         # wrapper: replaying through the batch queue would stall each record
         # up to batch_timeout (the reference replays directly against the

@@ -84,6 +84,15 @@ class SequenceBucketing:
     # output alias -> axis holding the seq dim, sliced back after fetch.
     output_seq_axes: dict = dc_field(default_factory=dict)
     axis: int = 1
+    # Model-imposed ceiling on any bucket (e.g. a position-embedding
+    # table's size). Survives dataclasses.replace, so a platform-config
+    # override cannot silently push buckets past what the model can
+    # actually embed.
+    hard_max: Optional[int] = None
+    # Aliases holding CONTENT tokens (ids): the platform config's
+    # SequenceBucketing.pad_value may override their pad scalar; mask-like
+    # aliases keep their structural pad (0) regardless.
+    content_aliases: tuple = ()
 
     def __post_init__(self):
         # round_up assumes ascending ints; normalize here so every
@@ -93,6 +102,10 @@ class SequenceBucketing:
                            tuple(sorted(int(b) for b in self.buckets)))
         if not self.buckets:
             raise ValueError("SequenceBucketing needs at least one bucket")
+        if self.hard_max is not None and self.buckets[-1] > self.hard_max:
+            raise ValueError(
+                f"sequence bucket {self.buckets[-1]} exceeds the model's "
+                f"maximum supported length {self.hard_max}")
 
     def round_up(self, length: int) -> int:
         for bucket in self.buckets:

@@ -341,6 +341,9 @@ def _parse_platform_config_file(path: str) -> dict[str, dict]:
             if tpu_config.HasField("sequence_bucketing"):
                 overrides["seq_buckets"] = list(
                     tpu_config.sequence_bucketing.allowed_lengths)
+                if tpu_config.sequence_bucketing.pad_value:
+                    overrides["seq_pad_value"] = int(
+                        tpu_config.sequence_bucketing.pad_value)
         elif any_config.type_url:
             raise ServingError.invalid_argument(
                 f"platform {platform!r}: unsupported source_adapter_config "

@@ -161,6 +161,61 @@ class TestSeqBucketing:
         # regress have fixed seq 0... count >= 2 batch x 2 seq for predict.
         assert runs >= 4
 
+    def test_buckets_beyond_position_table_rejected(self, tiny_bert):
+        """A bucket past max_position would clamp position gathers and
+        silently corrupt outputs — fail the BUILD instead."""
+        config, params = tiny_bert  # tiny: max_position=64
+        with pytest.raises(ValueError, match="maximum supported length"):
+            bert.build_signatures(params, config, seq_len=0,
+                                  seq_buckets=(8, 128))
+
+    def test_platform_override_respects_hard_max(self, tiny_bert, tmp_path):
+        from min_tfs_client_tpu.models import export
+        from min_tfs_client_tpu.servables import platforms
+        from min_tfs_client_tpu.utils.status import ServingError
+
+        config, params = tiny_bert
+        base = tmp_path / "bert_hm"
+        export.export_servable(
+            base, 1, "bert",
+            {"vocab_size": config.vocab_size,
+             "hidden_size": config.hidden_size,
+             "num_layers": config.num_layers,
+             "num_heads": config.num_heads,
+             "intermediate_size": config.intermediate_size,
+             "max_position": config.max_position},
+            params, signature_kwargs={"seq_len": 0, "seq_buckets": [8, 16]})
+        loader = platforms.make_loader(
+            "jax", "bert_hm", 1, str(base / "1"),
+            {"seq_buckets": [8, 128], "enable_model_warmup": False})
+        with pytest.raises((ServingError, ValueError)):
+            loader.load()
+
+    def test_platform_pad_value_overrides_content_only(self, tiny_bert,
+                                                       tmp_path):
+        from min_tfs_client_tpu.models import export
+        from min_tfs_client_tpu.servables import platforms
+
+        config, params = tiny_bert
+        base = tmp_path / "bert_pv"
+        export.export_servable(
+            base, 1, "bert",
+            {"vocab_size": config.vocab_size,
+             "hidden_size": config.hidden_size,
+             "num_layers": config.num_layers,
+             "num_heads": config.num_heads,
+             "intermediate_size": config.intermediate_size,
+             "max_position": config.max_position},
+            params, signature_kwargs={"seq_len": 0, "seq_buckets": [8, 16]})
+        loader = platforms.make_loader(
+            "jax", "bert_pv", 1, str(base / "1"),
+            {"seq_pad_value": 103, "enable_model_warmup": False})
+        loader.load()
+        sb = loader.servable().signature("").sequence_bucketing
+        assert sb.pad_values["input_ids"] == 103
+        assert sb.pad_values["attention_mask"] == 0  # mask stays masked
+        loader.unload()
+
     def test_platform_config_overrides_buckets(self, tiny_bert, tmp_path):
         from min_tfs_client_tpu.models import export
         from min_tfs_client_tpu.servables import platforms
