@@ -80,8 +80,14 @@ def init_params(rng: jax.Array, config: BertConfig) -> dict:
 
 def encode(params: dict, config: BertConfig, input_ids: jax.Array,
            attention_mask: jax.Array,
-           token_type_ids: jax.Array | None = None) -> jax.Array:
-    """(B, S) ids -> (B, S, H) contextual embeddings. Post-LN transformer."""
+           token_type_ids: jax.Array | None = None,
+           seq_mesh=None) -> jax.Array:
+    """(B, S) ids -> (B, S, H) contextual embeddings. Post-LN transformer.
+
+    With `seq_mesh` (a Mesh carrying a "seq" axis), every self-attention
+    runs sequence-parallel over the ICI ring (ring_attention) — the
+    long-context serving path for sequences whose scores would not fit
+    one chip."""
     b, s = input_ids.shape
     emb = params["embeddings"]
     x = nn.embed(emb["word"], input_ids)
@@ -94,7 +100,7 @@ def encode(params: dict, config: BertConfig, input_ids: jax.Array,
     lengths = nn.lengths_from_mask(attention_mask)
     for layer in params["layers"]:
         attn, _ = nn.mha(layer["attention"], x, num_heads=config.num_heads,
-                         lengths=lengths)
+                         lengths=lengths, seq_mesh=seq_mesh)
         x = nn.layer_norm(layer["attention_norm"], x + attn,
                           eps=config.layer_norm_eps)
         x = nn.layer_norm(layer["mlp_norm"], x + nn.mlp(layer["mlp"], x),
@@ -119,9 +125,64 @@ def logits_fn(params: dict, config: BertConfig, input_ids, attention_mask,
 # -- servable construction ---------------------------------------------------
 
 
+def build_long_context_signature(params: dict, config: BertConfig, *,
+                                 seq_len: int, mesh=None,
+                                 batch_buckets=(1, 2, 4)):
+    """Served long-context encoder: (B, seq_len) -> (B, seq_len, H)
+    embeddings with self-attention sharded on the mesh's "seq" axis
+    (ring attention over ICI; SURVEY §5 long-context row — capability the
+    reference lacks entirely). seq_len must be a multiple of the mesh's
+    seq axis size and within the model's max_position; falls back to
+    single-device attention when no multi-device mesh is available (same
+    numerics)."""
+    from min_tfs_client_tpu.parallel.mesh import SEQ_AXIS, make_mesh
+    from min_tfs_client_tpu.servables.servable import Signature, TensorSpec
+
+    if seq_len > config.max_position:
+        # Past the position table, gathers clamp and embeddings silently
+        # corrupt — same guard as SequenceBucketing.hard_max.
+        raise ValueError(
+            f"long_context seq_len {seq_len} exceeds the model's "
+            f"max_position {config.max_position}")
+    if mesh is None:
+        try:
+            mesh = make_mesh({SEQ_AXIS: -1})
+        except Exception:
+            mesh = None
+        if mesh is not None and dict(mesh.shape).get(SEQ_AXIS, 1) <= 1:
+            mesh = None
+    if mesh is not None:
+        n_seq = dict(mesh.shape).get(SEQ_AXIS)
+        if n_seq is None:
+            raise ValueError(
+                f"long-context mesh has no {SEQ_AXIS!r} axis "
+                f"(axes: {sorted(dict(mesh.shape))})")
+        if seq_len % n_seq:
+            raise ValueError(
+                f"long-context seq_len {seq_len} must be a multiple of "
+                f"the mesh's {SEQ_AXIS} axis size {n_seq}")
+
+    def encode_long(params, inputs):
+        ids = jnp.asarray(inputs["input_ids"], jnp.int32)
+        mask = jnp.asarray(inputs["attention_mask"], jnp.int32)
+        x = encode(params, config, ids, mask, seq_mesh=mesh)
+        return {"embeddings": x.astype(jnp.float32)}
+
+    return Signature(
+        fn=encode_long,
+        params=params,
+        inputs={"input_ids": TensorSpec(np.int32, (None, seq_len)),
+                "attention_mask": TensorSpec(np.int32, (None, seq_len))},
+        outputs={"embeddings": TensorSpec(
+            np.float32, (None, seq_len, config.hidden_size))},
+        batch_buckets=tuple(batch_buckets),
+    )
+
+
 def build_signatures(params: dict, config: BertConfig, *, seq_len: int,
                      class_labels: list[bytes] | None = None,
-                     seq_buckets: tuple | list | None = None) -> dict:
+                     seq_buckets: tuple | list | None = None,
+                     long_context_seq: int | None = None) -> dict:
     """The model family's serving surface:
 
       serving_default / predict: ids+mask -> logits, probabilities
@@ -219,5 +280,9 @@ def build_signatures(params: dict, config: BertConfig, *, seq_len: int,
         feature_specs=feature_specs,
     )
 
-    return {"serving_default": predict_sig, "predict": predict_sig,
-            "classify": classify_sig, "regress": regress_sig}
+    signatures = {"serving_default": predict_sig, "predict": predict_sig,
+                  "classify": classify_sig, "regress": regress_sig}
+    if long_context_seq:
+        signatures["encode_long"] = build_long_context_signature(
+            params, config, seq_len=long_context_seq)
+    return signatures

@@ -119,6 +119,7 @@ def mha(
     cache: Optional[dict] = None,
     cache_index: Optional[jax.Array] = None,
     scale: Optional[float] = None,
+    seq_mesh=None,
 ) -> tuple[jax.Array, Optional[dict]]:
     """Multi-head attention over x (self) or x->kv (cross).
 
@@ -156,6 +157,20 @@ def mha(
         else:
             causal = False  # decode: lengths masking subsumes causality
 
+    if seq_mesh is not None:
+        # Sequence-parallel exact attention: Q/K/V shard on the seq axis
+        # of `seq_mesh`, K/V rotate over the ICI ring (ring_attention).
+        # Unsupported together with caches/bias (decode uses caches; T5
+        # carries a bias) — long-context encoders are the target.
+        if cache is not None or bias is not None:
+            raise ValueError(
+                "seq_mesh attention does not combine with KV caches or "
+                "additive bias")
+        from min_tfs_client_tpu.parallel.ring_attention import ring_attention
+
+        out = ring_attention(q, k, v, mesh=seq_mesh, causal=causal,
+                             lengths=lengths, scale=scale)
+        return dense(params["out"], _unheads(out)), cache
     out = attention(q, k, v, causal=causal, lengths=lengths, bias=bias,
                     scale=scale, causal_offset=causal_offset)
     return dense(params["out"], _unheads(out)), cache

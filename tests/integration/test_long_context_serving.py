@@ -1,0 +1,100 @@
+"""Served long-context encoding: ring attention over the mesh seq axis,
+through the full serving stack (SURVEY §2.11 SP/CP row — beyond-reference
+capability). Runs on the 8-device virtual CPU mesh from conftest."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from min_tfs_client_tpu.models import bert
+from min_tfs_client_tpu.parallel.mesh import SEQ_AXIS, make_mesh
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    config = bert.BertConfig.tiny()
+    params = bert.init_params(jax.random.PRNGKey(0), config)
+    return config, params
+
+
+def _request(config, batch, seq, seed=3):
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(1, config.vocab_size, (batch, seq)).astype(np.int32)
+    mask = np.ones((batch, seq), np.int32)
+    mask[-1, seq // 2:] = 0  # one ragged example
+    return ids, mask
+
+
+class TestLongContextSignature:
+    def test_matches_single_device_encode(self, tiny):
+        config, params = tiny
+        seq = 64  # 8 tokens per device on the 8-way seq mesh
+        sig = bert.build_long_context_signature(
+            params, config, seq_len=seq,
+            mesh=make_mesh({SEQ_AXIS: -1}))
+        ids, mask = _request(config, 2, seq)
+        got = sig.run({"input_ids": ids, "attention_mask": mask})
+        want = np.asarray(bert.encode(
+            params, config, jnp.asarray(ids), jnp.asarray(mask)),
+            np.float32)
+        assert got["embeddings"].shape == (2, seq, config.hidden_size)
+        np.testing.assert_allclose(got["embeddings"], want,
+                                   rtol=5e-2, atol=5e-2)
+
+    def test_indivisible_seq_rejected(self, tiny):
+        config, params = tiny
+        mesh = make_mesh({SEQ_AXIS: -1})
+        n = dict(mesh.shape)[SEQ_AXIS]
+        with pytest.raises(ValueError,
+                           match=f"must be a multiple of .*{n}"):
+            bert.build_long_context_signature(
+                params, config, seq_len=n + 1, mesh=mesh)
+
+    def test_over_max_position_rejected(self, tiny):
+        config, params = tiny  # tiny: max_position=64
+        with pytest.raises(ValueError, match="exceeds the model's"):
+            bert.build_long_context_signature(params, config, seq_len=128)
+
+    def test_mesh_without_seq_axis_rejected(self, tiny):
+        config, params = tiny
+        from min_tfs_client_tpu.parallel.mesh import make_mesh as mm
+
+        with pytest.raises(ValueError, match="no 'seq' axis"):
+            bert.build_long_context_signature(
+                params, config, seq_len=64, mesh=mm({"data": -1}))
+
+    def test_served_over_the_wire(self, tiny, tmp_path):
+        from min_tfs_client_tpu.client import TensorServingClient
+        from min_tfs_client_tpu.client.inprocess import unregister_server
+        from min_tfs_client_tpu.models import export
+        from min_tfs_client_tpu.tensor.codec import tensor_proto_to_ndarray
+
+        config, params = tiny
+        seq = 64
+        base = tmp_path / "bert_long"
+        export.export_servable(
+            base, 1, "bert",
+            {"vocab_size": config.vocab_size,
+             "hidden_size": config.hidden_size,
+             "num_layers": config.num_layers,
+             "num_heads": config.num_heads,
+             "intermediate_size": config.intermediate_size,
+             "max_position": config.max_position},
+            params,
+            signature_kwargs={"seq_len": 16, "long_context_seq": seq})
+        client = TensorServingClient(f"tpu://{base}")
+        try:
+            ids, mask = _request(config, 2, seq)
+            resp = client.predict_request(
+                "bert_long", {"input_ids": ids, "attention_mask": mask},
+                signature_name="encode_long", timeout=300)
+            emb = tensor_proto_to_ndarray(resp.outputs["embeddings"])
+            want = np.asarray(bert.encode(
+                params, config, jnp.asarray(ids), jnp.asarray(mask)),
+                np.float32)
+            assert emb.shape == (2, seq, config.hidden_size)
+            np.testing.assert_allclose(emb, want, rtol=5e-2, atol=5e-2)
+        finally:
+            unregister_server(f"tpu://{base}")
