@@ -81,6 +81,17 @@ class BatchQueue:
                 self._open_size = 0
             self._batches[-1].append(task)
             self._open_size += task.size
+            self._report_depth_locked()
+
+    def _report_depth_locked(self) -> None:
+        """Publish under self._lock so depths cannot race out of order
+        and stick stale."""
+        try:
+            from min_tfs_client_tpu.server import metrics
+        except Exception:  # pragma: no cover
+            return
+        metrics.safe_set(metrics.batch_queue_depth, len(self._batches),
+                         self.name)
 
     def _pop_mature(self, now: float) -> Optional[list[BatchTask]]:
         with self._lock:
@@ -96,6 +107,7 @@ class BatchQueue:
                 self._batches.popleft()
                 if is_last_open:
                     self._open_size = 0
+                self._report_depth_locked()
                 return head
             return None
 
@@ -111,6 +123,7 @@ class BatchQueue:
             self.closed = True
             stranded = [t for b in self._batches for t in b]
             self._batches.clear()
+            self._report_depth_locked()  # never leave a stale nonzero gauge
             return stranded
 
 

@@ -203,6 +203,52 @@ class TensorServingClient:
         request.input.CopyFrom(self._coerce_input(input))
         return PredictionServiceStub(self._channel).MultiInference(request, timeout)
 
+    def decode_session(
+        self,
+        model_name: str,
+        input_ids: np.ndarray,
+        *,
+        max_steps: int,
+        session_id: Optional[bytes] = None,
+        timeout: int = 60,
+        model_version: Optional[int] = None,
+    ):
+        """Generator over per-session incremental decode: yields one
+        (B,) int32 token array per yielded step, driving the
+        decode_init / decode_step / decode_close signatures (the
+        repeated-Predict surface; KV cache stays in server HBM between
+        calls). Stops after `max_steps` or when every row finishes; the
+        session is closed on normal exhaustion, generator close, and
+        errors alike."""
+        import uuid
+
+        from min_tfs_client_tpu.tensor.codec import tensor_proto_to_ndarray
+
+        sid = np.asarray(session_id or uuid.uuid4().hex.encode(), object)
+        self.predict_request(
+            model_name, {"session_id": sid, "input_ids": input_ids},
+            timeout=timeout, model_version=model_version,
+            signature_name="decode_init")
+        try:
+            for _ in range(max_steps):
+                resp = self.predict_request(
+                    model_name, {"session_id": sid}, timeout=timeout,
+                    model_version=model_version,
+                    signature_name="decode_step")
+                token = tensor_proto_to_ndarray(resp.outputs["token"])
+                finished = tensor_proto_to_ndarray(resp.outputs["finished"])
+                yield token
+                if finished.all():
+                    break
+        finally:
+            try:
+                self.predict_request(
+                    model_name, {"session_id": sid}, timeout=timeout,
+                    model_version=model_version,
+                    signature_name="decode_close")
+            except grpc.RpcError:
+                pass  # already exhausted/expired server-side
+
     def reload_config_request(
         self,
         config: apis.ModelServerConfig,

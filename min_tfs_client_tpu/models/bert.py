@@ -144,7 +144,8 @@ def build_long_context_signature(params: dict, config: BertConfig, *,
         raise ValueError(
             f"long_context seq_len {seq_len} exceeds the model's "
             f"max_position {config.max_position}")
-    if mesh is None:
+    auto_mesh = mesh is None
+    if auto_mesh:
         try:
             mesh = make_mesh({SEQ_AXIS: -1})
         except Exception:
@@ -158,9 +159,15 @@ def build_long_context_signature(params: dict, config: BertConfig, *,
                 f"long-context mesh has no {SEQ_AXIS!r} axis "
                 f"(axes: {sorted(dict(mesh.shape))})")
         if seq_len % n_seq:
-            raise ValueError(
-                f"long-context seq_len {seq_len} must be a multiple of "
-                f"the mesh's {SEQ_AXIS} axis size {n_seq}")
+            if auto_mesh:
+                # Host device count is an environment property, not a
+                # model property: an export must stay loadable anywhere.
+                # Fall back to single-device attention (same numerics).
+                mesh = None
+            else:
+                raise ValueError(
+                    f"long-context seq_len {seq_len} must be a multiple "
+                    f"of the mesh's {SEQ_AXIS} axis size {n_seq}")
 
     def encode_long(params, inputs):
         ids = jnp.asarray(inputs["input_ids"], jnp.int32)

@@ -345,7 +345,7 @@ def build_session_signatures(params: dict, config: T5Config, *, seq_len: int,
     from min_tfs_client_tpu.utils.status import ServingError
 
     store = DecodeSessionStore(max_sessions=max_sessions,
-                               ttl_s=session_ttl_s)
+                               ttl_s=session_ttl_s, metric_label="t5")
     prefill_jit = jax.jit(
         lambda p, ids: prefill_state(p, config, ids,
                                      max_decode_len=max_decode_len))
@@ -414,5 +414,9 @@ def build_session_signatures(params: dict, config: T5Config, *, seq_len: int,
         outputs={"closed": TensorSpec(np.int32, ())},
         on_host=True, batched=False,
     )
+    # The loader re-labels the store's gauge with the real model:version
+    # (platforms.make_loader) — the family builder doesn't know it.
+    for sig in (init_sig, step_sig, close_sig):
+        sig._decode_store = store
     return {"decode_init": init_sig, "decode_step": step_sig,
             "decode_close": close_sig}

@@ -29,11 +29,30 @@ from min_tfs_client_tpu.utils.status import ServingError
 class DecodeSessionStore:
     """session id (bytes) -> opaque device-state pytree; TTL + capacity."""
 
-    def __init__(self, *, max_sessions: int = 64, ttl_s: float = 600.0):
+    def __init__(self, *, max_sessions: int = 64, ttl_s: float = 600.0,
+                 metric_label: str = "default"):
         self._lock = threading.Lock()
         self._states: dict[bytes, tuple[object, float]] = {}
         self._max = max_sessions
         self._ttl = ttl_s
+        self._metric_label = metric_label
+
+    def set_metric_label(self, label: str) -> None:
+        """Re-label the gauge cell (the loader knows the model name and
+        version; the family builder does not). Distinct stores must carry
+        distinct labels or they overwrite each other's cell."""
+        with self._lock:
+            self._metric_label = label
+            self._report()
+
+    def _report(self) -> None:
+        """Called under self._lock after every mutation."""
+        try:
+            from min_tfs_client_tpu.server import metrics
+        except Exception:  # pragma: no cover
+            return
+        metrics.safe_set(metrics.decode_session_count, len(self._states),
+                         self._metric_label)
 
     def __len__(self) -> int:
         with self._lock:
@@ -52,6 +71,7 @@ class DecodeSessionStore:
                     f"decode session capacity ({self._max}) reached; close "
                     "idle sessions or raise max_sessions")
             self._states[session_id] = (state, now)
+            self._report()
 
     def take(self, session_id: bytes) -> object:
         """Remove and return the state (the caller owns it until it puts
@@ -60,6 +80,7 @@ class DecodeSessionStore:
         with self._lock:
             self._sweep_locked(time.monotonic())
             entry = self._states.pop(session_id, None)
+            self._report()
         if entry is None:
             raise ServingError.not_found(
                 f"decode session {session_id!r} does not exist (never "
@@ -68,11 +89,14 @@ class DecodeSessionStore:
 
     def close(self, session_id: bytes) -> bool:
         with self._lock:
-            return self._states.pop(session_id, None) is not None
+            existed = self._states.pop(session_id, None) is not None
+            self._report()
+            return existed
 
     def clear(self) -> None:
         with self._lock:
             self._states.clear()
+            self._report()
 
     def _sweep_locked(self, now: float) -> None:
         """TTL sweep only: a session that stopped stepping frees its HBM
@@ -81,3 +105,5 @@ class DecodeSessionStore:
                    if now - t > self._ttl]
         for sid in expired:
             del self._states[sid]
+        if expired:
+            self._report()

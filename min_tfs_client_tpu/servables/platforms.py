@@ -61,6 +61,15 @@ def make_loader(
         servable.name = name
         servable.version = version
         config = platform_config or {}
+        # Decode-session stores report a per-model gauge; the family
+        # builder only knew its family name — re-label with the real
+        # model:version so two loaded models never share a gauge cell.
+        relabeled = set()
+        for sig in servable.signatures.values():
+            store = getattr(sig, "_decode_store", None)
+            if store is not None and id(store) not in relabeled:
+                relabeled.add(id(store))
+                store.set_metric_label(f"{name}:{version}")
         # Server-level mesh ("mesh_axes": {"data": -1, ...}): every batched
         # device signature serves data-parallel over it. Exports with their
         # own TP sharding config already attached a mesh at build; the

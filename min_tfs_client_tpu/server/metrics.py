@@ -119,6 +119,21 @@ model_load_latency = Histogram(
     ":tensorflow/serving/load_latency",
     "Servable load latency in microseconds.", ("model",),
     buckets=exponential_buckets(100, 2.0, 24))
+batch_queue_depth = Gauge(
+    ":tpu/serving/batch_queue_depth",
+    "Batches in the queue (including the open tail), by queue.", ("queue",))
+decode_session_count = Gauge(
+    ":tpu/serving/decode_session_count",
+    "Live incremental-decode sessions pinning HBM state.", ("model",))
+
+
+def safe_set(gauge: Gauge, value: float, *labels) -> None:
+    """Set a gauge without ever letting metrics break serving (the one
+    place the swallow-everything policy lives)."""
+    try:
+        gauge.set(value, *labels)
+    except Exception:  # pragma: no cover - metrics must not break serving
+        pass
 
 
 def _sanitize(name: str) -> str:

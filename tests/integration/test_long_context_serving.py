@@ -98,3 +98,20 @@ class TestLongContextSignature:
             np.testing.assert_allclose(emb, want, rtol=5e-2, atol=5e-2)
         finally:
             unregister_server(f"tpu://{base}")
+
+
+def test_auto_mesh_indivisible_falls_back_single_device(tiny):
+    """An export must load on ANY host: when the auto seq mesh does not
+    divide seq_len, fall back to single-device attention (exact same
+    numerics), never fail the load."""
+    import jax.numpy as jnp
+
+    config, params = tiny  # max_position=64; 8-device mesh; 60 % 8 == 4
+    sig = bert.build_long_context_signature(params, config, seq_len=60)
+    ids = np.random.default_rng(0).integers(
+        1, config.vocab_size, (2, 60)).astype(np.int32)
+    mask = np.ones((2, 60), np.int32)
+    got = sig.run({"input_ids": ids, "attention_mask": mask})
+    want = np.asarray(bert.encode(
+        params, config, jnp.asarray(ids), jnp.asarray(mask)), np.float32)
+    np.testing.assert_allclose(got["embeddings"], want, rtol=5e-2, atol=5e-2)

@@ -104,6 +104,46 @@ class TestSessionStore:
 
 
 class TestSessionDecodeOverWire:
+    def test_client_decode_session_helper(self, tiny, tmp_path):
+        """client.decode_session drives init/step/close and matches the
+        single-shot generation."""
+        config, params, sigs = tiny
+        from min_tfs_client_tpu.client import TensorServingClient
+        from min_tfs_client_tpu.client.inprocess import unregister_server
+        from min_tfs_client_tpu.models import export
+        from min_tfs_client_tpu.tensor.codec import tensor_proto_to_ndarray
+
+        base = tmp_path / "t5_gen"
+        export.export_servable(
+            base, 1, "t5",
+            {"vocab_size": config.vocab_size, "d_model": config.d_model,
+             "d_kv": config.d_kv, "num_heads": config.num_heads,
+             "d_ff": config.d_ff,
+             "num_encoder_layers": config.num_encoder_layers,
+             "num_decoder_layers": config.num_decoder_layers,
+             "rel_pos_buckets": config.rel_pos_buckets,
+             "rel_pos_max_distance": config.rel_pos_max_distance},
+            params, signature_kwargs={"seq_len": 12, "max_decode_len": 6})
+        client = TensorServingClient(f"tpu://{base}")
+        try:
+            ids = _ids(config)
+            whole = client.predict_request("t5_gen", {"input_ids": ids},
+                                           signature_name="decode")
+            want = tensor_proto_to_ndarray(whole.outputs["output_ids"])
+            tokens = list(client.decode_session("t5_gen", ids, max_steps=6))
+            got = np.stack(tokens, axis=1)
+            # the loader re-labeled the session gauge with model:version
+            from min_tfs_client_tpu.server import metrics
+
+            assert ("t5_gen:1",) in metrics.decode_session_count._cells
+            # decode_session may stop early once every row emits EOS/pad;
+            # compare the generated prefix.
+            np.testing.assert_array_equal(got, want[:, :got.shape[1]])
+            assert (got.shape[1] == 6
+                    or (want[:, got.shape[1]:] == config.pad_id).all())
+        finally:
+            unregister_server(f"tpu://{base}")
+
     def test_repeated_predict_through_tpu_scheme(self, tiny, tmp_path):
         """The full BASELINE-5 wire surface: repeated Predict() calls with
         the session id carried in the request tensors."""
