@@ -310,6 +310,13 @@ def _erf(node, inputs, lib):
     return [erf(jnp.asarray(inputs[0]))]
 
 
+def _erfc(node, inputs, lib):
+    import jax.numpy as jnp
+    from jax.scipy.special import erfc
+
+    return [erfc(jnp.asarray(inputs[0]))]
+
+
 def _select_v1(inputs, lib):
     # TF1 Select: a rank-1 condition of length batch selects whole rows of
     # higher-rank t/e (array_ops semantics SelectV2 dropped).
@@ -440,6 +447,7 @@ OPS: dict[str, Callable] = {
     "SelectV2": lambda n, i, lib: [lib.where(i[0], i[1], i[2])],
     # activations / math
     "Erf": _erf,
+    "Erfc": _erfc,
     "Softplus": lambda n, i, lib: [lib.logaddexp(i[0], 0)],
     "Elu": lambda n, i, lib: [lib.where(i[0] > 0, i[0],
                                         lib.exp(lib.minimum(i[0], 0)) - 1)],

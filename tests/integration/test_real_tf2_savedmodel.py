@@ -134,3 +134,57 @@ def test_real_tf2_export_through_server(tmp_path):
         np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
     finally:
         server.stop()
+
+
+TRANSFORMER_EXPORT = """
+import sys
+import numpy as np
+import tensorflow as tf
+
+tf.keras.utils.set_random_seed(7)
+
+# A genuine transformer block: Keras MultiHeadAttention (einsum-based),
+# residuals, LayerNormalization, gelu MLP — the op mix (Einsum,
+# BatchMatMul, Erf/approx-gelu, Rsqrt, StridedSlice...) of real
+# transformer SavedModels.
+seq, dm, heads = 10, 16, 4
+inp = tf.keras.layers.Input(shape=(seq, dm), dtype=tf.float32, name="x")
+attn = tf.keras.layers.MultiHeadAttention(
+    num_heads=heads, key_dim=dm // heads, name="mha")(inp, inp)
+h = tf.keras.layers.LayerNormalization(name="ln1")(inp + attn)
+ff = tf.keras.layers.Dense(32, activation="gelu", name="ff1")(h)
+ff = tf.keras.layers.Dense(dm, name="ff2")(ff)
+out = tf.keras.layers.LayerNormalization(name="ln2")(h + ff)
+pooled = tf.keras.layers.GlobalAveragePooling1D(name="pool")(out)
+logits = tf.keras.layers.Dense(3, name="head")(pooled)
+model = tf.keras.Model(inp, logits)
+
+x = np.random.default_rng(5).standard_normal((4, seq, dm)).astype(np.float32)
+np.save(sys.argv[2], x)
+np.save(sys.argv[3], model(x).numpy())
+
+@tf.function(input_signature=[
+    tf.TensorSpec([None, seq, dm], tf.float32, name="x")])
+def serve(x):
+    return {"logits": model(x)}
+
+tf.saved_model.save(model, sys.argv[1],
+                    signatures={"serving_default": serve})
+print("SAVED")
+"""
+
+
+@pytest.mark.integration
+def test_real_keras_transformer_export_serves(tmp_path):
+    """A real Keras MultiHeadAttention transformer block SavedModel
+    (einsum attention, layer norm, gelu) imports and matches TF's own
+    outputs — the op mix of production transformer exports."""
+    xp, yp = str(tmp_path / "x.npy"), str(tmp_path / "y.npy")
+    proc = _run_tf(TRANSFORMER_EXPORT, str(tmp_path / "1"), xp, yp)
+    if proc.returncode != 0 or "SAVED" not in proc.stdout:
+        pytest.skip(f"tensorflow/keras unavailable: {proc.stderr[-400:]}")
+    servable = load_saved_model(str(tmp_path / "1"), "transformer", 1)
+    x = np.load(xp)
+    want = np.load(yp)
+    got = servable.signature("").run({"x": x})
+    np.testing.assert_allclose(got["logits"], want, rtol=2e-4, atol=2e-5)

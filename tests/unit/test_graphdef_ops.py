@@ -187,6 +187,17 @@ def test_split_and_unpack_multi_output():
     np.testing.assert_allclose(u1, x[1])
 
 
+def test_erfc():
+    g = _graph()
+    _node(g, "x", "Placeholder", dtype=DT.DT_FLOAT)
+    _node(g, "e", "Erfc", ["x"])
+    x = np.array([[-1.5, 0.0, 0.7, 3.0]], np.float32)
+    (e,) = _run(g, ["x:0"], ["e:0"], [x])
+    import math
+    np.testing.assert_allclose(
+        e[0], [math.erfc(v) for v in x[0]], rtol=1e-5, atol=1e-6)
+
+
 def test_erf_softplus_logsoftmax():
     g = _graph()
     _node(g, "x", "Placeholder", dtype=DT.DT_FLOAT)
