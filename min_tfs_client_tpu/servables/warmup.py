@@ -33,6 +33,45 @@ def warmup_file(version_path) -> pathlib.Path:
     return pathlib.Path(version_path) / WARMUP_ASSET_DIR / WARMUP_FILENAME
 
 
+def write_warmup(version_path, logs) -> pathlib.Path:
+    """Write PredictionLog records into <version>/assets.extra/
+    tf_serving_warmup_requests (the operator-side half of the reference's
+    warmup story, g3doc/saved_model_warmup.md: export requests so loads
+    prime the compile cache). Accepts PredictionLog protos, request
+    protos (wrapped by their type), or raw bytes."""
+    logs = list(logs)
+    if len(logs) > MAX_WARMUP_RECORDS:
+        raise ServingError.invalid_argument(
+            f"{len(logs)} warmup records exceed the maximum "
+            f"({MAX_WARMUP_RECORDS})")
+    path = warmup_file(version_path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    tfrecord.write_records(path, [_to_record(log) for log in logs])
+    return path
+
+
+_REQUEST_LOG_FIELDS = {
+    "PredictRequest": "predict_log",
+    "ClassificationRequest": "classify_log",
+    "RegressionRequest": "regress_log",
+    "MultiInferenceRequest": "multi_inference_log",
+}
+
+
+def _to_record(log) -> bytes:
+    if isinstance(log, bytes):
+        return log
+    if isinstance(log, apis.PredictionLog):
+        return log.SerializeToString()
+    field = _REQUEST_LOG_FIELDS.get(type(log).__name__)
+    if field is None or not isinstance(log, getattr(apis, type(log).__name__)):
+        raise ServingError.invalid_argument(
+            f"cannot write a warmup record from {type(log).__name__}")
+    wrapper = apis.PredictionLog()
+    getattr(wrapper, field).request.CopyFrom(log)
+    return wrapper.SerializeToString()
+
+
 def run_warmup(servable: Servable, version_path,
                num_iterations: int = 1) -> int:
     """Replay the warmup log if present. Returns records replayed."""

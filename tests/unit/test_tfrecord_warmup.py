@@ -301,3 +301,49 @@ def test_session_runner_cache_bounded():
     for i in range(40):
         runner.run({"x": np.zeros(1, np.float32)}, [f"y{i}:0"])
     assert len(runner._cache) <= SessionRunner.MAX_CACHED_PLANS
+
+
+class TestWriteWarmup:
+    def test_write_then_replay_roundtrip(self, tmp_path):
+        """write_warmup (operator half) feeds run_warmup (load half)."""
+        import numpy as np
+
+        from min_tfs_client_tpu.protos import tfs_apis_pb2 as apis
+        from min_tfs_client_tpu.servables.servable import (
+            Servable,
+            Signature,
+            TensorSpec,
+        )
+        from min_tfs_client_tpu.servables.warmup import (
+            run_warmup,
+            write_warmup,
+        )
+        from min_tfs_client_tpu.tensor.codec import ndarray_to_tensor_proto
+
+        req = apis.PredictRequest()
+        req.model_spec.name = "m"
+        req.inputs["x"].CopyFrom(
+            ndarray_to_tensor_proto(np.ones((2, 3), np.float32)))
+        vdir = tmp_path / "1"
+        path = write_warmup(vdir, [req])  # bare request gets wrapped
+        assert path.is_file()
+
+        seen = []
+
+        def fn(inputs):
+            seen.append(np.asarray(inputs["x"]).shape)
+            return {"y": inputs["x"]}
+
+        servable = Servable("m", 1, {"serving_default": Signature(
+            fn=fn, inputs={"x": TensorSpec(np.float32, (None, 3))},
+            outputs={"y": TensorSpec(np.float32, (None, 3))},
+            on_host=True)})
+        assert run_warmup(servable, vdir) == 1
+        assert seen == [(2, 3)]
+
+    def test_unsupported_record_type_rejected(self, tmp_path):
+        from min_tfs_client_tpu.servables.warmup import write_warmup
+        from min_tfs_client_tpu.utils.status import ServingError
+
+        with pytest.raises(ServingError, match="cannot write"):
+            write_warmup(tmp_path / "1", [object()])
