@@ -71,7 +71,9 @@ def _probe_platform(deadline: float) -> str:
     "cpu" when init fails, errors, or hangs (round-1 failure mode)."""
     if os.environ.get("BENCH_PLATFORM"):
         return os.environ["BENCH_PLATFORM"]
-    timeout = min(100.0, max(20.0, _remaining(deadline) / 2))
+    # Healthy init + one matmul ≈ 25-40s; a wedged claim hangs forever, so
+    # every probe second past ~2x typical is stolen from the CPU fallback.
+    timeout = min(75.0, max(20.0, _remaining(deadline) / 2))
     try:
         res = subprocess.run(
             [sys.executable, "-c", _PROBE_CODE], capture_output=True,
