@@ -49,8 +49,9 @@ def _input_from_tensor_dict(input_dict: Mapping[str, np.ndarray]) -> apis.Input:
     arrays = {k: np.asarray(v) for k, v in input_dict.items()}
     sizes = {a.shape[0] if a.ndim else 1 for a in arrays.values()}
     if len(sizes) != 1:
+        shapes = {k: np.asarray(v).shape for k, v in input_dict.items()}
         raise ValueError(
-            f"inconsistent leading (example) dimensions: { {k: np.asarray(v).shape for k, v in input_dict.items()} }")
+            f"inconsistent leading (example) dimensions: {shapes}")
     n = sizes.pop()
     examples = [
         {k: (a[i] if a.ndim else a) for k, a in arrays.items()} for i in range(n)

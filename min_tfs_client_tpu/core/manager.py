@@ -142,7 +142,9 @@ class AspiredVersionsManager:
                 existing = streams.get(version)
                 if existing is not None and existing.state not in (
                         HarnessState.DISABLED, HarnessState.ERROR):
-                    continue  # already tracked (or re-aspired after error: keep error visible)
+                    # already tracked (or re-aspired after error: keep
+                    # the error visible)
+                    continue
                 if existing is not None and existing.state == HarnessState.ERROR:
                     continue  # do not silently retry an errored version
                 streams[version] = LoaderHarness(

@@ -21,12 +21,16 @@ SERVICE_SCHEMAS = {
         "Classify": (apis.ClassificationRequest, apis.ClassificationResponse),
         "Regress": (apis.RegressionRequest, apis.RegressionResponse),
         "Predict": (apis.PredictRequest, apis.PredictResponse),
-        "MultiInference": (apis.MultiInferenceRequest, apis.MultiInferenceResponse),
-        "GetModelMetadata": (apis.GetModelMetadataRequest, apis.GetModelMetadataResponse),
+        "MultiInference": (apis.MultiInferenceRequest,
+                           apis.MultiInferenceResponse),
+        "GetModelMetadata": (apis.GetModelMetadataRequest,
+                             apis.GetModelMetadataResponse),
     },
     "ModelService": {
-        "GetModelStatus": (apis.GetModelStatusRequest, apis.GetModelStatusResponse),
-        "HandleReloadConfigRequest": (apis.ReloadConfigRequest, apis.ReloadConfigResponse),
+        "GetModelStatus": (apis.GetModelStatusRequest,
+                           apis.GetModelStatusResponse),
+        "HandleReloadConfigRequest": (apis.ReloadConfigRequest,
+                                      apis.ReloadConfigResponse),
     },
     "SessionService": {
         "SessionRun": (apis.SessionRunRequest, apis.SessionRunResponse),
@@ -81,17 +85,26 @@ def _make_registrar(service: str, methods: dict, pkg: str = _PKG):
     return add_to_server
 
 
-PredictionServiceStub = _make_stub_class("PredictionService", SERVICE_SCHEMAS["PredictionService"])
-ModelServiceStub = _make_stub_class("ModelService", SERVICE_SCHEMAS["ModelService"])
-SessionServiceStub = _make_stub_class("SessionService", SERVICE_SCHEMAS["SessionService"])
+PredictionServiceStub = _make_stub_class(
+    "PredictionService", SERVICE_SCHEMAS["PredictionService"])
+ModelServiceStub = _make_stub_class(
+    "ModelService", SERVICE_SCHEMAS["ModelService"])
+SessionServiceStub = _make_stub_class(
+    "SessionService", SERVICE_SCHEMAS["SessionService"])
 
-PredictionServiceServicer = _make_servicer_class("PredictionService", SERVICE_SCHEMAS["PredictionService"])
-ModelServiceServicer = _make_servicer_class("ModelService", SERVICE_SCHEMAS["ModelService"])
-SessionServiceServicer = _make_servicer_class("SessionService", SERVICE_SCHEMAS["SessionService"])
+PredictionServiceServicer = _make_servicer_class(
+    "PredictionService", SERVICE_SCHEMAS["PredictionService"])
+ModelServiceServicer = _make_servicer_class(
+    "ModelService", SERVICE_SCHEMAS["ModelService"])
+SessionServiceServicer = _make_servicer_class(
+    "SessionService", SERVICE_SCHEMAS["SessionService"])
 
-add_PredictionServiceServicer_to_server = _make_registrar("PredictionService", SERVICE_SCHEMAS["PredictionService"])
-add_ModelServiceServicer_to_server = _make_registrar("ModelService", SERVICE_SCHEMAS["ModelService"])
-add_SessionServiceServicer_to_server = _make_registrar("SessionService", SERVICE_SCHEMAS["SessionService"])
+add_PredictionServiceServicer_to_server = _make_registrar(
+    "PredictionService", SERVICE_SCHEMAS["PredictionService"])
+add_ModelServiceServicer_to_server = _make_registrar(
+    "ModelService", SERVICE_SCHEMAS["ModelService"])
+add_SessionServiceServicer_to_server = _make_registrar(
+    "SessionService", SERVICE_SCHEMAS["SessionService"])
 
 
 # -- ProfilerService (package tensorflow, not tensorflow.serving) ------------

@@ -82,7 +82,8 @@ def _array_to_json(arr: np.ndarray):
     return arr.tolist()
 
 
-def build_predict_request(body: dict, spec_match: re.Match) -> tuple[apis.PredictRequest, bool]:
+def build_predict_request(
+        body: dict, spec_match: re.Match) -> tuple[apis.PredictRequest, bool]:
     request = apis.PredictRequest()
     _fill_spec(request.model_spec, spec_match)
     if "signature_name" in body:

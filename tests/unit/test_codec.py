@@ -40,8 +40,9 @@ def test_numeric_roundtrip(dtype, use_content):
     back = tensor_proto_to_ndarray(proto)
     assert back.dtype == np.dtype(dtype)
     assert back.shape == (3, 4)
-    np.testing.assert_array_equal(np.asarray(back, np.float64) if dtype is ml_dtypes.bfloat16 else back,
-                                  np.asarray(arr, np.float64) if dtype is ml_dtypes.bfloat16 else arr)
+    widen = (lambda a: np.asarray(a, np.float64)) \
+        if dtype is ml_dtypes.bfloat16 else (lambda a: a)
+    np.testing.assert_array_equal(widen(back), widen(arr))
     if use_content:
         assert proto.tensor_content
     else:
