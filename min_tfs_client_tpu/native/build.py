@@ -13,24 +13,35 @@ import subprocess
 NATIVE_DIR = pathlib.Path(__file__).resolve().parent
 SO_PATH = NATIVE_DIR / "libtpuserve.so"
 SRC = NATIVE_DIR / "tpuserve.cpp"
+HTTP_SO_PATH = NATIVE_DIR / "libtpunethttp.so"
+HTTP_SRC = NATIVE_DIR / "net_http.cpp"
 
 
-def build(force: bool = False) -> pathlib.Path | None:
-    if SO_PATH.exists() and not force and \
-            SO_PATH.stat().st_mtime >= SRC.stat().st_mtime:
-        return SO_PATH
+def _compile(src: pathlib.Path, out: pathlib.Path,
+             extra: list[str], force: bool) -> pathlib.Path | None:
+    if out.exists() and not force and \
+            out.stat().st_mtime >= src.stat().st_mtime:
+        return out
     cxx = shutil.which("g++") or shutil.which("c++") or shutil.which("clang++")
     if cxx is None:
         return None
     cmd = [cxx, "-O3", "-shared", "-fPIC", "-std=c++17",
-           "-o", str(SO_PATH), str(SRC)]
+           "-o", str(out), str(src)] + extra
     try:
         subprocess.run(cmd, check=True, capture_output=True)
     except subprocess.CalledProcessError:
         return None
-    return SO_PATH
+    return out
+
+
+def build(force: bool = False) -> pathlib.Path | None:
+    return _compile(SRC, SO_PATH, [], force)
+
+
+def build_http(force: bool = False) -> pathlib.Path | None:
+    return _compile(HTTP_SRC, HTTP_SO_PATH, ["-lz", "-lpthread"], force)
 
 
 if __name__ == "__main__":
-    path = build(force=True)
-    print(f"built: {path}")
+    print(f"built: {build(force=True)}")
+    print(f"built: {build_http(force=True)}")

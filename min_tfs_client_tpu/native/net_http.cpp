@@ -1,0 +1,748 @@
+// net_http — epoll-based HTTP/1.1 front-end for the TPU model server.
+//
+// TPU-native counterpart of the reference's libevent-backed net_http stack
+// (tensorflow_serving/util/net_http/server/internal/evhttp_server.cc,
+// evhttp_request.cc): a non-blocking event loop owns all sockets; complete
+// requests are handed to a worker pool which invokes the registered handler
+// (the Python REST router via ctypes); responses flow back to the event
+// loop over an eventfd. Keep-alive, chunked request bodies, gzip in both
+// directions (evhttp_request.cc gzip support), idle timeouts, and header /
+// body size limits are handled here in C so the Python layer only ever
+// sees one plain (method, uri, body) triple per request.
+//
+// C ABI (ctypes, see server/native_http.py):
+//   tpuhttp_start(host, port, num_workers, timeout_ms, handler, user)
+//   tpuhttp_port(server)                 -> bound port (0 -> ephemeral)
+//   tpuhttp_send_response(req, status, content_type, body, len)
+//   tpuhttp_stop(server)
+//
+// The handler MUST call tpuhttp_send_response exactly once per request,
+// before returning (synchronous completion); a handler that returns
+// without responding produces a 500.
+
+#include <arpa/inet.h>
+#include <errno.h>
+#include <fcntl.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <signal.h>
+#include <stdint.h>
+#include <string.h>
+#include <sys/epoll.h>
+#include <sys/eventfd.h>
+#include <sys/socket.h>
+#include <unistd.h>
+#include <zlib.h>
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <deque>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+constexpr size_t kMaxHeaderBytes = 64 * 1024;
+constexpr size_t kMaxBodyBytes = 256ull * 1024 * 1024;
+constexpr size_t kGzipMinBytes = 1024;  // compress responses >= 1 KiB
+
+// ---------------------------------------------------------------- gzip --
+
+bool GzipInflate(const std::string& in, std::string* out) {
+  z_stream zs;
+  memset(&zs, 0, sizeof(zs));
+  // 16+15: gzip framing with max window.
+  if (inflateInit2(&zs, 16 + 15) != Z_OK) return false;
+  zs.next_in = reinterpret_cast<Bytef*>(const_cast<char*>(in.data()));
+  zs.avail_in = static_cast<uInt>(in.size());
+  char buf[64 * 1024];
+  int rc = Z_OK;
+  while (rc != Z_STREAM_END) {
+    zs.next_out = reinterpret_cast<Bytef*>(buf);
+    zs.avail_out = sizeof(buf);
+    rc = inflate(&zs, Z_NO_FLUSH);
+    if (rc != Z_OK && rc != Z_STREAM_END) {
+      inflateEnd(&zs);
+      return false;
+    }
+    out->append(buf, sizeof(buf) - zs.avail_out);
+    if (out->size() > kMaxBodyBytes) {
+      inflateEnd(&zs);
+      return false;
+    }
+    if (rc == Z_OK && zs.avail_in == 0 && zs.avail_out != 0) {
+      inflateEnd(&zs);
+      return false;  // truncated stream
+    }
+  }
+  inflateEnd(&zs);
+  return true;
+}
+
+bool GzipDeflate(const std::string& in, std::string* out) {
+  z_stream zs;
+  memset(&zs, 0, sizeof(zs));
+  if (deflateInit2(&zs, 5, Z_DEFLATED, 16 + 15, 8,
+                   Z_DEFAULT_STRATEGY) != Z_OK)
+    return false;
+  zs.next_in = reinterpret_cast<Bytef*>(const_cast<char*>(in.data()));
+  zs.avail_in = static_cast<uInt>(in.size());
+  char buf[64 * 1024];
+  int rc = Z_OK;
+  do {
+    zs.next_out = reinterpret_cast<Bytef*>(buf);
+    zs.avail_out = sizeof(buf);
+    rc = deflate(&zs, Z_FINISH);
+    if (rc == Z_STREAM_ERROR) {
+      deflateEnd(&zs);
+      return false;
+    }
+    out->append(buf, sizeof(buf) - zs.avail_out);
+  } while (rc != Z_STREAM_END);
+  deflateEnd(&zs);
+  return true;
+}
+
+// ------------------------------------------------------------- parsing --
+
+std::string LowerCopy(const std::string& s) {
+  std::string r = s;
+  for (char& c : r) c = static_cast<char>(tolower(static_cast<unsigned>(c)));
+  return r;
+}
+
+struct ParsedRequest {
+  std::string method;
+  std::string uri;
+  std::string version;  // "HTTP/1.1"
+  std::unordered_map<std::string, std::string> headers;  // lower-cased keys
+  std::string body;
+  bool keep_alive = true;
+
+  const std::string* Header(const char* name) const {
+    auto it = headers.find(name);
+    return it == headers.end() ? nullptr : &it->second;
+  }
+};
+
+enum class ParseState { kHeaders, kBody, kChunkSize, kChunkData, kTrailers };
+
+// Incremental HTTP/1.1 parser over a connection's read buffer. Returns
+// +1 when a full request is ready, 0 when more bytes are needed, -N for a
+// protocol error where N is the HTTP status to respond with.
+struct RequestParser {
+  ParseState state = ParseState::kHeaders;
+  ParsedRequest req;
+  size_t content_length = 0;
+  size_t chunk_remaining = 0;
+
+  void Reset() {
+    state = ParseState::kHeaders;
+    req = ParsedRequest();
+    content_length = 0;
+    chunk_remaining = 0;
+  }
+
+  int Feed(std::string* buf) {
+    for (;;) {
+      switch (state) {
+        case ParseState::kHeaders: {
+          size_t end = buf->find("\r\n\r\n");
+          if (end == std::string::npos)
+            return buf->size() > kMaxHeaderBytes ? -431 : 0;
+          if (end > kMaxHeaderBytes) return -431;
+          if (!ParseHeaderBlock(buf->substr(0, end))) return -400;
+          buf->erase(0, end + 4);
+          const std::string* te = req.Header("transfer-encoding");
+          if (te != nullptr && LowerCopy(*te).find("chunked") !=
+                                   std::string::npos) {
+            state = ParseState::kChunkSize;
+            continue;
+          }
+          const std::string* cl = req.Header("content-length");
+          if (cl != nullptr) {
+            errno = 0;
+            char* endp = nullptr;
+            unsigned long long v = strtoull(cl->c_str(), &endp, 10);
+            if (errno != 0 || endp == cl->c_str() || *endp != '\0')
+              return -400;
+            if (v > kMaxBodyBytes) return -413;
+            content_length = static_cast<size_t>(v);
+          }
+          if (content_length == 0) return 1;
+          state = ParseState::kBody;
+          continue;
+        }
+        case ParseState::kBody: {
+          size_t want = content_length - req.body.size();
+          size_t take = buf->size() < want ? buf->size() : want;
+          req.body.append(*buf, 0, take);
+          buf->erase(0, take);
+          if (req.body.size() == content_length) return 1;
+          return 0;
+        }
+        case ParseState::kChunkSize: {
+          size_t eol = buf->find("\r\n");
+          if (eol == std::string::npos) return buf->size() > 1024 ? -400 : 0;
+          errno = 0;
+          char* endp = nullptr;
+          // Chunk extensions (";...") are legal; strtoull stops at ';'.
+          unsigned long long v = strtoull(buf->c_str(), &endp, 16);
+          if (errno != 0 || endp == buf->c_str()) return -400;
+          buf->erase(0, eol + 2);
+          if (req.body.size() + v > kMaxBodyBytes) return -413;
+          if (v == 0) {
+            state = ParseState::kTrailers;
+            continue;
+          }
+          chunk_remaining = static_cast<size_t>(v);
+          state = ParseState::kChunkData;
+          continue;
+        }
+        case ParseState::kChunkData: {
+          if (chunk_remaining > 0) {
+            size_t take =
+                buf->size() < chunk_remaining ? buf->size() : chunk_remaining;
+            req.body.append(*buf, 0, take);
+            buf->erase(0, take);
+            chunk_remaining -= take;
+            if (chunk_remaining > 0) return 0;
+          }
+          if (buf->size() < 2) return 0;
+          if (buf->compare(0, 2, "\r\n") != 0) return -400;
+          buf->erase(0, 2);
+          state = ParseState::kChunkSize;
+          continue;
+        }
+        case ParseState::kTrailers: {
+          // Trailers end with an empty line; we accept and discard them.
+          size_t eol = buf->find("\r\n");
+          if (eol == std::string::npos)
+            return buf->size() > kMaxHeaderBytes ? -431 : 0;
+          bool empty = (eol == 0);
+          buf->erase(0, eol + 2);
+          if (empty) return 1;
+          continue;
+        }
+      }
+    }
+  }
+
+ private:
+  bool ParseHeaderBlock(const std::string& block) {
+    size_t line_end = block.find("\r\n");
+    std::string request_line =
+        line_end == std::string::npos ? block : block.substr(0, line_end);
+    size_t sp1 = request_line.find(' ');
+    size_t sp2 =
+        sp1 == std::string::npos ? std::string::npos
+                                 : request_line.find(' ', sp1 + 1);
+    if (sp1 == std::string::npos || sp2 == std::string::npos) return false;
+    req.method = request_line.substr(0, sp1);
+    req.uri = request_line.substr(sp1 + 1, sp2 - sp1 - 1);
+    req.version = request_line.substr(sp2 + 1);
+    if (req.version != "HTTP/1.1" && req.version != "HTTP/1.0") return false;
+    size_t pos = line_end == std::string::npos ? block.size() : line_end + 2;
+    while (pos < block.size()) {
+      size_t eol = block.find("\r\n", pos);
+      if (eol == std::string::npos) eol = block.size();
+      size_t colon = block.find(':', pos);
+      if (colon == std::string::npos || colon > eol) return false;
+      std::string key = LowerCopy(block.substr(pos, colon - pos));
+      size_t vstart = colon + 1;
+      while (vstart < eol && (block[vstart] == ' ' || block[vstart] == '\t'))
+        ++vstart;
+      size_t vend = eol;
+      while (vend > vstart &&
+             (block[vend - 1] == ' ' || block[vend - 1] == '\t'))
+        --vend;
+      req.headers[key] = block.substr(vstart, vend - vstart);
+      pos = eol + 2;
+    }
+    req.keep_alive = (req.version == "HTTP/1.1");
+    const std::string* conn = req.Header("connection");
+    if (conn != nullptr) {
+      std::string c = LowerCopy(*conn);
+      if (c.find("close") != std::string::npos) req.keep_alive = false;
+      if (c.find("keep-alive") != std::string::npos) req.keep_alive = true;
+    }
+    return true;
+  }
+};
+
+// --------------------------------------------------------------- server --
+
+typedef void (*tpuhttp_handler_fn)(void* user, void* req, const char* method,
+                                   const char* uri, const char* body,
+                                   uint64_t body_len);
+
+struct Server;
+
+// A request in flight between the event loop, a worker, and the handler.
+struct Request {
+  Server* server = nullptr;
+  uint64_t conn_id = 0;
+  ParsedRequest parsed;
+  bool accepts_gzip = false;
+  bool keep_alive = true;
+  std::atomic<bool> responded{false};
+};
+
+struct Conn {
+  int fd = -1;
+  uint64_t id = 0;
+  std::string rbuf;
+  std::string wbuf;
+  size_t woff = 0;
+  RequestParser parser;
+  bool busy = false;        // a request from this conn is with a worker
+  bool close_after = false;  // close once wbuf drains
+  std::chrono::steady_clock::time_point last_activity;
+};
+
+struct Response {
+  uint64_t conn_id;
+  std::string bytes;
+  bool keep_alive;
+};
+
+struct Server {
+  int listen_fd = -1;
+  int epoll_fd = -1;
+  int wake_fd = -1;  // eventfd: response ready or stop requested
+  int port = 0;
+  int timeout_ms = 30000;
+  tpuhttp_handler_fn handler = nullptr;
+  void* user = nullptr;
+
+  std::thread loop_thread;
+  std::vector<std::thread> workers;
+  std::atomic<bool> stopping{false};
+
+  std::mutex work_mu;
+  std::condition_variable work_cv;
+  std::deque<Request*> work_queue;
+
+  std::mutex resp_mu;
+  std::deque<Response> resp_queue;
+
+  std::unordered_map<uint64_t, Conn*> conns;
+  uint64_t next_conn_id = 1;
+};
+
+void SetNonBlocking(int fd) {
+  int flags = fcntl(fd, F_GETFL, 0);
+  fcntl(fd, F_SETFL, flags | O_NONBLOCK);
+}
+
+const char* StatusText(int code) {
+  switch (code) {
+    case 200: return "OK";
+    case 400: return "Bad Request";
+    case 404: return "Not Found";
+    case 408: return "Request Timeout";
+    case 413: return "Payload Too Large";
+    case 431: return "Request Header Fields Too Large";
+    case 500: return "Internal Server Error";
+    case 501: return "Not Implemented";
+    case 503: return "Service Unavailable";
+    case 504: return "Gateway Timeout";
+    default: return "";
+  }
+}
+
+std::string BuildResponseBytes(int status, const std::string& content_type,
+                               const std::string& body, bool gzip_ok,
+                               bool keep_alive) {
+  std::string out_body;
+  bool gzipped = false;
+  if (gzip_ok && body.size() >= kGzipMinBytes) {
+    std::string z;
+    if (GzipDeflate(body, &z) && z.size() < body.size()) {
+      out_body.swap(z);
+      gzipped = true;
+    }
+  }
+  if (!gzipped) out_body = body;
+  std::string head;
+  head.reserve(256);
+  head += "HTTP/1.1 ";
+  head += std::to_string(status);
+  head += " ";
+  head += StatusText(status);
+  head += "\r\nContent-Type: ";
+  head += content_type.empty() ? "application/octet-stream" : content_type;
+  head += "\r\nContent-Length: ";
+  head += std::to_string(out_body.size());
+  if (gzipped) head += "\r\nContent-Encoding: gzip";
+  head += keep_alive ? "\r\nConnection: keep-alive"
+                     : "\r\nConnection: close";
+  head += "\r\n\r\n";
+  head += out_body;
+  return head;
+}
+
+void EnqueueResponse(Server* s, uint64_t conn_id, std::string bytes,
+                     bool keep_alive) {
+  {
+    std::lock_guard<std::mutex> lk(s->resp_mu);
+    s->resp_queue.push_back(Response{conn_id, std::move(bytes), keep_alive});
+  }
+  uint64_t one = 1;
+  ssize_t rc = write(s->wake_fd, &one, sizeof(one));
+  (void)rc;
+}
+
+void WorkerMain(Server* s) {
+  for (;;) {
+    Request* req = nullptr;
+    {
+      std::unique_lock<std::mutex> lk(s->work_mu);
+      s->work_cv.wait(lk, [s] {
+        return s->stopping.load() || !s->work_queue.empty();
+      });
+      if (s->stopping.load() && s->work_queue.empty()) return;
+      req = s->work_queue.front();
+      s->work_queue.pop_front();
+    }
+    // Inflate gzip request bodies here so the handler sees plain bytes.
+    const std::string* enc = req->parsed.Header("content-encoding");
+    if (enc != nullptr && LowerCopy(*enc).find("gzip") != std::string::npos) {
+      std::string plain;
+      if (GzipInflate(req->parsed.body, &plain)) {
+        req->parsed.body.swap(plain);
+      } else {
+        std::string msg =
+            "{\"error\": \"body declared Content-Encoding: gzip but did "
+            "not decompress\"}";
+        EnqueueResponse(req->server, req->conn_id,
+                        BuildResponseBytes(400, "application/json", msg,
+                                           false, req->keep_alive),
+                        req->keep_alive);
+        delete req;
+        continue;
+      }
+    }
+    s->handler(s->user, req, req->parsed.method.c_str(),
+               req->parsed.uri.c_str(), req->parsed.body.data(),
+               req->parsed.body.size());
+    if (!req->responded.load()) {
+      EnqueueResponse(req->server, req->conn_id,
+                      BuildResponseBytes(
+                          500, "application/json",
+                          "{\"error\": \"handler produced no response\"}",
+                          false, req->keep_alive),
+                      req->keep_alive);
+    }
+    delete req;
+  }
+}
+
+void CloseConn(Server* s, Conn* c) {
+  epoll_ctl(s->epoll_fd, EPOLL_CTL_DEL, c->fd, nullptr);
+  close(c->fd);
+  s->conns.erase(c->id);
+  delete c;
+}
+
+void ArmEvents(Server* s, Conn* c) {
+  epoll_event ev;
+  memset(&ev, 0, sizeof(ev));
+  ev.events = EPOLLIN | (c->wbuf.size() > c->woff ? EPOLLOUT : 0u);
+  ev.data.u64 = c->id;
+  epoll_ctl(s->epoll_fd, EPOLL_CTL_MOD, c->fd, &ev);
+}
+
+// Sends a canned error and marks the connection for close.
+void SendProtocolError(Server* s, Conn* c, int status) {
+  std::string body = "{\"error\": \"";
+  body += StatusText(status);
+  body += "\"}";
+  c->wbuf += BuildResponseBytes(status, "application/json", body, false,
+                                false);
+  c->close_after = true;
+  ArmEvents(s, c);
+}
+
+// Parse as many complete requests as the buffer holds; dispatch at most one
+// (responses must be written in request order, so a connection is "busy"
+// until its in-flight request is answered).
+void TryDispatch(Server* s, Conn* c) {
+  if (c->busy || c->close_after) return;
+  int rc = c->parser.Feed(&c->rbuf);
+  if (rc == 0) return;
+  if (rc < 0) {
+    SendProtocolError(s, c, -rc);
+    return;
+  }
+  Request* req = new Request();
+  req->server = s;
+  req->conn_id = c->id;
+  req->parsed = std::move(c->parser.req);
+  req->keep_alive = req->parsed.keep_alive;
+  const std::string* ae = req->parsed.Header("accept-encoding");
+  req->accepts_gzip =
+      ae != nullptr && LowerCopy(*ae).find("gzip") != std::string::npos;
+  c->parser.Reset();
+  c->busy = true;
+  {
+    std::lock_guard<std::mutex> lk(s->work_mu);
+    s->work_queue.push_back(req);
+  }
+  s->work_cv.notify_one();
+}
+
+void HandleReadable(Server* s, Conn* c) {
+  if (c->close_after) return;  // already doomed; stop consuming input
+  char buf[64 * 1024];
+  for (;;) {
+    ssize_t n = read(c->fd, buf, sizeof(buf));
+    if (n > 0) {
+      c->rbuf.append(buf, static_cast<size_t>(n));
+      c->last_activity = std::chrono::steady_clock::now();
+      if (c->rbuf.size() > kMaxBodyBytes + kMaxHeaderBytes) {
+        SendProtocolError(s, c, 413);
+        return;
+      }
+      continue;
+    }
+    if (n == 0) {  // peer closed
+      if (!c->busy && c->wbuf.size() <= c->woff) CloseConn(s, c);
+      else c->close_after = true;
+      return;
+    }
+    if (errno == EAGAIN || errno == EWOULDBLOCK) break;
+    CloseConn(s, c);
+    return;
+  }
+  TryDispatch(s, c);
+}
+
+void HandleWritable(Server* s, Conn* c) {
+  while (c->woff < c->wbuf.size()) {
+    ssize_t n =
+        write(c->fd, c->wbuf.data() + c->woff, c->wbuf.size() - c->woff);
+    if (n > 0) {
+      c->woff += static_cast<size_t>(n);
+      c->last_activity = std::chrono::steady_clock::now();
+      continue;
+    }
+    if (errno == EAGAIN || errno == EWOULDBLOCK) break;
+    CloseConn(s, c);
+    return;
+  }
+  if (c->woff >= c->wbuf.size()) {
+    c->wbuf.clear();
+    c->woff = 0;
+    if (c->close_after) {
+      CloseConn(s, c);
+      return;
+    }
+    // Pipelined bytes may already be buffered; parse them now.
+    TryDispatch(s, c);
+  }
+  ArmEvents(s, c);
+}
+
+void DrainResponses(Server* s) {
+  std::deque<Response> batch;
+  {
+    std::lock_guard<std::mutex> lk(s->resp_mu);
+    batch.swap(s->resp_queue);
+  }
+  for (Response& r : batch) {
+    auto it = s->conns.find(r.conn_id);
+    if (it == s->conns.end()) continue;  // connection already gone
+    Conn* c = it->second;
+    c->busy = false;
+    c->wbuf += r.bytes;
+    if (!r.keep_alive) c->close_after = true;
+    HandleWritable(s, c);  // try an immediate write; arms EPOLLOUT if short
+  }
+}
+
+void SweepIdle(Server* s) {
+  auto now = std::chrono::steady_clock::now();
+  std::vector<Conn*> stale;
+  for (auto& kv : s->conns) {
+    Conn* c = kv.second;
+    if (c->busy) continue;  // a worker owns a request from this conn
+    auto idle = std::chrono::duration_cast<std::chrono::milliseconds>(
+                    now - c->last_activity)
+                    .count();
+    if (idle > s->timeout_ms) stale.push_back(c);
+  }
+  for (Conn* c : stale) {
+    if (!c->rbuf.empty() || c->parser.state != ParseState::kHeaders) {
+      // Mid-request timeout: tell the client before closing.
+      SendProtocolError(s, c, 408);
+    } else {
+      CloseConn(s, c);
+    }
+  }
+}
+
+void LoopMain(Server* s) {
+  epoll_event events[128];
+  for (;;) {
+    int n = epoll_wait(s->epoll_fd, events, 128, 1000);
+    if (s->stopping.load()) return;
+    for (int i = 0; i < n; ++i) {
+      uint64_t tag = events[i].data.u64;
+      if (tag == 0) {  // listen socket
+        for (;;) {
+          int fd = accept4(s->listen_fd, nullptr, nullptr, SOCK_NONBLOCK);
+          if (fd < 0) break;
+          int one = 1;
+          setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+          Conn* c = new Conn();
+          c->fd = fd;
+          c->id = s->next_conn_id++;
+          c->last_activity = std::chrono::steady_clock::now();
+          s->conns[c->id] = c;
+          epoll_event ev;
+          memset(&ev, 0, sizeof(ev));
+          ev.events = EPOLLIN;
+          ev.data.u64 = c->id;
+          epoll_ctl(s->epoll_fd, EPOLL_CTL_ADD, fd, &ev);
+        }
+        continue;
+      }
+      if (tag == UINT64_MAX) {  // wake eventfd
+        uint64_t junk;
+        ssize_t rc = read(s->wake_fd, &junk, sizeof(junk));
+        (void)rc;
+        DrainResponses(s);
+        continue;
+      }
+      auto it = s->conns.find(tag);
+      if (it == s->conns.end()) continue;
+      Conn* c = it->second;
+      if (events[i].events & (EPOLLHUP | EPOLLERR)) {
+        if (!c->busy) CloseConn(s, c);
+        else c->close_after = true;
+        continue;
+      }
+      if (events[i].events & EPOLLIN) HandleReadable(s, c);
+      // The conn may have been closed by the read path; re-look-up.
+      it = s->conns.find(tag);
+      if (it == s->conns.end()) continue;
+      if (events[i].events & EPOLLOUT) HandleWritable(s, it->second);
+    }
+    DrainResponses(s);  // responses enqueued while we were in epoll_wait
+    SweepIdle(s);
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+void* tpuhttp_start(const char* host, int port, int num_workers,
+                    int timeout_ms, tpuhttp_handler_fn handler, void* user) {
+  signal(SIGPIPE, SIG_IGN);
+  int listen_fd = socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK, 0);
+  if (listen_fd < 0) return nullptr;
+  int one = 1;
+  setsockopt(listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr;
+  memset(&addr, 0, sizeof(addr));
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (host == nullptr || host[0] == '\0' ||
+      inet_pton(AF_INET, host, &addr.sin_addr) != 1)
+    addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  if (bind(listen_fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0 ||
+      listen(listen_fd, 512) < 0) {
+    close(listen_fd);
+    return nullptr;
+  }
+  socklen_t alen = sizeof(addr);
+  getsockname(listen_fd, reinterpret_cast<sockaddr*>(&addr), &alen);
+
+  Server* s = new Server();
+  s->listen_fd = listen_fd;
+  s->port = ntohs(addr.sin_port);
+  s->timeout_ms = timeout_ms > 0 ? timeout_ms : 30000;
+  s->handler = handler;
+  s->user = user;
+  s->epoll_fd = epoll_create1(0);
+  s->wake_fd = eventfd(0, EFD_NONBLOCK);
+  if (s->epoll_fd < 0 || s->wake_fd < 0) {
+    close(listen_fd);
+    if (s->epoll_fd >= 0) close(s->epoll_fd);
+    if (s->wake_fd >= 0) close(s->wake_fd);
+    delete s;
+    return nullptr;
+  }
+  epoll_event ev;
+  memset(&ev, 0, sizeof(ev));
+  ev.events = EPOLLIN;
+  ev.data.u64 = 0;  // tag 0 == listen socket
+  epoll_ctl(s->epoll_fd, EPOLL_CTL_ADD, listen_fd, &ev);
+  memset(&ev, 0, sizeof(ev));
+  ev.events = EPOLLIN;
+  ev.data.u64 = UINT64_MAX;  // tag MAX == wake eventfd
+  epoll_ctl(s->epoll_fd, EPOLL_CTL_ADD, s->wake_fd, &ev);
+
+  int workers = num_workers > 0 ? num_workers : 4;
+  for (int i = 0; i < workers; ++i)
+    s->workers.emplace_back(WorkerMain, s);
+  s->loop_thread = std::thread(LoopMain, s);
+  return s;
+}
+
+int tpuhttp_port(void* server) {
+  return server == nullptr ? -1 : static_cast<Server*>(server)->port;
+}
+
+void tpuhttp_send_response(void* req_ptr, int status,
+                           const char* content_type, const char* body,
+                           uint64_t body_len) {
+  Request* req = static_cast<Request*>(req_ptr);
+  if (req == nullptr || req->responded.exchange(true)) return;
+  std::string b(body == nullptr ? "" : body,
+                body == nullptr ? 0 : static_cast<size_t>(body_len));
+  EnqueueResponse(req->server, req->conn_id,
+                  BuildResponseBytes(status,
+                                     content_type ? content_type : "",
+                                     b, req->accepts_gzip, req->keep_alive),
+                  req->keep_alive);
+}
+
+void tpuhttp_stop(void* server) {
+  Server* s = static_cast<Server*>(server);
+  if (s == nullptr) return;
+  {
+    // The store must happen under work_mu: a worker between its predicate
+    // check and cv sleep would otherwise miss this notify forever.
+    std::lock_guard<std::mutex> lk(s->work_mu);
+    s->stopping.store(true);
+  }
+  s->work_cv.notify_all();
+  uint64_t one = 1;
+  ssize_t rc = write(s->wake_fd, &one, sizeof(one));
+  (void)rc;
+  for (std::thread& t : s->workers) t.join();
+  s->loop_thread.join();
+  for (auto& kv : s->conns) {
+    close(kv.second->fd);
+    delete kv.second;
+  }
+  s->conns.clear();
+  {
+    std::lock_guard<std::mutex> lk(s->work_mu);
+    for (Request* r : s->work_queue) delete r;
+    s->work_queue.clear();
+  }
+  close(s->listen_fd);
+  close(s->epoll_fd);
+  close(s->wake_fd);
+  delete s;
+}
+
+}  // extern "C"

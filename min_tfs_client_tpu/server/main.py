@@ -19,6 +19,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="gRPC port to listen on")
     p.add_argument("--rest_api_port", type=int, default=0,
                    help="HTTP/REST port; 0 disables")
+    p.add_argument("--rest_api_num_threads", type=int, default=4,
+                   help="HTTP front-end worker threads (main.cc:70)")
+    p.add_argument("--rest_api_timeout_in_ms", type=int, default=30000,
+                   help="HTTP idle/request timeout (main.cc:73)")
     p.add_argument("--model_name", default="default")
     p.add_argument("--model_base_path", default="")
     p.add_argument("--model_platform", default="tensorflow",
@@ -81,6 +85,8 @@ def options_from_args(args) -> ServerOptions:
     return ServerOptions(
         grpc_port=args.port,
         rest_api_port=args.rest_api_port,
+        rest_api_num_threads=args.rest_api_num_threads,
+        rest_api_timeout_in_ms=args.rest_api_timeout_in_ms,
         model_name=args.model_name,
         model_base_path=args.model_base_path,
         model_platform=args.model_platform,

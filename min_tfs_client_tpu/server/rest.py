@@ -142,6 +142,72 @@ def predict_response_to_json(response: apis.PredictResponse, row_format: bool):
     return {"outputs": {k: _array_to_json(v) for k, v in outputs.items()}}
 
 
+def route_request(
+    handlers: Handlers,
+    prometheus_path: Optional[str],
+    method: str,
+    path: str,
+    body_bytes: bytes,
+) -> tuple[int, str, bytes]:
+    """Transport-independent /v1 router: (status, content_type, body).
+
+    Shared by the Python `http.server` backend below and the native epoll
+    front-end (`server/native_http.py`). Mirrors the reference's route
+    dispatch (http_rest_api_handler.cc:106-123); transport concerns
+    (gzip, keep-alive, limits) live in the respective servers.
+    """
+    try:
+        if method == "GET":
+            if prometheus_path and path == prometheus_path:
+                from min_tfs_client_tpu.server.metrics import prometheus_text
+
+                return (200, "text/plain; version=0.0.4",
+                        prometheus_text().encode())
+            m = _METADATA_PATH.match(path)
+            if m:
+                request = apis.GetModelMetadataRequest()
+                _fill_spec(request.model_spec, m)
+                request.metadata_field.append("signature_def")
+                response = handlers.get_model_metadata(request)
+                return _json_reply(200, json_format.MessageToDict(
+                    response, preserving_proto_field_name=True))
+            m = _MODEL_PATH.match(path)
+            if m and not m.group("verb"):
+                request = apis.GetModelStatusRequest()
+                _fill_spec(request.model_spec, m)
+                response = handlers.get_model_status(request)
+                return _json_reply(200, json_format.MessageToDict(
+                    response, preserving_proto_field_name=True))
+            return _json_reply(
+                404, {"error": f"Malformed request: GET {path}"})
+        if method == "POST":
+            m = _MODEL_PATH.match(path)
+            if not m or not m.group("verb"):
+                return _json_reply(
+                    404, {"error": f"Malformed request: POST {path}"})
+            body = json.loads(body_bytes or b"{}")
+            verb = m.group("verb").lower()
+            if verb == "predict":
+                request, row = build_predict_request(body, m)
+                response = handlers.predict(request)
+                return _json_reply(
+                    200, predict_response_to_json(response, row))
+            if verb in ("classify", "regress"):
+                return _json_reply(
+                    200, _classify_regress(handlers, verb, body, m))
+            return _json_reply(400, {"error": f"unsupported verb {verb}"})
+        return _json_reply(400, {"error": f"unsupported method {method}"})
+    except Exception as exc:  # noqa: BLE001
+        err = error_from_exception(exc)
+        http_code = {3: 400, 5: 404, 12: 501, 14: 503, 4: 504}.get(
+            err.code, 500)
+        return _json_reply(http_code, {"error": err.message})
+
+
+def _json_reply(code: int, payload: dict) -> tuple[int, str, bytes]:
+    return code, "application/json", json.dumps(payload).encode()
+
+
 class _RestHandler(BaseHTTPRequestHandler):
     handlers: Handlers = None
     prometheus_path: Optional[str] = None
@@ -150,10 +216,9 @@ class _RestHandler(BaseHTTPRequestHandler):
     def log_message(self, *args):  # quiet
         pass
 
-    def _send_json(self, code: int, payload: dict) -> None:
-        body = json.dumps(payload).encode()
+    def _send(self, code: int, content_type: str, body: bytes) -> None:
         self.send_response(code)
-        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Type", content_type)
         # Response compression when the client accepts it (the reference's
         # net_http gzip support, evhttp_request.cc; worthwhile from ~1KB).
         if (len(body) >= 1024 and "gzip" in
@@ -166,6 +231,9 @@ class _RestHandler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    def _send_json(self, code: int, payload: dict) -> None:
+        self._send(code, "application/json", json.dumps(payload).encode())
+
     def _read_body(self) -> bytes:
         length = int(self.headers.get("Content-Length", "0"))
         raw = self.rfile.read(length)
@@ -176,105 +244,66 @@ class _RestHandler(BaseHTTPRequestHandler):
 
             try:
                 raw = _gzip.decompress(raw)
-            except (OSError, EOFError, _zlib.error) as exc:
+            except (OSError, EOFError, _zlib.error):
                 # corrupt deflate streams raise zlib.error / EOFError,
                 # not OSError — all are the client's fault: 400.
-                raise ServingError.invalid_argument(
-                    f"body declared Content-Encoding: gzip but did not "
-                    f"decompress: {exc}")
+                self._send_json(400, {
+                    "error": "body declared Content-Encoding: gzip but "
+                             "did not decompress"})
+                return None
         return raw
 
-    def _send_error_status(self, exc: Exception) -> None:
-        err = error_from_exception(exc)
-        http_code = {3: 400, 5: 404, 12: 501, 14: 503, 4: 504}.get(err.code, 500)
-        self._send_json(http_code, {"error": err.message})
-
     def do_GET(self):  # noqa: N802 - http.server API
-        try:
-            if self.prometheus_path and self.path == self.prometheus_path:
-                from min_tfs_client_tpu.server.metrics import prometheus_text
-
-                body = prometheus_text().encode()
-                self.send_response(200)
-                self.send_header("Content-Type", "text/plain; version=0.0.4")
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
-                return
-            m = _METADATA_PATH.match(self.path)
-            if m:
-                request = apis.GetModelMetadataRequest()
-                _fill_spec(request.model_spec, m)
-                request.metadata_field.append("signature_def")
-                response = self.handlers.get_model_metadata(request)
-                self._send_json(200, json_format.MessageToDict(
-                    response, preserving_proto_field_name=True))
-                return
-            m = _MODEL_PATH.match(self.path)
-            if m and not m.group("verb"):
-                request = apis.GetModelStatusRequest()
-                _fill_spec(request.model_spec, m)
-                response = self.handlers.get_model_status(request)
-                self._send_json(200, json_format.MessageToDict(
-                    response, preserving_proto_field_name=True))
-                return
-            self._send_json(404, {"error": f"Malformed request: GET {self.path}"})
-        except Exception as exc:  # noqa: BLE001
-            self._send_error_status(exc)
+        self._send(*route_request(
+            self.handlers, self.prometheus_path, "GET", self.path, b""))
 
     def do_POST(self):  # noqa: N802 - http.server API
-        try:
-            m = _MODEL_PATH.match(self.path)
-            if not m or not m.group("verb"):
-                self._send_json(
-                    404, {"error": f"Malformed request: POST {self.path}"})
-                return
-            body = json.loads(self._read_body() or b"{}")
-            verb = m.group("verb").lower()
-            if verb == "predict":
-                request, row = build_predict_request(body, m)
-                response = self.handlers.predict(request)
-                self._send_json(200, predict_response_to_json(response, row))
-            elif verb in ("classify", "regress"):
-                response = self._classify_regress(verb, body, m)
-                self._send_json(200, response)
-            else:
-                self._send_json(400, {"error": f"unsupported verb {verb}"})
-        except Exception as exc:  # noqa: BLE001
-            self._send_error_status(exc)
+        raw = self._read_body()
+        if raw is None:
+            return
+        self._send(*route_request(
+            self.handlers, self.prometheus_path, "POST", self.path, raw))
 
-    def _classify_regress(self, verb: str, body: dict, m: re.Match):
-        from min_tfs_client_tpu.tensor.example_codec import build_input
 
-        examples = body.get("examples")
-        if not isinstance(examples, list) or not examples:
-            raise ServingError.invalid_argument(
-                "JSON body must carry a non-empty 'examples' list")
-        context = body.get("context")
-        decoded = []
-        for ex in examples:
-            decoded.append({
-                k: (base64.b64decode(v["b64"])
-                    if isinstance(v, dict) and set(v) == {"b64"} else v)
-                for k, v in ex.items()})
-        inp = build_input(decoded, context=context)
-        if verb == "classify":
-            request = apis.ClassificationRequest()
-            _fill_spec(request.model_spec, m)
-            if "signature_name" in body:
-                request.model_spec.signature_name = body["signature_name"]
-            request.input.CopyFrom(inp)
-            response = self.handlers.classify(request)
-            return {"results": [
-                [[c.label, c.score] for c in cl.classes]
-                for cl in response.result.classifications]}
-        request = apis.RegressionRequest()
+def _classify_regress(handlers: Handlers, verb: str, body: dict, m: re.Match):
+    from min_tfs_client_tpu.tensor.example_codec import build_input
+
+    examples = body.get("examples")
+    if not isinstance(examples, list) or not examples:
+        raise ServingError.invalid_argument(
+            "JSON body must carry a non-empty 'examples' list")
+    context = body.get("context")
+    decoded = []
+    for ex in examples:
+        decoded.append({
+            k: (base64.b64decode(v["b64"])
+                if isinstance(v, dict) and set(v) == {"b64"} else v)
+            for k, v in ex.items()})
+    inp = build_input(decoded, context=context)
+    if verb == "classify":
+        request = apis.ClassificationRequest()
         _fill_spec(request.model_spec, m)
         if "signature_name" in body:
             request.model_spec.signature_name = body["signature_name"]
         request.input.CopyFrom(inp)
-        response = self.handlers.regress(request)
-        return {"results": [r.value for r in response.result.regressions]}
+        response = handlers.classify(request)
+        return {"results": [
+            [[c.label, c.score] for c in cl.classes]
+            for cl in response.result.classifications]}
+    request = apis.RegressionRequest()
+    _fill_spec(request.model_spec, m)
+    if "signature_name" in body:
+        request.model_spec.signature_name = body["signature_name"]
+    request.input.CopyFrom(inp)
+    response = handlers.regress(request)
+    return {"results": [r.value for r in response.result.regressions]}
+
+
+def prometheus_path_from(monitoring: Optional[object]) -> Optional[str]:
+    """MonitoringConfig -> metrics path, or None when disabled."""
+    if monitoring is None or not monitoring.prometheus_config.enable:
+        return None
+    return monitoring.prometheus_config.path or PROMETHEUS_DEFAULT_PATH
 
 
 def start_rest_server(
@@ -284,10 +313,7 @@ def start_rest_server(
 ) -> tuple[ThreadingHTTPServer, int]:
     handler_cls = type("BoundRestHandler", (_RestHandler,), {
         "handlers": handlers,
-        "prometheus_path": (
-            (monitoring.prometheus_config.path or PROMETHEUS_DEFAULT_PATH)
-            if monitoring is not None and monitoring.prometheus_config.enable
-            else None),
+        "prometheus_path": prometheus_path_from(monitoring),
     })
     server = ThreadingHTTPServer(("0.0.0.0", port), handler_cls)
     thread = threading.Thread(

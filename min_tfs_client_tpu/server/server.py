@@ -39,6 +39,12 @@ class ServerOptions:
 
     grpc_port: int = 8500
     rest_api_port: int = 0
+    # Reference main.cc:70-75: worker-thread count and idle timeout of the
+    # HTTP front-end. Consumed by the native epoll server; the Python
+    # fallback backend is thread-per-connection and ignores them.
+    rest_api_num_threads: int = 4
+    rest_api_timeout_in_ms: int = 30000
+    rest_api_impl: str = "auto"  # auto | native | python
     model_name: str = "default"
     model_base_path: str = ""
     model_platform: str = "tensorflow"
@@ -186,14 +192,19 @@ class Server:
         self._grpc_server.start()
 
         if opts.rest_api_port or opts.monitoring_config_file:
-            from min_tfs_client_tpu.server.rest import start_rest_server
+            from min_tfs_client_tpu.server.native_http import (
+                start_best_rest_server,
+            )
 
             monitoring = None
             if opts.monitoring_config_file:
                 monitoring = _parse_text_proto(
                     opts.monitoring_config_file, tfs_config_pb2.MonitoringConfig)
-            self._rest_server, self.rest_port = start_rest_server(
-                handlers, opts.rest_api_port, monitoring)
+            self._rest_server, self.rest_port = start_best_rest_server(
+                handlers, opts.rest_api_port, monitoring,
+                num_threads=opts.rest_api_num_threads,
+                timeout_ms=opts.rest_api_timeout_in_ms,
+                impl=opts.rest_api_impl)
 
         if opts.profiler_port:
             from min_tfs_client_tpu.server.profiler import (

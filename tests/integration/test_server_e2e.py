@@ -71,8 +71,17 @@ def server(config_file):
     srv.stop()
 
 
-@pytest.fixture(scope="module")
-def rest_server(config_file):
+@pytest.fixture(scope="module", params=["native", "python"])
+def rest_server(config_file, request):
+    """The full REST surface, exercised against BOTH HTTP backends: the
+    native epoll front-end (net_http.cpp) and the http.server fallback."""
+    if request.param == "native":
+        from min_tfs_client_tpu.server.native_http import (
+            native_http_available,
+        )
+
+        if not native_http_available():
+            pytest.skip("native HTTP library not buildable here")
     mon = config_file.parent / "monitoring.config"
     mon.write_text('prometheus_config { enable: true }\n')
     srv = Server(ServerOptions(
@@ -81,6 +90,7 @@ def rest_server(config_file):
         model_config_file=str(config_file),
         file_system_poll_wait_seconds=0,
         monitoring_config_file=str(mon),
+        rest_api_impl=request.param,
     ))
     srv.build_and_start()
     yield srv
