@@ -452,7 +452,11 @@ void CloseConn(Server* s, Conn* c) {
 void ArmEvents(Server* s, Conn* c) {
   epoll_event ev;
   memset(&ev, 0, sizeof(ev));
-  ev.events = EPOLLIN | (c->wbuf.size() > c->woff ? EPOLLOUT : 0u);
+  // A doomed connection must not keep EPOLLIN armed: HandleReadable
+  // refuses to consume its bytes, and level-triggered epoll would spin
+  // the loop thread at 100% until the peer drained the error response.
+  ev.events = (c->close_after ? 0u : EPOLLIN) |
+              (c->wbuf.size() > c->woff ? EPOLLOUT : 0u);
   ev.data.u64 = c->id;
   epoll_ctl(s->epoll_fd, EPOLL_CTL_MOD, c->fd, &ev);
 }
@@ -577,7 +581,12 @@ void SweepIdle(Server* s) {
     if (idle > s->timeout_ms) stale.push_back(c);
   }
   for (Conn* c : stale) {
-    if (!c->rbuf.empty() || c->parser.state != ParseState::kHeaders) {
+    if (c->close_after) {
+      // Already answered (408/protocol error) a full sweep period ago and
+      // the peer never drained it: force the close, don't re-answer.
+      CloseConn(s, c);
+    } else if (!c->rbuf.empty() ||
+               c->parser.state != ParseState::kHeaders) {
       // Mid-request timeout: tell the client before closing.
       SendProtocolError(s, c, 408);
     } else {

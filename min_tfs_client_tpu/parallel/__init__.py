@@ -26,4 +26,17 @@ from min_tfs_client_tpu.parallel.sharding import (  # noqa: F401
 from min_tfs_client_tpu.parallel.ring_attention import (  # noqa: F401
     ring_attention,
 )
+from min_tfs_client_tpu.parallel.pipeline import (  # noqa: F401
+    STAGE_AXIS,
+    pipeline_apply,
+    stack_stage_params,
+)
+from min_tfs_client_tpu.parallel.moe import (  # noqa: F401
+    MoeParams,
+    capacity_for,
+    init_moe_params,
+    moe_ffn,
+    moe_ffn_reference,
+    shard_moe_params,
+)
 from min_tfs_client_tpu.parallel import distributed  # noqa: F401

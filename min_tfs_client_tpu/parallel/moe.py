@@ -1,0 +1,149 @@
+"""Expert parallelism: Switch-style MoE FFN sharded over an "expert" axis.
+
+Completes the §2.11 parallelism inventory (SURVEY.md row "Expert
+parallel") the TPU way — the GShard/Switch formulation: routing is
+expressed as dense one-hot dispatch/combine einsums over an expert-major
+tensor whose expert dim is sharded on the mesh's "expert" axis, and GSPMD
+materializes the token all-to-alls on ICI from the shardings alone. No
+hand-written NCCL alltoall, no host-side routing tables; capacity is a
+static shape so every step compiles once.
+
+Routing math (Switch Transformer, top-1):
+- router logits (G, E) over G = B*S token groups; softmax -> gates;
+- each token goes to its argmax expert, position = its running count
+  within that expert, tokens beyond capacity C are dropped (output 0);
+- dispatch tensor D (G, E, C) one-hot; combine tensor = D * gate;
+- expert_in (E, C, D) = einsum(D, x); FFN per expert; combine back.
+
+The auxiliary load-balancing loss (mean fraction * mean router prob per
+expert, scaled by E) is returned for training use.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from min_tfs_client_tpu.parallel.mesh import EXPERT_AXIS
+
+
+class MoeParams(NamedTuple):
+    router: jax.Array  # (D, E)
+    w_in: jax.Array    # (E, D, F)
+    b_in: jax.Array    # (E, F)
+    w_out: jax.Array   # (E, F, D)
+    b_out: jax.Array   # (E, D)
+
+
+def init_moe_params(rng: jax.Array, d_model: int, d_ff: int,
+                    num_experts: int, dtype=jnp.float32) -> MoeParams:
+    k1, k2, k3 = jax.random.split(rng, 3)
+    scale_in = 1.0 / np.sqrt(d_model)
+    scale_out = 1.0 / np.sqrt(d_ff)
+    return MoeParams(
+        router=(jax.random.normal(k1, (d_model, num_experts)) *
+                scale_in).astype(dtype),
+        w_in=(jax.random.normal(k2, (num_experts, d_model, d_ff)) *
+              scale_in).astype(dtype),
+        b_in=jnp.zeros((num_experts, d_ff), dtype),
+        w_out=(jax.random.normal(k3, (num_experts, d_ff, d_model)) *
+               scale_out).astype(dtype),
+        b_out=jnp.zeros((num_experts, d_model), dtype),
+    )
+
+
+def expert_shardings(mesh: Mesh,
+                     axis_name: str = EXPERT_AXIS) -> MoeParams:
+    """NamedShardings placing the expert dim of each weight on `axis_name`
+    (router weights are replicated — every device routes its tokens)."""
+    return MoeParams(
+        router=NamedSharding(mesh, P()),
+        w_in=NamedSharding(mesh, P(axis_name, None, None)),
+        b_in=NamedSharding(mesh, P(axis_name, None)),
+        w_out=NamedSharding(mesh, P(axis_name, None, None)),
+        b_out=NamedSharding(mesh, P(axis_name, None)),
+    )
+
+
+def shard_moe_params(params: MoeParams, mesh: Mesh,
+                     axis_name: str = EXPERT_AXIS) -> MoeParams:
+    shardings = expert_shardings(mesh, axis_name)
+    return MoeParams(*(jax.device_put(p, s)
+                       for p, s in zip(params, shardings)))
+
+
+def capacity_for(num_tokens: int, num_experts: int,
+                 capacity_factor: float = 1.25) -> int:
+    """Static per-expert token capacity (Switch capacity rule)."""
+    return max(1, int(np.ceil(num_tokens / num_experts * capacity_factor)))
+
+
+def moe_ffn(params: MoeParams, x: jax.Array, *,
+            capacity: int) -> tuple[jax.Array, jax.Array]:
+    """Switch MoE FFN. x (B, S, D) -> (y (B, S, D), aux_loss scalar).
+
+    Tokens routed past an expert's static `capacity` produce zeros (the
+    residual connection around the layer carries them through — Switch
+    semantics). Under jit with `shard_moe_params` weights, the dispatch
+    and combine einsums become ICI all-to-alls on the expert axis.
+    """
+    b, s, d = x.shape
+    e = params.router.shape[1]
+    g = b * s
+    tokens = x.reshape(g, d)
+
+    router_logits = tokens.astype(jnp.float32) @ params.router.astype(
+        jnp.float32)                                          # (G, E)
+    gates = jax.nn.softmax(router_logits, axis=-1)
+    expert_idx = jnp.argmax(gates, axis=-1)                   # (G,)
+    gate = jnp.take_along_axis(gates, expert_idx[:, None], 1)[:, 0]
+
+    onehot = jax.nn.one_hot(expert_idx, e, dtype=jnp.int32)   # (G, E)
+    # Position of each token within its chosen expert's queue.
+    position = jnp.cumsum(onehot, axis=0) * onehot - 1        # (G, E)
+    pos_in_expert = jnp.sum(position * onehot, axis=-1)       # (G,)
+    keep = pos_in_expert < capacity
+
+    # dispatch (G, E, C): 1 where token g occupies slot c of expert e.
+    slot = jax.nn.one_hot(pos_in_expert, capacity, dtype=jnp.int32)
+    dispatch = (onehot[:, :, None] * slot[:, None, :] *
+                keep[:, None, None]).astype(x.dtype)
+    combine = dispatch * gate.astype(x.dtype)[:, None, None]
+
+    # Expert-major compute; the e dim carries the expert-axis sharding.
+    expert_in = jnp.einsum("gec,gd->ecd", dispatch, tokens)   # (E, C, D)
+    h = jnp.einsum("ecd,edf->ecf", expert_in, params.w_in)
+    h = jax.nn.relu(h + params.b_in[:, None, :])
+    expert_out = jnp.einsum("ecf,efd->ecd", h, params.w_out)
+    expert_out = expert_out + params.b_out[:, None, :]
+    y = jnp.einsum("gec,ecd->gd", combine, expert_out)        # (G, D)
+
+    # Switch aux loss: encourages uniform routing. fraction (E,): share of
+    # tokens per expert; prob (E,): mean router probability.
+    fraction = jnp.mean(onehot.astype(jnp.float32), axis=0)
+    prob = jnp.mean(gates, axis=0)
+    aux_loss = e * jnp.sum(fraction * prob)
+    return y.reshape(b, s, d), aux_loss
+
+
+def moe_ffn_reference(params: MoeParams, x: jax.Array) -> jax.Array:
+    """Dense oracle: every token through its argmax expert, no capacity
+    limit — what moe_ffn converges to with capacity >= tokens-per-expert
+    max. For tests."""
+    b, s, d = x.shape
+    tokens = x.reshape(-1, d)
+    gates = jax.nn.softmax(
+        tokens.astype(jnp.float32) @ params.router.astype(jnp.float32), -1)
+    idx = jnp.argmax(gates, axis=-1)
+    gate = jnp.take_along_axis(gates, idx[:, None], 1)[:, 0].astype(x.dtype)
+
+    def one(tok, i, gt):
+        h = jax.nn.relu(tok @ params.w_in[i] + params.b_in[i])
+        return (h @ params.w_out[i] + params.b_out[i]) * gt
+
+    out = jax.vmap(one)(tokens, idx, gate)
+    return out.reshape(b, s, d)

@@ -1,0 +1,119 @@
+"""Pipeline parallelism: GPipe-style microbatch streaming over a mesh axis.
+
+Completes the §2.11 parallelism inventory (SURVEY.md row "Pipeline
+parallel") the TPU way: stages live on consecutive devices of a named mesh
+axis, activations hop stage-to-stage with `lax.ppermute` (one ICI hop),
+and a `lax.scan` over ticks streams microbatches so all stages compute
+concurrently after the fill phase. No NCCL P2P, no scheduler threads —
+the whole schedule is one compiled XLA program.
+
+Design constraints (deliberate, TPU-first):
+- All stages share one `stage_fn` signature and activation shape (uniform
+  transformer blocks — the shape every serving model here satisfies).
+  Per-stage weights are a stacked pytree with a leading `n_stages` dim,
+  sharded over the stage axis, so each device holds exactly its slice.
+- The schedule is the classic GPipe fill-drain: `n_micro + n_stages - 1`
+  ticks; bubble fraction (n_stages-1)/(n_micro+n_stages-1) shrinks as
+  microbatches increase. Early garbage ticks compute on zeros and their
+  results are masked out of the output buffer.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from min_tfs_client_tpu.parallel.ring_attention import shard_map
+
+STAGE_AXIS = "stage"
+
+
+def stack_stage_params(per_stage_params: list) -> object:
+    """[stage0_tree, stage1_tree, ...] -> one tree with leading stage dim."""
+    return jax.tree_util.tree_map(
+        lambda *leaves: jnp.stack(leaves), *per_stage_params)
+
+
+def _pipeline_shard_fn(params, x_micro, *, stage_fn, axis_name, n_stages,
+                       n_micro):
+    """Per-device body. params: this stage's slice (leading dim 1);
+    x_micro: (n_micro, mb, ...) full microbatched input, replicated."""
+    params = jax.tree_util.tree_map(lambda p: p[0], params)
+    idx = jax.lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+    mb_shape = x_micro.shape[1:]
+
+    def tick(carry, t):
+        state, outputs = carry
+        # Stage 0 pulls microbatch t (clamped during the drain phase, when
+        # its compute is masked garbage anyway); others use the activation
+        # handed to them by the previous stage on the last tick.
+        feed = x_micro[jnp.minimum(t, n_micro - 1)]
+        inp = jnp.where(idx == 0, feed, state)
+        out = stage_fn(params, inp)
+        passed = jax.lax.ppermute(out, axis_name, perm)
+        # The last stage finishes microbatch (t - n_stages + 1) at tick t.
+        write_pos = t - (n_stages - 1)
+        updated = jax.lax.dynamic_update_index_in_dim(
+            outputs, out, jnp.maximum(write_pos, 0), 0)
+        outputs = jnp.where(
+            (write_pos >= 0) & (idx == n_stages - 1), updated, outputs)
+        return (passed, outputs), None
+
+    state0 = jnp.zeros(mb_shape, x_micro.dtype)
+    outputs0 = jnp.zeros((n_micro,) + mb_shape, x_micro.dtype)
+    (_, outputs), _ = jax.lax.scan(
+        tick, (state0, outputs0), jnp.arange(n_micro + n_stages - 1))
+    # Only the last stage holds real outputs (others carry zeros); one
+    # psum replicates the result to every stage.
+    return jax.lax.psum(outputs, axis_name)
+
+
+def pipeline_apply(
+    stage_fn: Callable,
+    stacked_params,
+    x: jax.Array,
+    *,
+    mesh: Mesh,
+    axis_name: str = STAGE_AXIS,
+    n_micro: int | None = None,
+) -> jax.Array:
+    """Run `x` through `n_stages` pipelined applications of `stage_fn`.
+
+    stage_fn(params_for_stage, activation) -> activation (same shape).
+    stacked_params: pytree with leading dim n_stages == mesh axis size.
+    x: (batch, ...); batch must divide into n_micro microbatches (default:
+    one per stage, the minimum that fills the pipeline).
+
+    Equivalent to
+        for s in range(n_stages): x = stage_fn(params[s], x)
+    but with stages resident on different devices and microbatches
+    in flight concurrently.
+    """
+    n_stages = mesh.shape[axis_name]
+    leading = {int(p.shape[0])
+               for p in jax.tree_util.tree_leaves(stacked_params)}
+    if leading != {n_stages}:
+        raise ValueError(
+            f"stacked_params leading dims {sorted(leading)} must all equal "
+            f"the {axis_name!r} mesh axis size {n_stages}")
+    if n_micro is None:
+        n_micro = n_stages
+    batch = x.shape[0]
+    if batch % n_micro:
+        raise ValueError(f"batch {batch} not divisible by n_micro {n_micro}")
+    x_micro = x.reshape((n_micro, batch // n_micro) + x.shape[1:])
+
+    body = partial(_pipeline_shard_fn, stage_fn=stage_fn,
+                   axis_name=axis_name, n_stages=n_stages, n_micro=n_micro)
+    param_specs = jax.tree_util.tree_map(
+        lambda p: P(axis_name), stacked_params)
+    out = shard_map(
+        body, mesh=mesh,
+        in_specs=(param_specs, P()),
+        out_specs=P())(stacked_params, x_micro)
+    return out.reshape((batch,) + x.shape[1:])

@@ -14,6 +14,7 @@ import gzip
 import json
 import socket
 import threading
+import time
 import urllib.request
 
 import pytest
@@ -176,6 +177,29 @@ def test_malformed_request_line_rejected(server):
     resp = _recv_n_responses(s, 1)
     assert b"400" in resp.split(b"\r\n", 1)[0]
     s.close()
+
+
+def test_doomed_connection_force_closed_by_sweep():
+    """A client that provokes a protocol error and never reads the reply
+    must be force-closed by the idle sweep — one error response, then EOF
+    (no repeated 408s, no fd leak)."""
+    srv = NativeRestServer(None, 0, route_fn=echo_route, timeout_ms=300)
+    try:
+        s = socket.create_connection(("127.0.0.1", srv.port))
+        s.sendall(b"NONSENSE\r\n\r\n")  # malformed -> 400 + close_after
+        time.sleep(3.5)  # > several 1s sweep periods
+        s.settimeout(10)
+        data = b""
+        while True:
+            chunk = s.recv(65536)
+            if not chunk:
+                break
+            data += chunk
+        assert data.count(b"HTTP/1.1 ") == 1  # exactly one error response
+        assert b"400" in data.split(b"\r\n", 1)[0]
+        s.close()
+    finally:
+        srv.shutdown()
 
 
 def test_idle_connection_swept():
