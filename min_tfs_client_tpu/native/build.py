@@ -6,6 +6,7 @@ Invoked lazily at import by native/__init__.py (cached), or manually:
 
 from __future__ import annotations
 
+import os
 import pathlib
 import shutil
 import subprocess
@@ -15,6 +16,8 @@ SO_PATH = NATIVE_DIR / "libtpuserve.so"
 SRC = NATIVE_DIR / "tpuserve.cpp"
 HTTP_SO_PATH = NATIVE_DIR / "libtpunethttp.so"
 HTTP_SRC = NATIVE_DIR / "net_http.cpp"
+JSON_SO_PATH = NATIVE_DIR / "libtpujson.so"
+JSON_SRC = NATIVE_DIR / "json_tensor.cpp"
 
 
 def _compile(src: pathlib.Path, out: pathlib.Path,
@@ -25,11 +28,17 @@ def _compile(src: pathlib.Path, out: pathlib.Path,
     cxx = shutil.which("g++") or shutil.which("c++") or shutil.which("clang++")
     if cxx is None:
         return None
+    # Compile to a process-unique temp path, then atomically rename:
+    # concurrent builders (threads or processes) each produce a whole .so
+    # and the last rename wins — never a torn file under a CDLL load.
+    tmp = out.with_suffix(f".tmp{os.getpid()}.so")
     cmd = [cxx, "-O3", "-shared", "-fPIC", "-std=c++17",
-           "-o", str(out), str(src)] + extra
+           "-o", str(tmp), str(src)] + extra
     try:
         subprocess.run(cmd, check=True, capture_output=True)
-    except subprocess.CalledProcessError:
+        os.replace(tmp, out)
+    except (subprocess.CalledProcessError, OSError):
+        tmp.unlink(missing_ok=True)
         return None
     return out
 
@@ -42,6 +51,11 @@ def build_http(force: bool = False) -> pathlib.Path | None:
     return _compile(HTTP_SRC, HTTP_SO_PATH, ["-lz", "-lpthread"], force)
 
 
+def build_json(force: bool = False) -> pathlib.Path | None:
+    return _compile(JSON_SRC, JSON_SO_PATH, [], force)
+
+
 if __name__ == "__main__":
     print(f"built: {build(force=True)}")
     print(f"built: {build_http(force=True)}")
+    print(f"built: {build_json(force=True)}")

@@ -1,0 +1,511 @@
+// json_tensor — native fast path for the REST JSON tensor codec.
+//
+// TPU-native counterpart of the reference's util/json_tensor.{h,cc}
+// (~4.4k LoC): the dominant REST Predict bodies are dense numeric
+// literals — {"instances": [[...]...]}, {"instances": [{"x": ...}...]},
+// {"inputs": {...}} — and parsing them through a general-purpose JSON
+// library then re-walking the Python object tree is the REST hot path's
+// main cost. This parser goes straight from bytes to flat double buffers
+// (+ shape + integer-ness), one pass, no intermediate objects. Anything
+// outside the dense-numeric subset (strings, b64 objects, bools, nulls,
+// ragged arrays) returns FALLBACK and the Python codec handles it — the
+// fast path must never guess.
+//
+// Response side: tpujson_encode_f32/_i32 render a numeric tensor to a
+// JSON array literal directly from the buffer (row-major, nested by
+// shape), replacing ndarray.tolist() + json.dumps.
+//
+// C ABI (ctypes, see server/json_fast.py): all numbers are parsed into
+// double buffers; per-tensor all_int says whether every literal was an
+// integer token, so Python can apply the same dtype rules as the slow
+// path (float->f32, int->i32 when in range).
+
+#include <ctype.h>
+#include <math.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include <string>
+#include <vector>
+
+namespace {
+
+constexpr int kMaxRank = 8;
+constexpr int kMaxTensors = 16;
+constexpr int kNameCap = 64;
+
+struct Tensor {
+  char name[kNameCap];
+  int rank = 0;
+  int64_t shape[kMaxRank] = {0};  // 0 = dim not yet seen (empty rejected)
+  int leaf_depth = -1;            // depth where scalars live; -1 = none yet
+  int all_int = 1;
+  std::vector<double>* data = nullptr;
+};
+
+struct Parser {
+  const char* p;
+  const char* end;
+
+  void SkipWs() {
+    while (p < end && (*p == ' ' || *p == '\t' || *p == '\n' || *p == '\r'))
+      ++p;
+  }
+  bool Eof() { return p >= end; }
+  char Peek() { return p < end ? *p : '\0'; }
+  bool Consume(char c) {
+    SkipWs();
+    if (p < end && *p == c) {
+      ++p;
+      return true;
+    }
+    return false;
+  }
+};
+
+// Parses a JSON string (after the opening quote) into out; handles the
+// escapes the fast path tolerates in KEY positions. Returns false on
+// anything exotic (surrogates etc. force a fallback).
+bool ParseString(Parser* ps, std::string* out) {
+  while (ps->p < ps->end) {
+    char c = *ps->p++;
+    if (c == '"') return true;
+    if (c == '\\') {
+      if (ps->p >= ps->end) return false;
+      char e = *ps->p++;
+      switch (e) {
+        case '"': out->push_back('"'); break;
+        case '\\': out->push_back('\\'); break;
+        case '/': out->push_back('/'); break;
+        case 'n': out->push_back('\n'); break;
+        case 't': out->push_back('\t'); break;
+        case 'r': out->push_back('\r'); break;
+        default: return false;  // \uXXXX etc: fallback
+      }
+      continue;
+    }
+    out->push_back(c);
+  }
+  return false;
+}
+
+// Parses one number token with STRICT JSON grammar
+// (-?(0|[1-9][0-9]*)(\.[0-9]+)?([eE][+-]?[0-9]+)?): anything json.loads
+// would reject (+5, 5., .5, 05) must fail here too, or the fast path
+// would serve bodies the fallback codec answers with 400. Sets *is_int
+// for integer tokens; those are additionally required to round-trip
+// through double exactly (|v| <= 2^53), else the caller must fall back
+// to the exact int64 path.
+bool ParseNumber(Parser* ps, double* out, bool* is_int) {
+  ps->SkipWs();
+  const char* start = ps->p;
+  if (ps->p < ps->end && *ps->p == '-') ++ps->p;
+  // Integer part: "0" alone, or [1-9][0-9]*.
+  if (ps->p >= ps->end || *ps->p < '0' || *ps->p > '9') return false;
+  if (*ps->p == '0') {
+    ++ps->p;
+  } else {
+    while (ps->p < ps->end && *ps->p >= '0' && *ps->p <= '9') ++ps->p;
+  }
+  bool dot = false, exp = false;
+  if (ps->p < ps->end && *ps->p == '.') {
+    dot = true;
+    ++ps->p;
+    if (ps->p >= ps->end || *ps->p < '0' || *ps->p > '9') return false;
+    while (ps->p < ps->end && *ps->p >= '0' && *ps->p <= '9') ++ps->p;
+  }
+  if (ps->p < ps->end && (*ps->p == 'e' || *ps->p == 'E')) {
+    exp = true;
+    ++ps->p;
+    if (ps->p < ps->end && (*ps->p == '-' || *ps->p == '+')) ++ps->p;
+    if (ps->p >= ps->end || *ps->p < '0' || *ps->p > '9') return false;
+    while (ps->p < ps->end && *ps->p >= '0' && *ps->p <= '9') ++ps->p;
+  }
+  char buf[64];
+  size_t n = static_cast<size_t>(ps->p - start);
+  if (n >= sizeof(buf)) return false;
+  memcpy(buf, start, n);
+  buf[n] = '\0';
+  *out = strtod(buf, nullptr);
+  *is_int = !dot && !exp;
+  // Integers at/beyond 2^53 don't reliably survive the double buffer
+  // (2^53+1 rounds to exactly 2^53, so the bound must be exclusive); the
+  // Python codec keeps them exact as int64 — decline rather than corrupt.
+  if (*is_int && (*out >= 9007199254740992.0 || *out <= -9007199254740992.0))
+    return false;
+  return true;
+}
+
+// Recursively parses a dense numeric array literal into t->data,
+// validating rectangular shape. depth = current dim. Shape dims are
+// recorded inside-out (inner arrays close first), so "first traversal"
+// is detected per-dim via the 0 sentinel (empty arrays are rejected, so
+// a legitimate dim can never be 0); scalar/array consistency is enforced
+// by requiring every scalar to sit at the same leaf_depth.
+bool ParseDense(Parser* ps, Tensor* t, int depth) {
+  ps->SkipWs();
+  if (ps->Peek() == '[') {
+    ++ps->p;
+    if (depth + 1 > kMaxRank) return false;
+    int64_t count = 0;
+    ps->SkipWs();
+    if (ps->Peek() == ']') {  // empty arrays: fallback (dtype unknowable)
+      return false;
+    }
+    for (;;) {
+      if (!ParseDense(ps, t, depth + 1)) return false;
+      ++count;
+      ps->SkipWs();
+      if (ps->Consume(',')) continue;
+      if (ps->Consume(']')) break;
+      return false;
+    }
+    if (t->shape[depth] == 0) {
+      t->shape[depth] = count;
+      if (depth + 1 > t->rank) t->rank = depth + 1;
+    } else if (t->shape[depth] != count) {
+      return false;  // ragged
+    }
+    return true;
+  }
+  double v;
+  bool is_int;
+  if (!ParseNumber(ps, &v, &is_int)) return false;
+  if (!is_int) t->all_int = 0;
+  if (t->leaf_depth == -1) {
+    t->leaf_depth = depth;
+  } else if (t->leaf_depth != depth) {
+    return false;  // scalar at a different nesting level: not rectangular
+  }
+  t->data->push_back(v);
+  return true;
+}
+
+struct ParseResult {
+  std::vector<Tensor> tensors;
+  int row_format = 0;
+  std::string signature;
+};
+
+Tensor* FindOrAdd(ParseResult* r, const std::string& name) {
+  for (Tensor& t : r->tensors)
+    if (name == t.name) return &t;
+  if (r->tensors.size() >= kMaxTensors) return nullptr;
+  if (name.size() >= kNameCap) return nullptr;
+  r->tensors.emplace_back();
+  Tensor* t = &r->tensors.back();
+  memset(t->name, 0, kNameCap);
+  memcpy(t->name, name.data(), name.size());
+  t->data = new std::vector<double>();
+  return t;
+}
+
+void FreeResult(ParseResult* r) {
+  for (Tensor& t : r->tensors) delete t.data;
+  r->tensors.clear();
+}
+
+// {"instances": [...]} row format. Two dense shapes:
+//   [v, v, ...]            -> single tensor named "inputs"
+//   [{"x": v, ...}, ...]   -> one tensor per name, batch dim prepended
+bool ParseInstances(Parser* ps, ParseResult* r) {
+  if (!ps->Consume('[')) return false;
+  ps->SkipWs();
+  if (ps->Peek() == '{') {
+    int64_t rows = 0;
+    for (;;) {
+      if (!ps->Consume('{')) return false;
+      size_t seen = 0;
+      for (;;) {
+        ps->SkipWs();
+        if (!ps->Consume('"')) return false;
+        std::string key;
+        if (!ParseString(ps, &key)) return false;
+        if (!ps->Consume(':')) return false;
+        Tensor* t = FindOrAdd(r, key);
+        if (t == nullptr) return false;
+        // Per-row values: parse at depth 1; dim 0 becomes the batch.
+        if (!ParseDense(ps, t, 1)) return false;
+        ++seen;
+        if (ps->Consume(',')) continue;
+        if (ps->Consume('}')) break;
+        return false;
+      }
+      if (rows == 0) {
+        if (seen != r->tensors.size()) return false;
+      } else if (seen != r->tensors.size()) {
+        return false;  // rows with differing key sets
+      }
+      ++rows;
+      if (ps->Consume(',')) continue;
+      if (ps->Consume(']')) break;
+      return false;
+    }
+    for (Tensor& t : r->tensors) {
+      if (t.rank == 0) t.rank = 1;  // scalars per row -> (rows,)
+      t.shape[0] = rows;
+      int64_t expect = 1;
+      for (int i = 0; i < t.rank; ++i) expect *= (i == 0 ? rows : t.shape[i]);
+      if (static_cast<int64_t>(t.data->size()) != expect) return false;
+    }
+    r->row_format = 1;
+    return true;
+  }
+  // Plain (possibly nested) numeric array -> one tensor "inputs".
+  // The opening '[' is already consumed; parse each element at depth 1
+  // and prepend the outer (batch) dim afterwards.
+  Tensor* t = FindOrAdd(r, "inputs");
+  if (t == nullptr) return false;
+  int64_t count = 0;
+  ps->SkipWs();
+  if (ps->Peek() == ']') return false;  // empty
+  for (;;) {
+    if (!ParseDense(ps, t, 1)) return false;
+    ++count;
+    if (ps->Consume(',')) continue;
+    if (ps->Consume(']')) break;
+    return false;
+  }
+  if (t->rank == 0) t->rank = 1;
+  t->shape[0] = count;
+  int64_t expect = 1;
+  for (int i = 0; i < t->rank; ++i) expect *= (i == 0 ? count : t->shape[i]);
+  if (static_cast<int64_t>(t->data->size()) != expect) return false;
+  r->row_format = 1;
+  return true;
+}
+
+// {"inputs": {...}} columnar format: dict of name -> dense array, or a
+// bare dense array (single unnamed input).
+bool ParseInputs(Parser* ps, ParseResult* r) {
+  ps->SkipWs();
+  if (ps->Peek() == '{') {
+    ++ps->p;
+    for (;;) {
+      ps->SkipWs();
+      if (!ps->Consume('"')) return false;
+      std::string key;
+      if (!ParseString(ps, &key)) return false;
+      if (!ps->Consume(':')) return false;
+      Tensor* t = FindOrAdd(r, key);
+      if (t == nullptr) return false;
+      if (!ParseDense(ps, t, 0)) return false;
+      if (ps->Consume(',')) continue;
+      if (ps->Consume('}')) break;
+      return false;
+    }
+    r->row_format = 0;
+    return true;
+  }
+  Tensor* t = FindOrAdd(r, "inputs");
+  if (t == nullptr) return false;
+  if (!ParseDense(ps, t, 0)) return false;
+  r->row_format = 0;
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Flat result view handed to Python. data points into the internal
+// vector; valid until tpujson_free(handle).
+typedef struct {
+  const char* name;
+  int rank;
+  const int64_t* shape;
+  int all_int;
+  const double* data;
+  int64_t size;
+} TpuJsonTensorView;
+
+typedef struct {
+  ParseResult* result;
+  TpuJsonTensorView views[kMaxTensors];
+  int n;
+  int row_format;
+  char signature[256];
+} TpuJsonParse;
+
+// Parses a Predict request body. Returns a handle on success, NULL when
+// the body is outside the dense-numeric fast path (caller falls back).
+void* tpujson_parse_predict(const char* body, uint64_t len) {
+  Parser ps{body, body + len};
+  ParseResult r;
+  bool ok = false;
+  bool saw_payload = false;
+  if (ps.Consume('{')) {
+    for (;;) {
+      ps.SkipWs();
+      if (!ps.Consume('"')) break;
+      std::string key;
+      if (!ParseString(&ps, &key)) break;
+      if (!ps.Consume(':')) break;
+      if (key == "instances") {
+        if (saw_payload || !ParseInstances(&ps, &r)) break;
+        saw_payload = true;
+        r.row_format = 1;
+      } else if (key == "inputs") {
+        if (saw_payload || !ParseInputs(&ps, &r)) break;
+        saw_payload = true;
+        r.row_format = 0;
+      } else if (key == "signature_name") {
+        ps.SkipWs();
+        if (!ps.Consume('"')) break;
+        if (!ParseString(&ps, &r.signature)) break;
+        if (r.signature.size() >= 256) break;
+      } else {
+        break;  // unknown key: fallback, don't guess
+      }
+      if (ps.Consume(',')) continue;
+      if (ps.Consume('}')) {
+        ps.SkipWs();
+        ok = saw_payload && ps.Eof();
+      }
+      break;
+    }
+  }
+  if (ok) {
+    // Central consistency gate: every tensor's element count must equal
+    // the product of its recorded dims (catches duplicate keys re-feeding
+    // a tensor, and any residual shape inconsistency).
+    for (Tensor& t : r.tensors) {
+      int64_t expect = 1;
+      for (int i = 0; i < t.rank; ++i) expect *= t.shape[i];
+      if (static_cast<int64_t>(t.data->size()) != expect) {
+        ok = false;
+        break;
+      }
+    }
+  }
+  if (!ok) {
+    FreeResult(&r);
+    return nullptr;
+  }
+  TpuJsonParse* h = new TpuJsonParse();
+  h->result = new ParseResult(std::move(r));
+  h->n = static_cast<int>(h->result->tensors.size());
+  h->row_format = h->result->row_format;
+  memset(h->signature, 0, sizeof(h->signature));
+  memcpy(h->signature, h->result->signature.data(),
+         h->result->signature.size());
+  for (int i = 0; i < h->n; ++i) {
+    Tensor& t = h->result->tensors[i];
+    h->views[i] = TpuJsonTensorView{
+        t.name, t.rank, t.shape, t.all_int, t.data->data(),
+        static_cast<int64_t>(t.data->size())};
+  }
+  return h;
+}
+
+int tpujson_num_tensors(void* handle) {
+  return static_cast<TpuJsonParse*>(handle)->n;
+}
+const TpuJsonTensorView* tpujson_tensor(void* handle, int i) {
+  return &static_cast<TpuJsonParse*>(handle)->views[i];
+}
+int tpujson_row_format(void* handle) {
+  return static_cast<TpuJsonParse*>(handle)->row_format;
+}
+const char* tpujson_signature(void* handle) {
+  return static_cast<TpuJsonParse*>(handle)->signature;
+}
+void tpujson_free(void* handle) {
+  TpuJsonParse* h = static_cast<TpuJsonParse*>(handle);
+  FreeResult(h->result);
+  delete h->result;
+  delete h;
+}
+
+// ---- encode: numeric buffer -> JSON array literal ----------------------
+
+namespace {
+
+void EncodeF32(const float* data, const int64_t* shape, int rank, int dim,
+               int64_t* offset, std::string* out) {
+  if (dim == rank) {
+    float v = data[(*offset)++];
+    char buf[40];
+    if (isfinite(v)) {
+      // Shortest round-trip float formatting ala Python repr; keep the
+      // token recognizably a float ("3.0", not "3") to match the Python
+      // codec's json.dumps of float values.
+      int n = snprintf(buf, sizeof(buf), "%.9g", static_cast<double>(v));
+      if (memchr(buf, '.', n) == nullptr &&
+          memchr(buf, 'e', n) == nullptr && n + 2 < 40) {
+        buf[n] = '.';
+        buf[n + 1] = '0';
+        buf[n + 2] = '\0';
+      }
+    } else if (isnan(v)) {
+      snprintf(buf, sizeof(buf), "NaN");
+    } else {
+      snprintf(buf, sizeof(buf), v > 0 ? "Infinity" : "-Infinity");
+    }
+    out->append(buf);
+    return;
+  }
+  out->push_back('[');
+  for (int64_t i = 0; i < shape[dim]; ++i) {
+    if (i) out->push_back(',');
+    EncodeF32(data, shape, rank, dim + 1, offset, out);
+  }
+  out->push_back(']');
+}
+
+void EncodeI32(const int32_t* data, const int64_t* shape, int rank, int dim,
+               int64_t* offset, std::string* out) {
+  if (dim == rank) {
+    char buf[16];
+    snprintf(buf, sizeof(buf), "%d", data[(*offset)++]);
+    out->append(buf);
+    return;
+  }
+  out->push_back('[');
+  for (int64_t i = 0; i < shape[dim]; ++i) {
+    if (i) out->push_back(',');
+    EncodeI32(data, shape, rank, dim + 1, offset, out);
+  }
+  out->push_back(']');
+}
+
+}  // namespace
+
+// Renders a float32 tensor as a JSON array literal. Returns a malloc'd
+// NUL-terminated string (caller frees with tpujson_release) and its
+// length via out_len.
+char* tpujson_encode_f32(const float* data, const int64_t* shape, int rank,
+                         uint64_t* out_len) {
+  std::string out;
+  int64_t total = 1;
+  for (int i = 0; i < rank; ++i) total *= shape[i];
+  out.reserve(static_cast<size_t>(total) * 12 + 16);
+  int64_t offset = 0;
+  EncodeF32(data, shape, rank, 0, &offset, &out);
+  char* buf = static_cast<char*>(malloc(out.size() + 1));
+  memcpy(buf, out.data(), out.size());
+  buf[out.size()] = '\0';
+  *out_len = out.size();
+  return buf;
+}
+
+char* tpujson_encode_i32(const int32_t* data, const int64_t* shape, int rank,
+                         uint64_t* out_len) {
+  std::string out;
+  int64_t total = 1;
+  for (int i = 0; i < rank; ++i) total *= shape[i];
+  out.reserve(static_cast<size_t>(total) * 8 + 16);
+  int64_t offset = 0;
+  EncodeI32(data, shape, rank, 0, &offset, &out);
+  char* buf = static_cast<char*>(malloc(out.size() + 1));
+  memcpy(buf, out.data(), out.size());
+  buf[out.size()] = '\0';
+  *out_len = out.size();
+  return buf;
+}
+
+void tpujson_release(char* buf) { free(buf); }
+
+}  // extern "C"

@@ -1,0 +1,181 @@
+"""Native JSON tensor codec bindings (native/json_tensor.cpp).
+
+Fast path for the REST hot loop — dense numeric Predict bodies go
+straight from bytes to numpy arrays in one native pass (no intermediate
+Python object tree), and numeric response tensors render to JSON array
+literals directly from their buffers. Anything the native parser can't
+prove is dense-numeric (strings, b64 objects, bools, ragged arrays,
+unknown keys) returns None here and the caller uses the general Python
+codec — behavior is identical either way, only the speed differs.
+
+Parity: util/json_tensor.{h,cc} in the reference (its REST codec is C++
+for the same reason).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import threading
+from typing import Optional
+
+import numpy as np
+
+_lib: Optional[ctypes.CDLL] = None
+_lib_tried = False
+_lib_lock = threading.Lock()
+
+
+class _TensorView(ctypes.Structure):
+    _fields_ = [
+        ("name", ctypes.c_char_p),
+        ("rank", ctypes.c_int),
+        ("shape", ctypes.POINTER(ctypes.c_int64)),
+        ("all_int", ctypes.c_int),
+        ("data", ctypes.POINTER(ctypes.c_double)),
+        ("size", ctypes.c_int64),
+    ]
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _lib_tried
+    with _lib_lock:
+        return _load_locked()
+
+
+def _load_locked() -> Optional[ctypes.CDLL]:
+    global _lib, _lib_tried
+    if _lib_tried:
+        return _lib
+    _lib_tried = True
+    try:
+        from min_tfs_client_tpu.native.build import build_json
+
+        so_path = build_json()
+        if so_path is None:
+            return None
+        lib = ctypes.CDLL(str(so_path))
+    except OSError:
+        return None
+    lib.tpujson_parse_predict.restype = ctypes.c_void_p
+    lib.tpujson_parse_predict.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+    lib.tpujson_num_tensors.restype = ctypes.c_int
+    lib.tpujson_num_tensors.argtypes = [ctypes.c_void_p]
+    lib.tpujson_tensor.restype = ctypes.POINTER(_TensorView)
+    lib.tpujson_tensor.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.tpujson_row_format.restype = ctypes.c_int
+    lib.tpujson_row_format.argtypes = [ctypes.c_void_p]
+    lib.tpujson_signature.restype = ctypes.c_char_p
+    lib.tpujson_signature.argtypes = [ctypes.c_void_p]
+    lib.tpujson_free.restype = None
+    lib.tpujson_free.argtypes = [ctypes.c_void_p]
+    lib.tpujson_encode_f32.restype = ctypes.c_void_p
+    lib.tpujson_encode_f32.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64), ctypes.c_int,
+        ctypes.POINTER(ctypes.c_uint64)]
+    lib.tpujson_encode_i32.restype = ctypes.c_void_p
+    lib.tpujson_encode_i32.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64), ctypes.c_int,
+        ctypes.POINTER(ctypes.c_uint64)]
+    lib.tpujson_release.restype = None
+    lib.tpujson_release.argtypes = [ctypes.c_void_p]
+    _lib = lib
+    return _lib
+
+
+def json_fast_available() -> bool:
+    return _load() is not None
+
+
+def parse_predict_fast(
+        body: bytes) -> Optional[tuple[dict[str, np.ndarray], bool, str]]:
+    """bytes -> ({name: array}, row_format, signature_name), or None.
+
+    Dtype rules match rest._json_value_to_array exactly: integer literals
+    become int32 when they all fit (else int64); any float literal makes
+    the tensor float32.
+    """
+    lib = _load()
+    if lib is None:
+        return None
+    handle = lib.tpujson_parse_predict(body, len(body))
+    if not handle:
+        return None
+    try:
+        n = lib.tpujson_num_tensors(handle)
+        tensors: dict[str, np.ndarray] = {}
+        for i in range(n):
+            view = lib.tpujson_tensor(handle, i).contents
+            shape = tuple(view.shape[d] for d in range(view.rank))
+            flat = np.ctypeslib.as_array(
+                view.data, shape=(view.size,)).copy()
+            arr = flat.reshape(shape)
+            if view.all_int:
+                arr = arr.astype(np.int64)
+                if np.all(np.abs(arr) < 2 ** 31):
+                    arr = arr.astype(np.int32)
+            else:
+                arr = arr.astype(np.float32)
+            tensors[view.name.decode()] = arr
+        row = bool(lib.tpujson_row_format(handle))
+        sig = lib.tpujson_signature(handle).decode()
+        return tensors, row, sig
+    finally:
+        lib.tpujson_free(handle)
+
+
+def _encode_array(lib, arr: np.ndarray) -> Optional[bytes]:
+    """One tensor -> JSON array literal bytes, or None if unsupported."""
+    if arr.dtype == np.dtype("float16") or str(arr.dtype) == "bfloat16":
+        arr = arr.astype(np.float32)
+    if arr.dtype == np.float64:
+        arr = arr.astype(np.float32)
+    if arr.dtype == np.int64:
+        if not np.all(np.abs(arr) < 2 ** 31):
+            return None
+        arr = arr.astype(np.int32)
+    if arr.dtype == np.float32:
+        fn = lib.tpujson_encode_f32
+    elif arr.dtype == np.int32:
+        fn = lib.tpujson_encode_i32
+    else:
+        return None
+    arr = np.ascontiguousarray(arr)
+    shape = (ctypes.c_int64 * arr.ndim)(*arr.shape)
+    out_len = ctypes.c_uint64()
+    buf = fn(arr.ctypes.data_as(ctypes.c_void_p), shape, arr.ndim,
+             ctypes.byref(out_len))
+    if not buf:
+        return None
+    try:
+        return ctypes.string_at(buf, out_len.value)
+    finally:
+        lib.tpujson_release(buf)
+
+
+def encode_predict_response_fast(
+        outputs: dict[str, np.ndarray], row_format: bool) -> Optional[bytes]:
+    """{name: array} -> full JSON response body bytes, or None to fall
+    back (non-numeric outputs, or row format with multiple outputs whose
+    per-row interleaving the flat encoder can't express)."""
+    lib = _load()
+    if lib is None or not outputs:
+        return None
+    if row_format:
+        if len(outputs) != 1:
+            return None
+        body = _encode_array(lib, next(iter(outputs.values())))
+        if body is None:
+            return None
+        return b'{"predictions": ' + body + b"}"
+    if len(outputs) == 1:
+        body = _encode_array(lib, next(iter(outputs.values())))
+        if body is None:
+            return None
+        return b'{"outputs": ' + body + b"}"
+    parts = []
+    for name, arr in outputs.items():
+        body = _encode_array(lib, arr)
+        if body is None:
+            return None
+        parts.append(b'"' + name.encode() + b'": ' + body)
+    return b'{"outputs": {' + b", ".join(parts) + b"}}"

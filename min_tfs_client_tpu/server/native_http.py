@@ -152,6 +152,12 @@ def start_best_rest_server(
     impl: "auto" (native if the toolchain builds it), "native" (required,
     raises if unavailable), or "python" (force the http.server backend).
     """
+    # Warm the native JSON codec now — building it lazily inside the
+    # first predict request would stall that request on a g++ run.
+    from min_tfs_client_tpu.server.json_fast import json_fast_available
+
+    json_fast_available()
+
     prometheus_path = prometheus_path_from(monitoring)
     if impl == "native" and not native_http_available():
         raise RuntimeError("rest_api_impl=native but the native HTTP "

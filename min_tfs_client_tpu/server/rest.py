@@ -128,6 +128,10 @@ def predict_response_to_json(response: apis.PredictResponse, row_format: bool):
 
     outputs = {k: tensor_proto_to_ndarray(v)
                for k, v in response.outputs.items()}
+    return outputs_to_json(outputs, row_format)
+
+
+def outputs_to_json(outputs: dict, row_format: bool):
     if row_format:
         n = next(iter(outputs.values())).shape[0] if outputs else 0
         if len(outputs) == 1:
@@ -185,14 +189,28 @@ def route_request(
             if not m or not m.group("verb"):
                 return _json_reply(
                     404, {"error": f"Malformed request: POST {path}"})
-            body = json.loads(body_bytes or b"{}")
             verb = m.group("verb").lower()
             if verb == "predict":
-                request, row = build_predict_request(body, m)
+                # Native fast path: dense numeric bodies parse straight to
+                # arrays (json_tensor.cpp); None -> general Python codec.
+                request = row = None
+                fast = _parse_predict_fast(body_bytes or b"{}")
+                if fast is not None:
+                    tensors, row, signature = fast
+                    request = apis.PredictRequest()
+                    _fill_spec(request.model_spec, m)
+                    if signature:
+                        request.model_spec.signature_name = signature
+                    for name, arr in tensors.items():
+                        request.inputs[name].CopyFrom(
+                            ndarray_to_tensor_proto(arr))
+                else:
+                    body = json.loads(body_bytes or b"{}")
+                    request, row = build_predict_request(body, m)
                 response = handlers.predict(request)
-                return _json_reply(
-                    200, predict_response_to_json(response, row))
+                return _predict_reply(response, row)
             if verb in ("classify", "regress"):
+                body = json.loads(body_bytes or b"{}")
                 return _json_reply(
                     200, _classify_regress(handlers, verb, body, m))
             return _json_reply(400, {"error": f"unsupported verb {verb}"})
@@ -206,6 +224,29 @@ def route_request(
 
 def _json_reply(code: int, payload: dict) -> tuple[int, str, bytes]:
     return code, "application/json", json.dumps(payload).encode()
+
+
+def _parse_predict_fast(body_bytes: bytes):
+    from min_tfs_client_tpu.server.json_fast import parse_predict_fast
+
+    return parse_predict_fast(body_bytes)
+
+
+def _predict_reply(response, row_format: bool) -> tuple[int, str, bytes]:
+    """Render a PredictResponse, preferring the native encoder for
+    numeric outputs; falls back to the general Python path. The proto ->
+    ndarray conversion happens exactly once either way."""
+    from min_tfs_client_tpu.server.json_fast import (
+        encode_predict_response_fast,
+    )
+    from min_tfs_client_tpu.tensor.codec import tensor_proto_to_ndarray
+
+    outputs = {k: tensor_proto_to_ndarray(v)
+               for k, v in response.outputs.items()}
+    fast = encode_predict_response_fast(outputs, row_format)
+    if fast is not None:
+        return 200, "application/json", fast
+    return _json_reply(200, outputs_to_json(outputs, row_format))
 
 
 class _RestHandler(BaseHTTPRequestHandler):

@@ -1,0 +1,193 @@
+"""Native JSON tensor codec vs the general Python REST codec.
+
+The fast path (native/json_tensor.cpp via server/json_fast.py) must be
+byte-for-meaning identical to the Python codec on every body it accepts,
+and must decline (None -> fallback) on everything outside the
+dense-numeric subset. Parity target: util/json_tensor.{h,cc}.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+
+import numpy as np
+import pytest
+
+from min_tfs_client_tpu.server import rest
+from min_tfs_client_tpu.server.json_fast import (
+    encode_predict_response_fast,
+    json_fast_available,
+    parse_predict_fast,
+)
+
+pytestmark = pytest.mark.skipif(
+    not json_fast_available(), reason="native json library not buildable")
+
+_SPEC = re.compile(
+    r"^/v1/models/(?P<model>[^/:]+)"
+    r"(?:/versions/(?P<version>\d+)|/labels/(?P<label>[^/:]+))?"
+    r"(?::(?P<verb>predict))?$")
+
+
+def python_path(body_bytes: bytes):
+    """The general codec's view of a body: ({name: ndarray}, row)."""
+    from min_tfs_client_tpu.tensor.codec import tensor_proto_to_ndarray
+
+    m = _SPEC.match("/v1/models/m:predict")
+    request, row = rest.build_predict_request(json.loads(body_bytes), m)
+    arrays = {k: tensor_proto_to_ndarray(v)
+              for k, v in request.inputs.items()}
+    return arrays, row, request.model_spec.signature_name
+
+
+PARITY_BODIES = [
+    b'{"instances": [1, 2, 3]}',
+    b'{"instances": [1.5, -2.25, 3e2]}',
+    b'{"instances": [[1, 2], [3, 4]]}',
+    b'{"instances": [[[1.0, 2.0]], [[3.5, 4.5]]]}',
+    b'{"instances": [{"x": 1.0}, {"x": 2.0}]}',
+    b'{"instances": [{"x": 1, "y": [1, 2]}, {"x": 2, "y": [3, 4]}]}',
+    b'{"inputs": [4.0, 6.0]}',
+    b'{"inputs": {"a": [[1.0, 2.0], [3.0, 4.0]], "b": [7, 8]}}',
+    b'{"signature_name": "serving_default", "inputs": {"x": [1.0]}}',
+    b'{"instances": [2147483648, 1]}',  # exceeds int32: must stay int64
+    b'{"instances": [-2147483647, 5]}',  # fits int32
+    b' { "instances"\t: [ 1 , 2 ] } ',  # whitespace tolerance
+]
+
+
+@pytest.mark.parametrize("body", PARITY_BODIES, ids=lambda b: b[:40].decode())
+def test_parse_parity_with_python_codec(body):
+    fast = parse_predict_fast(body)
+    assert fast is not None, "fast path unexpectedly declined"
+    f_arrays, f_row, f_sig = fast
+    p_arrays, p_row, p_sig = python_path(body)
+    assert f_row == p_row
+    assert f_sig == p_sig
+    assert set(f_arrays) == set(p_arrays)
+    for name in p_arrays:
+        assert f_arrays[name].dtype == p_arrays[name].dtype, name
+        assert f_arrays[name].shape == p_arrays[name].shape, name
+        np.testing.assert_array_equal(f_arrays[name], p_arrays[name])
+
+
+FALLBACK_BODIES = [
+    b'{"instances": ["a", "b"]}',           # strings
+    b'{"instances": [{"b64": "aGk="}]}',    # binary payloads
+    b'{"instances": [true, false]}',        # booleans
+    b'{"instances": [null]}',               # nulls
+    b'{"instances": [[1, 2], [3]]}',        # ragged
+    b'{"instances": [{"x": 1}, {"y": 2}]}',  # differing key sets
+    b'{"instances": []}',                   # empty (dtype unknowable)
+    b'{"inputs": {"a": []}}',               # empty nested
+    b'{"examples": [1]}',                   # unknown top-level key
+    b'{"instances": [1], "context": {}}',   # extra key
+    b'{"inputs": {"a": [1, [2]]}}',         # scalar/array mix
+    b'{"inputs": {"a": [1,2], "a": [3,4]}}',  # duplicate key
+    b'{"instances": [{"x": 1, "x": 2}]}',   # duplicate key in row
+    b'not json',
+    b'{"instances": [1, 2]',                # truncated
+    b'{"instances": [NaN]}',                # non-finite literal
+    b'',
+    # Integers beyond 2^53 lose precision in a double buffer; the Python
+    # codec keeps them exact, so the fast path must decline.
+    b'{"instances": [9007199254740993]}',
+    b'{"instances": [-9007199254740993]}',
+    # Strict JSON number grammar: json.loads rejects all of these, so a
+    # 200 from the fast path would fork client-visible behavior.
+    b'{"inputs": [+5]}',
+    b'{"inputs": [5.]}',
+    b'{"inputs": [.5]}',
+    b'{"inputs": [05]}',
+    b'{"inputs": [5e]}',
+    b'{"inputs": [--5]}',
+]
+
+
+@pytest.mark.parametrize("body", FALLBACK_BODIES,
+                         ids=lambda b: (b[:40] or b"empty").decode())
+def test_fallback_cases_decline(body):
+    assert parse_predict_fast(body) is None
+
+
+def test_deeply_nested_beyond_max_rank_declines():
+    body = b'{"inputs": ' + b"[" * 10 + b"1" + b"]" * 10 + b"}"
+    assert parse_predict_fast(body) is None
+
+
+def test_parse_large_body_correct():
+    data = np.arange(4096, dtype=np.float32).reshape(64, 64) / 7.0
+    body = json.dumps({"inputs": {"x": data.tolist()}}).encode()
+    fast = parse_predict_fast(body)
+    assert fast is not None
+    arrays, row, _ = fast
+    assert not row
+    np.testing.assert_array_equal(arrays["x"], data)
+
+
+class TestEncode:
+    def _roundtrip(self, outputs, row):
+        raw = encode_predict_response_fast(outputs, row)
+        assert raw is not None
+        return json.loads(raw)
+
+    def test_row_single_output_f32(self):
+        arr = np.array([[1.5, 2.0], [3.0, 4.25]], np.float32)
+        got = self._roundtrip({"p": arr}, True)
+        np.testing.assert_array_equal(
+            np.asarray(got["predictions"], np.float32), arr)
+
+    def test_f32_values_roundtrip_exactly(self):
+        # Shortest-repr %.9g must reparse to the identical float32.
+        rng = np.random.default_rng(0)
+        arr = rng.standard_normal(512).astype(np.float32) * 1e3
+        got = self._roundtrip({"p": arr}, True)
+        back = np.asarray(got["predictions"], np.float64).astype(np.float32)
+        np.testing.assert_array_equal(back, arr)
+
+    def test_columnar_multi_output(self):
+        outs = {"a": np.arange(6, dtype=np.int32).reshape(2, 3),
+                "b": np.array([0.5, 1.5], np.float32)}
+        got = self._roundtrip(outs, False)
+        np.testing.assert_array_equal(got["outputs"]["a"],
+                                      outs["a"].tolist())
+        np.testing.assert_array_equal(got["outputs"]["b"], [0.5, 1.5])
+
+    def test_row_multi_output_declines(self):
+        outs = {"a": np.zeros((2, 2), np.float32),
+                "b": np.zeros((2,), np.float32)}
+        assert encode_predict_response_fast(outs, True) is None
+
+    def test_string_outputs_decline(self):
+        outs = {"a": np.array([b"x", b"y"], object)}
+        assert encode_predict_response_fast(outs, False) is None
+
+    def test_int64_overflow_declines(self):
+        outs = {"a": np.array([2 ** 40], np.int64)}
+        assert encode_predict_response_fast(outs, False) is None
+
+    def test_nonfinite_floats_match_python_json(self):
+        arr = np.array([np.nan, np.inf, -np.inf, 1.0], np.float32)
+        raw = encode_predict_response_fast({"p": arr}, True)
+        assert raw is not None
+        # Python's json module emits NaN/Infinity/-Infinity and parses
+        # them back; the native encoder must match that dialect.
+        got = json.loads(raw)["predictions"]
+        assert np.isnan(got[0]) and got[1] == np.inf and got[2] == -np.inf
+
+    def test_whole_floats_keep_float_tokens(self):
+        # json.dumps(3.0) emits "3.0"; the native encoder must not
+        # degrade whole floats to integer tokens.
+        raw = encode_predict_response_fast(
+            {"p": np.array([3.0, -4.0, 2.5e9], np.float32)}, True)
+        assert b"3.0" in raw and b"-4.0" in raw
+        got = json.loads(raw)["predictions"]
+        assert all(isinstance(v, float) for v in got)
+
+    def test_bf16_cast_matches_python_path(self):
+        import jax.numpy as jnp
+
+        arr = np.asarray(jnp.arange(4, dtype=jnp.bfloat16))
+        got = self._roundtrip({"p": arr}, True)
+        assert got["predictions"] == [0.0, 1.0, 2.0, 3.0]
