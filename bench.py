@@ -529,6 +529,11 @@ def bench_matmul(max_iters: int) -> dict:
         # The hop the reference client always pays (requests.py:49) and
         # tpu:// skips: same model over a real localhost gRPC socket.
         extra["grpc_loopback_p50_ms"] = round(grpc_p50, 3)
+    rest_p50 = _rest_loopback_p50(base, x)
+    if rest_p50 is not None:
+        # Same model over the native epoll HTTP front-end + native JSON
+        # tensor codec (net_http.cpp / json_tensor.cpp).
+        extra["rest_loopback_p50_ms"] = round(rest_p50, 3)
     yardstick = _tf_cpu_yardstick(BATCH)
     return {"metric": f"toy_predict_p50_b{BATCH}", "value": stats["p50"],
             "unit": "ms", "extra": extra, "yardstick": yardstick}
@@ -555,6 +560,46 @@ def _grpc_loopback_p50(base: pathlib.Path, x) -> float | None:
                                                   timeout=60)
                     tensor_proto_to_ndarray(resp.outputs["probs"])
                     ts.append((time.perf_counter() - t0) * 1e3)
+            ts.sort()
+            return ts[len(ts) // 2]
+        finally:
+            srv.stop()
+    except Exception:
+        traceback.print_exc(file=sys.stderr)
+        return None
+
+
+def _rest_loopback_p50(base: pathlib.Path, x) -> float | None:
+    """Same toy model over the REST surface (native HTTP + JSON codec)."""
+    if _child_time_left() < 30:
+        return None
+    try:
+        import json as _json
+        import urllib.request
+
+        from min_tfs_client_tpu.server.server import Server, ServerOptions
+
+        # rest_api_port=0 alone disables REST; an enabled monitoring
+        # config turns it on at an ephemeral port (same as the e2e tests).
+        mon = base.parent / "bench_monitoring.config"
+        mon.write_text("prometheus_config { enable: true }\n")
+        srv = Server(ServerOptions(
+            grpc_port=0, rest_api_port=0, model_name="matmul",
+            model_base_path=str(base),
+            monitoring_config_file=str(mon),
+            file_system_poll_wait_seconds=0)).build_and_start()
+        try:
+            body = _json.dumps({"inputs": {"x": x.tolist()}}).encode()
+            url = (f"http://127.0.0.1:{srv.rest_port}"
+                   "/v1/models/matmul:predict")
+            ts = []
+            for _ in range(20):
+                t0 = time.perf_counter()
+                with urllib.request.urlopen(
+                        urllib.request.Request(url, data=body),
+                        timeout=60) as r:
+                    r.read()
+                ts.append((time.perf_counter() - t0) * 1e3)
             ts.sort()
             return ts[len(ts) // 2]
         finally:
@@ -669,9 +714,72 @@ def bench_t5(max_iters: int) -> dict:
                                signature_name="decode_close", timeout=600)
         extra["tokens_per_s_stepwise"] = round(batch * steps / wall, 1)
         extra["stepwise_ms_per_token"] = round(wall / steps * 1e3, 2)
+    if _child_time_left() > 40:
+        pooled = _t5_pooled_tokens_per_s(config, params, seq, decode_len)
+        if pooled:
+            extra.update(pooled)
     return {"metric": f"t5_small_decode_tokens_per_s_b{batch}",
             "value": tok_s, "unit": "tokens/s", "higher_is_better": True,
             "extra": extra}
+
+
+def _t5_pooled_tokens_per_s(config, params, seq: int,
+                            decode_len: int) -> dict:
+    """Continuous batching: N concurrent single-sequence decode sessions
+    share one vmapped device tick per token (SlotPool/TickBatcher) vs N
+    independent per-session dispatches."""
+    import threading
+
+    import numpy as np
+
+    from min_tfs_client_tpu.models import t5
+
+    try:
+        n_sessions = 8
+        sigs = t5.build_session_signatures(
+            params, config, seq_len=seq, max_decode_len=decode_len,
+            max_sessions=n_sessions, continuous_batching=True)
+        rng = np.random.default_rng(1)
+        prompts = [rng.integers(2, config.vocab_size, (1, seq)).astype(
+            np.int32) for _ in range(n_sessions)]
+        for i, ids in enumerate(prompts):
+            sigs["decode_init"].run({
+                "session_id": np.asarray(f"b{i}".encode(), object),
+                "input_ids": ids})
+        # Warm the tick executable before timing.
+        sigs["decode_step"].run(
+            {"session_id": np.asarray(b"b0", object)})
+
+        steps = decode_len - 2
+        barrier = threading.Barrier(n_sessions)
+
+        def worker(i):
+            sid = np.asarray(f"b{i}".encode(), object)
+            barrier.wait()
+            start = 0 if i else 1  # session 0 already stepped once
+            for _ in range(start, steps):
+                sigs["decode_step"].run({"session_id": sid})
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(n_sessions)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        for i in range(n_sessions):
+            sigs["decode_close"].run(
+                {"session_id": np.asarray(f"b{i}".encode(), object)})
+        total_tokens = steps * (n_sessions - 1) + (steps - 1)
+        return {
+            "tokens_per_s_continuous_batching":
+                round(total_tokens / wall, 1),
+            "continuous_batching_sessions": n_sessions,
+        }
+    except Exception:
+        traceback.print_exc(file=sys.stderr)
+        return {}
 
 
 def bench_resnet(max_iters: int) -> dict:

@@ -81,10 +81,22 @@ class TensorServingClient:
             self._channel = InProcessChannel.for_target(host)
         else:
             self._host_address = f"{host}:{port}"
+            # Serving tensors routinely exceed gRPC's 4 MB default (a
+            # b32 ResNet request is ~19 MB); match the server's
+            # unlimited sizes (server.cc:340) instead of failing
+            # RESOURCE_EXHAUSTED on large batches like the reference
+            # client does.
+            channel_options = [
+                ("grpc.max_send_message_length", -1),
+                ("grpc.max_receive_message_length", -1),
+            ]
             if credentials:
-                self._channel = grpc.secure_channel(self._host_address, credentials)
+                self._channel = grpc.secure_channel(
+                    self._host_address, credentials,
+                    options=channel_options)
             else:
-                self._channel = grpc.insecure_channel(self._host_address)
+                self._channel = grpc.insecure_channel(
+                    self._host_address, options=channel_options)
 
     def close(self) -> None:
         self._channel.close()

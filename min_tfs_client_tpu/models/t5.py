@@ -236,7 +236,10 @@ def greedy_decode(params: dict, config: T5Config, input_ids: jax.Array,
 
 
 def build_signatures(params: dict, config: T5Config, *, seq_len: int,
-                     max_decode_len: int) -> dict:
+                     max_decode_len: int,
+                     continuous_batching: bool = False,
+                     max_sessions: int = 64,
+                     session_ttl_s: float = 600.0) -> dict:
     from min_tfs_client_tpu.servables.servable import Signature, TensorSpec
 
     def decode_fn(params, inputs):
@@ -274,7 +277,9 @@ def build_signatures(params: dict, config: T5Config, *, seq_len: int,
     signatures = {"serving_default": decode_sig, "decode": decode_sig,
                   "encode": encode_sig}
     signatures.update(build_session_signatures(
-        params, config, seq_len=seq_len, max_decode_len=max_decode_len))
+        params, config, seq_len=seq_len, max_decode_len=max_decode_len,
+        max_sessions=max_sessions, session_ttl_s=session_ttl_s,
+        continuous_batching=continuous_batching))
     return signatures
 
 
@@ -326,7 +331,8 @@ def decode_step_state(params: dict, config: T5Config, state: dict
 def build_session_signatures(params: dict, config: T5Config, *, seq_len: int,
                              max_decode_len: int,
                              max_sessions: int = 64,
-                             session_ttl_s: float = 600.0) -> dict:
+                             session_ttl_s: float = 600.0,
+                             continuous_batching: bool = False) -> dict:
     """The repeated-Predict decode surface (BASELINE config 5):
 
       decode_init:  session_id + input_ids -> prefill; KV cache parked in
@@ -337,7 +343,17 @@ def build_session_signatures(params: dict, config: T5Config, *, seq_len: int,
       decode_close: session_id -> free the session's HBM
 
     Host signatures: the store lookup is Python, the math is jitted.
+
+    continuous_batching=True swaps the per-session device dispatch for a
+    slot pool: concurrent decode_step requests coalesce into ONE vmapped
+    device tick (decode_sessions.SlotPool/TickBatcher) — K active
+    sessions cost one dispatch per token instead of K. Sessions are then
+    single-sequence (batch 1); the wire surface is identical.
     """
+    if continuous_batching:
+        return _build_pooled_session_signatures(
+            params, config, seq_len=seq_len, max_decode_len=max_decode_len,
+            max_slots=max_sessions, session_ttl_s=session_ttl_s)
     from min_tfs_client_tpu.servables.decode_sessions import (
         DecodeSessionStore,
     )
@@ -416,6 +432,119 @@ def build_session_signatures(params: dict, config: T5Config, *, seq_len: int,
     )
     # The loader re-labels the store's gauge with the real model:version
     # (platforms.make_loader) — the family builder doesn't know it.
+    for sig in (init_sig, step_sig, close_sig):
+        sig._decode_store = store
+    return {"decode_init": init_sig, "decode_step": step_sig,
+            "decode_close": close_sig}
+
+
+def _build_pooled_session_signatures(params: dict, config: T5Config, *,
+                                     seq_len: int, max_decode_len: int,
+                                     max_slots: int,
+                                     session_ttl_s: float) -> dict:
+    """Continuous-batching variant: same wire surface, slot-pool device
+    state, one vmapped tick per token across all concurrently-stepping
+    sessions. See decode_sessions.SlotPool."""
+    from min_tfs_client_tpu.servables.decode_sessions import (
+        DecodeSessionStore,
+        SlotPool,
+        TickBatcher,
+    )
+    from min_tfs_client_tpu.servables.servable import Signature, TensorSpec
+    from min_tfs_client_tpu.utils.status import ServingError
+
+    template = jax.eval_shape(
+        lambda p, ids: prefill_state(p, config, ids,
+                                     max_decode_len=max_decode_len),
+        params, jax.ShapeDtypeStruct((1, seq_len), jnp.int32))
+
+    def one_step(state):
+        new_state, token = decode_step_state(params, config, state)
+        return new_state, {"token": token,
+                           "finished": new_state["finished"]}
+
+    pool = SlotPool(template, one_step, max_slots=max_slots)
+    batcher = TickBatcher(pool.tick)
+    store = DecodeSessionStore(
+        max_sessions=max_slots, ttl_s=session_ttl_s,
+        metric_label="t5-pooled",
+        on_evict=lambda entry: pool.release_slot(entry[0]))
+    prefill_jit = jax.jit(
+        lambda p, ids: prefill_state(p, config, ids,
+                                     max_decode_len=max_decode_len))
+
+    def _session_id(inputs) -> bytes:
+        raw = np.asarray(inputs["session_id"]).reshape(-1)
+        if raw.size != 1:
+            raise ServingError.invalid_argument(
+                f"session_id must hold exactly one id, got {raw.size}")
+        value = raw[0]
+        return value if isinstance(value, bytes) else str(value).encode()
+
+    def init_fn(inputs):
+        sid = _session_id(inputs)
+        ids = np.asarray(inputs["input_ids"]).astype(np.int32)
+        if ids.shape[0] != 1:
+            raise ServingError.invalid_argument(
+                "continuous-batching decode sessions are single-sequence: "
+                f"input_ids batch must be 1, got {ids.shape[0]}")
+        state = prefill_jit(params, jax.device_put(ids))
+        slot = pool.acquire_slot()
+        try:
+            pool.write(state, slot)
+            store.put(sid, (slot, 0))
+        except Exception:
+            pool.release_slot(slot)
+            raise
+        return {"session_id": np.asarray(sid, object),
+                "batch": np.asarray(1, np.int32)}
+
+    def step_fn(inputs):
+        sid = _session_id(inputs)
+        slot, host_step = store.take(sid)
+        try:
+            row = batcher.step(slot)
+        except Exception:
+            # The pool row may be in an undefined state; retire the slot
+            # rather than hand it to a future session mid-generation.
+            pool.release_slot(slot)
+            raise
+        host_step += 1
+        if host_step < max_decode_len:
+            store.put(sid, (slot, host_step))
+        else:
+            pool.release_slot(slot)  # cache exhausted: session ends
+        return {"token": row["token"].reshape(-1),
+                "finished": row["finished"].reshape(-1).astype(np.int32),
+                "step": np.asarray(host_step, np.int32)}
+
+    def close_fn(inputs):
+        closed = store.close(_session_id(inputs))  # on_evict frees slot
+        return {"closed": np.asarray(int(closed), np.int32)}
+
+    session_spec = TensorSpec("DT_STRING", ())
+    init_sig = Signature(
+        fn=init_fn,
+        inputs={"session_id": session_spec,
+                "input_ids": TensorSpec(np.int32, (None, seq_len))},
+        outputs={"session_id": TensorSpec("DT_STRING", ()),
+                 "batch": TensorSpec(np.int32, ())},
+        on_host=True, batched=False,
+    )
+    step_sig = Signature(
+        fn=step_fn,
+        inputs={"session_id": session_spec},
+        outputs={"token": TensorSpec(np.int32, (None,)),
+                 "finished": TensorSpec(np.int32, (None,)),
+                 "step": TensorSpec(np.int32, ())},
+        on_host=True, batched=False,
+    )
+    close_sig = Signature(
+        fn=close_fn,
+        inputs={"session_id": session_spec},
+        outputs={"closed": TensorSpec(np.int32, ())},
+        on_host=True, batched=False,
+    )
     for sig in (init_sig, step_sig, close_sig):
         sig._decode_store = store
     return {"decode_init": init_sig, "decode_step": step_sig,

@@ -336,6 +336,7 @@ void* tpujson_parse_predict(const char* body, uint64_t len) {
   ParseResult r;
   bool ok = false;
   bool saw_payload = false;
+  bool saw_signature = false;
   if (ps.Consume('{')) {
     for (;;) {
       ps.SkipWs();
@@ -352,6 +353,8 @@ void* tpujson_parse_predict(const char* body, uint64_t len) {
         saw_payload = true;
         r.row_format = 0;
       } else if (key == "signature_name") {
+        if (saw_signature) break;  // duplicate key: decline, don't concat
+        saw_signature = true;
         ps.SkipWs();
         if (!ps.Consume('"')) break;
         if (!ParseString(&ps, &r.signature)) break;

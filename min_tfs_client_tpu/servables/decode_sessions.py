@@ -22,20 +22,29 @@ from __future__ import annotations
 
 import threading
 import time
+from typing import Callable, Optional
 
 from min_tfs_client_tpu.utils.status import ServingError
 
 
 class DecodeSessionStore:
-    """session id (bytes) -> opaque device-state pytree; TTL + capacity."""
+    """session id (bytes) -> opaque device-state pytree; TTL + capacity.
+
+    on_evict(state) fires whenever the store drops an entry WITHOUT
+    handing ownership to a caller — TTL sweep, close(), clear() — so a
+    slot-pooled state (an int slot index) can return to the free list.
+    take() transfers ownership and does not fire it.
+    """
 
     def __init__(self, *, max_sessions: int = 64, ttl_s: float = 600.0,
-                 metric_label: str = "default"):
+                 metric_label: str = "default",
+                 on_evict: Optional[Callable[[object], None]] = None):
         self._lock = threading.Lock()
         self._states: dict[bytes, tuple[object, float]] = {}
         self._max = max_sessions
         self._ttl = ttl_s
         self._metric_label = metric_label
+        self._on_evict = on_evict
 
     def set_metric_label(self, label: str) -> None:
         """Re-label the gauge cell (the loader knows the model name and
@@ -70,6 +79,14 @@ class DecodeSessionStore:
                 raise ServingError.resource_exhausted(
                     f"decode session capacity ({self._max}) reached; close "
                     "idle sessions or raise max_sessions")
+            displaced = self._states.get(session_id)
+            # A re-init over a live session drops the old state without
+            # handing it to anyone — fire on_evict (slot reclamation) the
+            # same as sweep/close, unless it's the same state coming back
+            # from a take()/put() step cycle.
+            if (displaced is not None and self._on_evict is not None
+                    and displaced[0] is not state):
+                self._on_evict(displaced[0])
             self._states[session_id] = (state, now)
             self._report()
 
@@ -89,12 +106,17 @@ class DecodeSessionStore:
 
     def close(self, session_id: bytes) -> bool:
         with self._lock:
-            existed = self._states.pop(session_id, None) is not None
+            entry = self._states.pop(session_id, None)
+            if entry is not None and self._on_evict is not None:
+                self._on_evict(entry[0])
             self._report()
-            return existed
+            return entry is not None
 
     def clear(self) -> None:
         with self._lock:
+            if self._on_evict is not None:
+                for state, _ in self._states.values():
+                    self._on_evict(state)
             self._states.clear()
             self._report()
 
@@ -104,6 +126,180 @@ class DecodeSessionStore:
         expired = [sid for sid, (_, t) in self._states.items()
                    if now - t > self._ttl]
         for sid in expired:
-            del self._states[sid]
+            state, _ = self._states.pop(sid)
+            if self._on_evict is not None:
+                self._on_evict(state)
         if expired:
             self._report()
+
+
+class SlotPool:
+    """Continuous batching: S sessions stacked into ONE device state.
+
+    The modern decode-serving design the reference has no analogue for
+    (vLLM-style continuous batching), built the TPU way: session state
+    lives in a statically-shaped slot pool (leaves `(S, 1, ...)` — S
+    single-sequence sessions), one jitted `tick` advances every
+    *requested* slot per device call (vmapped step + active-mask merge,
+    pool buffers donated so caches update in place), and slots are
+    recycled as sessions close or expire. K concurrent sessions cost one
+    dispatch per token instead of K.
+
+    step_fn(state) -> (new_state, outputs) must be pure over a single
+    session's state (leaves `(1, ...)`); params belong inside its closure.
+    """
+
+    def __init__(self, template_state, step_fn, *, max_slots: int):
+        import jax
+        import jax.numpy as jnp
+
+        self._jax = jax
+        self.max_slots = max_slots
+        shapes = jax.eval_shape(lambda: template_state)
+        self._pool = jax.tree_util.tree_map(
+            lambda sd: jnp.zeros((max_slots,) + sd.shape, sd.dtype), shapes)
+        self._lock = threading.Lock()
+        self._free = list(range(max_slots))
+
+        def write_fn(pool, state, slot):
+            def upd(p, s):
+                return jax.lax.dynamic_update_slice(
+                    p, s[None].astype(p.dtype),
+                    (slot,) + (0,) * s.ndim)
+            return jax.tree_util.tree_map(upd, pool, state)
+
+        def tick_fn(pool, active):
+            new_pool, outputs = jax.vmap(step_fn)(pool)
+
+            def merge(n, o):
+                mask = active.reshape((-1,) + (1,) * (n.ndim - 1))
+                return jnp.where(mask, n, o)
+
+            merged = jax.tree_util.tree_map(merge, new_pool, pool)
+            return merged, outputs
+
+        self._write_jit = jax.jit(write_fn, donate_argnums=(0,))
+        self._tick_jit = jax.jit(tick_fn, donate_argnums=(0,))
+
+    def acquire_slot(self) -> int:
+        with self._lock:
+            if not self._free:
+                raise ServingError.resource_exhausted(
+                    f"decode slot pool ({self.max_slots}) exhausted; close "
+                    "idle sessions or raise max_slots")
+            return self._free.pop()
+
+    def release_slot(self, slot: int) -> None:
+        with self._lock:
+            if slot not in self._free:
+                self._free.append(slot)
+
+    def write(self, state, slot: int) -> None:
+        """Park a freshly-prefilled session state into its slot."""
+        with self._lock:
+            self._pool = self._write_jit(self._pool, state,
+                                         self._jax.numpy.int32(slot))
+
+    def tick(self, slots: list[int]) -> dict[int, dict]:
+        """Advance the given slots in ONE device call; other slots'
+        state is untouched (masked merge). Returns per-slot host outputs
+        after a single overlapped fetch."""
+        import numpy as np
+
+        from min_tfs_client_tpu.servables.servable import fetch_outputs
+
+        with self._lock:
+            active = np.zeros((self.max_slots,), bool)
+            active[list(slots)] = True
+            self._pool, outputs = self._tick_jit(
+                self._pool, self._jax.numpy.asarray(active))
+        fetched = fetch_outputs(outputs)
+        return {s: {k: np.asarray(v)[s] for k, v in fetched.items()}
+                for s in slots}
+
+
+class _TickEntry:
+    __slots__ = ("done", "result", "error")
+
+    def __init__(self):
+        self.done = False
+        self.result = None
+        self.error = None
+
+
+class TickBatcher:
+    """Coalesces concurrent decode_step requests into shared ticks.
+
+    The first arriving thread becomes the leader: it waits a short join
+    window, snapshots all pending slots, runs one tick for the union, and
+    delivers each waiter its row — then keeps draining rounds until the
+    queue is empty (arrivals during a tick ride the next round). The
+    leader role hands off safely: a waiter that wakes to find no leader
+    takes over. Same-slot serialization is the session store's job (take/
+    put), not this class's.
+    """
+
+    def __init__(self, tick_fn, *, join_window_s: float = 0.0005):
+        self._tick_fn = tick_fn  # (sorted list[slot]) -> {slot: result}
+        self._join_window_s = join_window_s
+        self._cv = threading.Condition()
+        self._pending: dict[int, _TickEntry] = {}
+        self._inflight: set[int] = set()
+        self._leader = False
+
+    def step(self, slot: int):
+        entry = _TickEntry()
+        with self._cv:
+            while slot in self._pending or slot in self._inflight:
+                self._cv.wait()
+            self._pending[slot] = entry
+            if self._leader:
+                # A leader is running; wait for delivery — or take over
+                # if leadership lapses before our round runs.
+                while not entry.done:
+                    if not self._leader:
+                        self._leader = True
+                        break
+                    self._cv.wait()
+                if entry.done:
+                    if entry.error is not None:
+                        raise entry.error
+                    return entry.result
+                # fell through: we are the new leader
+            else:
+                self._leader = True
+        return self._lead(entry)
+
+    def _lead(self, own: _TickEntry):
+        try:
+            if self._join_window_s:
+                time.sleep(self._join_window_s)
+            while True:
+                with self._cv:
+                    batch = self._pending
+                    self._pending = {}
+                    self._inflight = set(batch)
+                if not batch:
+                    break
+                err = None
+                results: dict = {}
+                try:
+                    results = self._tick_fn(sorted(batch))
+                except Exception as exc:  # noqa: BLE001 - delivered to waiters
+                    err = exc
+                with self._cv:
+                    for s, e in batch.items():
+                        e.done = True
+                        e.error = err
+                        e.result = results.get(s)
+                    self._inflight = set()
+                    self._cv.notify_all()
+                    if own.done and not self._pending:
+                        break
+        finally:
+            with self._cv:
+                self._leader = False
+                self._cv.notify_all()
+        if own.error is not None:
+            raise own.error
+        return own.result

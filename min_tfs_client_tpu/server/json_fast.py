@@ -106,16 +106,17 @@ def parse_predict_fast(
         for i in range(n):
             view = lib.tpujson_tensor(handle, i).contents
             shape = tuple(view.shape[d] for d in range(view.rank))
-            flat = np.ctypeslib.as_array(
-                view.data, shape=(view.size,)).copy()
+            # Zero-copy view over the C buffer; the single astype below
+            # is the only materialization (valid until tpujson_free).
+            flat = np.ctypeslib.as_array(view.data, shape=(view.size,))
             arr = flat.reshape(shape)
             if view.all_int:
-                arr = arr.astype(np.int64)
-                if np.all(np.abs(arr) < 2 ** 31):
-                    arr = arr.astype(np.int32)
+                dtype = (np.int32 if flat.size == 0
+                         or np.abs(flat).max(initial=0) < 2 ** 31
+                         else np.int64)
             else:
-                arr = arr.astype(np.float32)
-            tensors[view.name.decode()] = arr
+                dtype = np.float32
+            tensors[view.name.decode()] = arr.astype(dtype)
         row = bool(lib.tpujson_row_format(handle))
         sig = lib.tpujson_signature(handle).decode()
         return tensors, row, sig
@@ -126,9 +127,12 @@ def parse_predict_fast(
 def _encode_array(lib, arr: np.ndarray) -> Optional[bytes]:
     """One tensor -> JSON array literal bytes, or None if unsupported."""
     if arr.dtype == np.dtype("float16") or str(arr.dtype) == "bfloat16":
+        # The Python path also renders these through a float32 cast.
         arr = arr.astype(np.float32)
     if arr.dtype == np.float64:
-        arr = arr.astype(np.float32)
+        # The Python path serializes f64 at full precision; an f32 cast
+        # here would fork response bytes by environment. Decline.
+        return None
     if arr.dtype == np.int64:
         if not np.all(np.abs(arr) < 2 ** 31):
             return None

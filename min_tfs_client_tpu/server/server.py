@@ -98,7 +98,12 @@ class ServerOptions:
 
 def _parse_channel_arguments(spec: str) -> list[tuple[str, object]]:
     """"grpc.max_send_message_length=4194304,..." -> grpc options list,
-    ints coerced (the main.cc grpc_channel_arguments format)."""
+    ints coerced (the main.cc grpc_channel_arguments format).
+
+    Serving tensors routinely exceed gRPC's 4 MB default, so the server
+    is unlimited by default (reference parity: server.cc:340
+    SetMaxMessageSize(kint32max)); explicit grpc_channel_arguments win.
+    """
     out: list[tuple[str, object]] = []
     for part in (spec or "").split(","):
         part = part.strip()
@@ -109,7 +114,12 @@ def _parse_channel_arguments(spec: str) -> list[tuple[str, object]]:
             raise ServingError.invalid_argument(
                 f"malformed gRPC channel argument {part!r} (want key=value)")
         out.append((key, int(value) if value.lstrip("-").isdigit() else value))
-    return out
+    user_keys = {key for key, _ in out}
+    defaults: list[tuple[str, object]] = [
+        ("grpc.max_send_message_length", -1),
+        ("grpc.max_receive_message_length", -1),
+    ]
+    return [d for d in defaults if d[0] not in user_keys] + out
 
 
 def _parse_text_proto(path: str, proto_cls):

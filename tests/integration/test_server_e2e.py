@@ -307,7 +307,11 @@ def test_profiler_rpc_on_main_port(server):
     from min_tfs_client_tpu.protos import tf_profiler_pb2 as pb
     from min_tfs_client_tpu.protos.grpc_service import ProfilerServiceStub
 
-    channel = grpc_mod.insecure_channel(f"127.0.0.1:{server.grpc_port}")
+    # Trace size scales with prior in-process jit activity; don't let the
+    # client's 4 MB default fail a large capture.
+    channel = grpc_mod.insecure_channel(
+        f"127.0.0.1:{server.grpc_port}",
+        options=[("grpc.max_receive_message_length", -1)])
     stub = ProfilerServiceStub(channel)
     mon = stub.Monitor(pb.MonitorRequest(), timeout=10)
     assert ":tensorflow:serving" in mon.data or "tensorflow" in mon.data

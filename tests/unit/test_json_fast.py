@@ -102,6 +102,9 @@ FALLBACK_BODIES = [
     b'{"inputs": [05]}',
     b'{"inputs": [5e]}',
     b'{"inputs": [--5]}',
+    # Duplicate signature_name: json.loads keeps the last value; the fast
+    # path must decline rather than concatenate.
+    b'{"signature_name": "a", "signature_name": "b", "inputs": [1.0]}',
 ]
 
 
@@ -165,6 +168,12 @@ class TestEncode:
 
     def test_int64_overflow_declines(self):
         outs = {"a": np.array([2 ** 40], np.int64)}
+        assert encode_predict_response_fast(outs, False) is None
+
+    def test_float64_outputs_decline(self):
+        # The Python path serializes f64 at full precision; casting to
+        # f32 here would fork response bytes by environment.
+        outs = {"a": np.array([1.0 / 3.0], np.float64)}
         assert encode_predict_response_fast(outs, False) is None
 
     def test_nonfinite_floats_match_python_json(self):
