@@ -604,6 +604,7 @@ def _rest_loopback_p50(base: pathlib.Path, x) -> float | None:
             return ts[len(ts) // 2]
         finally:
             srv.stop()
+            mon.unlink(missing_ok=True)
     except Exception:
         traceback.print_exc(file=sys.stderr)
         return None

@@ -125,13 +125,16 @@ def mha(
 
     With `cache` ({"k","v"} of (B, H, S_max, D)) and `cache_index`, the new
     K/V rows are written at cache_index and attention runs over the whole
-    cache with unwritten slots masked via lengths. Two cache modes, both
+    cache with unwritten slots masked via lengths. Cache modes, all
     jit-safe:
-     * prefill: x is the prompt, cache_index must be 0 — the full causal
-       prompt attention runs with queries at absolute positions 0..S;
+     * prefill: x is the prompt, cache_index 0 — full causal prompt
+       attention with queries at absolute positions 0..S;
      * decode: x is one token (S=1), cache_index is its absolute position —
        the single query is the newest position, so masking unwritten slots
-       subsumes causality.
+       subsumes causality;
+     * verify block: x is S>1 tokens at a (possibly traced) cache_index —
+       causal within the block at absolute offset cache_index, attending
+       the cache behind it (speculative decoding's target pass).
     Returns (output, updated_cache).
     """
     q = _heads(dense(params["query"], x), num_heads)
@@ -153,7 +156,10 @@ def mha(
         else:
             lengths = jnp.minimum(lengths, written)
         if x.shape[1] > 1:
-            causal_offset = 0  # prefill: queries sit at absolute 0..S
+            # Prefill (cache_index 0) and speculative verify blocks
+            # (cache_index = step): queries sit at absolute positions
+            # cache_index .. cache_index + S.
+            causal_offset = cache_index
         else:
             causal = False  # decode: lengths masking subsumes causality
 

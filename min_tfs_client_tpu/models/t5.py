@@ -167,15 +167,18 @@ def encode(params: dict, config: T5Config, input_ids: jax.Array,
 # -- decoder -----------------------------------------------------------------
 
 
-def _decoder_step(params: dict, config: T5Config, token: jax.Array,
-                  step: jax.Array, caches: list[dict], encoded: jax.Array,
-                  enc_lengths: jax.Array) -> tuple[jax.Array, list[dict]]:
-    """One decode position: token (B, 1) at absolute position `step`.
-    Returns (logits (B, vocab), updated caches)."""
+def _decoder_positions(params: dict, config: T5Config, tokens: jax.Array,
+                       step: jax.Array, caches: list[dict],
+                       encoded: jax.Array, enc_lengths: jax.Array
+                       ) -> tuple[jax.Array, list[dict]]:
+    """Decode a block of L positions: tokens (B, L) at absolute positions
+    step .. step+L (causal within the block, attending the cache behind
+    it). L=1 is the classic decode step; L=k+1 is a speculative verify
+    block. Returns (logits (B, L, vocab), updated caches)."""
     dec = params["decoder"]
-    x = nn.embed(params["shared_embedding"], token)
+    x = nn.embed(params["shared_embedding"], tokens)
     max_len = caches[0]["self"]["k"].shape[2]
-    bias = relative_bias(dec["rel_bias"], config, 1, max_len,
+    bias = relative_bias(dec["rel_bias"], config, tokens.shape[1], max_len,
                          bidirectional=False, q_offset=step)
     new_caches = []
     for layer, cache in zip(dec["layers"], caches):
@@ -198,6 +201,16 @@ def _decoder_step(params: dict, config: T5Config, token: jax.Array,
     logits = jnp.einsum(
         "bld,vd->blv", x.astype(jnp.float32) / np.sqrt(config.d_model),
         params["shared_embedding"]["embedding"])
+    return logits, new_caches
+
+
+def _decoder_step(params: dict, config: T5Config, token: jax.Array,
+                  step: jax.Array, caches: list[dict], encoded: jax.Array,
+                  enc_lengths: jax.Array) -> tuple[jax.Array, list[dict]]:
+    """One decode position: token (B, 1) at absolute position `step`.
+    Returns (logits (B, vocab), updated caches)."""
+    logits, new_caches = _decoder_positions(
+        params, config, token, step, caches, encoded, enc_lengths)
     return logits[:, 0], new_caches
 
 
@@ -232,6 +245,121 @@ def greedy_decode(params: dict, config: T5Config, input_ids: jax.Array,
     return output_ids, out_lengths
 
 
+def speculative_decode(
+    params: dict,
+    config: T5Config,
+    draft_params: dict,
+    draft_config: T5Config,
+    input_ids: jax.Array,
+    lengths: jax.Array,
+    *,
+    max_decode_len: int,
+    k: int = 4,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Greedy speculative decoding: draft proposes k tokens, the target
+    verifies all of them in ONE decoder pass (`_decoder_positions` block).
+
+    Token-exact versus `greedy_decode(params, config, ...)` by
+    construction: only tokens the target's own greedy argmax would emit
+    are ever accepted, so the draft quality affects speed, never output.
+    Per round the target runs once over k+1 positions and advances
+    n_accepted+1 tokens (1..k+1); with a good draft that's ~k+1 tokens
+    per target pass instead of 1 — the MXU sees k+1-wide matmuls instead
+    of width-1 vectors, which is where the speedup comes from on TPU.
+
+    Batched: examples advance in lockstep by the batch-min acceptance
+    (conservative, still exact); finished examples emit pad (oracle
+    semantics). Returns (output_ids (B, max_decode_len), output_lengths
+    (B,), target_passes scalar int32 — rounds of target execution, for
+    acceptance-rate accounting).
+    """
+    b = input_ids.shape[0]
+    encoded_t = encode(params, config, input_ids, lengths)
+    encoded_d = encode(draft_params, draft_config, input_ids, lengths)
+    cache_len = max_decode_len + k  # room for the last round's overshoot
+    caches_t = [{"self": nn.init_cache(b, config.num_heads, cache_len,
+                                       config.d_kv)}
+                for _ in range(config.num_decoder_layers)]
+    caches_d = [{"self": nn.init_cache(b, draft_config.num_heads, cache_len,
+                                       draft_config.d_kv)}
+                for _ in range(draft_config.num_decoder_layers)]
+    out0 = jnp.full((b, max_decode_len + k + 1), config.pad_id, jnp.int32)
+    cur0 = jnp.full((b, 1), config.decoder_start_id, jnp.int32)
+
+    def cond(carry):
+        step, _, finished, *_ = carry
+        return jnp.logical_and(step < max_decode_len,
+                               jnp.logical_not(jnp.all(finished)))
+
+    def body(carry):
+        step, cur, finished, caches_t, caches_d, out, passes = carry
+
+        # Draft: k greedy single-token steps from `cur`.
+        def dstep(c, i):
+            tok, caches_d = c
+            logits, caches_d = _decoder_step(
+                draft_params, draft_config, tok, step + i, caches_d,
+                encoded_d, lengths)
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)[:, None]
+            return (nxt, caches_d), nxt[:, 0]
+
+        (_, caches_d), d_tokens = jax.lax.scan(
+            dstep, (cur, caches_d), jnp.arange(k))
+        d_tokens = d_tokens.T  # (B, k)
+
+        # Target: ONE pass over the k+1-position block [cur, d_1..d_k].
+        block = jnp.concatenate([cur, d_tokens], axis=1)  # (B, k+1)
+        logits, caches_t = _decoder_positions(
+            params, config, block, step, caches_t, encoded_t, lengths)
+        t_pred = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # (B, k+1)
+
+        # Acceptance: longest prefix where the draft matched the target's
+        # own greedy choice; batch-min keeps examples in lockstep.
+        # Finished rows count as all-accepted — their emissions are
+        # pad-masked regardless, and letting their (meaningless) draft
+        # mismatches pin the batch min would degrade every live row to
+        # one token per round.
+        matches = (d_tokens == t_pred[:, :k]).astype(jnp.int32)
+        matches = jnp.where(finished[:, None], 1, matches)
+        n_acc = jnp.min(jnp.sum(jnp.cumprod(matches, axis=1), axis=1))
+        n_emit = n_acc + 1  # accepted drafts + the target's bonus token
+
+        # Oracle emission semantics: finished examples emit pad; EOS
+        # flips finished from the next position on.
+        def emit(fin, raw):
+            tok = jnp.where(fin, config.pad_id, raw)
+            return jnp.logical_or(fin, tok == config.eos_id), tok
+
+        finished_in = finished
+        _, emitted = jax.lax.scan(emit, finished_in, t_pred.T)
+        emitted = emitted.T  # (B, k+1)
+        # The scan's final flag saw positions beyond n_emit (not actually
+        # emitted — they are overwritten next round or masked after the
+        # loop); recompute `finished` over the kept prefix only.
+        kept = jnp.arange(k + 1)[None, :] < n_emit
+        finished = jnp.logical_or(
+            finished_in,
+            jnp.any(jnp.logical_and(emitted == config.eos_id, kept),
+                    axis=1))
+
+        out = jax.lax.dynamic_update_slice(out, emitted, (0, step))
+        cur = jnp.take_along_axis(
+            emitted, jnp.full((b, 1), n_acc, jnp.int32), axis=1)
+        return (step + n_emit, cur, finished, caches_t, caches_d, out,
+                passes + 1)
+
+    step, _, finished, _, _, out, passes = jax.lax.while_loop(
+        cond, body,
+        (jnp.int32(0), cur0, jnp.zeros((b,), bool), caches_t, caches_d,
+         out0, jnp.int32(0)))
+    # Positions past the final frontier were never emitted: oracle pads
+    # them (the loop only exits early when every example is finished).
+    pos = jnp.arange(max_decode_len + k + 1)[None, :]
+    out = jnp.where(pos < step, out, config.pad_id)[:, :max_decode_len]
+    out_lengths = jnp.sum((out != config.pad_id).astype(jnp.int32), axis=-1)
+    return out, out_lengths, passes
+
+
 # -- servable construction ---------------------------------------------------
 
 
@@ -239,7 +367,10 @@ def build_signatures(params: dict, config: T5Config, *, seq_len: int,
                      max_decode_len: int,
                      continuous_batching: bool = False,
                      max_sessions: int = 64,
-                     session_ttl_s: float = 600.0) -> dict:
+                     session_ttl_s: float = 600.0,
+                     draft_params: dict | None = None,
+                     draft_config: "T5Config | None" = None,
+                     speculative_k: int = 4) -> dict:
     from min_tfs_client_tpu.servables.servable import Signature, TensorSpec
 
     def decode_fn(params, inputs):
@@ -276,6 +407,35 @@ def build_signatures(params: dict, config: T5Config, *, seq_len: int,
 
     signatures = {"serving_default": decode_sig, "decode": decode_sig,
                   "encode": encode_sig}
+
+    if draft_params is not None:
+        if draft_config is None:
+            raise ValueError("draft_params requires draft_config")
+
+        def spec_fn(params, inputs):
+            ids = jnp.asarray(inputs["input_ids"], jnp.int32)
+            lens = jnp.sum((ids != config.pad_id).astype(jnp.int32),
+                           axis=-1)
+            out_ids, out_lengths, passes = speculative_decode(
+                params, config, draft_params, draft_config, ids, lens,
+                max_decode_len=max_decode_len, k=speculative_k)
+            return {"output_ids": out_ids,
+                    "output_lengths": out_lengths,
+                    "target_passes": jnp.broadcast_to(
+                        passes, out_lengths.shape)}
+
+        signatures["decode_speculative"] = Signature(
+            fn=spec_fn,
+            params=params,
+            inputs={"input_ids": TensorSpec(np.int32, (None, seq_len))},
+            outputs={
+                "output_ids": TensorSpec(np.int32, (None, max_decode_len)),
+                "output_lengths": TensorSpec(np.int32, (None,)),
+                "target_passes": TensorSpec(np.int32, (None,)),
+            },
+            batch_buckets=(1, 4, 16, 32),
+        )
+
     signatures.update(build_session_signatures(
         params, config, seq_len=seq_len, max_decode_len=max_decode_len,
         max_sessions=max_sessions, session_ttl_s=session_ttl_s,
@@ -430,12 +590,27 @@ def build_session_signatures(params: dict, config: T5Config, *, seq_len: int,
         outputs={"closed": TensorSpec(np.int32, ())},
         on_host=True, batched=False,
     )
+    init_sig.warmup_fn = _session_warmup_fn(
+        init_fn, step_fn, close_fn, seq_len)
     # The loader re-labels the store's gauge with the real model:version
     # (platforms.make_loader) — the family builder doesn't know it.
     for sig in (init_sig, step_sig, close_sig):
         sig._decode_store = store
     return {"decode_init": init_sig, "decode_step": step_sig,
             "decode_close": close_sig}
+
+
+def _session_warmup_fn(init_fn, step_fn, close_fn, seq_len: int):
+    """Prime prefill + step/tick executables with a throwaway session so
+    the first real decode_init/step never compiles (synthesize_warmup
+    calls this through the warmup_fn hook)."""
+    def _warm():
+        sid = b"__warmup__"
+        ids = np.zeros((1, seq_len), np.int32)
+        init_fn({"session_id": np.asarray(sid, object), "input_ids": ids})
+        step_fn({"session_id": np.asarray(sid, object)})
+        close_fn({"session_id": np.asarray(sid, object)})
+    return _warm
 
 
 def _build_pooled_session_signatures(params: dict, config: T5Config, *,
@@ -545,6 +720,9 @@ def _build_pooled_session_signatures(params: dict, config: T5Config, *,
         outputs={"closed": TensorSpec(np.int32, ())},
         on_host=True, batched=False,
     )
+
+    init_sig.warmup_fn = _session_warmup_fn(
+        init_fn, step_fn, close_fn, seq_len)
     for sig in (init_sig, step_sig, close_sig):
         sig._decode_store = store
     return {"decode_init": init_sig, "decode_step": step_sig,

@@ -243,6 +243,10 @@ def attention(
         and bias is None
         and q.shape[-1] % 8 == 0
         and q.shape[-2] >= 8
+        # The kernel takes causal_offset as a static arg; a traced offset
+        # (speculative verify blocks at a dynamic step) uses the
+        # reference path.
+        and isinstance(causal_offset, (int, type(None)))
     )
     if use_pallas:
         return flash_attention(

@@ -76,8 +76,14 @@ def hybrid_mesh(
     names = list(dcn_axes) + list(ici_axes)
     mesh_shape = [1] * len(dcn_axes) + [ici_axes[n] for n in ici_axes]
     dcn_shape = [dcn_axes[n] for n in dcn_axes] + [1] * len(ici_axes)
+    # TPU pods group by slice_index; platforms without real slice
+    # partitioning (CPU multi-process, single-slice clusters) group by
+    # process (one process == one "slice" of the DCN topology).
+    slice_ids = {getattr(d, "slice_index", None) for d in jax.devices()}
+    by_process = len(slice_ids) <= 1
     devices = mesh_utils.create_hybrid_device_mesh(
-        mesh_shape=mesh_shape, dcn_mesh_shape=dcn_shape)
+        mesh_shape=mesh_shape, dcn_mesh_shape=dcn_shape,
+        process_is_granule=by_process)
     return Mesh(devices, names)
 
 

@@ -294,7 +294,11 @@ class TickBatcher:
                         e.result = results.get(s)
                     self._inflight = set()
                     self._cv.notify_all()
-                    if own.done and not self._pending:
+                    # Return as soon as our own round ran — pending
+                    # arrivals elect a new leader via the handoff path in
+                    # step() (a leader that kept draining would give its
+                    # own caller unbounded latency under sustained load).
+                    if own.done:
                         break
         finally:
             with self._cv:

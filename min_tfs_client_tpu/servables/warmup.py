@@ -134,9 +134,17 @@ def synthesize_warmup(servable: Servable) -> int:
     runs = 0
     seen: set[int] = set()
     for signature in servable.signatures.values():
-        if signature.on_host or not signature.batched:
-            continue
         if id(signature) in seen:  # aliased keys share one Signature
+            continue
+        # Host signatures that own device executables (decode sessions:
+        # prefill + step jits) expose warmup_fn to prime them here.
+        warm = getattr(signature, "warmup_fn", None)
+        if warm is not None:
+            seen.add(id(signature))
+            warm()
+            runs += 1
+            continue
+        if signature.on_host or not signature.batched:
             continue
         seen.add(id(signature))
         # One executable per (batch bucket x seq bucket): prime the full

@@ -1,0 +1,115 @@
+"""True multi-process distributed serving runtime test.
+
+Two OS processes (4 virtual CPU devices each) join via
+`parallel.distributed.initialize` (the JAX coordination service — our
+control plane, replacing the reference's distributed_runtime gRPC
+master/worker stack), build a hybrid DCN x ICI mesh with
+`distributed.hybrid_mesh`, and run cross-process collectives: a global
+psum and a tensor-parallel matmul whose reduction spans device shards.
+This is the multi-host story executed for real — not a single-process
+simulation.
+"""
+
+from __future__ import annotations
+
+import pathlib
+import socket
+import subprocess
+import sys
+
+REPO = str(pathlib.Path(__file__).resolve().parents[2])
+
+WORKER = r'''
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=4")
+sys.path.insert(0, {repo!r})
+
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from min_tfs_client_tpu.parallel import distributed
+
+pid = int(sys.argv[1])
+distributed.initialize(coordinator_address={coord!r},
+                       num_processes=2, process_id=pid)
+assert jax.process_count() == 2, jax.process_count()
+assert jax.device_count() == 8, jax.device_count()
+assert jax.local_device_count() == 4
+
+# Hybrid mesh: replica axis spans the two processes (the DCN analogue),
+# data x model ride within a process (the ICI analogue).
+mesh = distributed.hybrid_mesh({{"data": 2, "model": 2}}, {{"replica": 2}})
+assert dict(mesh.shape) == {{"replica": 2, "data": 2, "model": 2}}, mesh.shape
+
+# 1. Cross-process reduction: each process contributes its own values
+# along a process-spanning sharded dim; the jitted sum must see both.
+arr = jax.make_array_from_process_local_data(
+    NamedSharding(mesh, P(("replica", "data"))),
+    np.full((2, 8), float(pid + 1), np.float32))
+
+@jax.jit
+def global_sum(a):
+    return a.sum()
+
+total = float(global_sum(arr))
+assert total == 2 * 8 * 1.0 + 2 * 8 * 2.0, total
+
+# 2. Tensor-parallel matmul: w sharded on the contracted dim over
+# "model" -- GSPMD inserts the reduction across shards. Compared on
+# device (the result may not be fully addressable from one process).
+k, n, b = 16, 8, 4
+w_full = np.arange(k * n, dtype=np.float32).reshape(k, n) / 100.0
+x_full = np.ones((b, k), np.float32)
+w = jax.make_array_from_process_local_data(
+    NamedSharding(mesh, P("model", None)), w_full)
+x = jax.make_array_from_process_local_data(
+    NamedSharding(mesh, P()), x_full)
+want = jax.make_array_from_process_local_data(
+    NamedSharding(mesh, P()), x_full @ w_full)
+
+@jax.jit
+def max_abs_err(x, w, want):
+    return jnp.abs(x @ w - want).max()
+
+err = float(max_abs_err(x, w, want))
+assert err < 1e-5, err
+
+print(f"proc {{pid}}: multihost OK", flush=True)
+jax.distributed.shutdown()
+'''
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_distributed_mesh(tmp_path):
+    coord = f"127.0.0.1:{_free_port()}"
+    script = tmp_path / "worker.py"
+    script.write_text(WORKER.format(repo=REPO, coord=coord))
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-u", str(script), str(i)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        for i in range(2)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=420)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append(out)
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"proc {i} rc={p.returncode}:\n{out}"
+        assert f"proc {i}: multihost OK" in out, out

@@ -172,6 +172,31 @@ class TestPooledSessions:
         assert err.value.code == 3  # INVALID_ARGUMENT
 
 
+def test_synthesize_warmup_primes_session_executables():
+    """synthesize_warmup runs the warmup_fn hook: a throwaway session
+    exercises prefill + tick, then closes — no session/slot leaks."""
+    import types
+
+    from min_tfs_client_tpu.servables.warmup import synthesize_warmup
+
+    config = t5.T5Config.tiny()
+    params = t5.init_params(jax.random.PRNGKey(0), config)
+    for continuous in (False, True):
+        sigs = t5.build_session_signatures(
+            params, config, seq_len=SEQ, max_decode_len=MAXDEC,
+            max_sessions=4, continuous_batching=continuous)
+        servable = types.SimpleNamespace(signatures=sigs)
+        runs = synthesize_warmup(servable)
+        assert runs == 1
+        store = sigs["decode_init"]._decode_store
+        assert len(store) == 0  # warmup session closed behind itself
+        # Every slot available again in the pooled case.
+        sid = np.asarray(b"after-warm", object)
+        ids = np.zeros((1, SEQ), np.int32)
+        sigs["decode_init"].run({"session_id": sid, "input_ids": ids})
+        sigs["decode_close"].run({"session_id": sid})
+
+
 class TestTickBatcher:
     def test_concurrent_steps_coalesce(self):
         batch_sizes = []

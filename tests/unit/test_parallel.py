@@ -179,9 +179,10 @@ def test_hybrid_mesh_multi_slice_call_contract(monkeypatch):
 
     seen = {}
 
-    def fake_create(mesh_shape, dcn_mesh_shape):
+    def fake_create(mesh_shape, dcn_mesh_shape, process_is_granule):
         seen["mesh_shape"] = mesh_shape
         seen["dcn_mesh_shape"] = dcn_mesh_shape
+        seen["process_is_granule"] = process_is_granule
         total_shape = [a * b for a, b in zip(mesh_shape, dcn_mesh_shape)]
         return np.array(jax.devices()).reshape(total_shape)
 
@@ -189,6 +190,8 @@ def test_hybrid_mesh_multi_slice_call_contract(monkeypatch):
     mesh = distributed.hybrid_mesh({"data": 2, "model": 2}, {"replica": 2})
     assert seen["mesh_shape"] == [1, 2, 2]
     assert seen["dcn_mesh_shape"] == [2, 1, 1]
+    # Single-process CPU has no real slice partitioning: granule=process.
+    assert seen["process_is_granule"] is True
     assert dict(mesh.shape) == {"replica": 2, "data": 2, "model": 2}
 
 

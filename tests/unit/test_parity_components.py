@@ -156,10 +156,15 @@ def test_curry_signature_binds_fixed_inputs():
 
 
 def test_parse_channel_arguments():
-    assert _parse_channel_arguments("") == []
+    # Unlimited message sizes by default (server.cc:340 parity) ...
+    assert _parse_channel_arguments("") == [
+        ("grpc.max_send_message_length", -1),
+        ("grpc.max_receive_message_length", -1)]
+    # ... with explicit user values overriding the default for that key.
     assert _parse_channel_arguments(
         "grpc.max_send_message_length=4194304,grpc.lb_policy_name=pick_first"
-    ) == [("grpc.max_send_message_length", 4194304),
+    ) == [("grpc.max_receive_message_length", -1),
+          ("grpc.max_send_message_length", 4194304),
           ("grpc.lb_policy_name", "pick_first")]
     with pytest.raises(ServingError, match="key=value"):
         _parse_channel_arguments("bogus")

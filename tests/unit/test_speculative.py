@@ -1,0 +1,158 @@
+"""Speculative decoding: draft proposes, target verifies in one pass.
+
+The correctness bar is absolute: output must be TOKEN-EXACT against
+`greedy_decode` for ANY draft — a perfect draft only changes how many
+target passes the generation costs, never its result.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import numpy as np
+import pytest
+
+from min_tfs_client_tpu.models import t5
+
+MAXDEC = 10
+SEQ = 12
+
+
+@pytest.fixture(scope="module")
+def models():
+    config = t5.T5Config.tiny()
+    params = t5.init_params(jax.random.PRNGKey(0), config)
+    # A differently-seeded draft (disagrees often) and a structurally
+    # smaller draft (1 decoder layer).
+    rand_draft = t5.init_params(jax.random.PRNGKey(7), config)
+    small_config = t5.T5Config.tiny(num_decoder_layers=1)
+    small_draft = t5.init_params(jax.random.PRNGKey(3), small_config)
+    return config, params, rand_draft, small_config, small_draft
+
+
+def _prompts(config, n=2, rng_seed=0):
+    rng = np.random.default_rng(rng_seed)
+    ids = rng.integers(2, config.vocab_size, (n, SEQ)).astype(np.int32)
+    ids[:, 7:] = config.pad_id
+    lengths = np.sum(ids != config.pad_id, axis=-1).astype(np.int32)
+    return ids, lengths
+
+
+class TestSpeculativeDecode:
+    @pytest.mark.parametrize("k", [1, 3, 5])
+    def test_token_exact_with_perfect_draft(self, models, k):
+        config, params, *_ = models
+        ids, lengths = _prompts(config)
+        want, want_len = t5.greedy_decode(
+            params, config, ids, lengths, max_decode_len=MAXDEC)
+        got, got_len, passes = t5.speculative_decode(
+            params, config, params, config, ids, lengths,
+            max_decode_len=MAXDEC, k=k)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+        np.testing.assert_array_equal(np.asarray(got_len),
+                                      np.asarray(want_len))
+        # A perfect draft advances k+1 tokens per target pass.
+        assert int(passes) == -(-MAXDEC // (k + 1))
+
+    @pytest.mark.parametrize("seed", [1, 2, 3])
+    def test_token_exact_with_disagreeing_draft(self, models, seed):
+        config, params, rand_draft, *_ = models
+        ids, lengths = _prompts(config, rng_seed=seed)
+        want, _ = t5.greedy_decode(
+            params, config, ids, lengths, max_decode_len=MAXDEC)
+        got, _, passes = t5.speculative_decode(
+            params, config, rand_draft, config, ids, lengths,
+            max_decode_len=MAXDEC, k=3)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+        assert 1 <= int(passes) <= MAXDEC
+
+    def test_token_exact_with_smaller_draft_architecture(self, models):
+        config, params, _, small_config, small_draft = models
+        ids, lengths = _prompts(config)
+        want, _ = t5.greedy_decode(
+            params, config, ids, lengths, max_decode_len=MAXDEC)
+        got, _, _ = t5.speculative_decode(
+            params, config, small_draft, small_config, ids, lengths,
+            max_decode_len=MAXDEC, k=4)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    def test_eos_and_padding_semantics(self, models):
+        """Force early EOS by declaring the token the model actually
+        emits mid-stream to BE the EOS id: post-EOS positions must be
+        pad, lengths must match the oracle exactly."""
+        config, params, *_ = models
+        ids, lengths = _prompts(config)
+        probe, _ = t5.greedy_decode(
+            params, config, ids, lengths, max_decode_len=MAXDEC)
+        eos = int(np.asarray(probe)[0, 2])  # emitted at position 2
+        assert eos != config.pad_id
+        cfg = dataclasses.replace(config, eos_id=eos)
+        want, want_len = t5.greedy_decode(
+            params, cfg, ids, lengths, max_decode_len=MAXDEC)
+        got, got_len, _ = t5.speculative_decode(
+            params, cfg, params, cfg, ids, lengths,
+            max_decode_len=MAXDEC, k=3)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+        np.testing.assert_array_equal(np.asarray(got_len),
+                                      np.asarray(want_len))
+        # The scenario actually exercised early stop.
+        assert int(np.asarray(want_len).max()) < MAXDEC
+
+    def test_finished_row_does_not_pin_acceptance(self, models):
+        """A row that finishes early must not drag the batch-min
+        acceptance to zero: with a perfect draft, the pass count stays at
+        the ceil(MAXDEC/(k+1)) optimum even when row 0 hit EOS at the
+        start."""
+        config, params, *_ = models
+        ids, lengths = _prompts(config)
+        probe, _ = t5.greedy_decode(
+            params, config, ids, lengths, max_decode_len=MAXDEC)
+        # Declare row 0's first emitted token as EOS: row 0 finishes at
+        # position 0 while row 1 (different prompt) keeps decoding.
+        eos = int(np.asarray(probe)[0, 0])
+        cfg = dataclasses.replace(config, eos_id=eos)
+        want, _ = t5.greedy_decode(
+            params, cfg, ids, lengths, max_decode_len=MAXDEC)
+        got, _, passes = t5.speculative_decode(
+            params, cfg, params, cfg, ids, lengths,
+            max_decode_len=MAXDEC, k=3)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+        assert int(passes) <= -(-MAXDEC // 4) + 1
+
+    def test_jit_compatible(self, models):
+        config, params, rand_draft, *_ = models
+        ids, lengths = _prompts(config)
+        fn = jax.jit(lambda ids, lens: t5.speculative_decode(
+            params, config, rand_draft, config, ids, lens,
+            max_decode_len=MAXDEC, k=2))
+        want, _ = t5.greedy_decode(
+            params, config, ids, lengths, max_decode_len=MAXDEC)
+        got, _, _ = fn(ids, lengths)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+class TestServingSurface:
+    def test_decode_speculative_signature(self, models, tmp_path):
+        """Full path: export with a draft model -> load -> the
+        decode_speculative signature serves oracle-equal outputs."""
+        from min_tfs_client_tpu.models import export
+
+        config, params, rand_draft, *_ = models
+        base = tmp_path / "t5spec"
+        export.export_servable(
+            base, 1, "t5", dataclasses.asdict(config), params,
+            signature_kwargs={"seq_len": SEQ, "max_decode_len": MAXDEC,
+                              "speculative_k": 3},
+            draft=(dataclasses.asdict(config), rand_draft))
+        sigs = export.load_signatures(base / "1")
+        assert "decode_speculative" in sigs
+        ids, lengths = _prompts(config)
+        want = sigs["decode"].run({"input_ids": ids})
+        got = sigs["decode_speculative"].run({"input_ids": ids})
+        np.testing.assert_array_equal(got["output_ids"],
+                                      want["output_ids"])
+        np.testing.assert_array_equal(got["output_lengths"],
+                                      want["output_lengths"])
+        assert got["target_passes"].shape == (2,)
+        assert int(got["target_passes"][0]) >= 1
