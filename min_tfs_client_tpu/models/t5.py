@@ -412,12 +412,13 @@ def build_signatures(params: dict, config: T5Config, *, seq_len: int,
         if draft_config is None:
             raise ValueError("draft_params requires draft_config")
 
-        def spec_fn(params, inputs):
+        def spec_fn(bundle, inputs):
             ids = jnp.asarray(inputs["input_ids"], jnp.int32)
             lens = jnp.sum((ids != config.pad_id).astype(jnp.int32),
                            axis=-1)
             out_ids, out_lengths, passes = speculative_decode(
-                params, config, draft_params, draft_config, ids, lens,
+                bundle["target"], config, bundle["draft"],
+                draft_config, ids, lens,
                 max_decode_len=max_decode_len, k=speculative_k)
             return {"output_ids": out_ids,
                     "output_lengths": out_lengths,
@@ -426,7 +427,10 @@ def build_signatures(params: dict, config: T5Config, *, seq_len: int,
 
         signatures["decode_speculative"] = Signature(
             fn=spec_fn,
-            params=params,
+            # BOTH weight trees ride as jit arguments: a closed-over
+            # draft would be re-baked as constants into every batch
+            # bucket's executable.
+            params={"target": params, "draft": draft_params},
             inputs={"input_ids": TensorSpec(np.int32, (None, seq_len))},
             outputs={
                 "output_ids": TensorSpec(np.int32, (None, max_decode_len)),
@@ -520,13 +524,16 @@ def build_session_signatures(params: dict, config: T5Config, *, seq_len: int,
     from min_tfs_client_tpu.servables.servable import Signature, TensorSpec
     from min_tfs_client_tpu.utils.status import ServingError
 
+    from min_tfs_client_tpu.models.quantize import maybe_dequantize
+
     store = DecodeSessionStore(max_sessions=max_sessions,
                                ttl_s=session_ttl_s, metric_label="t5")
     prefill_jit = jax.jit(
-        lambda p, ids: prefill_state(p, config, ids,
+        lambda p, ids: prefill_state(maybe_dequantize(p), config, ids,
                                      max_decode_len=max_decode_len))
     step_jit = jax.jit(
-        lambda p, s: decode_step_state(p, config, s), donate_argnums=(1,))
+        lambda p, s: decode_step_state(maybe_dequantize(p), config, s),
+        donate_argnums=(1,))
 
     def _session_id(inputs) -> bytes:
         raw = np.asarray(inputs["session_id"]).reshape(-1)
@@ -628,13 +635,16 @@ def _build_pooled_session_signatures(params: dict, config: T5Config, *,
     from min_tfs_client_tpu.servables.servable import Signature, TensorSpec
     from min_tfs_client_tpu.utils.status import ServingError
 
+    from min_tfs_client_tpu.models.quantize import maybe_dequantize
+
     template = jax.eval_shape(
-        lambda p, ids: prefill_state(p, config, ids,
+        lambda p, ids: prefill_state(maybe_dequantize(p), config, ids,
                                      max_decode_len=max_decode_len),
         params, jax.ShapeDtypeStruct((1, seq_len), jnp.int32))
 
     def one_step(state):
-        new_state, token = decode_step_state(params, config, state)
+        new_state, token = decode_step_state(
+            maybe_dequantize(params), config, state)
         return new_state, {"token": token,
                            "finished": new_state["finished"]}
 
@@ -645,7 +655,7 @@ def _build_pooled_session_signatures(params: dict, config: T5Config, *,
         metric_label="t5-pooled",
         on_evict=lambda entry: pool.release_slot(entry[0]))
     prefill_jit = jax.jit(
-        lambda p, ids: prefill_state(p, config, ids,
+        lambda p, ids: prefill_state(maybe_dequantize(p), config, ids,
                                      max_decode_len=max_decode_len))
 
     def _session_id(inputs) -> bytes:

@@ -167,6 +167,8 @@ class Signature:
                                       compare=False)
 
     _jitted: Callable | None = dc_field(default=None, repr=False, compare=False)
+    _resolved_fn: Callable | None = dc_field(default=None, repr=False,
+                                             compare=False)
 
     def __post_init__(self):
         if self.transfer_casts:
@@ -191,8 +193,31 @@ class Signature:
         if self._jitted is None:
             import jax
 
-            self._jitted = jax.jit(self.fn)
+            self._jitted = jax.jit(self._device_fn())
         return self._jitted
+
+    def _device_fn(self) -> Callable:
+        """self.fn, with int8 weights dequantized INSIDE the traced
+        computation (XLA fuses the dequant into the consuming matmuls;
+        HBM keeps the int8 residency). Resolved once — the quantization
+        walk must not run per request."""
+        if self._resolved_fn is not None:
+            return self._resolved_fn
+        fn = self.fn
+        if self.params is not None:
+            from min_tfs_client_tpu.models.quantize import (
+                dequantize_tree,
+                is_quantized,
+            )
+
+            if is_quantized(self.params):
+                inner = fn
+
+                def fn(params, arrays):
+                    return inner(dequantize_tree(params), arrays)
+
+        self._resolved_fn = fn
+        return fn
 
     def _execute(self, arrays: dict) -> dict:
         if self.params is not None:
@@ -257,7 +282,7 @@ class Signature:
         keys = list(output_filter) if output_filter else list(self.outputs)
 
         if self.on_host:
-            outputs = (self.fn(self.params, arrays)
+            outputs = (self._device_fn()(self.params, arrays)
                        if self.params is not None else self.fn(arrays))
             self._check_produced(outputs, keys)
             return {k: np.asarray(outputs[k]) for k in keys}
@@ -534,7 +559,7 @@ class Servable:
         if fused is None:
             import jax
 
-            fn_map = {k: s.fn for k, s in sigs.items()}
+            fn_map = {k: s._device_fn() for k, s in sigs.items()}
 
             def union_fn(params_map, arrays):
                 return {
