@@ -642,13 +642,13 @@ def _build_pooled_session_signatures(params: dict, config: T5Config, *,
                                      max_decode_len=max_decode_len),
         params, jax.ShapeDtypeStruct((1, seq_len), jnp.int32))
 
-    def one_step(state):
+    def one_step(p, state):
         new_state, token = decode_step_state(
-            maybe_dequantize(params), config, state)
+            maybe_dequantize(p), config, state)
         return new_state, {"token": token,
                            "finished": new_state["finished"]}
 
-    pool = SlotPool(template, one_step, max_slots=max_slots)
+    pool = SlotPool(template, one_step, max_slots=max_slots, params=params)
     batcher = TickBatcher(pool.tick)
     store = DecodeSessionStore(
         max_sessions=max_slots, ttl_s=session_ttl_s,

@@ -76,6 +76,13 @@ def infer_transformer_specs(
     replicated — always correct, just not memory-saving.
     """
 
+    from min_tfs_client_tpu.models.quantize import (
+        _DT,
+        _Q,
+        _SCALE,
+        _is_quant_node,
+    )
+
     def sp(*axes):
         return logical_spec(*axes, rules=rules, mesh=mesh)
 
@@ -83,6 +90,19 @@ def infer_transformer_specs(
         if isinstance(node, (list, tuple)):
             out = [walk(x, path) for x in node]
             return type(node)(out) if isinstance(node, tuple) else out
+        if _is_quant_node(node):
+            # int8-quantized leaf (models/quantize.py): the q8 tensor
+            # takes the spec its full-precision kernel would have; the
+            # per-last-dim scale follows the kernel's LAST dim sharding.
+            kspec = _leaf_spec(path, sp)
+            rank = node[_Q].ndim
+            last = kspec[rank - 1] if len(kspec) >= rank else None
+            return {
+                _Q: kspec,
+                _SCALE: (PartitionSpec(last) if last is not None
+                         else PartitionSpec()),
+                _DT: PartitionSpec(),
+            }
         if not isinstance(node, dict):
             return _leaf_spec(path, sp)
         return {k: walk(v, path + (k,)) for k, v in node.items()}

@@ -38,4 +38,5 @@ def curry_signature(signature: Signature,
     # Fixed inputs are usually unbatched constants, so the curried
     # signature loses the shared-leading-batch-dim property.
     return dataclasses.replace(
-        signature, fn=fn, inputs=remaining, batched=False, _jitted=None)
+        signature, fn=fn, inputs=remaining, batched=False, _jitted=None,
+        _resolved_fn=None)

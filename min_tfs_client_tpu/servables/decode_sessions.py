@@ -145,16 +145,22 @@ class SlotPool:
     recycled as sessions close or expire. K concurrent sessions cost one
     dispatch per token instead of K.
 
-    step_fn(state) -> (new_state, outputs) must be pure over a single
-    session's state (leaves `(1, ...)`); params belong inside its closure.
+    step_fn(params, state) -> (new_state, outputs) must be pure over a
+    single session's state (leaves `(1, ...)`). `params` rides as a jit
+    ARGUMENT of the tick (a closed-over tree would be re-baked into the
+    executable as constants — losing sharding constraints and int8
+    residency for quantized weights); pass params=None and a
+    single-argument step_fn for stateless tests.
     """
 
-    def __init__(self, template_state, step_fn, *, max_slots: int):
+    def __init__(self, template_state, step_fn, *, max_slots: int,
+                 params=None):
         import jax
         import jax.numpy as jnp
 
         self._jax = jax
         self.max_slots = max_slots
+        self._params = params
         shapes = jax.eval_shape(lambda: template_state)
         self._pool = jax.tree_util.tree_map(
             lambda sd: jnp.zeros((max_slots,) + sd.shape, sd.dtype), shapes)
@@ -168,8 +174,12 @@ class SlotPool:
                     (slot,) + (0,) * s.ndim)
             return jax.tree_util.tree_map(upd, pool, state)
 
-        def tick_fn(pool, active):
-            new_pool, outputs = jax.vmap(step_fn)(pool)
+        def tick_fn(params, pool, active):
+            if params is None:
+                new_pool, outputs = jax.vmap(step_fn)(pool)
+            else:
+                new_pool, outputs = jax.vmap(
+                    lambda s: step_fn(params, s))(pool)
 
             def merge(n, o):
                 mask = active.reshape((-1,) + (1,) * (n.ndim - 1))
@@ -179,7 +189,7 @@ class SlotPool:
             return merged, outputs
 
         self._write_jit = jax.jit(write_fn, donate_argnums=(0,))
-        self._tick_jit = jax.jit(tick_fn, donate_argnums=(0,))
+        self._tick_jit = jax.jit(tick_fn, donate_argnums=(1,))
 
     def acquire_slot(self) -> int:
         with self._lock:
@@ -212,7 +222,7 @@ class SlotPool:
             active = np.zeros((self.max_slots,), bool)
             active[list(slots)] = True
             self._pool, outputs = self._tick_jit(
-                self._pool, self._jax.numpy.asarray(active))
+                self._params, self._pool, self._jax.numpy.asarray(active))
         fetched = fetch_outputs(outputs)
         return {s: {k: np.asarray(v)[s] for k, v in fetched.items()}
                 for s in slots}
