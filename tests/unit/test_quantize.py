@@ -148,6 +148,26 @@ class TestQuantizedServing:
         # (same weights, same math, different execution schedule).
         np.testing.assert_array_equal(got, whole["output_ids"])
 
+    def test_quantized_resnet_serves(self, tmp_path):
+        """Rank-4 conv kernels quantize per-output-channel too."""
+        from min_tfs_client_tpu.models import export, resnet
+
+        # width=32 makes the deeper conv kernels cross the quantization
+        # size threshold (tiny's width=8 kernels all stay full precision).
+        config = resnet.ResNetConfig.tiny(width=32)
+        params = resnet.init_params(jax.random.PRNGKey(0), config)
+        base = tmp_path / "rq8"
+        export.export_servable(
+            base, 1, "resnet", dataclasses.asdict(config), params,
+            quantize="int8")
+        sigs = export.load_signatures(base / "1")
+        assert is_quantized(sigs["serving_default"].params)
+        img = np.random.default_rng(0).random(
+            (2, config.image_size, config.image_size, 3)).astype(np.float32)
+        out = sigs["serving_default"].run({"images": img})
+        assert np.isfinite(out["probabilities"]).all()
+        assert np.isfinite(out["logits"]).all()
+
     def test_bf16_params_roundtrip_through_npz(self, tmp_path):
         """bfloat16 leaves (and quant dtype sentinels) survive
         save_params/load_params — npz stores them as raw void16 and
