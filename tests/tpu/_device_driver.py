@@ -84,6 +84,58 @@ def main() -> int:
     probs2 = tensor_proto_to_ndarray(resp2.outputs["probs"])
     emit("mesh_attach_predict",
          bool(np.allclose(probs, probs2, atol=1e-5)))
+
+    # -- 4. int8 quantized serving on device vs full precision -------------
+    # Each trailing check fails in isolation (emit ok=False) — an
+    # exception here must not turn already-passed checks into failures.
+    try:
+        import dataclasses
+
+        from min_tfs_client_tpu.models import bert, export
+
+        config = bert.BertConfig.tiny(num_labels=4)
+        params = bert.init_params(jax.random.PRNGKey(0), config)
+        qbase = (pathlib.Path(tempfile.mkdtemp(prefix="tpu_tier_"))
+                 / "bert_q8")
+        export.export_servable(qbase, 1, "bert", dataclasses.asdict(config),
+                               params, signature_kwargs={"seq_len": 16},
+                               quantize="int8")
+        qclient = TensorServingClient(f"tpu://{qbase}")
+        ids = rng.integers(0, config.vocab_size, (4, 16)).astype(np.int32)
+        mask = np.ones((4, 16), np.int32)
+        resp = qclient.predict_request(
+            "bert_q8", {"input_ids": ids, "attention_mask": mask})
+        q_logits = tensor_proto_to_ndarray(resp.outputs["logits"])
+        fp_logits = np.asarray(bert.logits_fn(params, config, ids, mask),
+                               np.float32)
+        rel = float(np.max(np.abs(q_logits - fp_logits))
+                    / max(float(np.max(np.abs(fp_logits))), 1e-6))
+        emit("int8_predict",
+             bool(np.isfinite(q_logits).all() and rel < 0.35),
+             rel_dev=round(rel, 4))
+    except Exception as exc:  # noqa: BLE001 - per-check isolation
+        emit("int8_predict", False, error=repr(exc)[:500])
+
+    # -- 5. continuous-batching decode sessions on device ------------------
+    try:
+        from min_tfs_client_tpu.models import t5
+
+        t5c = t5.T5Config.tiny()
+        t5p = t5.init_params(jax.random.PRNGKey(0), t5c)
+        sigs = t5.build_session_signatures(
+            t5p, t5c, seq_len=12, max_decode_len=6, max_sessions=4,
+            continuous_batching=True)
+        prompt = rng.integers(2, t5c.vocab_size, (1, 12)).astype(np.int32)
+        lengths = np.sum(prompt != t5c.pad_id, axis=-1).astype(np.int32)
+        want = np.asarray(t5.greedy_decode(
+            t5p, t5c, prompt, lengths, max_decode_len=6)[0])[0]
+        sid = np.asarray(b"tier", object)
+        sigs["decode_init"].run({"session_id": sid, "input_ids": prompt})
+        toks = [int(sigs["decode_step"].run(
+            {"session_id": sid})["token"][0]) for _ in range(6)]
+        emit("continuous_batching_decode", toks == list(want), tokens=toks)
+    except Exception as exc:  # noqa: BLE001 - per-check isolation
+        emit("continuous_batching_decode", False, error=repr(exc)[:500])
     return 0
 
 

@@ -8,7 +8,9 @@ Checks driven on hardware (tests/tpu/_device_driver.py):
   * Pallas flash attention (non-interpret) vs the jnp oracle — plain,
     causal, and ragged-lengths variants;
   * a bucketed Predict through the full tpu:// serving stack;
-  * mesh attach + predict on a 1-device device mesh.
+  * mesh attach + predict on a 1-device device mesh;
+  * int8 weight-only quantized Predict vs full precision;
+  * continuous-batching decode sessions vs the greedy oracle.
 """
 
 import json
@@ -83,4 +85,16 @@ def test_bucketed_predict_on_device(device_results):
 @pytest.mark.integration
 def test_mesh_attach_predict_on_device(device_results):
     rec = device_results.get("mesh_attach_predict")
+    assert rec is not None and rec["ok"], rec
+
+
+@pytest.mark.integration
+def test_int8_predict_on_device(device_results):
+    rec = device_results.get("int8_predict")
+    assert rec is not None and rec["ok"], rec
+
+
+@pytest.mark.integration
+def test_continuous_batching_decode_on_device(device_results):
+    rec = device_results.get("continuous_batching_decode")
     assert rec is not None and rec["ok"], rec
