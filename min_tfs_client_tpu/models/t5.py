@@ -245,6 +245,75 @@ def greedy_decode(params: dict, config: T5Config, input_ids: jax.Array,
     return output_ids, out_lengths
 
 
+def _per_example_keys(seed: jax.Array) -> jax.Array:
+    """seed (B,) int32 -> (B, 2) uint32 old-style PRNG keys (plain uint32
+    data so they stack/zero-init cleanly in session slot pools)."""
+    return jax.vmap(
+        lambda s: jax.random.fold_in(jax.random.PRNGKey(0), s))(seed)
+
+
+def _split_keys(keys: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """(B, 2) keys -> (new_keys (B, 2), subkeys (B, 2))."""
+    both = jax.vmap(lambda k: jax.random.split(k, 2))(keys)
+    return both[:, 0], both[:, 1]
+
+
+def _sample_token(logits: jax.Array, keys: jax.Array,
+                  temperature: jax.Array, top_k: int,
+                  pad_id: int) -> jax.Array:
+    """Per-example token sampling. logits (B, V); keys (B, 2) per-example
+    PRNG keys; temperature (B,) — 0 or negative means greedy for that
+    example (the untouched argmax, keeping temperature-0 EXACTLY equal to
+    greedy_decode). top_k is STATIC (0 = full distribution). pad_id is
+    masked out of the sampling distribution: pad marks end-of-stream on
+    the wire, so a random draw must never emit it mid-generation."""
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    scaled = logits / jnp.maximum(temperature, 1e-6)[:, None]
+    scaled = scaled.at[:, pad_id].set(-jnp.inf)
+    if top_k:
+        kth = jax.lax.top_k(scaled, top_k)[0][:, -1:]
+        scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
+    sampled = jax.vmap(jax.random.categorical)(keys, scaled).astype(jnp.int32)
+    return jnp.where(temperature <= 0.0, greedy, sampled)
+
+
+def sample_decode(params: dict, config: T5Config, input_ids: jax.Array,
+                  lengths: jax.Array, *, max_decode_len: int,
+                  temperature: jax.Array, seed: jax.Array,
+                  top_k: int = 0) -> tuple[jax.Array, jax.Array]:
+    """Sampled generation: greedy_decode's scan with a categorical draw
+    per step. temperature (B,) f32 per example (<= 0 -> greedy for that
+    example, making this a strict superset of greedy_decode); seed (B,)
+    int32 per example — identical seeds give identical streams.
+    Returns (output_ids (B, max_decode_len), output_lengths (B,))."""
+    b = input_ids.shape[0]
+    encoded = encode(params, config, input_ids, lengths)
+    caches = [{"self": nn.init_cache(b, config.num_heads, max_decode_len,
+                                     config.d_kv)}
+              for _ in range(config.num_decoder_layers)]
+    token0 = jnp.full((b, 1), config.decoder_start_id, jnp.int32)
+    keys0 = _per_example_keys(seed)
+
+    def step_fn(carry, step):
+        token, caches, finished, keys = carry
+        logits, caches = _decoder_step(params, config, token, step, caches,
+                                       encoded, lengths)
+        keys, subs = _split_keys(keys)
+        next_token = _sample_token(logits, subs, temperature, top_k,
+                                   config.pad_id)
+        next_token = jnp.where(finished, config.pad_id, next_token)
+        finished = jnp.logical_or(finished, next_token == config.eos_id)
+        return (next_token[:, None], caches, finished, keys), next_token
+
+    (_, _, finished, _), tokens = jax.lax.scan(
+        step_fn, (token0, caches, jnp.zeros((b,), bool), keys0),
+        jnp.arange(max_decode_len))
+    output_ids = tokens.T
+    out_lengths = jnp.sum(
+        (output_ids != config.pad_id).astype(jnp.int32), axis=-1)
+    return output_ids, out_lengths
+
+
 def speculative_decode(
     params: dict,
     config: T5Config,
@@ -370,7 +439,9 @@ def build_signatures(params: dict, config: T5Config, *, seq_len: int,
                      session_ttl_s: float = 600.0,
                      draft_params: dict | None = None,
                      draft_config: "T5Config | None" = None,
-                     speculative_k: int = 4) -> dict:
+                     speculative_k: int = 4,
+                     sampling_top_k: int = 0,
+                     session_sampling: bool = False) -> dict:
     from min_tfs_client_tpu.servables.servable import Signature, TensorSpec
 
     def decode_fn(params, inputs):
@@ -405,8 +476,29 @@ def build_signatures(params: dict, config: T5Config, *, seq_len: int,
         batch_buckets=(1, 4, 16, 32),
     )
 
+    def sampled_fn(params, inputs):
+        ids = jnp.asarray(inputs["input_ids"], jnp.int32)
+        lens = jnp.sum((ids != config.pad_id).astype(jnp.int32), axis=-1)
+        out_ids, out_lengths = sample_decode(
+            params, config, ids, lens, max_decode_len=max_decode_len,
+            temperature=jnp.asarray(inputs["temperature"], jnp.float32),
+            seed=jnp.asarray(inputs["seed"], jnp.int32),
+            top_k=sampling_top_k)
+        return {"output_ids": out_ids, "output_lengths": out_lengths}
+
+    sampled_sig = Signature(
+        fn=sampled_fn,
+        params=params,
+        inputs={"input_ids": TensorSpec(np.int32, (None, seq_len)),
+                "temperature": TensorSpec(np.float32, (None,)),
+                "seed": TensorSpec(np.int32, (None,))},
+        outputs={"output_ids": TensorSpec(np.int32, (None, max_decode_len)),
+                 "output_lengths": TensorSpec(np.int32, (None,))},
+        batch_buckets=(1, 4, 16, 32),
+    )
+
     signatures = {"serving_default": decode_sig, "decode": decode_sig,
-                  "encode": encode_sig}
+                  "decode_sampled": sampled_sig, "encode": encode_sig}
 
     if draft_params is not None:
         if draft_config is None:
@@ -443,7 +535,8 @@ def build_signatures(params: dict, config: T5Config, *, seq_len: int,
     signatures.update(build_session_signatures(
         params, config, seq_len=seq_len, max_decode_len=max_decode_len,
         max_sessions=max_sessions, session_ttl_s=session_ttl_s,
-        continuous_batching=continuous_batching))
+        continuous_batching=continuous_batching,
+        sampling=session_sampling, sampling_top_k=sampling_top_k))
     return signatures
 
 
@@ -451,16 +544,21 @@ def build_signatures(params: dict, config: T5Config, *, seq_len: int,
 
 
 def prefill_state(params: dict, config: T5Config, input_ids: jax.Array,
-                  *, max_decode_len: int) -> dict:
+                  *, max_decode_len: int,
+                  temperature: jax.Array | None = None,
+                  seed: jax.Array | None = None) -> dict:
     """Encode the prompt and build empty caches: the device state one
-    decode session carries between Predict("decode_step") calls."""
+    decode session carries between Predict("decode_step") calls. With
+    `temperature`/`seed` (B,) the state also carries per-example PRNG
+    keys and sampling temperature (sampled sessions); absent, steps are
+    greedy."""
     b = input_ids.shape[0]
     lengths = jnp.sum((input_ids != config.pad_id).astype(jnp.int32), axis=-1)
     encoded = encode(params, config, input_ids, lengths)
     caches = [{"self": nn.init_cache(b, config.num_heads, max_decode_len,
                                      config.d_kv)}
               for _ in range(config.num_decoder_layers)]
-    return {
+    state = {
         "encoded": encoded,
         "enc_lengths": lengths,
         "caches": caches,
@@ -468,16 +566,27 @@ def prefill_state(params: dict, config: T5Config, input_ids: jax.Array,
         "finished": jnp.zeros((b,), jnp.bool_),
         "step": jnp.int32(0),
     }
+    if temperature is not None:
+        state["temperature"] = jnp.asarray(temperature, jnp.float32)
+        state["key"] = _per_example_keys(jnp.asarray(seed, jnp.int32))
+    return state
 
 
-def decode_step_state(params: dict, config: T5Config, state: dict
-                      ) -> tuple[dict, jax.Array]:
+def decode_step_state(params: dict, config: T5Config, state: dict,
+                      *, top_k: int = 0) -> tuple[dict, jax.Array]:
     """Advance one token. Pure: (state) -> (state', token); jitted with
-    the state donated so the KV caches update in place in HBM."""
+    the state donated so the KV caches update in place in HBM. Sampled
+    when the state carries temperature/key (see prefill_state), greedy
+    otherwise — the choice is part of the traced structure."""
     logits, caches = _decoder_step(
         params, config, state["token"], state["step"], state["caches"],
         state["encoded"], state["enc_lengths"])
-    next_token = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    if "temperature" in state:
+        keys, subs = _split_keys(state["key"])
+        next_token = _sample_token(logits, subs, state["temperature"],
+                                   top_k, config.pad_id)
+    else:
+        next_token = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     next_token = jnp.where(state["finished"], config.pad_id, next_token)
     finished = jnp.logical_or(state["finished"],
                               next_token == config.eos_id)
@@ -489,14 +598,57 @@ def decode_step_state(params: dict, config: T5Config, state: dict
         "finished": finished,
         "step": state["step"] + 1,
     }
+    if "temperature" in state:
+        new_state["temperature"] = state["temperature"]
+        new_state["key"] = keys
     return new_state, next_token
+
+
+def _sampling_session_helpers(config: T5Config, max_decode_len: int,
+                              sampling: bool):
+    """(prefill_fn, read_sampling_inputs, extra_input_specs) shared by
+    the pooled and unpooled session builders — the ONLY place the
+    sampled/greedy prefill wiring exists."""
+    from min_tfs_client_tpu.models.quantize import maybe_dequantize
+    from min_tfs_client_tpu.servables.servable import TensorSpec
+    from min_tfs_client_tpu.utils.status import ServingError
+
+    if sampling:
+        def prefill_fn(p, ids, temp, seed):
+            return prefill_state(maybe_dequantize(p), config, ids,
+                                 max_decode_len=max_decode_len,
+                                 temperature=temp, seed=seed)
+
+        def read_inputs(inputs, batch):
+            temp = np.asarray(inputs["temperature"],
+                              np.float32).reshape(-1)
+            seed = np.asarray(inputs["seed"], np.int32).reshape(-1)
+            if temp.shape != (batch,) or seed.shape != (batch,):
+                raise ServingError.invalid_argument(
+                    f"temperature/seed must have {batch} elements "
+                    f"(one per input_ids row); got {temp.shape[0]} / "
+                    f"{seed.shape[0]}")
+            return (jax.device_put(temp), jax.device_put(seed))
+
+        extra_specs = {"temperature": TensorSpec(np.float32, (None,)),
+                       "seed": TensorSpec(np.int32, (None,))}
+    else:
+        def prefill_fn(p, ids):
+            return prefill_state(maybe_dequantize(p), config, ids,
+                                 max_decode_len=max_decode_len)
+
+        read_inputs = None
+        extra_specs = {}
+    return prefill_fn, read_inputs, extra_specs
 
 
 def build_session_signatures(params: dict, config: T5Config, *, seq_len: int,
                              max_decode_len: int,
                              max_sessions: int = 64,
                              session_ttl_s: float = 600.0,
-                             continuous_batching: bool = False) -> dict:
+                             continuous_batching: bool = False,
+                             sampling: bool = False,
+                             sampling_top_k: int = 0) -> dict:
     """The repeated-Predict decode surface (BASELINE config 5):
 
       decode_init:  session_id + input_ids -> prefill; KV cache parked in
@@ -517,7 +669,8 @@ def build_session_signatures(params: dict, config: T5Config, *, seq_len: int,
     if continuous_batching:
         return _build_pooled_session_signatures(
             params, config, seq_len=seq_len, max_decode_len=max_decode_len,
-            max_slots=max_sessions, session_ttl_s=session_ttl_s)
+            max_slots=max_sessions, session_ttl_s=session_ttl_s,
+            sampling=sampling, sampling_top_k=sampling_top_k)
     from min_tfs_client_tpu.servables.decode_sessions import (
         DecodeSessionStore,
     )
@@ -528,11 +681,12 @@ def build_session_signatures(params: dict, config: T5Config, *, seq_len: int,
 
     store = DecodeSessionStore(max_sessions=max_sessions,
                                ttl_s=session_ttl_s, metric_label="t5")
-    prefill_jit = jax.jit(
-        lambda p, ids: prefill_state(maybe_dequantize(p), config, ids,
-                                     max_decode_len=max_decode_len))
+    prefill_fn, read_sampling, extra_specs = _sampling_session_helpers(
+        config, max_decode_len, sampling)
+    prefill_jit = jax.jit(prefill_fn)
     step_jit = jax.jit(
-        lambda p, s: decode_step_state(maybe_dequantize(p), config, s),
+        lambda p, s: decode_step_state(maybe_dequantize(p), config, s,
+                                       top_k=sampling_top_k),
         donate_argnums=(1,))
 
     def _session_id(inputs) -> bytes:
@@ -546,7 +700,10 @@ def build_session_signatures(params: dict, config: T5Config, *, seq_len: int,
     def init_fn(inputs):
         sid = _session_id(inputs)
         ids = np.asarray(inputs["input_ids"]).astype(np.int32)
-        state = prefill_jit(params, jax.device_put(ids))
+        args = (params, jax.device_put(ids))
+        if read_sampling is not None:
+            args += read_sampling(inputs, ids.shape[0])
+        state = prefill_jit(*args)
         store.put(sid, (state, 0))  # host-side step mirror: no fetch later
         return {"session_id": np.asarray(sid, object),
                 "batch": np.asarray(ids.shape[0], np.int32)}
@@ -575,10 +732,12 @@ def build_session_signatures(params: dict, config: T5Config, *, seq_len: int,
         return {"closed": np.asarray(int(closed), np.int32)}
 
     session_spec = TensorSpec("DT_STRING", ())
+    init_inputs = {"session_id": session_spec,
+                   "input_ids": TensorSpec(np.int32, (None, seq_len)),
+                   **extra_specs}
     init_sig = Signature(
         fn=init_fn,
-        inputs={"session_id": session_spec,
-                "input_ids": TensorSpec(np.int32, (None, seq_len))},
+        inputs=init_inputs,
         outputs={"session_id": TensorSpec("DT_STRING", ()),
                  "batch": TensorSpec(np.int32, ())},
         on_host=True, batched=False,
@@ -598,7 +757,7 @@ def build_session_signatures(params: dict, config: T5Config, *, seq_len: int,
         on_host=True, batched=False,
     )
     init_sig.warmup_fn = _session_warmup_fn(
-        init_fn, step_fn, close_fn, seq_len)
+        init_fn, step_fn, close_fn, seq_len, sampling=sampling)
     # The loader re-labels the store's gauge with the real model:version
     # (platforms.make_loader) — the family builder doesn't know it.
     for sig in (init_sig, step_sig, close_sig):
@@ -607,14 +766,19 @@ def build_session_signatures(params: dict, config: T5Config, *, seq_len: int,
             "decode_close": close_sig}
 
 
-def _session_warmup_fn(init_fn, step_fn, close_fn, seq_len: int):
+def _session_warmup_fn(init_fn, step_fn, close_fn, seq_len: int,
+                       sampling: bool = False):
     """Prime prefill + step/tick executables with a throwaway session so
     the first real decode_init/step never compiles (synthesize_warmup
     calls this through the warmup_fn hook)."""
     def _warm():
         sid = b"__warmup__"
-        ids = np.zeros((1, seq_len), np.int32)
-        init_fn({"session_id": np.asarray(sid, object), "input_ids": ids})
+        inputs = {"session_id": np.asarray(sid, object),
+                  "input_ids": np.zeros((1, seq_len), np.int32)}
+        if sampling:
+            inputs["temperature"] = np.zeros((1,), np.float32)
+            inputs["seed"] = np.zeros((1,), np.int32)
+        init_fn(inputs)
         step_fn({"session_id": np.asarray(sid, object)})
         close_fn({"session_id": np.asarray(sid, object)})
     return _warm
@@ -623,7 +787,9 @@ def _session_warmup_fn(init_fn, step_fn, close_fn, seq_len: int):
 def _build_pooled_session_signatures(params: dict, config: T5Config, *,
                                      seq_len: int, max_decode_len: int,
                                      max_slots: int,
-                                     session_ttl_s: float) -> dict:
+                                     session_ttl_s: float,
+                                     sampling: bool = False,
+                                     sampling_top_k: int = 0) -> dict:
     """Continuous-batching variant: same wire surface, slot-pool device
     state, one vmapped tick per token across all concurrently-stepping
     sessions. See decode_sessions.SlotPool."""
@@ -637,14 +803,17 @@ def _build_pooled_session_signatures(params: dict, config: T5Config, *,
 
     from min_tfs_client_tpu.models.quantize import maybe_dequantize
 
-    template = jax.eval_shape(
-        lambda p, ids: prefill_state(maybe_dequantize(p), config, ids,
-                                     max_decode_len=max_decode_len),
-        params, jax.ShapeDtypeStruct((1, seq_len), jnp.int32))
+    prefill_fn, read_sampling, extra_specs = _sampling_session_helpers(
+        config, max_decode_len, sampling)
+    template_args = [params, jax.ShapeDtypeStruct((1, seq_len), jnp.int32)]
+    if sampling:
+        template_args += [jax.ShapeDtypeStruct((1,), jnp.float32),
+                          jax.ShapeDtypeStruct((1,), jnp.int32)]
+    template = jax.eval_shape(prefill_fn, *template_args)
 
     def one_step(p, state):
         new_state, token = decode_step_state(
-            maybe_dequantize(p), config, state)
+            maybe_dequantize(p), config, state, top_k=sampling_top_k)
         return new_state, {"token": token,
                            "finished": new_state["finished"]}
 
@@ -654,9 +823,7 @@ def _build_pooled_session_signatures(params: dict, config: T5Config, *,
         max_sessions=max_slots, ttl_s=session_ttl_s,
         metric_label="t5-pooled",
         on_evict=lambda entry: pool.release_slot(entry[0]))
-    prefill_jit = jax.jit(
-        lambda p, ids: prefill_state(maybe_dequantize(p), config, ids,
-                                     max_decode_len=max_decode_len))
+    prefill_jit = jax.jit(prefill_fn)
 
     def _session_id(inputs) -> bytes:
         raw = np.asarray(inputs["session_id"]).reshape(-1)
@@ -673,7 +840,10 @@ def _build_pooled_session_signatures(params: dict, config: T5Config, *,
             raise ServingError.invalid_argument(
                 "continuous-batching decode sessions are single-sequence: "
                 f"input_ids batch must be 1, got {ids.shape[0]}")
-        state = prefill_jit(params, jax.device_put(ids))
+        args = (params, jax.device_put(ids))
+        if read_sampling is not None:
+            args += read_sampling(inputs, 1)
+        state = prefill_jit(*args)
         slot = pool.acquire_slot()
         try:
             pool.write(state, slot)
@@ -708,10 +878,12 @@ def _build_pooled_session_signatures(params: dict, config: T5Config, *,
         return {"closed": np.asarray(int(closed), np.int32)}
 
     session_spec = TensorSpec("DT_STRING", ())
+    init_inputs = {"session_id": session_spec,
+                   "input_ids": TensorSpec(np.int32, (None, seq_len)),
+                   **extra_specs}
     init_sig = Signature(
         fn=init_fn,
-        inputs={"session_id": session_spec,
-                "input_ids": TensorSpec(np.int32, (None, seq_len))},
+        inputs=init_inputs,
         outputs={"session_id": TensorSpec("DT_STRING", ()),
                  "batch": TensorSpec(np.int32, ())},
         on_host=True, batched=False,
@@ -732,7 +904,7 @@ def _build_pooled_session_signatures(params: dict, config: T5Config, *,
     )
 
     init_sig.warmup_fn = _session_warmup_fn(
-        init_fn, step_fn, close_fn, seq_len)
+        init_fn, step_fn, close_fn, seq_len, sampling=sampling)
     for sig in (init_sig, step_sig, close_sig):
         sig._decode_store = store
     return {"decode_init": init_sig, "decode_step": step_sig,

@@ -1,0 +1,222 @@
+"""Sampled decoding: temperature / top-k / per-example seeds.
+
+Contracts: temperature <= 0 is EXACTLY greedy (strict superset of
+greedy_decode); identical seeds give identical streams; sampling
+composes with the session surface (state-carried keys advance per step)
+and continuous batching (keys live in the slot pool).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from min_tfs_client_tpu.models import t5
+
+SEQ, MAXDEC = 12, 8
+
+
+@pytest.fixture(scope="module")
+def model():
+    config = t5.T5Config.tiny()
+    params = t5.init_params(jax.random.PRNGKey(0), config)
+    return config, params
+
+
+def _prompts(config, n=3, seed=0):
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(2, config.vocab_size, (n, SEQ)).astype(np.int32)
+    ids[:, 7:] = config.pad_id
+    lengths = np.sum(ids != config.pad_id, -1).astype(np.int32)
+    return ids, lengths
+
+
+class TestSampleDecode:
+    def test_zero_temperature_is_greedy(self, model):
+        config, params = model
+        ids, lengths = _prompts(config)
+        want, want_len = t5.greedy_decode(
+            params, config, ids, lengths, max_decode_len=MAXDEC)
+        got, got_len = t5.sample_decode(
+            params, config, ids, lengths, max_decode_len=MAXDEC,
+            temperature=jnp.zeros((3,)),
+            seed=jnp.arange(3, dtype=jnp.int32))
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+        np.testing.assert_array_equal(np.asarray(got_len),
+                                      np.asarray(want_len))
+
+    def test_deterministic_given_seed(self, model):
+        config, params = model
+        ids, lengths = _prompts(config)
+        kw = dict(max_decode_len=MAXDEC,
+                  temperature=jnp.full((3,), 5.0),
+                  seed=jnp.full((3,), 7, jnp.int32))
+        a, _ = t5.sample_decode(params, config, ids, lengths, **kw)
+        b, _ = t5.sample_decode(params, config, ids, lengths, **kw)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        c, _ = t5.sample_decode(
+            params, config, ids, lengths, max_decode_len=MAXDEC,
+            temperature=jnp.full((3,), 5.0),
+            seed=jnp.full((3,), 8, jnp.int32))
+        assert not np.array_equal(np.asarray(a), np.asarray(c))
+
+    def test_per_example_temperature_mixes(self, model):
+        """temperature 0 rows stay greedy even in a batch where other
+        rows sample."""
+        config, params = model
+        ids, lengths = _prompts(config)
+        want, _ = t5.greedy_decode(
+            params, config, ids, lengths, max_decode_len=MAXDEC)
+        got, _ = t5.sample_decode(
+            params, config, ids, lengths, max_decode_len=MAXDEC,
+            temperature=jnp.asarray([0.0, 8.0, 0.0]),
+            seed=jnp.arange(3, dtype=jnp.int32))
+        np.testing.assert_array_equal(np.asarray(got)[0],
+                                      np.asarray(want)[0])
+        np.testing.assert_array_equal(np.asarray(got)[2],
+                                      np.asarray(want)[2])
+
+    def test_top_k_restricts_support(self, model):
+        """With top_k=1 exactly one (non-pad) token survives per step, so
+        the stream is fully deterministic — independent of seed — even at
+        high temperature."""
+        config, params = model
+        ids, lengths = _prompts(config)
+        a, _ = t5.sample_decode(
+            params, config, ids, lengths, max_decode_len=MAXDEC,
+            temperature=jnp.full((3,), 9.0),
+            seed=jnp.arange(3, dtype=jnp.int32), top_k=1)
+        b, _ = t5.sample_decode(
+            params, config, ids, lengths, max_decode_len=MAXDEC,
+            temperature=jnp.full((3,), 9.0),
+            seed=jnp.arange(3, dtype=jnp.int32) + 100, top_k=1)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_sampling_never_emits_pad_mid_stream(self, model):
+        """pad marks end-of-stream on the wire: a sampled draw must never
+        produce it before EOS (the distribution masks pad out)."""
+        config, params = model
+        ids, lengths = _prompts(config)
+        got, _ = t5.sample_decode(
+            params, config, ids, lengths, max_decode_len=MAXDEC,
+            temperature=jnp.full((3,), 50.0),
+            seed=jnp.arange(3, dtype=jnp.int32))
+        arr = np.asarray(got)
+        for row in arr:
+            pads = np.where(row == config.pad_id)[0]
+            if pads.size:
+                # pad only after an EOS, and contiguous to the end.
+                first = pads[0]
+                assert config.eos_id in row[:first]
+                assert np.all(row[first:] == config.pad_id)
+
+    def test_high_temperature_actually_samples(self, model):
+        config, params = model
+        ids, lengths = _prompts(config)
+        want, _ = t5.greedy_decode(
+            params, config, ids, lengths, max_decode_len=MAXDEC)
+        got, _ = t5.sample_decode(
+            params, config, ids, lengths, max_decode_len=MAXDEC,
+            temperature=jnp.full((3,), 50.0),
+            seed=jnp.arange(3, dtype=jnp.int32))
+        assert not np.array_equal(np.asarray(got), np.asarray(want))
+
+
+class TestSampledServing:
+    def test_decode_sampled_signature(self, model):
+        config, params = model
+        sigs = t5.build_signatures(params, config, seq_len=SEQ,
+                                   max_decode_len=MAXDEC)
+        assert "decode_sampled" in sigs
+        ids, _ = _prompts(config)
+        greedy = sigs["decode"].run({"input_ids": ids})
+        out0 = sigs["decode_sampled"].run({
+            "input_ids": ids,
+            "temperature": np.zeros((3,), np.float32),
+            "seed": np.arange(3, dtype=np.int32)})
+        np.testing.assert_array_equal(out0["output_ids"],
+                                      greedy["output_ids"])
+        hot_a = sigs["decode_sampled"].run({
+            "input_ids": ids,
+            "temperature": np.full((3,), 5.0, np.float32),
+            "seed": np.full((3,), 3, np.int32)})
+        hot_b = sigs["decode_sampled"].run({
+            "input_ids": ids,
+            "temperature": np.full((3,), 5.0, np.float32),
+            "seed": np.full((3,), 3, np.int32)})
+        np.testing.assert_array_equal(hot_a["output_ids"],
+                                      hot_b["output_ids"])
+
+    @pytest.mark.parametrize("continuous", [False, True])
+    def test_sampled_sessions_match_single_shot(self, model, continuous):
+        """Stepwise sampled sessions produce the SAME stream as
+        sample_decode with the same seed/temperature — the state-carried
+        key advances exactly like the scan's."""
+        config, params = model
+        n = 1 if continuous else 2
+        ids, lengths = _prompts(config, n=n, seed=4)
+        sigs = t5.build_session_signatures(
+            params, config, seq_len=SEQ, max_decode_len=MAXDEC,
+            max_sessions=4, continuous_batching=continuous, sampling=True)
+        temp = np.full((n,), 4.0, np.float32)
+        seed = np.arange(n, dtype=np.int32) + 11
+        want, _ = t5.sample_decode(
+            params, config, ids, lengths, max_decode_len=MAXDEC,
+            temperature=jnp.asarray(temp), seed=jnp.asarray(seed))
+        sid = np.asarray(b"samp", object)
+        sigs["decode_init"].run({
+            "session_id": sid, "input_ids": ids,
+            "temperature": temp, "seed": seed})
+        toks = []
+        for _ in range(MAXDEC):
+            toks.append(sigs["decode_step"].run({"session_id": sid})["token"])
+        got = np.stack(toks, axis=1)
+        np.testing.assert_array_equal(got, np.asarray(want))
+
+    def test_sampled_sessions_zero_temp_greedy(self, model):
+        config, params = model
+        ids, lengths = _prompts(config, n=1, seed=5)
+        sigs = t5.build_session_signatures(
+            params, config, seq_len=SEQ, max_decode_len=MAXDEC,
+            max_sessions=4, continuous_batching=True, sampling=True)
+        want, _ = t5.greedy_decode(
+            params, config, ids, lengths, max_decode_len=MAXDEC)
+        sid = np.asarray(b"zt", object)
+        sigs["decode_init"].run({
+            "session_id": sid, "input_ids": ids,
+            "temperature": np.zeros((1,), np.float32),
+            "seed": np.zeros((1,), np.int32)})
+        toks = [int(sigs["decode_step"].run({"session_id": sid})["token"][0])
+                for _ in range(MAXDEC)]
+        np.testing.assert_array_equal(toks, np.asarray(want)[0])
+
+    def test_mismatched_sampling_shapes_rejected(self, model):
+        from min_tfs_client_tpu.utils.status import ServingError
+
+        config, params = model
+        ids, _ = _prompts(config, n=2, seed=6)
+        sigs = t5.build_session_signatures(
+            params, config, seq_len=SEQ, max_decode_len=MAXDEC,
+            max_sessions=4, sampling=True)
+        with pytest.raises(ServingError) as err:
+            sigs["decode_init"].run({
+                "session_id": np.asarray(b"bad", object),
+                "input_ids": ids,
+                "temperature": np.zeros((1,), np.float32),  # batch is 2
+                "seed": np.zeros((2,), np.int32)})
+        assert err.value.code == 3  # INVALID_ARGUMENT
+
+    def test_sampled_session_warmup(self, model):
+        import types
+
+        from min_tfs_client_tpu.servables.warmup import synthesize_warmup
+
+        config, params = model
+        sigs = t5.build_session_signatures(
+            params, config, seq_len=SEQ, max_decode_len=MAXDEC,
+            max_sessions=4, continuous_batching=True, sampling=True)
+        assert synthesize_warmup(
+            types.SimpleNamespace(signatures=sigs)) == 1
+        assert len(sigs["decode_init"]._decode_store) == 0
