@@ -260,19 +260,31 @@ def _split_keys(keys: jax.Array) -> tuple[jax.Array, jax.Array]:
 
 def _sample_token(logits: jax.Array, keys: jax.Array,
                   temperature: jax.Array, top_k: int,
-                  pad_id: int) -> jax.Array:
+                  pad_id: int,
+                  top_p: jax.Array | None = None) -> jax.Array:
     """Per-example token sampling. logits (B, V); keys (B, 2) per-example
     PRNG keys; temperature (B,) — 0 or negative means greedy for that
     example (the untouched argmax, keeping temperature-0 EXACTLY equal to
-    greedy_decode). top_k is STATIC (0 = full distribution). pad_id is
-    masked out of the sampling distribution: pad marks end-of-stream on
-    the wire, so a random draw must never emit it mid-generation."""
+    greedy_decode). top_k is STATIC (0 = full distribution); top_p (B,)
+    is per-example nucleus sampling (>= 1 disables). pad_id is masked out
+    of the sampling distribution: pad marks end-of-stream on the wire, so
+    a random draw must never emit it mid-generation."""
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     scaled = logits / jnp.maximum(temperature, 1e-6)[:, None]
     scaled = scaled.at[:, pad_id].set(-jnp.inf)
     if top_k:
         kth = jax.lax.top_k(scaled, top_k)[0][:, -1:]
         scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
+    if top_p is not None:
+        # Nucleus: keep the smallest prefix of descending-prob tokens
+        # whose mass reaches top_p (the first crossing token included).
+        desc = jnp.sort(scaled, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(desc, axis=-1)
+        before = jnp.cumsum(probs, axis=-1) - probs
+        keep = before < jnp.clip(top_p, 1e-6, 1.0)[:, None]
+        cutoff = jnp.min(jnp.where(keep, desc, jnp.inf), axis=-1,
+                         keepdims=True)
+        scaled = jnp.where(scaled < cutoff, -jnp.inf, scaled)
     sampled = jax.vmap(jax.random.categorical)(keys, scaled).astype(jnp.int32)
     return jnp.where(temperature <= 0.0, greedy, sampled)
 
@@ -280,7 +292,9 @@ def _sample_token(logits: jax.Array, keys: jax.Array,
 def sample_decode(params: dict, config: T5Config, input_ids: jax.Array,
                   lengths: jax.Array, *, max_decode_len: int,
                   temperature: jax.Array, seed: jax.Array,
-                  top_k: int = 0) -> tuple[jax.Array, jax.Array]:
+                  top_k: int = 0,
+                  top_p: jax.Array | None = None
+                  ) -> tuple[jax.Array, jax.Array]:
     """Sampled generation: greedy_decode's scan with a categorical draw
     per step. temperature (B,) f32 per example (<= 0 -> greedy for that
     example, making this a strict superset of greedy_decode); seed (B,)
@@ -300,7 +314,7 @@ def sample_decode(params: dict, config: T5Config, input_ids: jax.Array,
                                        encoded, lengths)
         keys, subs = _split_keys(keys)
         next_token = _sample_token(logits, subs, temperature, top_k,
-                                   config.pad_id)
+                                   config.pad_id, top_p)
         next_token = jnp.where(finished, config.pad_id, next_token)
         finished = jnp.logical_or(finished, next_token == config.eos_id)
         return (next_token[:, None], caches, finished, keys), next_token
@@ -441,6 +455,7 @@ def build_signatures(params: dict, config: T5Config, *, seq_len: int,
                      draft_config: "T5Config | None" = None,
                      speculative_k: int = 4,
                      sampling_top_k: int = 0,
+                     sampling_top_p: bool = False,
                      session_sampling: bool = False) -> dict:
     from min_tfs_client_tpu.servables.servable import Signature, TensorSpec
 
@@ -483,15 +498,22 @@ def build_signatures(params: dict, config: T5Config, *, seq_len: int,
             params, config, ids, lens, max_decode_len=max_decode_len,
             temperature=jnp.asarray(inputs["temperature"], jnp.float32),
             seed=jnp.asarray(inputs["seed"], jnp.int32),
-            top_k=sampling_top_k)
+            top_k=sampling_top_k,
+            top_p=(jnp.asarray(inputs["top_p"], jnp.float32)
+                   if sampling_top_p else None))
         return {"output_ids": out_ids, "output_lengths": out_lengths}
 
+    sampled_inputs = {"input_ids": TensorSpec(np.int32, (None, seq_len)),
+                      "temperature": TensorSpec(np.float32, (None,)),
+                      "seed": TensorSpec(np.int32, (None,))}
+    if sampling_top_p:
+        # Nucleus is opt-in: its per-step full-vocab sort only compiles
+        # into the executable when the export asks for it.
+        sampled_inputs["top_p"] = TensorSpec(np.float32, (None,))
     sampled_sig = Signature(
         fn=sampled_fn,
         params=params,
-        inputs={"input_ids": TensorSpec(np.int32, (None, seq_len)),
-                "temperature": TensorSpec(np.float32, (None,)),
-                "seed": TensorSpec(np.int32, (None,))},
+        inputs=sampled_inputs,
         outputs={"output_ids": TensorSpec(np.int32, (None, max_decode_len)),
                  "output_lengths": TensorSpec(np.int32, (None,))},
         batch_buckets=(1, 4, 16, 32),
@@ -536,7 +558,8 @@ def build_signatures(params: dict, config: T5Config, *, seq_len: int,
         params, config, seq_len=seq_len, max_decode_len=max_decode_len,
         max_sessions=max_sessions, session_ttl_s=session_ttl_s,
         continuous_batching=continuous_batching,
-        sampling=session_sampling, sampling_top_k=sampling_top_k))
+        sampling=session_sampling, sampling_top_k=sampling_top_k,
+        sampling_top_p=sampling_top_p))
     return signatures
 
 
@@ -546,7 +569,8 @@ def build_signatures(params: dict, config: T5Config, *, seq_len: int,
 def prefill_state(params: dict, config: T5Config, input_ids: jax.Array,
                   *, max_decode_len: int,
                   temperature: jax.Array | None = None,
-                  seed: jax.Array | None = None) -> dict:
+                  seed: jax.Array | None = None,
+                  top_p: jax.Array | None = None) -> dict:
     """Encode the prompt and build empty caches: the device state one
     decode session carries between Predict("decode_step") calls. With
     `temperature`/`seed` (B,) the state also carries per-example PRNG
@@ -569,6 +593,10 @@ def prefill_state(params: dict, config: T5Config, input_ids: jax.Array,
     if temperature is not None:
         state["temperature"] = jnp.asarray(temperature, jnp.float32)
         state["key"] = _per_example_keys(jnp.asarray(seed, jnp.int32))
+        if top_p is not None:
+            # Present only when nucleus sampling is enabled at build
+            # time: its per-step full-vocab sort then compiles in.
+            state["top_p"] = jnp.asarray(top_p, jnp.float32)
     return state
 
 
@@ -584,7 +612,8 @@ def decode_step_state(params: dict, config: T5Config, state: dict,
     if "temperature" in state:
         keys, subs = _split_keys(state["key"])
         next_token = _sample_token(logits, subs, state["temperature"],
-                                   top_k, config.pad_id)
+                                   top_k, config.pad_id,
+                                   state.get("top_p"))
     else:
         next_token = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     next_token = jnp.where(state["finished"], config.pad_id, next_token)
@@ -601,11 +630,13 @@ def decode_step_state(params: dict, config: T5Config, state: dict,
     if "temperature" in state:
         new_state["temperature"] = state["temperature"]
         new_state["key"] = keys
+        if "top_p" in state:
+            new_state["top_p"] = state["top_p"]
     return new_state, next_token
 
 
 def _sampling_session_helpers(config: T5Config, max_decode_len: int,
-                              sampling: bool):
+                              sampling: bool, use_top_p: bool = False):
     """(prefill_fn, read_sampling_inputs, extra_input_specs) shared by
     the pooled and unpooled session builders — the ONLY place the
     sampled/greedy prefill wiring exists."""
@@ -614,24 +645,33 @@ def _sampling_session_helpers(config: T5Config, max_decode_len: int,
     from min_tfs_client_tpu.utils.status import ServingError
 
     if sampling:
-        def prefill_fn(p, ids, temp, seed):
-            return prefill_state(maybe_dequantize(p), config, ids,
-                                 max_decode_len=max_decode_len,
-                                 temperature=temp, seed=seed)
+        if use_top_p:
+            def prefill_fn(p, ids, temp, seed, top_p):
+                return prefill_state(maybe_dequantize(p), config, ids,
+                                     max_decode_len=max_decode_len,
+                                     temperature=temp, seed=seed,
+                                     top_p=top_p)
+        else:
+            def prefill_fn(p, ids, temp, seed):
+                return prefill_state(maybe_dequantize(p), config, ids,
+                                     max_decode_len=max_decode_len,
+                                     temperature=temp, seed=seed)
+
+        names = (("temperature", np.float32), ("seed", np.int32)) +             ((("top_p", np.float32),) if use_top_p else ())
 
         def read_inputs(inputs, batch):
-            temp = np.asarray(inputs["temperature"],
-                              np.float32).reshape(-1)
-            seed = np.asarray(inputs["seed"], np.int32).reshape(-1)
-            if temp.shape != (batch,) or seed.shape != (batch,):
-                raise ServingError.invalid_argument(
-                    f"temperature/seed must have {batch} elements "
-                    f"(one per input_ids row); got {temp.shape[0]} / "
-                    f"{seed.shape[0]}")
-            return (jax.device_put(temp), jax.device_put(seed))
+            out = []
+            for name, dtype in names:
+                arr = np.asarray(inputs[name], dtype).reshape(-1)
+                if arr.shape != (batch,):
+                    raise ServingError.invalid_argument(
+                        f"{name} must have {batch} elements (one per "
+                        f"input_ids row); got {arr.shape[0]}")
+                out.append(jax.device_put(arr))
+            return tuple(out)
 
-        extra_specs = {"temperature": TensorSpec(np.float32, (None,)),
-                       "seed": TensorSpec(np.int32, (None,))}
+        extra_specs = {name: TensorSpec(dtype, (None,))
+                       for name, dtype in names}
     else:
         def prefill_fn(p, ids):
             return prefill_state(maybe_dequantize(p), config, ids,
@@ -648,7 +688,8 @@ def build_session_signatures(params: dict, config: T5Config, *, seq_len: int,
                              session_ttl_s: float = 600.0,
                              continuous_batching: bool = False,
                              sampling: bool = False,
-                             sampling_top_k: int = 0) -> dict:
+                             sampling_top_k: int = 0,
+                             sampling_top_p: bool = False) -> dict:
     """The repeated-Predict decode surface (BASELINE config 5):
 
       decode_init:  session_id + input_ids -> prefill; KV cache parked in
@@ -670,7 +711,8 @@ def build_session_signatures(params: dict, config: T5Config, *, seq_len: int,
         return _build_pooled_session_signatures(
             params, config, seq_len=seq_len, max_decode_len=max_decode_len,
             max_slots=max_sessions, session_ttl_s=session_ttl_s,
-            sampling=sampling, sampling_top_k=sampling_top_k)
+            sampling=sampling, sampling_top_k=sampling_top_k,
+            sampling_top_p=sampling_top_p)
     from min_tfs_client_tpu.servables.decode_sessions import (
         DecodeSessionStore,
     )
@@ -682,7 +724,7 @@ def build_session_signatures(params: dict, config: T5Config, *, seq_len: int,
     store = DecodeSessionStore(max_sessions=max_sessions,
                                ttl_s=session_ttl_s, metric_label="t5")
     prefill_fn, read_sampling, extra_specs = _sampling_session_helpers(
-        config, max_decode_len, sampling)
+        config, max_decode_len, sampling, sampling_top_p)
     prefill_jit = jax.jit(prefill_fn)
     step_jit = jax.jit(
         lambda p, s: decode_step_state(maybe_dequantize(p), config, s,
@@ -757,7 +799,8 @@ def build_session_signatures(params: dict, config: T5Config, *, seq_len: int,
         on_host=True, batched=False,
     )
     init_sig.warmup_fn = _session_warmup_fn(
-        init_fn, step_fn, close_fn, seq_len, sampling=sampling)
+        init_fn, step_fn, close_fn, seq_len, sampling=sampling,
+        use_top_p=sampling_top_p)
     # The loader re-labels the store's gauge with the real model:version
     # (platforms.make_loader) — the family builder doesn't know it.
     for sig in (init_sig, step_sig, close_sig):
@@ -767,7 +810,7 @@ def build_session_signatures(params: dict, config: T5Config, *, seq_len: int,
 
 
 def _session_warmup_fn(init_fn, step_fn, close_fn, seq_len: int,
-                       sampling: bool = False):
+                       sampling: bool = False, use_top_p: bool = False):
     """Prime prefill + step/tick executables with a throwaway session so
     the first real decode_init/step never compiles (synthesize_warmup
     calls this through the warmup_fn hook)."""
@@ -778,6 +821,8 @@ def _session_warmup_fn(init_fn, step_fn, close_fn, seq_len: int,
         if sampling:
             inputs["temperature"] = np.zeros((1,), np.float32)
             inputs["seed"] = np.zeros((1,), np.int32)
+            if use_top_p:
+                inputs["top_p"] = np.ones((1,), np.float32)
         init_fn(inputs)
         step_fn({"session_id": np.asarray(sid, object)})
         close_fn({"session_id": np.asarray(sid, object)})
@@ -789,7 +834,8 @@ def _build_pooled_session_signatures(params: dict, config: T5Config, *,
                                      max_slots: int,
                                      session_ttl_s: float,
                                      sampling: bool = False,
-                                     sampling_top_k: int = 0) -> dict:
+                                     sampling_top_k: int = 0,
+                                     sampling_top_p: bool = False) -> dict:
     """Continuous-batching variant: same wire surface, slot-pool device
     state, one vmapped tick per token across all concurrently-stepping
     sessions. See decode_sessions.SlotPool."""
@@ -804,11 +850,13 @@ def _build_pooled_session_signatures(params: dict, config: T5Config, *,
     from min_tfs_client_tpu.models.quantize import maybe_dequantize
 
     prefill_fn, read_sampling, extra_specs = _sampling_session_helpers(
-        config, max_decode_len, sampling)
+        config, max_decode_len, sampling, sampling_top_p)
     template_args = [params, jax.ShapeDtypeStruct((1, seq_len), jnp.int32)]
     if sampling:
         template_args += [jax.ShapeDtypeStruct((1,), jnp.float32),
                           jax.ShapeDtypeStruct((1,), jnp.int32)]
+        if sampling_top_p:
+            template_args.append(jax.ShapeDtypeStruct((1,), jnp.float32))
     template = jax.eval_shape(prefill_fn, *template_args)
 
     def one_step(p, state):
@@ -904,7 +952,8 @@ def _build_pooled_session_signatures(params: dict, config: T5Config, *,
     )
 
     init_sig.warmup_fn = _session_warmup_fn(
-        init_fn, step_fn, close_fn, seq_len, sampling=sampling)
+        init_fn, step_fn, close_fn, seq_len, sampling=sampling,
+        use_top_p=sampling_top_p)
     for sig in (init_sig, step_sig, close_sig):
         sig._decode_store = store
     return {"decode_init": init_sig, "decode_step": step_sig,

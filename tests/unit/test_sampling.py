@@ -124,6 +124,91 @@ class TestSampleDecode:
         assert not np.array_equal(np.asarray(got), np.asarray(want))
 
 
+class TestTopP:
+    def test_top_p_one_equals_unrestricted(self, model):
+        config, params = model
+        ids, lengths = _prompts(config)
+        kw = dict(max_decode_len=MAXDEC,
+                  temperature=jnp.full((3,), 5.0),
+                  seed=jnp.full((3,), 7, jnp.int32))
+        a, _ = t5.sample_decode(params, config, ids, lengths, **kw)
+        b, _ = t5.sample_decode(params, config, ids, lengths,
+                                top_p=jnp.ones((3,)), **kw)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_tiny_top_p_is_seed_independent(self, model):
+        """top_p -> 0 keeps only the single most-probable token: the
+        stream becomes deterministic regardless of seed."""
+        config, params = model
+        ids, lengths = _prompts(config)
+        a, _ = t5.sample_decode(
+            params, config, ids, lengths, max_decode_len=MAXDEC,
+            temperature=jnp.full((3,), 9.0),
+            seed=jnp.full((3,), 1, jnp.int32), top_p=jnp.full((3,), 1e-6))
+        b, _ = t5.sample_decode(
+            params, config, ids, lengths, max_decode_len=MAXDEC,
+            temperature=jnp.full((3,), 9.0),
+            seed=jnp.full((3,), 99, jnp.int32), top_p=jnp.full((3,), 1e-6))
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_top_p_session_surface(self, model):
+        """sampling_top_p=True sessions take a per-example top_p wire
+        input and carry it in the slot-pool state."""
+        config, params = model
+        sigs = t5.build_session_signatures(
+            params, config, seq_len=SEQ, max_decode_len=MAXDEC,
+            max_sessions=4, continuous_batching=True, sampling=True,
+            sampling_top_p=True)
+        assert "top_p" in sigs["decode_init"].inputs
+        ids, lengths = _prompts(config, n=1, seed=8)
+        want, _ = t5.sample_decode(
+            params, config, ids, lengths, max_decode_len=MAXDEC,
+            temperature=jnp.full((1,), 4.0),
+            seed=jnp.full((1,), 5, jnp.int32),
+            top_p=jnp.full((1,), 0.9))
+        sid = np.asarray(b"tp", object)
+        sigs["decode_init"].run({
+            "session_id": sid, "input_ids": ids,
+            "temperature": np.full((1,), 4.0, np.float32),
+            "seed": np.full((1,), 5, np.int32),
+            "top_p": np.full((1,), 0.9, np.float32)})
+        toks = [int(sigs["decode_step"].run(
+            {"session_id": sid})["token"][0]) for _ in range(MAXDEC)]
+        np.testing.assert_array_equal(toks, np.asarray(want)[0])
+
+    def test_top_p_single_shot_signature(self, model):
+        config, params = model
+        sigs = t5.build_signatures(params, config, seq_len=SEQ,
+                                   max_decode_len=MAXDEC,
+                                   sampling_top_p=True)
+        assert "top_p" in sigs["decode_sampled"].inputs
+        ids, _ = _prompts(config)
+        out = sigs["decode_sampled"].run({
+            "input_ids": ids,
+            "temperature": np.full((3,), 4.0, np.float32),
+            "seed": np.arange(3, dtype=np.int32),
+            "top_p": np.full((3,), 0.9, np.float32)})
+        assert out["output_ids"].shape == (3, MAXDEC)
+
+    def test_per_example_top_p(self, model):
+        """Row with top_p ~ 0 is deterministic while the other rows keep
+        sampling freely (per-example nucleus)."""
+        config, params = model
+        ids, lengths = _prompts(config)
+        a, _ = t5.sample_decode(
+            params, config, ids, lengths, max_decode_len=MAXDEC,
+            temperature=jnp.full((3,), 9.0),
+            seed=jnp.full((3,), 1, jnp.int32),
+            top_p=jnp.asarray([1e-6, 1.0, 1e-6]))
+        b, _ = t5.sample_decode(
+            params, config, ids, lengths, max_decode_len=MAXDEC,
+            temperature=jnp.full((3,), 9.0),
+            seed=jnp.full((3,), 2, jnp.int32),
+            top_p=jnp.asarray([1e-6, 1.0, 1e-6]))
+        np.testing.assert_array_equal(np.asarray(a)[0], np.asarray(b)[0])
+        np.testing.assert_array_equal(np.asarray(a)[2], np.asarray(b)[2])
+
+
 class TestSampledServing:
     def test_decode_sampled_signature(self, model):
         config, params = model
