@@ -328,6 +328,132 @@ def sample_decode(params: dict, config: T5Config, input_ids: jax.Array,
     return output_ids, out_lengths
 
 
+def beam_decode(params: dict, config: T5Config, input_ids: jax.Array,
+                lengths: jax.Array, *, max_decode_len: int,
+                beam_size: int = 4, length_penalty: float = 1.0,
+                ) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Beam search over the decoder: returns the highest-scoring finished
+    sequence per example (GNMT length penalty ((5+len)/6)^alpha), falling
+    back to the best alive beam when nothing finished.
+
+    One jitted lax.scan over steps; beams ride a flattened (B*K) batch
+    dim so every decoder step is one MXU-friendly batched call, and KV
+    caches reorder with the beams via take_along_axis gathers. beam_size
+    and length_penalty are static. Returns (output_ids (B, max_decode_len)
+    pad-padded after EOS, output_lengths (B,), scores (B,) — the winning
+    sequence's length-normalized log prob)."""
+    b = input_ids.shape[0]
+    k = beam_size
+    neg = -1e9  # python float: stays concrete under jit tracing
+
+    encoded = encode(params, config, input_ids, lengths)
+    # Beams share the prompt: tile encoder state to (B*K, ...).
+    enc_k = jnp.repeat(encoded, k, axis=0)
+    len_k = jnp.repeat(lengths, k, axis=0)
+    caches = [{"self": nn.init_cache(b * k, config.num_heads,
+                                     max_decode_len, config.d_kv)}
+              for _ in range(config.num_decoder_layers)]
+
+    def penalty(length):
+        return ((5.0 + length.astype(jnp.float32)) / 6.0) ** length_penalty
+
+    def gather_beams(tree, parent):  # parent (B, K) indices into K
+        def g(x):
+            xk = x.reshape((b, k) + x.shape[1:])
+            idx = parent.reshape((b, k) + (1,) * (x.ndim - 1))
+            return jnp.take_along_axis(xk, idx, axis=1).reshape(x.shape)
+        return jax.tree_util.tree_map(g, tree)
+
+    # alive: log probs (B, K) — beam 0 starts at 0, the rest at -inf so
+    # step 0 expands a single root; tokens (B, K, L); cur (B*K, 1).
+    alive_scores0 = jnp.tile(
+        jnp.asarray([0.0] + [neg] * (k - 1), jnp.float32), (b, 1))
+    state0 = dict(
+        cur=jnp.full((b * k, 1), config.decoder_start_id, jnp.int32),
+        alive_scores=alive_scores0,
+        alive_tokens=jnp.full((b, k, max_decode_len), config.pad_id,
+                              jnp.int32),
+        fin_scores=jnp.full((b, k), neg, jnp.float32),
+        fin_tokens=jnp.full((b, k, max_decode_len), config.pad_id,
+                            jnp.int32),
+        fin_lengths=jnp.zeros((b, k), jnp.int32),
+        caches=caches,
+    )
+
+    def step_fn(state, step):
+        logits, caches = _decoder_step(
+            params, config, state["cur"], step, state["caches"],
+            enc_k, len_k)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        v = logp.shape[-1]
+        logp = logp.reshape(b, k, v)
+        # A beam must never extend with pad (pad is padding, not a move).
+        logp = logp.at[:, :, config.pad_id].set(neg)
+        cand = state["alive_scores"][:, :, None] + logp      # (B, K, V)
+        flat = cand.reshape(b, k * v)
+        # 2K candidates: even if K of them are EOS, K alive survive.
+        top_scores, top_idx = jax.lax.top_k(flat, 2 * k)
+        parent = top_idx // v                                 # (B, 2K)
+        token = (top_idx % v).astype(jnp.int32)
+
+        seqs = jnp.take_along_axis(
+            state["alive_tokens"], parent[:, :, None], axis=1)
+        seqs = seqs.at[:, :, step].set(token)                 # wrote pos
+
+        is_eos = token == config.eos_id
+        # -- finished pool: EOS candidates, length-normalized, merged
+        # with the existing pool; keep top K.
+        fin_cand = jnp.where(is_eos,
+                             top_scores / penalty(step + 1), neg)
+        all_fin_scores = jnp.concatenate(
+            [state["fin_scores"], fin_cand], axis=1)          # (B, 3K)
+        all_fin_tokens = jnp.concatenate(
+            [state["fin_tokens"], seqs], axis=1)
+        all_fin_lengths = jnp.concatenate(
+            [state["fin_lengths"],
+             jnp.full((b, 2 * k), step + 1, jnp.int32)], axis=1)
+        fs, fi = jax.lax.top_k(all_fin_scores, k)
+        fin_tokens = jnp.take_along_axis(
+            all_fin_tokens, fi[:, :, None], axis=1)
+        fin_lengths = jnp.take_along_axis(all_fin_lengths, fi, axis=1)
+
+        # -- alive: the top K non-EOS candidates.
+        alive_cand = jnp.where(is_eos, neg, top_scores)
+        as_, ai = jax.lax.top_k(alive_cand, k)                # (B, K)
+        alive_parent = jnp.take_along_axis(parent, ai, axis=1)
+        alive_token = jnp.take_along_axis(token, ai, axis=1)
+        alive_tokens = jnp.take_along_axis(seqs, ai[:, :, None], axis=1)
+        caches = gather_beams(caches, alive_parent)
+
+        return dict(
+            cur=alive_token.reshape(b * k, 1),
+            alive_scores=as_,
+            alive_tokens=alive_tokens,
+            fin_scores=fs,
+            fin_tokens=fin_tokens,
+            fin_lengths=fin_lengths,
+            caches=caches,
+        ), None
+
+    state, _ = jax.lax.scan(step_fn, state0, jnp.arange(max_decode_len))
+
+    # Prefer finished beams; fall back to the best alive (normalized at
+    # full length) when nothing finished for an example.
+    alive_norm = state["alive_scores"][:, 0] / penalty(
+        jnp.int32(max_decode_len))
+    best_fin = state["fin_scores"][:, 0]
+    use_fin = best_fin > neg / 2
+    out = jnp.where(use_fin[:, None], state["fin_tokens"][:, 0],
+                    state["alive_tokens"][:, 0])
+    out_len = jnp.where(use_fin, state["fin_lengths"][:, 0],
+                        jnp.int32(max_decode_len))
+    scores = jnp.where(use_fin, best_fin, alive_norm)
+    # Zero out positions past the winning length (EOS kept, pad after).
+    pos = jnp.arange(max_decode_len)[None, :]
+    out = jnp.where(pos < out_len[:, None], out, config.pad_id)
+    return out, out_len, scores
+
+
 def speculative_decode(
     params: dict,
     config: T5Config,
@@ -456,7 +582,9 @@ def build_signatures(params: dict, config: T5Config, *, seq_len: int,
                      speculative_k: int = 4,
                      sampling_top_k: int = 0,
                      sampling_top_p: bool = False,
-                     session_sampling: bool = False) -> dict:
+                     session_sampling: bool = False,
+                     beam_size: int = 0,
+                     beam_length_penalty: float = 1.0) -> dict:
     from min_tfs_client_tpu.servables.servable import Signature, TensorSpec
 
     def decode_fn(params, inputs):
@@ -521,6 +649,28 @@ def build_signatures(params: dict, config: T5Config, *, seq_len: int,
 
     signatures = {"serving_default": decode_sig, "decode": decode_sig,
                   "decode_sampled": sampled_sig, "encode": encode_sig}
+
+    if beam_size:
+        def beam_fn(params, inputs):
+            ids = jnp.asarray(inputs["input_ids"], jnp.int32)
+            lens = jnp.sum((ids != config.pad_id).astype(jnp.int32),
+                           axis=-1)
+            out_ids, out_lengths, scores = beam_decode(
+                params, config, ids, lens, max_decode_len=max_decode_len,
+                beam_size=beam_size, length_penalty=beam_length_penalty)
+            return {"output_ids": out_ids, "output_lengths": out_lengths,
+                    "scores": scores}
+
+        signatures["decode_beam"] = Signature(
+            fn=beam_fn,
+            params=params,
+            inputs={"input_ids": TensorSpec(np.int32, (None, seq_len))},
+            outputs={"output_ids": TensorSpec(
+                         np.int32, (None, max_decode_len)),
+                     "output_lengths": TensorSpec(np.int32, (None,)),
+                     "scores": TensorSpec(np.float32, (None,))},
+            batch_buckets=(1, 4, 16, 32),
+        )
 
     if draft_params is not None:
         if draft_config is None:
