@@ -807,7 +807,9 @@ def _sampling_session_helpers(config: T5Config, max_decode_len: int,
                                      max_decode_len=max_decode_len,
                                      temperature=temp, seed=seed)
 
-        names = (("temperature", np.float32), ("seed", np.int32)) +             ((("top_p", np.float32),) if use_top_p else ())
+        names = (("temperature", np.float32), ("seed", np.int32))
+        if use_top_p:
+            names += (("top_p", np.float32),)
 
         def read_inputs(inputs, batch):
             out = []
