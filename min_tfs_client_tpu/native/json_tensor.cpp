@@ -42,6 +42,7 @@ struct Tensor {
   int64_t shape[kMaxRank] = {0};  // 0 = dim not yet seen (empty rejected)
   int leaf_depth = -1;            // depth where scalars live; -1 = none yet
   int all_int = 1;
+  int64_t fed_rows = 0;  // rows that have fed this tensor (row format)
   std::vector<double>* data = nullptr;
 };
 
@@ -226,6 +227,12 @@ bool ParseInstances(Parser* ps, ParseResult* r) {
         if (!ps->Consume(':')) return false;
         Tensor* t = FindOrAdd(r, key);
         if (t == nullptr) return false;
+        // Exactly-once per row: a duplicate key in this row, or a key first
+        // appearing after row 0, leaves fed_rows != rows. Counting keys
+        // alone would let {a,b},{a,a},{b,b} through with aligned counts but
+        // misaligned values.
+        if (t->fed_rows != rows) return false;
+        t->fed_rows = rows + 1;
         // Per-row values: parse at depth 1; dim 0 becomes the batch.
         if (!ParseDense(ps, t, 1)) return false;
         ++seen;
@@ -233,9 +240,7 @@ bool ParseInstances(Parser* ps, ParseResult* r) {
         if (ps->Consume('}')) break;
         return false;
       }
-      if (rows == 0) {
-        if (seen != r->tensors.size()) return false;
-      } else if (seen != r->tensors.size()) {
+      if (seen != r->tensors.size()) {
         return false;  // rows with differing key sets
       }
       ++rows;
@@ -426,16 +431,43 @@ void tpujson_free(void* handle) {
 
 namespace {
 
+// Python repr of a finite double: shortest decimal that round-trips,
+// fixed notation for decimal exponent in [-4, 16), scientific otherwise.
+// (C %g alone is wrong here: it goes scientific once exponent >= the
+// precision, so 20.0 would render "2e+01" where repr says "20.0".)
+// Round-trip accuracy is monotone in digit count, so binary-search the
+// minimal count — ~5 snprintf+strtod probes, not 17, on the hot path.
+int PyReprDouble(double w, char* buf, size_t cap) {
+  char tmp[40];
+  int lo = 1, hi = 17;
+  while (lo < hi) {
+    int mid = (lo + hi) / 2;
+    snprintf(tmp, sizeof(tmp), "%.*e", mid - 1, w);
+    if (strtod(tmp, nullptr) == w) {
+      hi = mid;
+    } else {
+      lo = mid + 1;
+    }
+  }
+  snprintf(tmp, sizeof(tmp), "%.*e", lo - 1, w);
+  int exp10 = atoi(strchr(tmp, 'e') + 1);
+  if (exp10 >= -4 && exp10 < 16) {
+    int frac = lo - 1 - exp10;
+    return snprintf(buf, cap, "%.*f", frac < 0 ? 0 : frac, w);
+  }
+  return snprintf(buf, cap, "%.*e", lo - 1, w);
+}
+
 void EncodeF32(const float* data, const int64_t* shape, int rank, int dim,
                int64_t* offset, std::string* out) {
   if (dim == rank) {
     float v = data[(*offset)++];
     char buf[40];
     if (isfinite(v)) {
-      // Shortest round-trip float formatting ala Python repr; keep the
-      // token recognizably a float ("3.0", not "3") to match the Python
-      // codec's json.dumps of float values.
-      int n = snprintf(buf, sizeof(buf), "%.9g", static_cast<double>(v));
+      // Byte parity with the Python path: json.dumps serializes the
+      // float32 widened to double with repr (0.1f ->
+      // "0.10000000149011612", not %.9g's "0.100000001").
+      int n = PyReprDouble(static_cast<double>(v), buf, sizeof(buf));
       if (memchr(buf, '.', n) == nullptr &&
           memchr(buf, 'e', n) == nullptr && n + 2 < 40) {
         buf[n] = '.';

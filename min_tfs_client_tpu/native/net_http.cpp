@@ -194,7 +194,10 @@ struct RequestParser {
           unsigned long long v = strtoull(buf->c_str(), &endp, 16);
           if (errno != 0 || endp == buf->c_str()) return -400;
           buf->erase(0, eol + 2);
-          if (req.body.size() + v > kMaxBodyBytes) return -413;
+          // v is attacker-controlled and up to 2^64-1: the sum below would
+          // wrap, so bound v on its own before adding.
+          if (v > kMaxBodyBytes ||
+              req.body.size() + v > kMaxBodyBytes) return -413;
           if (v == 0) {
             state = ParseState::kTrailers;
             continue;

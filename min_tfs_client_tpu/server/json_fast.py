@@ -134,7 +134,10 @@ def _encode_array(lib, arr: np.ndarray) -> Optional[bytes]:
         # here would fork response bytes by environment. Decline.
         return None
     if arr.dtype == np.int64:
-        if not np.all(np.abs(arr) < 2 ** 31):
+        # Explicit bounds, not abs(): np.abs(INT64_MIN) overflows back to
+        # INT64_MIN, which would pass an abs-based test and then be
+        # silently truncated by the int32 cast.
+        if not np.all((arr >= -2 ** 31) & (arr < 2 ** 31)):
             return None
         arr = arr.astype(np.int32)
     if arr.dtype == np.float32:

@@ -111,6 +111,19 @@ def test_chunked_request_body(server):
     s.close()
 
 
+def test_chunked_size_near_uint64_max_rejected_413(server):
+    # A hex chunk size near 2^64 must be rejected outright: summing it
+    # into body.size() first would wrap past the 256MB cap and let the
+    # client stream unbounded data (remote memory-exhaustion DoS).
+    s = socket.create_connection(("127.0.0.1", server.port))
+    s.sendall(b"POST /c HTTP/1.1\r\nHost: x\r\n"
+              b"Transfer-Encoding: chunked\r\n\r\n"
+              b"1\r\na\r\nFFFFFFFFFFFFFFF0\r\n")
+    resp = _recv_n_responses(s, 1)
+    assert b"413" in resp.split(b"\r\n", 1)[0]
+    s.close()
+
+
 def test_chunked_with_extensions_and_trailers(server):
     s = socket.create_connection(("127.0.0.1", server.port))
     s.sendall(b"POST /c HTTP/1.1\r\nHost: x\r\n"

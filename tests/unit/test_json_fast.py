@@ -86,6 +86,12 @@ FALLBACK_BODIES = [
     b'{"inputs": {"a": [1, [2]]}}',         # scalar/array mix
     b'{"inputs": {"a": [1,2], "a": [3,4]}}',  # duplicate key
     b'{"instances": [{"x": 1, "x": 2}]}',   # duplicate key in row
+    # Per-row key counts align but the key SETS differ: accepting this
+    # would feed tensor "a" rows 1,2,2 and "b" rows 1,3,3 — silently
+    # misaligned. The Python codec rejects it; the fast path must too.
+    b'{"instances": [{"a": 1, "b": 2}, {"a": 3, "a": 4}, {"b": 5, "b": 6}]}',
+    # A key first appearing after row 0 with counts kept aligned.
+    b'{"instances": [{"a": 1, "a": 2}, {"a": 3, "c": 4}]}',
     b'not json',
     b'{"instances": [1, 2]',                # truncated
     b'{"instances": [NaN]}',                # non-finite literal
@@ -169,6 +175,41 @@ class TestEncode:
     def test_int64_overflow_declines(self):
         outs = {"a": np.array([2 ** 40], np.int64)}
         assert encode_predict_response_fast(outs, False) is None
+
+    def test_int64_min_declines(self):
+        # np.abs(INT64_MIN) overflows back to INT64_MIN; an abs-based
+        # range test would pass it through a truncating int32 cast.
+        outs = {"a": np.array([-2 ** 63, 1], np.int64)}
+        assert encode_predict_response_fast(outs, False) is None
+
+    def test_f32_bytes_match_python_json_dumps(self):
+        # Byte parity, not just value parity: the Python path serializes
+        # the float32 widened to double via json.dumps (repr shortest
+        # round-trip), e.g. 0.1f -> "0.10000000149011612".
+        vals = np.array([0.1, 1.0, -2.5, 3.14159, 1e-8, 12345.678,
+                         2.0 / 3.0, 1e20,
+                         # Fixed-vs-scientific cutoffs: repr keeps fixed
+                         # notation up to exponent 16 (%g does not).
+                         20.0, 100.0, 1e10, 1e15, 1e16, 0.0001, 1e-5,
+                         0.0, -0.0, 65504.0, 3e-39], np.float32)
+        raw = encode_predict_response_fast({"p": vals}, True)
+        assert raw is not None
+        inner = raw[raw.index(b"[") + 1:raw.rindex(b"]")]
+        tokens = [t.decode() for t in inner.split(b",")]
+        assert tokens == [repr(float(v)) for v in vals]
+
+    def test_f32_bytes_match_python_repr_randomized(self):
+        rng = np.random.default_rng(7)
+        # Bit-pattern sampling covers subnormals, extremes, and round
+        # decimals alike; keep finite ones only.
+        bits = rng.integers(0, 2 ** 32, 4096, dtype=np.uint32)
+        vals = bits.view(np.float32)
+        vals = vals[np.isfinite(vals)]
+        raw = encode_predict_response_fast({"p": vals}, True)
+        assert raw is not None
+        inner = raw[raw.index(b"[") + 1:raw.rindex(b"]")]
+        tokens = [t.decode() for t in inner.split(b",")]
+        assert tokens == [repr(float(v)) for v in vals]
 
     def test_float64_outputs_decline(self):
         # The Python path serializes f64 at full precision; casting to
