@@ -41,6 +41,16 @@ import traceback
 
 REPO = pathlib.Path(__file__).resolve().parent
 BASELINE_FILE = REPO / "bench_baseline.json"
+# Last accelerator-measured records, committed so a round where the chip
+# tunnel is wedged still carries the on-chip performance story (with
+# explicit stale provenance) instead of losing it entirely.
+LASTGOOD_FILE = REPO / "bench_lastgood.json"
+
+ACCEL_CONFIGS = ["bert", "resnet", "bert_int8", "matmul", "use", "t5"]
+# CPU fallback: BERT-base is ~7.6 s/call on this host's CPU and never
+# finished inside the budget in any round; the stale accelerator record
+# carries the BERT story instead.
+CPU_CONFIGS = ["matmul", "use", "t5"]
 
 BUDGET = float(os.environ.get("BENCH_BUDGET", 240))
 _START = time.monotonic()
@@ -63,12 +73,14 @@ print("PROBE_OK", d[0].platform, len(d))
 """
 
 
-def _probe_platform(deadline: float) -> str:
+def _probe_platform(deadline: float, attempt: int = 1) -> str:
     """Initialize the default backend and run one matmul in a subprocess.
 
     Returns "default" when the accelerator works (leave jax_platforms
     alone in the child: this image's sitecustomize selects "axon,cpu"),
-    "cpu" when init fails, errors, or hangs (round-1 failure mode)."""
+    "cpu" when init fails, errors, or hangs (round-1 failure mode).
+    Called again mid-budget (attempt=2) after the CPU legs finish — a
+    tunnel that was wedged at t=0 sometimes recovers."""
     if os.environ.get("BENCH_PLATFORM"):
         return os.environ["BENCH_PLATFORM"]
     # Healthy init + one matmul ≈ 25-40s; a wedged claim hangs forever, so
@@ -79,15 +91,17 @@ def _probe_platform(deadline: float) -> str:
             [sys.executable, "-c", _PROBE_CODE], capture_output=True,
             text=True, timeout=timeout, cwd=str(REPO))
     except subprocess.TimeoutExpired:
-        print("bench: accelerator probe timed out -> cpu", file=sys.stderr)
+        print(f"bench: accelerator probe timed out (attempt {attempt}) "
+              "-> cpu", file=sys.stderr)
         return "cpu"
     if res.returncode == 0 and "PROBE_OK" in res.stdout:
         plat = res.stdout.split("PROBE_OK", 1)[1].split()[0]
         print(f"bench: accelerator probe ok (platform={plat})",
               file=sys.stderr)
         return "default" if plat != "cpu" else "cpu"
-    print(f"bench: accelerator probe failed (rc={res.returncode}) -> cpu\n"
-          f"{res.stderr[-2000:]}", file=sys.stderr)
+    print(f"bench: accelerator probe failed (rc={res.returncode}, "
+          f"attempt {attempt}) -> cpu\n{res.stderr[-2000:]}",
+          file=sys.stderr)
     return "cpu"
 
 
@@ -191,7 +205,12 @@ def _emit(primary: dict, others: list[dict], platform: str) -> None:
                       primary.get("yardstick"))
     for rec in others:
         if rec.get("yardstick"):
-            _vs_baseline(rec["metric"], platform, rec["value"],
+            # Store under the record's own platform and canonical metric
+            # name (the "@cpu" display suffix marks a duplicate leg, not
+            # a distinct metric).
+            metric = rec["metric"].removesuffix("@cpu")
+            rplat = rec.get("extra", {}).get("measured_platform", platform)
+            _vs_baseline(metric, rplat, rec["value"],
                          rec.get("higher_is_better", False),
                          rec["yardstick"])
     extra = dict(primary.get("extra", {}))
@@ -233,6 +252,34 @@ def _marshal_fallback() -> dict:
                       "transport": "none (proto codec only)"}}
 
 
+def _save_lastgood(records: list[dict], platform: str) -> None:
+    try:
+        LASTGOOD_FILE.write_text(json.dumps({
+            "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+            "platform": platform,
+            "records": records,
+        }, indent=1) + "\n")
+    except OSError:
+        pass
+
+
+def _load_lastgood() -> list[dict]:
+    """Last accelerator-measured records, each marked stale in-place."""
+    if not LASTGOOD_FILE.exists():
+        return []
+    try:
+        blob = json.loads(LASTGOOD_FILE.read_text())
+    except (ValueError, OSError):
+        return []
+    records = blob.get("records", [])
+    for rec in records:
+        extra = rec.setdefault("extra", {})
+        extra["stale"] = True
+        extra["measured_at"] = blob.get("measured_at")
+        extra.setdefault("measured_platform", blob.get("platform"))
+    return records
+
+
 def main() -> None:
     deadline = _START + BUDGET
     platform = _probe_platform(deadline)
@@ -240,27 +287,60 @@ def main() -> None:
     os.close(fd)
     out = pathlib.Path(out_name)
 
-    if platform == "cpu":
-        configs = ["matmul", "use", "t5", "bert"]  # slowest last: CPU BERT ~10s/call
+    if platform != "cpu":
+        _run_child(platform, ACCEL_CONFIGS, out, deadline - 10)
+        if not _load_results(out) and _remaining(deadline) > 45:
+            print("bench: accelerator child produced nothing; cpu rescue",
+                  file=sys.stderr)
+            _run_child("cpu", ["matmul"], out, deadline - 8, iters_cap=5)
     else:
-        configs = ["bert", "matmul", "use", "t5", "resnet", "bert_int8"]
-    _run_child(platform, configs, out, deadline - 10)
+        # CPU fallback — but reserve time to re-probe the accelerator once
+        # mid-budget, so a transient t=0 wedge doesn't cost the round its
+        # on-chip legs (round-3 failure mode).
+        reprobe = _remaining(deadline) > 150
+        cpu_deadline = (time.monotonic() + _remaining(deadline) - 110
+                        if reprobe else deadline - 10)
+        _run_child("cpu", CPU_CONFIGS, out, cpu_deadline)
+        if reprobe and _remaining(deadline) > 90:
+            platform = _probe_platform(deadline, attempt=2)
+            if platform != "cpu":
+                _run_child(platform, ACCEL_CONFIGS, out, deadline - 8,
+                           iters_cap=20)
 
     records = _load_results(out)
-    if not records and platform != "cpu" and _remaining(deadline) > 45:
-        print("bench: accelerator child produced nothing; cpu rescue",
-              file=sys.stderr)
-        platform = "cpu"
-        _run_child("cpu", ["matmul"], out, deadline - 8, iters_cap=5)
-        records = _load_results(out)
+    accel = [r for r in records
+             if r.get("extra", {}).get("measured_platform")
+             not in (None, "cpu")]
+    live_cpu = [r for r in records if r not in accel]
 
     try:
-        if records:
+        if accel:
+            _save_lastgood(accel, accel[0]["extra"]["measured_platform"])
+            pool, others_extra = accel, live_cpu
+        else:
+            stale = _load_lastgood()
+            if stale:
+                print("bench: no live accelerator; attaching stale "
+                      "on-chip records", file=sys.stderr)
+            pool, others_extra = (stale, live_cpu) if stale \
+                else (live_cpu, [])
+        if pool or others_extra:
             primary = next(
-                (r for r in records if r["metric"].startswith("bert")),
-                records[0])
-            others = [r for r in records if r is not primary]
-            _emit(primary, others, platform)
+                (r for r in pool if r["metric"].startswith("bert_base_p")),
+                next((r for r in pool if r["metric"].startswith("bert")),
+                     (pool or others_extra)[0]))
+            # De-dup metric names when the same config ran on both
+            # platforms: the accelerator/stale record keeps the name.
+            pool_metrics = {r["metric"] for r in pool}
+            deduped = []
+            for rec in others_extra:
+                if rec["metric"] in pool_metrics:
+                    rec = dict(rec, metric=rec["metric"] + "@cpu")
+                deduped.append(rec)
+            others = [r for r in pool + deduped if r is not primary]
+            platform_out = primary.get("extra", {}).get(
+                "measured_platform", platform)
+            _emit(primary, others, platform_out)
         else:
             try:
                 _emit(_marshal_fallback(), [], "none")
@@ -352,16 +432,26 @@ import numpy as np
 import tensorflow as tf
 tf.config.threading.set_intra_op_parallelism_threads(0)
 rng = np.random.default_rng(0)
-x = tf.constant(rng.standard_normal(({batch}, 8)).astype("float32"))
+xs = rng.standard_normal(({batch}, 8)).astype("float32")
 w = tf.constant(rng.standard_normal((8, 4)).astype("float32"))
 b = tf.constant(rng.standard_normal((4,)).astype("float32"))
 @tf.function
 def model(x):
     return tf.nn.softmax(tf.matmul(x, w) + b)
-model(x)
+# Like-for-like with the serving path being measured: every request pays
+# request marshal (ndarray->TensorProto), parse (TensorProto->tensor),
+# execute, response marshal, response parse. TF's own C-accelerated
+# make_tensor_proto/make_ndarray are the reference stack's equivalents.
+def serve_once():
+    req = tf.make_tensor_proto(xs)
+    x = tf.constant(tf.make_ndarray(req))
+    out = model(x).numpy()
+    resp = tf.make_tensor_proto(out)
+    return tf.make_ndarray(resp)
+serve_once()
 ts = []
-for _ in range(200):
-    t0 = time.perf_counter(); model(x).numpy(); ts.append((time.perf_counter()-t0)*1e3)
+for _ in range(300):
+    t0 = time.perf_counter(); serve_once(); ts.append((time.perf_counter()-t0)*1e3)
 ts.sort()
 print(json.dumps({{"p50_ms": ts[len(ts)//2]}}))
 """
@@ -384,8 +474,10 @@ def _tf_cpu_yardstick(batch: int) -> dict | None:
         if res.returncode == 0:
             p50 = json.loads(res.stdout.strip().splitlines()[-1])["p50_ms"]
             return {"value": p50, "unit": "ms",
-                    "source": "measured: tensorflow-2.x CPU eager "
-                              "tf.function, same computation, this host"}
+                    "source": "measured: tensorflow-2.x CPU tf.function + "
+                              "make_tensor_proto/make_ndarray marshalling "
+                              "both directions (the per-request work the "
+                              "reference stack pays), this host"}
     except Exception:
         pass
     return None
@@ -559,6 +651,11 @@ def bench_matmul(max_iters: int) -> dict:
         out = tensor_proto_to_ndarray(resp.outputs["probs"])
         assert out.shape == (BATCH, 4)
 
+    # Sub-ms calls need more samples than the default cap for a stable
+    # p50 — this is the config the TF yardstick is compared against. An
+    # explicit BENCH_ITERS cap (time-constrained rescue legs) still wins.
+    if not os.environ.get("BENCH_ITERS"):
+        max_iters = max(300, max_iters)
     stats = _measure(call, max_iters)
     extra = {"model": "matmul-toy", "batch": BATCH,
              "p99_ms": round(stats["p99"], 4),
@@ -858,6 +955,15 @@ def bench_resnet(max_iters: int) -> dict:
     if _child_time_left() > 30:
         extra.update(_concurrent_qps(call, batch=BATCH, p50_ms=stats["p50"],
                                      threads=4, total=12))
+    peak = _peak_flops_per_s()
+    if peak:
+        flops = float(resnet.fwd_flops(config)) * BATCH
+        extra["mfu_sync"] = round(flops / (stats["p50"] / 1e3) / peak, 4)
+        per_call = extra.get("pipelined_per_call_ms")
+        if per_call:
+            # RTT overlaps under pipelining: per-call wall bounds device
+            # time from above, so this MFU is a lower bound on the chip's.
+            extra["mfu"] = round(flops / (per_call / 1e3) / peak, 4)
     return {"metric": f"resnet50_predict_p50_b{BATCH}", "value": stats["p50"],
             "unit": "ms", "extra": extra}
 
@@ -869,11 +975,16 @@ _CONFIG_FNS = {"bert": bench_bert, "bert_int8": bench_bert_int8,
 
 def child_main(out: pathlib.Path, configs: list[str]) -> None:
     _child_setup()
+    import jax
+
+    measured_platform = jax.devices()[0].platform
     max_iters = int(os.environ.get("BENCH_ITERS", 50))
     with out.open("a") as sink:
         for name in configs:
             try:
                 rec = _CONFIG_FNS[name](max_iters)
+                rec.setdefault("extra", {})[
+                    "measured_platform"] = measured_platform
                 sink.write(json.dumps(rec) + "\n")
                 sink.flush()
                 print(f"bench child: {name} -> "
