@@ -40,6 +40,9 @@ import time
 import traceback
 
 REPO = pathlib.Path(__file__).resolve().parent
+sys.path.insert(0, str(REPO))
+
+from min_tfs_client_tpu.utils import chip_probe  # noqa: E402 (stdlib-only)
 BASELINE_FILE = REPO / "bench_baseline.json"
 # Last accelerator-measured records, committed so a round where the chip
 # tunnel is wedged still carries the on-chip performance story (with
@@ -83,6 +86,16 @@ def _probe_platform(deadline: float, attempt: int = 1) -> str:
     tunnel that was wedged at t=0 sometimes recovers."""
     if os.environ.get("BENCH_PLATFORM"):
         return os.environ["BENCH_PLATFORM"]
+    if attempt == 1:
+        # A fresh verdict from the other prober (tests/tpu tier, or a
+        # previous bench run) saves the probe budget for measurements.
+        cached = chip_probe.cached_verdict()
+        if cached is not None:
+            print(f"bench: cached probe verdict ok={cached['ok']} "
+                  f"platform={cached.get('platform')}", file=sys.stderr)
+            if cached["ok"] and cached.get("platform") != "cpu":
+                return "default"
+            return "cpu"
     # Healthy init + one matmul ≈ 25-40s; a wedged claim hangs forever, so
     # every probe second past ~2x typical is stolen from the CPU fallback.
     timeout = min(75.0, max(20.0, _remaining(deadline) / 2))
@@ -93,15 +106,19 @@ def _probe_platform(deadline: float, attempt: int = 1) -> str:
     except subprocess.TimeoutExpired:
         print(f"bench: accelerator probe timed out (attempt {attempt}) "
               "-> cpu", file=sys.stderr)
+        chip_probe.record(False, detail=f"probe timeout {timeout:.0f}s")
         return "cpu"
     if res.returncode == 0 and "PROBE_OK" in res.stdout:
         plat = res.stdout.split("PROBE_OK", 1)[1].split()[0]
         print(f"bench: accelerator probe ok (platform={plat})",
               file=sys.stderr)
+        chip_probe.record(plat != "cpu", platform=plat)
         return "default" if plat != "cpu" else "cpu"
     print(f"bench: accelerator probe failed (rc={res.returncode}, "
           f"attempt {attempt}) -> cpu\n{res.stderr[-2000:]}",
           file=sys.stderr)
+    chip_probe.record(False, detail=f"rc={res.returncode} "
+                      + res.stderr[-300:])
     return "cpu"
 
 
@@ -253,11 +270,28 @@ def _marshal_fallback() -> dict:
 
 
 def _save_lastgood(records: list[dict], platform: str) -> None:
+    """Merge per-metric into the stored set: a partial accelerator run
+    (e.g. only the bert leg finished before the deadline) must not
+    discard the stored on-chip records for the other configs."""
+    now = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    merged: dict[str, dict] = {}
+    if LASTGOOD_FILE.exists():
+        try:
+            prev = json.loads(LASTGOOD_FILE.read_text())
+            for rec in prev.get("records", []):
+                rec.setdefault("extra", {}).setdefault(
+                    "measured_at", prev.get("measured_at"))
+                merged[rec["metric"]] = rec
+        except (ValueError, OSError):
+            pass
+    for rec in records:
+        rec = dict(rec, extra=dict(rec.get("extra", {}), measured_at=now))
+        merged[rec["metric"]] = rec
     try:
         LASTGOOD_FILE.write_text(json.dumps({
-            "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+            "measured_at": now,
             "platform": platform,
-            "records": records,
+            "records": list(merged.values()),
         }, indent=1) + "\n")
     except OSError:
         pass
@@ -275,7 +309,7 @@ def _load_lastgood() -> list[dict]:
     for rec in records:
         extra = rec.setdefault("extra", {})
         extra["stale"] = True
-        extra["measured_at"] = blob.get("measured_at")
+        extra.setdefault("measured_at", blob.get("measured_at"))
         extra.setdefault("measured_platform", blob.get("platform"))
     return records
 
@@ -312,6 +346,12 @@ def main() -> None:
              if r.get("extra", {}).get("measured_platform")
              not in (None, "cpu")]
     live_cpu = [r for r in records if r not in accel]
+    if platform != "cpu" and not accel:
+        # The probe (or a cached OK verdict) said healthy but the child
+        # measured nothing — flip the shared verdict so the tests tier /
+        # next bench run doesn't repeat the full-budget burn.
+        chip_probe.record(False,
+                          detail="accelerator child produced no records")
 
     try:
         if accel:
@@ -525,6 +565,20 @@ def _param_count(params) -> int:
                for p in jax.tree_util.tree_leaves(params))
 
 
+def _add_mfu(extra: dict, flops: float, p50_ms: float) -> None:
+    """mfu_sync from the synchronous p50; mfu from the pipelined per-call
+    time when measured — RTT overlaps under pipelining, so the per-call
+    wall bounds device time from above and this MFU is a lower bound on
+    the chip's."""
+    peak = _peak_flops_per_s()
+    if not peak:
+        return
+    extra["mfu_sync"] = round(flops / (p50_ms / 1e3) / peak, 4)
+    per_call = extra.get("pipelined_per_call_ms")
+    if per_call:
+        extra["mfu"] = round(flops / (per_call / 1e3) / peak, 4)
+
+
 def _peak_flops_per_s() -> float:
     """Best-effort peak bf16 FLOPs of device 0 for the MFU estimate."""
     import jax
@@ -578,16 +632,8 @@ def bench_bert(max_iters: int) -> dict:
              "transport_rtt_ms": round(_transport_rtt_ms(), 2)}
     if _child_time_left() > 30:
         extra.update(_concurrent_qps(call, batch=BATCH, p50_ms=stats["p50"]))
-    peak = _peak_flops_per_s()
-    if peak:
-        # forward ≈ 2 * params * tokens FLOPs
-        flops = 2.0 * n_params * BATCH * SEQ_LEN
-        extra["mfu_sync"] = round(flops / (stats["p50"] / 1e3) / peak, 4)
-        per_call = extra.get("pipelined_per_call_ms")
-        if per_call:
-            # RTT overlaps under pipelining: per-call wall bounds device
-            # time from above, so this MFU is a lower bound on the chip's.
-            extra["mfu"] = round(flops / (per_call / 1e3) / peak, 4)
+    # forward ≈ 2 * params * tokens FLOPs
+    _add_mfu(extra, 2.0 * n_params * BATCH * SEQ_LEN, stats["p50"])
     return {"metric": f"bert_base_predict_p50_b{BATCH}_s{SEQ_LEN}",
             "value": stats["p50"], "unit": "ms", "extra": extra}
 
@@ -955,15 +1001,7 @@ def bench_resnet(max_iters: int) -> dict:
     if _child_time_left() > 30:
         extra.update(_concurrent_qps(call, batch=BATCH, p50_ms=stats["p50"],
                                      threads=4, total=12))
-    peak = _peak_flops_per_s()
-    if peak:
-        flops = float(resnet.fwd_flops(config)) * BATCH
-        extra["mfu_sync"] = round(flops / (stats["p50"] / 1e3) / peak, 4)
-        per_call = extra.get("pipelined_per_call_ms")
-        if per_call:
-            # RTT overlaps under pipelining: per-call wall bounds device
-            # time from above, so this MFU is a lower bound on the chip's.
-            extra["mfu"] = round(flops / (per_call / 1e3) / peak, 4)
+    _add_mfu(extra, float(resnet.fwd_flops(config)) * BATCH, stats["p50"])
     return {"metric": f"resnet50_predict_p50_b{BATCH}", "value": stats["p50"],
             "unit": "ms", "extra": extra}
 

@@ -1,0 +1,63 @@
+"""Shared accelerator probe verdict cache.
+
+Both probers of the real chip (bench.py and the tests/tpu tier) pay up to
+~75 s to learn whether the tunneled accelerator is alive, and a wedged
+tunnel makes every prober pay the full timeout. They share one verdict
+file so a fresh answer from either side is reused by the other:
+
+  * a recent OK verdict lets the next prober skip straight to the device;
+  * a recent FAILED verdict lets it fall back to CPU immediately and
+    spend the saved budget on measurements (the mid-budget re-probe still
+    happens — a wedge can clear).
+
+The cache is advisory only: stale entries are ignored, and a prober that
+distrusts it can always probe fresh and overwrite.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+import time
+from typing import Optional
+
+CACHE_PATH = pathlib.Path(
+    os.environ.get("CHIP_PROBE_CACHE",
+                   pathlib.Path(__file__).resolve().parents[2]
+                   / ".chip_probe.json"))
+
+# An OK chip tends to stay up; a wedge tends to clear on tunnel restart,
+# so distrust failures sooner than successes.
+OK_TTL_S = 300.0
+FAIL_TTL_S = 150.0
+
+
+def record(ok: bool, platform: str = "", detail: str = "") -> None:
+    """Persist a probe outcome (best-effort; never raises)."""
+    try:
+        CACHE_PATH.write_text(json.dumps({
+            "at": time.time(),
+            "ok": bool(ok),
+            "platform": platform,
+            "detail": detail[:500],
+        }) + "\n")
+    except OSError:
+        pass
+
+
+def cached_verdict(now: Optional[float] = None) -> Optional[dict]:
+    """A still-trustworthy verdict, or None (missing, corrupt, expired)."""
+    try:
+        blob = json.loads(CACHE_PATH.read_text())
+    except (OSError, ValueError):
+        return None
+    if (not isinstance(blob, dict)
+            or not isinstance(blob.get("ok"), bool)
+            or not isinstance(blob.get("at"), (int, float))):
+        return None
+    age = (now if now is not None else time.time()) - blob["at"]
+    if age < 0:
+        return None
+    ttl = OK_TTL_S if blob["ok"] else FAIL_TTL_S
+    return blob if age <= ttl else None

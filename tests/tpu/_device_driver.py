@@ -13,7 +13,14 @@ import time
 sys.path.insert(0, "/root/repo")
 
 
+_LAST_EMIT = time.monotonic()
+
+
 def emit(check: str, ok: bool, **extra) -> None:
+    global _LAST_EMIT
+    now = time.monotonic()
+    extra.setdefault("ms", round((now - _LAST_EMIT) * 1e3, 1))
+    _LAST_EMIT = now
     print(json.dumps({"check": check, "ok": ok, **extra}), flush=True)
 
 

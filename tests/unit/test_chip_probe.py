@@ -1,0 +1,53 @@
+"""Shared probe-verdict cache: TTL and corruption behavior."""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from min_tfs_client_tpu.utils import chip_probe
+
+
+@pytest.fixture(autouse=True)
+def _isolated_cache(tmp_path, monkeypatch):
+    monkeypatch.setattr(chip_probe, "CACHE_PATH",
+                        tmp_path / "probe.json")
+
+
+def test_roundtrip_ok_verdict():
+    chip_probe.record(True, platform="tpu")
+    got = chip_probe.cached_verdict()
+    assert got is not None and got["ok"] and got["platform"] == "tpu"
+
+
+def test_ok_expires_after_ttl():
+    chip_probe.record(True, platform="tpu")
+    at = json.loads(chip_probe.CACHE_PATH.read_text())["at"]
+    assert chip_probe.cached_verdict(now=at + chip_probe.OK_TTL_S - 1)
+    assert chip_probe.cached_verdict(
+        now=at + chip_probe.OK_TTL_S + 1) is None
+
+
+def test_failure_distrusted_sooner_than_success():
+    assert chip_probe.FAIL_TTL_S < chip_probe.OK_TTL_S
+    chip_probe.record(False, detail="probe timeout 75s")
+    at = json.loads(chip_probe.CACHE_PATH.read_text())["at"]
+    got = chip_probe.cached_verdict(now=at + chip_probe.FAIL_TTL_S - 1)
+    assert got is not None and not got["ok"]
+    assert chip_probe.cached_verdict(
+        now=at + chip_probe.FAIL_TTL_S + 1) is None
+
+
+def test_missing_and_corrupt_files_yield_none():
+    assert chip_probe.cached_verdict() is None
+    chip_probe.CACHE_PATH.write_text("not json")
+    assert chip_probe.cached_verdict() is None
+    chip_probe.CACHE_PATH.write_text('{"at": 1}')  # missing "ok"
+    assert chip_probe.cached_verdict() is None
+
+
+def test_clock_skew_rejected():
+    chip_probe.record(True, platform="tpu")
+    at = json.loads(chip_probe.CACHE_PATH.read_text())["at"]
+    assert chip_probe.cached_verdict(now=at - 10) is None

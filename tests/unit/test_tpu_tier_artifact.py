@@ -1,0 +1,53 @@
+"""The tests/tpu tier must leave evidence on every exit path, and a later
+skip must not erase earlier on-hardware evidence (round-3 Missing #4)."""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from tests.tpu import test_on_device as tier
+
+
+@pytest.fixture(autouse=True)
+def _isolated_artifact(tmp_path, monkeypatch):
+    monkeypatch.setattr(tier, "ARTIFACT", tmp_path / "TPU_TIER.json")
+
+
+def _read():
+    return json.loads(tier.ARTIFACT.read_text())
+
+
+def test_skip_writes_explicit_record():
+    tier._persist("skipped", "accelerator wedged: probe timeout")
+    blob = _read()
+    assert blob["latest"]["status"] == "skipped"
+    assert "wedged" in blob["latest"]["detail"]
+    assert blob["last_ran"] is None
+
+
+def test_ran_recorded_with_checks():
+    checks = {"flash_attention/plain": {"ok": True, "ms": 12.5}}
+    tier._persist("ran", "", checks, platform="tpu")
+    blob = _read()
+    assert blob["latest"]["status"] == "ran"
+    assert blob["latest"]["platform"] == "tpu"
+    assert blob["latest"]["checks"] == checks
+    assert blob["last_ran"] == blob["latest"]
+
+
+def test_later_skip_preserves_last_ran():
+    checks = {"bucketed_predict": {"ok": True, "ms": 800.0}}
+    tier._persist("ran", "", checks, platform="tpu")
+    tier._persist("skipped", "no accelerator (cpu backend)")
+    blob = _read()
+    assert blob["latest"]["status"] == "skipped"
+    assert blob["last_ran"]["status"] == "ran"
+    assert blob["last_ran"]["checks"] == checks
+
+
+def test_corrupt_artifact_tolerated():
+    tier.ARTIFACT.write_text("garbage")
+    tier._persist("skipped", "wedged")
+    assert _read()["latest"]["status"] == "skipped"
