@@ -276,13 +276,18 @@ def _save_lastgood(records: list[dict], platform: str) -> None:
     now = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
     merged: dict[str, dict] = {}
     if LASTGOOD_FILE.exists():
+        # A corrupt-but-parseable state file must not crash the round
+        # that just measured fresh records (KeyError/AttributeError on
+        # malformed entries included).
         try:
             prev = json.loads(LASTGOOD_FILE.read_text())
             for rec in prev.get("records", []):
+                if not isinstance(rec, dict) or "metric" not in rec:
+                    continue
                 rec.setdefault("extra", {}).setdefault(
                     "measured_at", prev.get("measured_at"))
                 merged[rec["metric"]] = rec
-        except (ValueError, OSError):
+        except (ValueError, OSError, TypeError, AttributeError):
             pass
     for rec in records:
         rec = dict(rec, extra=dict(rec.get("extra", {}), measured_at=now))

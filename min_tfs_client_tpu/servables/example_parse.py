@@ -1,0 +1,212 @@
+"""Recover host-side Example parse specs from a graph's ParseExample node.
+
+The reference serves Classify/Regress on any SavedModel whose graph embeds
+`ParseExample`: `InputToSerializedExampleTensor` builds one string tensor
+of serialized Examples and the graph parses it itself
+(reference servables/tensorflow/classifier.h:16-90, util.h:57). XLA has no
+string kernels, so this framework parses Examples on the HOST
+(tensor/example_codec.py) and feeds the parse results to the device. For
+natively-exported families the exporter writes `feature_specs` directly;
+for IMPORTED SavedModels this module recovers the same specs from the
+`ParseExample`/`ParseExampleV2` node's attributes, and the import bypasses
+the node: the signature feeds the node's dense output tensors, everything
+upstream of them (the string placeholder, the parse op) never executes.
+
+Scope: FixedLen dense features only (float32 / int64 / bytes), matching
+what the host decoder implements. Sparse and ragged outputs are rejected
+with a clear error — VarLen features batch as dynamically-shaped sparse
+tensors, which the static-shape device path does not serve.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from min_tfs_client_tpu.protos import tf_tensor_pb2
+from min_tfs_client_tpu.tensor.codec import tensor_proto_to_ndarray
+from min_tfs_client_tpu.tensor.example_codec import FeatureSpec
+
+_DTYPES = {
+    tf_tensor_pb2.DT_FLOAT: np.dtype(np.float32),
+    tf_tensor_pb2.DT_INT64: np.dtype(np.int64),
+    tf_tensor_pb2.DT_STRING: np.dtype(object),
+}
+
+
+class ParseSynthesisError(ValueError):
+    """The graph parses Examples in a way the host decoder cannot mirror."""
+
+
+@dataclass(frozen=True)
+class ParseBypass:
+    """How to serve a signature around its ParseExample node."""
+
+    node_name: str
+    feature_order: list[str]       # aligned with dense_refs
+    dense_refs: list[str]          # "node:k" tensor refs to feed
+    specs: dict[str, FeatureSpec]  # keyed by feature name
+    dtype_enums: dict[str, int]    # feature -> DT_* enum (for TensorSpec)
+    shapes: dict[str, tuple[int, ...]]
+
+
+def _tensor_name(ref: str) -> tuple[str, int]:
+    name, _, idx = ref.partition(":")
+    return name, int(idx) if idx else 0
+
+
+def _follow_identities(nodes: dict, ref: str) -> tuple[str, int]:
+    """Resolve a tensor ref through Identity chains to its producer."""
+    name, idx = _tensor_name(ref)
+    seen = set()
+    while True:
+        node = nodes.get(name)
+        if node is None or node.op != "Identity" or name in seen:
+            return name, idx
+        seen.add(name)
+        name, idx = _tensor_name(node.input[0])
+
+
+def _const_ndarray(nodes: dict, ref: str, what: str,
+                   _depth: int = 0) -> np.ndarray:
+    """Evaluate a constant-producing tensor (Const, possibly through
+    Identity/Reshape/ExpandDims/Squeeze wrappers — tf.io.parse_example
+    emits `Reshape(Const)` for dense defaults)."""
+    if _depth > 8:
+        raise ParseSynthesisError(
+            f"{what} (tensor {ref!r}): constant chain too deep")
+    name, idx = _follow_identities(nodes, ref)
+    node = nodes.get(name)
+    if node is None or idx != 0:
+        raise ParseSynthesisError(
+            f"{what} (tensor {ref!r}) is not a Const; cannot synthesize "
+            "a host parse spec from a data-dependent key/default")
+    if node.op == "Const":
+        return tensor_proto_to_ndarray(node.attr["value"].tensor)
+    if node.op == "Reshape":
+        value = _const_ndarray(nodes, node.input[0], what, _depth + 1)
+        shape = _const_ndarray(nodes, node.input[1], what, _depth + 1)
+        return value.reshape(tuple(int(d) for d in shape.reshape(-1)))
+    if node.op in ("ExpandDims", "Squeeze"):
+        return _const_ndarray(nodes, node.input[0], what, _depth + 1)
+    raise ParseSynthesisError(
+        f"{what} (tensor {ref!r}) is produced by {node.op!r}, not a "
+        "Const; cannot synthesize a host parse spec")
+
+
+def _shape_tuple(shape_proto, key: str) -> tuple[int, ...]:
+    if shape_proto.unknown_rank:
+        raise ParseSynthesisError(
+            f"dense feature {key!r} has unknown-rank shape")
+    dims = tuple(int(d.size) for d in shape_proto.dim)
+    if any(d < 0 for d in dims):
+        raise ParseSynthesisError(
+            f"dense feature {key!r} has a partial shape {dims}; FixedLen "
+            "features must be fully defined (variable-length parsing is "
+            "sparse, which is out of scope)")
+    return dims
+
+
+def _default_value(arr: np.ndarray, dtype: np.dtype, shape: tuple[int, ...],
+                   key: str):
+    """Const default tensor -> FeatureSpec.default (None = required)."""
+    if arr.size == 0:
+        return None
+    n = int(np.prod(shape, dtype=np.int64)) if shape else 1
+    if arr.size not in (1, n):
+        raise ParseSynthesisError(
+            f"dense feature {key!r}: default has {arr.size} values for "
+            f"shape {shape}")
+    if dtype == object:
+        return [bytes(v) for v in arr.reshape(-1).tolist()]
+    return arr.reshape(-1).astype(dtype)
+
+
+def find_parse_bypass(graph_def, serialized_ref: str) -> "ParseBypass | None":
+    """ParseBypass for the ParseExample consumer of `serialized_ref`.
+
+    Returns None when no ParseExample/ParseExampleV2 consumes the tensor
+    (the signature is a genuine string model, e.g. a tokenizer input).
+    Raises ParseSynthesisError when there IS a parse node but its spec
+    cannot be mirrored host-side (sparse/ragged/partial shapes/...).
+    """
+    nodes = {n.name: n for n in graph_def.node}
+    src = _follow_identities(nodes, serialized_ref)
+    consumer = None
+    for node in graph_def.node:
+        if node.op not in ("ParseExample", "ParseExampleV2"):
+            continue
+        if node.input and _follow_identities(nodes, node.input[0]) == src:
+            consumer = node
+            break
+    if consumer is None:
+        return None
+
+    attrs = consumer.attr
+    if consumer.op == "ParseExample":
+        n_sparse = int(attrs["Nsparse"].i)
+        n_dense = int(attrs["Ndense"].i)
+        if n_sparse:
+            raise ParseSynthesisError(
+                f"{consumer.name}: {n_sparse} sparse features; only "
+                "FixedLen dense features are served (VarLen is "
+                "dynamically shaped)")
+        key_refs = consumer.input[2 + n_sparse: 2 + n_sparse + n_dense]
+        keys = [bytes(_const_ndarray(nodes, r, "dense key").reshape(())
+                      .item()).decode() for r in key_refs]
+        default_refs = consumer.input[2 + n_sparse + n_dense:
+                                      2 + n_sparse + 2 * n_dense]
+        dense_base = 3 * n_sparse
+    else:  # ParseExampleV2
+        n_sparse = int(attrs["num_sparse"].i)
+        n_ragged = len(attrs["ragged_value_types"].list.type)
+        if n_sparse or n_ragged:
+            raise ParseSynthesisError(
+                f"{consumer.name}: {n_sparse} sparse / {n_ragged} ragged "
+                "features; only FixedLen dense features are served")
+        keys_arr = _const_ndarray(nodes, consumer.input[3], "dense keys")
+        keys = [bytes(k).decode() for k in keys_arr.reshape(-1).tolist()]
+        n_dense = len(keys)
+        default_refs = consumer.input[5:5 + n_dense]
+        # V2 output order: sparse_indices, sparse_values, sparse_shapes,
+        # dense_values, ragged_values, ragged_row_splits — dense comes
+        # BEFORE ragged, so ragged slots do not offset the dense base.
+        dense_base = 3 * n_sparse
+
+    type_enums = list(attrs["Tdense"].list.type)
+    shape_protos = list(attrs["dense_shapes"].list.shape)
+    if not (len(type_enums) == len(shape_protos) == len(keys)
+            == len(default_refs)):
+        raise ParseSynthesisError(
+            f"{consumer.name}: inconsistent dense arity "
+            f"(keys={len(keys)}, types={len(type_enums)}, "
+            f"shapes={len(shape_protos)}, defaults={len(default_refs)})")
+
+    specs: dict[str, FeatureSpec] = {}
+    dtype_enums: dict[str, int] = {}
+    shapes: dict[str, tuple[int, ...]] = {}
+    for key, enum, shape_proto, default_ref in zip(
+            keys, type_enums, shape_protos, default_refs):
+        np_dtype = _DTYPES.get(int(enum))
+        if np_dtype is None:
+            raise ParseSynthesisError(
+                f"dense feature {key!r}: unsupported dtype enum {enum}")
+        shape = _shape_tuple(shape_proto, key)
+        default_arr = _const_ndarray(nodes, default_ref,
+                                     f"default for {key!r}")
+        specs[key] = FeatureSpec(
+            dtype=np_dtype, shape=shape,
+            default=_default_value(default_arr, np_dtype, shape, key))
+        dtype_enums[key] = int(enum)
+        shapes[key] = shape
+
+    return ParseBypass(
+        node_name=consumer.name,
+        feature_order=keys,
+        dense_refs=[f"{consumer.name}:{dense_base + i}"
+                    for i in range(n_dense)],
+        specs=specs,
+        dtype_enums=dtype_enums,
+        shapes=shapes,
+    )

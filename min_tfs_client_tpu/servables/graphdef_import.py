@@ -783,7 +783,10 @@ class GraphFunction:
 
     def _scan(self, graph_def) -> bool:
         """Reachability scan from fetches: validate ops, decode Consts,
-        detect string dtypes."""
+        detect string dtypes. Fed nodes prune the walk — feeding an
+        interior tensor (e.g. a ParseExample dense output the host decode
+        bypasses) shields everything upstream of it, the same way feeds
+        override producers in Session::Run."""
         has_string = False
         feeds = {name for name, _ in self._feeds}
         seen: set[str] = set()
@@ -793,6 +796,16 @@ class GraphFunction:
             if name in seen:
                 continue
             seen.add(name)
+            if name in feeds:
+                # Still sniff the fed node's own dtype: a string
+                # Placeholder feed must keep the signature on host.
+                node = self._nodes.get(name)
+                if node is not None:
+                    for key in ("dtype", "T"):
+                        a = _attr(node, key)
+                        if a is not None and a.type == DT_STRING:
+                            has_string = True
+                continue
             node = self._nodes.get(name)
             if node is None:
                 raise GraphImportError(f"graph references unknown node {name!r}")
@@ -841,9 +854,16 @@ class GraphFunction:
         return value
 
     def __call__(self, feed_values: Sequence[object], lib) -> list[object]:
+        _UNFED = object()  # unfed output slot of a partially-fed node
         memo: dict[str, list] = {}
-        for (name, _), value in zip(self._feeds, feed_values):
-            memo[name] = [value]
+        # Feeds grouped by node: interior multi-output refs ("parse:3")
+        # fill only their slot; touching a sibling slot the caller did
+        # not feed is an error, not a silent None.
+        for (name, idx), value in zip(self._feeds, feed_values):
+            slots = memo.setdefault(name, [])
+            if len(slots) <= idx:
+                slots.extend([_UNFED] * (idx + 1 - len(slots)))
+            slots[idx] = value
 
         def evaluate(name: str) -> list:
             if name in memo:
@@ -865,13 +885,26 @@ class GraphFunction:
                     evaluate(ref[1:])  # control dep: force evaluation only
                     continue
                 dep, idx = _tensor_name(ref)
-                args.append(evaluate(dep)[idx])
+                outs = evaluate(dep)
+                if idx >= len(outs) or outs[idx] is _UNFED:
+                    raise GraphImportError(
+                        f"tensor {dep}:{idx} is consumed but its node was "
+                        "bypassed by feeds and that output was not fed")
+                args.append(outs[idx])
             memo[name] = _dispatch(node, args, lib, self._funclib)
             return memo[name]
 
         for target in self._targets:
             evaluate(target)  # side-effect/validation only, no output slot
-        return [evaluate(name)[idx] for name, idx in self._fetches]
+        outs = []
+        for name, idx in self._fetches:
+            slots = evaluate(name)
+            if idx >= len(slots) or slots[idx] is _UNFED:
+                raise GraphImportError(
+                    f"fetch {name}:{idx} was bypassed by feeds and that "
+                    "output was not fed")
+            outs.append(slots[idx])
+        return outs
 
 
 def _spec_from_tensor_info(info: tf_graph_pb2.TensorInfo) -> TensorSpec:
@@ -929,11 +962,46 @@ def load_saved_model(
         out_aliases = sorted(sig_def.outputs)
         feed_names = [sig_def.inputs[a].name for a in in_aliases]
         fetch_names = [sig_def.outputs[a].name for a in out_aliases]
+
+        # A single string input feeding a ParseExample node is the
+        # reference's Classify/Regress shape (classifier.h:16-90: the
+        # graph parses serialized Examples itself). The host decodes
+        # Examples instead (XLA has no string kernels), so recover the
+        # parse spec from the node and feed its dense outputs directly.
+        feature_specs = None
+        if (len(in_aliases) == 1
+                and int(sig_def.inputs[in_aliases[0]].dtype) == DT_STRING):
+            from min_tfs_client_tpu.servables import example_parse
+            try:
+                bypass = example_parse.find_parse_bypass(
+                    meta_graph.graph_def, feed_names[0])
+            except example_parse.ParseSynthesisError as exc:
+                raise GraphImportError(
+                    f"signature {key!r}: {exc}") from exc
+            if bypass is not None:
+                feature_specs = bypass.specs
+                in_aliases = list(bypass.feature_order)
+                feed_names = list(bypass.dense_refs)
+
         graph_fn = GraphFunction(meta_graph.graph_def, feed_names, fetch_names,
                                  variables=variables, funclib=funclib)
+        on_host = graph_fn.has_string
+        if feature_specs is not None and any(
+                e == DT_STRING for e in bypass.dtype_enums.values()):
+            # A FixedLen bytes feature decodes to an object array, which
+            # the jitted device path cannot ingest; the scan can miss it
+            # (Tdense is a list attr on the bypassed node).
+            on_host = True
 
-        in_specs = {a: _spec_from_tensor_info(sig_def.inputs[a])
-                    for a in in_aliases}
+        if feature_specs is not None:
+            # Parse-result tensors: leading batch dim + the FixedLen shape.
+            in_specs = {
+                name: TensorSpec(DataType(bypass.dtype_enums[name]),
+                                 (None, *bypass.shapes[name]))
+                for name in in_aliases}
+        else:
+            in_specs = {a: _spec_from_tensor_info(sig_def.inputs[a])
+                        for a in in_aliases}
         out_specs = {a: _spec_from_tensor_info(sig_def.outputs[a])
                      for a in out_aliases}
         # Batched iff every input has a polymorphic leading dim.
@@ -941,7 +1009,7 @@ def load_saved_model(
             spec.shape and spec.shape[0] is None for spec in in_specs.values())
 
         def make_fn(graph_fn=graph_fn, in_aliases=in_aliases,
-                    out_aliases=out_aliases, on_host=graph_fn.has_string):
+                    out_aliases=out_aliases, on_host=on_host):
             def fn(inputs: Mapping[str, object]) -> dict[str, object]:
                 if on_host:
                     lib = np
@@ -956,7 +1024,8 @@ def load_saved_model(
             inputs=in_specs,
             outputs=out_specs,
             method_name=sig_def.method_name or PREDICT_METHOD_NAME_DEFAULT,
-            on_host=graph_fn.has_string,
+            feature_specs=feature_specs,
+            on_host=on_host,
             batched=batched,
             batch_buckets=batch_buckets,
         )

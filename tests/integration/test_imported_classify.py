@@ -1,0 +1,175 @@
+"""Classify/Regress over a genuinely-exported TF SavedModel whose graph
+embeds ParseExample (reference classifier.h:16-90: the graph parses the
+serialized-Example string tensor itself; util.h:57 feeds it). The import
+recovers FeatureSpecs from the ParseExample node, bypasses it, and the
+host decodes Examples — cross-validated against TF's own session output
+for the same serialized bytes. TF runs in a subprocess (descriptor-pool
+collision with this package's protos)."""
+
+from __future__ import annotations
+
+import pathlib
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from min_tfs_client_tpu.client import TensorServingClient
+from min_tfs_client_tpu.server.server import Server, ServerOptions
+from min_tfs_client_tpu.servables.graphdef_import import load_saved_model
+from min_tfs_client_tpu.tensor.example_codec import example_from_dict
+
+# TF1-style export: the SAME shape the reference's classify fixtures have
+# (tensorflow_model_server_test.py serves half_plus_two's classify
+# signature, which parses Examples in-graph). Variables exercise the
+# checkpoint-restore path; the string classes output exercises host
+# assembly. Outputs for the given serialized examples are computed by
+# TF's own Session and saved for cross-validation.
+EXPORT_SCRIPT = """
+import sys
+import numpy as np
+import tensorflow as tf
+
+tf1 = tf.compat.v1
+tf1.disable_eager_execution()
+
+export_dir, examples_path, out_path = sys.argv[1:4]
+payloads = np.load(examples_path, allow_pickle=True)
+
+g = tf1.Graph()
+with g.as_default():
+    serialized = tf1.placeholder(tf.string, [None],
+                                 name="input_example_tensor")
+    features = tf1.io.parse_example(serialized, {
+        "x": tf1.io.FixedLenFeature([3], tf.float32),
+        "bias_in": tf1.io.FixedLenFeature([], tf.float32,
+                                          default_value=0.25),
+    })
+    rng = np.random.default_rng(17)
+    w = tf1.get_variable(
+        "w", initializer=rng.standard_normal((3, 4)).astype(np.float32))
+    b = tf1.get_variable(
+        "b", initializer=rng.standard_normal((4,)).astype(np.float32))
+    logits = tf.matmul(features["x"], w) + b
+    scores = tf.nn.softmax(logits, name="scores")
+    labels = tf.constant([b"alpha", b"beta", b"gamma", b"delta"])
+    classes = tf.tile(tf.expand_dims(labels, 0),
+                      [tf.shape(scores)[0], 1], name="classes")
+    regression = tf.add(tf.reduce_sum(logits, axis=1),
+                        features["bias_in"], name="regression")
+
+    classify_sig = tf1.saved_model.classification_signature_def(
+        examples=serialized, classes=classes, scores=scores)
+    regress_sig = tf1.saved_model.regression_signature_def(
+        examples=serialized, predictions=regression)
+
+    builder = tf1.saved_model.Builder(export_dir)
+    with tf1.Session() as sess:
+        sess.run(tf1.global_variables_initializer())
+        builder.add_meta_graph_and_variables(
+            sess, [tf1.saved_model.SERVING],
+            signature_def_map={"serving_default": classify_sig,
+                               "regress": regress_sig})
+        builder.save()
+        got_scores, got_classes, got_reg = sess.run(
+            [scores, classes, regression],
+            {serialized: list(payloads)})
+np.savez(out_path, scores=got_scores, classes=got_classes,
+         regression=got_reg)
+print("SAVED")
+"""
+
+
+def _run_tf(script, *args):
+    return subprocess.run(
+        [sys.executable, "-c", script, *args], capture_output=True,
+        text=True, timeout=300,
+        env={"PATH": "/usr/bin:/bin:/usr/local/bin",
+             "CUDA_VISIBLE_DEVICES": "-1", "JAX_PLATFORMS": "cpu",
+             "TF_CPP_MIN_LOG_LEVEL": "3", "HOME": "/root"})
+
+
+FEATURE_DICTS = [
+    {"x": np.array([0.5, -1.0, 2.0], np.float32), "bias_in": 3.0},
+    {"x": np.array([1.5, 0.25, -0.75], np.float32)},   # default bias_in
+    {"x": np.array([-2.0, 0.0, 1.0], np.float32), "bias_in": -1.5},
+]
+
+
+@pytest.fixture(scope="module")
+def exported(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("classify_export")
+    examples = [example_from_dict(d) for d in FEATURE_DICTS]
+    payloads = np.array([e.SerializeToString() for e in examples],
+                        dtype=object)
+    ex_path = tmp / "examples.npy"
+    np.save(ex_path, payloads, allow_pickle=True)
+    version_dir = tmp / "model" / "1"
+    out_path = tmp / "tf_out.npz"
+    proc = _run_tf(EXPORT_SCRIPT, str(version_dir), str(ex_path),
+                   str(out_path))
+    if "SAVED" not in proc.stdout:
+        pytest.skip(f"tensorflow unavailable: {proc.stderr[-500:]}")
+    want = np.load(out_path, allow_pickle=True)
+    return version_dir.parent, want
+
+
+@pytest.mark.integration
+def test_import_synthesizes_feature_specs(exported):
+    base, _ = exported
+    servable = load_saved_model(str(base / "1"), "clf", 1)
+    sig = servable.signature("")  # serving_default = classify
+    assert sig.method_name == "tensorflow/serving/classify"
+    assert sig.feature_specs is not None
+    assert set(sig.feature_specs) == {"x", "bias_in"}
+    x = sig.feature_specs["x"]
+    assert x.dtype == np.float32 and x.shape == (3,) and x.default is None
+    bias = sig.feature_specs["bias_in"]
+    assert bias.default is not None
+    np.testing.assert_allclose(np.asarray(bias.default), [0.25])
+
+
+@pytest.mark.integration
+def test_classify_end_to_end_matches_tf(exported):
+    base, want = exported
+    srv = Server(ServerOptions(
+        grpc_port=0, model_name="clf", model_base_path=str(base),
+        file_system_poll_wait_seconds=0)).build_and_start()
+    try:
+        with TensorServingClient("127.0.0.1", srv.grpc_port) as client:
+            resp = client.classification_request(
+                "clf", FEATURE_DICTS, timeout=120)
+            result = resp.result
+            assert len(result.classifications) == len(FEATURE_DICTS)
+            for i, cl in enumerate(result.classifications):
+                got_scores = [c.score for c in cl.classes]
+                got_labels = [c.label for c in cl.classes]
+                np.testing.assert_allclose(
+                    got_scores, want["scores"][i], rtol=1e-5, atol=1e-6)
+                assert got_labels == [
+                    lb.decode() for lb in want["classes"][i]]
+
+            reg = client.regression_request(
+                "clf", FEATURE_DICTS, timeout=120,
+                signature_name="regress")
+            got = [r.value for r in reg.result.regressions]
+            np.testing.assert_allclose(got, want["regression"],
+                                       rtol=1e-5, atol=1e-6)
+    finally:
+        srv.stop()
+
+
+@pytest.mark.integration
+def test_missing_required_feature_rejected(exported):
+    base, _ = exported
+    srv = Server(ServerOptions(
+        grpc_port=0, model_name="clf", model_base_path=str(base),
+        file_system_poll_wait_seconds=0)).build_and_start()
+    try:
+        with TensorServingClient("127.0.0.1", srv.grpc_port) as client:
+            with pytest.raises(Exception, match="required feature 'x'"):
+                client.classification_request(
+                    "clf", [{"bias_in": 1.0}], timeout=120)
+    finally:
+        srv.stop()

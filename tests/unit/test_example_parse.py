@@ -1,0 +1,186 @@
+"""ParseExample spec synthesis from hand-built GraphDefs (no TF needed).
+
+Covers both node forms (ParseExample V1 / ParseExampleV2), required vs
+defaulted features, and the rejection surface: sparse/ragged features,
+partial shapes, non-const keys/defaults.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from min_tfs_client_tpu.protos import tf_graph_pb2
+from min_tfs_client_tpu.servables import example_parse
+from min_tfs_client_tpu.tensor.codec import ndarray_to_tensor_proto
+
+DT_FLOAT, DT_STRING, DT_INT64 = 1, 7, 9
+
+
+def _const(gd, name, arr):
+    node = gd.node.add()
+    node.name = name
+    node.op = "Const"
+    node.attr["value"].tensor.CopyFrom(ndarray_to_tensor_proto(arr))
+    return node
+
+
+def _placeholder(gd, name, dtype=DT_STRING):
+    node = gd.node.add()
+    node.name = name
+    node.op = "Placeholder"
+    node.attr["dtype"].type = dtype
+    return node
+
+
+def _shapes_attr(node, shapes):
+    for dims in shapes:
+        sh = node.attr["dense_shapes"].list.shape.add()
+        for d in dims:
+            sh.dim.add().size = d
+
+
+def _v1_graph(*, n_sparse=0, shapes=((3,), ()), defaults=(None, 0.25),
+              dtypes=(DT_FLOAT, DT_FLOAT), keys=("x", "bias")):
+    gd = tf_graph_pb2.GraphDef()
+    _placeholder(gd, "serialized")
+    _const(gd, "names", np.array([], object))
+    node = gd.node.add()
+    node.name = "parse"
+    node.op = "ParseExample"
+    node.input.append("serialized")
+    node.input.append("names")
+    node.attr["Nsparse"].i = n_sparse
+    node.attr["Ndense"].i = len(keys)
+    for i in range(n_sparse):
+        _const(gd, f"sk{i}", np.asarray(b"s%d" % i, object))
+        node.input.append(f"sk{i}")
+    for i, key in enumerate(keys):
+        _const(gd, f"dk{i}", np.asarray(key.encode(), object))
+        node.input.append(f"dk{i}")
+    for i, (default, dims) in enumerate(zip(defaults, shapes)):
+        if default is None:
+            arr = np.zeros((0,), np.float32)
+        else:
+            arr = np.asarray(default, np.float32).reshape(-1)
+        _const(gd, f"dd{i}", arr)
+        node.input.append(f"dd{i}")
+    for dt in dtypes:
+        node.attr["Tdense"].list.type.append(dt)
+    _shapes_attr(node, shapes)
+    return gd
+
+
+def test_v1_dense_synthesis():
+    gd = _v1_graph()
+    bp = example_parse.find_parse_bypass(gd, "serialized:0")
+    assert bp is not None
+    assert bp.feature_order == ["x", "bias"]
+    assert bp.dense_refs == ["parse:0", "parse:1"]
+    assert bp.specs["x"].shape == (3,) and bp.specs["x"].default is None
+    np.testing.assert_allclose(np.asarray(bp.specs["bias"].default), [0.25])
+
+
+def test_v1_sparse_rejected():
+    gd = _v1_graph(n_sparse=1)
+    with pytest.raises(example_parse.ParseSynthesisError, match="sparse"):
+        example_parse.find_parse_bypass(gd, "serialized:0")
+
+
+def test_v2_dense_base_is_sparse_slots_only():
+    # V2 output order puts dense_values BEFORE ragged outputs, so the
+    # dense base is 3*num_sparse only (0 here). Sparse/ragged graphs are
+    # rejected earlier, but the offset rule must stay correct for when
+    # that descope is relaxed.
+    gd = _v2_graph()
+    bp = example_parse.find_parse_bypass(gd, "serialized:0")
+    assert bp.dense_refs[0] == "parsev2:0"
+
+
+def _v2_graph(*, n_sparse=0, n_ragged=0):
+    gd = tf_graph_pb2.GraphDef()
+    _placeholder(gd, "serialized")
+    _const(gd, "names", np.array([], object))
+    _const(gd, "sparse_keys", np.array([], object))
+    _const(gd, "dense_keys", np.array([b"x", b"tag"], object))
+    _const(gd, "ragged_keys", np.array([], object))
+    _const(gd, "dd0", np.zeros((0,), np.float32))
+    _const(gd, "dd1", np.asarray([b"unk"], object))
+    node = gd.node.add()
+    node.name = "parsev2"
+    node.op = "ParseExampleV2"
+    node.input.extend(["serialized", "names", "sparse_keys", "dense_keys",
+                       "ragged_keys", "dd0", "dd1"])
+    node.attr["num_sparse"].i = n_sparse
+    for _ in range(n_ragged):
+        node.attr["ragged_value_types"].list.type.append(DT_INT64)
+    node.attr["Tdense"].list.type.extend([DT_FLOAT, DT_STRING])
+    _shapes_attr(node, [(2,), ()])
+    return gd
+
+
+def test_v2_dense_synthesis_with_bytes_feature():
+    bp = example_parse.find_parse_bypass(_v2_graph(), "serialized:0")
+    assert bp.feature_order == ["x", "tag"]
+    assert bp.specs["x"].dtype == np.float32
+    assert bp.specs["tag"].dtype == object
+    assert bp.specs["tag"].default == [b"unk"]
+    assert bp.dtype_enums == {"x": DT_FLOAT, "tag": DT_STRING}
+
+
+def test_v2_ragged_rejected():
+    with pytest.raises(example_parse.ParseSynthesisError, match="ragged"):
+        example_parse.find_parse_bypass(_v2_graph(n_ragged=1),
+                                        "serialized:0")
+
+
+def test_partial_shape_rejected():
+    gd = _v1_graph(shapes=((-1,), ()))
+    with pytest.raises(example_parse.ParseSynthesisError, match="partial"):
+        example_parse.find_parse_bypass(gd, "serialized:0")
+
+
+def test_nonconst_default_rejected():
+    gd = _v1_graph()
+    for node in gd.node:
+        if node.name == "dd1":
+            node.op = "Placeholder"
+            node.ClearField("attr")
+            node.attr["dtype"].type = DT_FLOAT
+    with pytest.raises(example_parse.ParseSynthesisError,
+                       match="not a Const"):
+        example_parse.find_parse_bypass(gd, "serialized:0")
+
+
+def test_no_parse_consumer_returns_none():
+    gd = tf_graph_pb2.GraphDef()
+    _placeholder(gd, "text")
+    assert example_parse.find_parse_bypass(gd, "text:0") is None
+
+
+def test_identity_chain_between_input_and_parse():
+    gd = _v1_graph()
+    ident = gd.node.add()
+    ident.name = "ident"
+    ident.op = "Identity"
+    ident.input.append("serialized")
+    for node in gd.node:
+        if node.name == "parse":
+            node.input[0] = "ident:0"
+    bp = example_parse.find_parse_bypass(gd, "serialized:0")
+    assert bp is not None and bp.node_name == "parse"
+
+
+def test_reshaped_default_folded():
+    gd = _v1_graph()
+    _const(gd, "rawdd", np.asarray([0.5], np.float32))
+    _const(gd, "ddshape", np.asarray([1], np.int64))
+    resh = gd.node.add()
+    resh.name = "dd1r"
+    resh.op = "Reshape"
+    resh.input.extend(["rawdd", "ddshape"])
+    for node in gd.node:
+        if node.name == "parse":
+            node.input[-1] = "dd1r:0"
+    bp = example_parse.find_parse_bypass(gd, "serialized:0")
+    np.testing.assert_allclose(np.asarray(bp.specs["bias"].default), [0.5])
