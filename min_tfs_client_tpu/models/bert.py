@@ -31,6 +31,11 @@ class BertConfig:
     type_vocab_size: int = 2
     num_labels: int = 2
     layer_norm_eps: float = 1e-12
+    # Switch-MoE FFN: >0 replaces every layer's dense MLP with a routed
+    # expert layer (parallel/moe.py); served expert-parallel when the
+    # export's sharding mesh carries an "expert" axis (SURVEY.md §2.11 EP).
+    moe_experts: int = 0
+    moe_capacity_factor: float = 1.25
 
     @staticmethod
     def base(**kw) -> "BertConfig":
@@ -67,14 +72,24 @@ def init_params(rng: jax.Array, config: BertConfig) -> dict:
                               config.num_labels),
     }
     for _ in range(config.num_layers):
-        params["layers"].append({
+        layer = {
             "attention": nn.mha_init(next(keys), config.hidden_size,
                                      config.num_heads),
             "attention_norm": nn.layer_norm_init(config.hidden_size),
-            "mlp": nn.mlp_init(next(keys), config.hidden_size,
-                               config.intermediate_size),
             "mlp_norm": nn.layer_norm_init(config.hidden_size),
-        })
+        }
+        if config.moe_experts:
+            from min_tfs_client_tpu.parallel.moe import init_moe_params
+
+            # Plain dict (not the MoeParams NamedTuple): the npz
+            # round-trip in models/export.py preserves dicts exactly.
+            layer["moe"] = init_moe_params(
+                next(keys), config.hidden_size, config.intermediate_size,
+                config.moe_experts)._asdict()
+        else:
+            layer["mlp"] = nn.mlp_init(next(keys), config.hidden_size,
+                                       config.intermediate_size)
+        params["layers"].append(layer)
     return params
 
 
@@ -103,9 +118,28 @@ def encode(params: dict, config: BertConfig, input_ids: jax.Array,
                          lengths=lengths, seq_mesh=seq_mesh)
         x = nn.layer_norm(layer["attention_norm"], x + attn,
                           eps=config.layer_norm_eps)
-        x = nn.layer_norm(layer["mlp_norm"], x + nn.mlp(layer["mlp"], x),
+        x = nn.layer_norm(layer["mlp_norm"], x + _ffn(layer, config, x),
                           eps=config.layer_norm_eps)
     return x
+
+
+def _ffn(layer: dict, config: BertConfig, x: jax.Array) -> jax.Array:
+    """Dense MLP, or the Switch-MoE layer when the config routes experts
+    (capacity is static per compiled shape, so each bucket compiles one
+    executable — dropped over-capacity tokens ride the residual)."""
+    if "moe" not in layer:
+        return nn.mlp(layer["mlp"], x)
+    from min_tfs_client_tpu.parallel.moe import (
+        MoeParams,
+        capacity_for,
+        moe_ffn,
+    )
+
+    b, s, _ = x.shape
+    capacity = capacity_for(b * s, config.moe_experts,
+                            config.moe_capacity_factor)
+    y, _aux = moe_ffn(MoeParams(**layer["moe"]), x, capacity=capacity)
+    return y
 
 
 def pooled(params: dict, config: BertConfig, input_ids, attention_mask,
@@ -119,6 +153,90 @@ def logits_fn(params: dict, config: BertConfig, input_ids, attention_mask,
               token_type_ids=None) -> jax.Array:
     h = pooled(params, config, input_ids, attention_mask, token_type_ids)
     return nn.dense(params["head"], h.astype(nn.COMPUTE_DTYPE)).astype(
+        jnp.float32)
+
+
+# -- pipeline-parallel serving (SURVEY.md §2.11 PP row) ----------------------
+
+
+def build_pipeline_state(params: dict, config: BertConfig, *, mesh):
+    """Regroup a standard BERT param pytree for pipelined serving: the
+    encoder layers split into `stage` contiguous groups stacked with a
+    leading stage dim (sharded over the mesh's stage axis — each device
+    holds exactly its stage's weights); embeddings/pooler/head replicate
+    (they run outside the pipeline on every stage)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from min_tfs_client_tpu.parallel.pipeline import (
+        STAGE_AXIS,
+        stack_stage_params,
+    )
+
+    n_stages = int(mesh.shape[STAGE_AXIS])
+    if config.num_layers % n_stages:
+        raise ValueError(
+            f"num_layers {config.num_layers} not divisible by "
+            f"{n_stages} pipeline stages")
+    group = config.num_layers // n_stages
+    stacked = stack_stage_params(
+        [{"layers": params["layers"][i * group:(i + 1) * group]}
+         for i in range(n_stages)])
+    stacked = jax.tree_util.tree_map(
+        lambda p: jax.device_put(jnp.asarray(p),
+                                 NamedSharding(mesh, P(STAGE_AXIS))),
+        stacked)
+    replicate = NamedSharding(mesh, P())
+
+    def rep(tree):
+        return jax.tree_util.tree_map(
+            lambda p: jax.device_put(jnp.asarray(p), replicate), tree)
+
+    return {"embeddings": rep(params["embeddings"]), "stages": stacked,
+            "pooler": rep(params["pooler"]), "head": rep(params["head"])}
+
+
+def pipelined_logits_fn(pp_params: dict, config: BertConfig, input_ids,
+                        attention_mask, *, mesh, n_micro: int | None = None):
+    """logits_fn over stage-sharded params: embeddings on every device,
+    the layer stack as a GPipe microbatch pipeline (one ICI hop per
+    stage), pooler/head on the drained outputs. Matches logits_fn
+    numerics exactly — same layers, different residency."""
+    import math
+
+    from min_tfs_client_tpu.parallel.pipeline import (
+        STAGE_AXIS,
+        pipeline_apply,
+    )
+
+    b, s = input_ids.shape
+    emb = pp_params["embeddings"]
+    x = nn.embed(emb["word"], input_ids)
+    x = x + nn.embed(emb["position"], jnp.arange(s)[None, :])
+    x = x + nn.embed(emb["token_type"], jnp.zeros_like(input_ids))
+    x = nn.layer_norm(emb["norm"], x, eps=config.layer_norm_eps)
+    lengths = nn.lengths_from_mask(attention_mask)
+
+    def stage_fn(stage_tree, carry):
+        x, lengths = carry
+        for layer in stage_tree["layers"]:
+            attn, _ = nn.mha(layer["attention"], x,
+                             num_heads=config.num_heads, lengths=lengths)
+            x = nn.layer_norm(layer["attention_norm"], x + attn,
+                              eps=config.layer_norm_eps)
+            x = nn.layer_norm(layer["mlp_norm"],
+                              x + _ffn(layer, config, x),
+                              eps=config.layer_norm_eps)
+        return (x, lengths)
+
+    requested = n_micro or int(mesh.shape[STAGE_AXIS])
+    x, _ = pipeline_apply(
+        stage_fn, pp_params["stages"], (x, lengths), mesh=mesh,
+        # Small batch buckets can't fill the requested microbatch count;
+        # gcd keeps the schedule legal per compiled shape (batch is
+        # static under jit).
+        n_micro=math.gcd(b, requested))
+    h = jnp.tanh(nn.dense(pp_params["pooler"], x[:, 0])).astype(jnp.float32)
+    return nn.dense(pp_params["head"], h.astype(nn.COMPUTE_DTYPE)).astype(
         jnp.float32)
 
 
@@ -189,7 +307,9 @@ def build_long_context_signature(params: dict, config: BertConfig, *,
 def build_signatures(params: dict, config: BertConfig, *, seq_len: int,
                      class_labels: list[bytes] | None = None,
                      seq_buckets: tuple | list | None = None,
-                     long_context_seq: int | None = None) -> dict:
+                     long_context_seq: int | None = None,
+                     pipeline_mesh=None,
+                     pipeline_n_micro: int | None = None) -> dict:
     """The model family's serving surface:
 
       serving_default / predict: ids+mask -> logits, probabilities
@@ -201,6 +321,11 @@ def build_signatures(params: dict, config: BertConfig, *, seq_len: int,
     ids with 0 and the mask with 0, and the attention-length masking makes
     the padded positions invisible — classification outputs are exact (one
     executable per batch x seq bucket; warmup primes the matrix).
+
+    With `pipeline_mesh` (a Mesh carrying a "stage" axis), every
+    signature serves pipeline-parallel: the layer stack is regrouped into
+    stage-resident weights and executed as a GPipe microbatch schedule
+    (pipelined_logits_fn) — same numerics, stage-sharded residency.
     """
     from min_tfs_client_tpu.servables.servable import (
         CLASSIFY_METHOD_NAME,
@@ -213,10 +338,25 @@ def build_signatures(params: dict, config: BertConfig, *, seq_len: int,
         TensorSpec,
     )
 
+    if pipeline_mesh is not None:
+        if config.moe_experts:
+            raise ValueError(
+                "pipeline and moe_experts cannot combine: per-microbatch "
+                "expert capacity diverges from sequential routing")
+        params = build_pipeline_state(params, config, mesh=pipeline_mesh)
+
+        def compute_logits(params, ids, mask):
+            return pipelined_logits_fn(params, config, ids, mask,
+                                       mesh=pipeline_mesh,
+                                       n_micro=pipeline_n_micro)
+    else:
+        def compute_logits(params, ids, mask):
+            return logits_fn(params, config, ids, mask)
+
     def predict(params, inputs):
-        logits = logits_fn(params, config,
-                           jnp.asarray(inputs["input_ids"]),
-                           jnp.asarray(inputs["attention_mask"]))
+        logits = compute_logits(params,
+                                jnp.asarray(inputs["input_ids"]),
+                                jnp.asarray(inputs["attention_mask"]))
         return {"logits": logits,
                 "probabilities": jax.nn.softmax(logits, axis=-1)}
 
@@ -254,9 +394,10 @@ def build_signatures(params: dict, config: BertConfig, *, seq_len: int,
     }
 
     def classify(params, inputs):
-        logits = logits_fn(params, config,
-                           jnp.asarray(inputs["input_ids"], jnp.int32),
-                           jnp.asarray(inputs["attention_mask"], jnp.int32))
+        logits = compute_logits(
+            params,
+            jnp.asarray(inputs["input_ids"], jnp.int32),
+            jnp.asarray(inputs["attention_mask"], jnp.int32))
         return {CLASSIFY_OUTPUT_SCORES: jax.nn.softmax(logits, axis=-1)}
 
     classify_sig = Signature(
@@ -272,9 +413,10 @@ def build_signatures(params: dict, config: BertConfig, *, seq_len: int,
     )
 
     def regress(params, inputs):
-        logits = logits_fn(params, config,
-                           jnp.asarray(inputs["input_ids"], jnp.int32),
-                           jnp.asarray(inputs["attention_mask"], jnp.int32))
+        logits = compute_logits(
+            params,
+            jnp.asarray(inputs["input_ids"], jnp.int32),
+            jnp.asarray(inputs["attention_mask"], jnp.int32))
         return {REGRESS_OUTPUTS: logits[:, 0]}
 
     regress_sig = Signature(
@@ -290,6 +432,10 @@ def build_signatures(params: dict, config: BertConfig, *, seq_len: int,
     signatures = {"serving_default": predict_sig, "predict": predict_sig,
                   "classify": classify_sig, "regress": regress_sig}
     if long_context_seq:
+        if pipeline_mesh is not None:
+            raise ValueError(
+                "long_context_seq and pipeline_mesh cannot combine: the "
+                "ring-attention path needs the standard param layout")
         signatures["encode_long"] = build_long_context_signature(
             params, config, seq_len=long_context_seq)
     return signatures

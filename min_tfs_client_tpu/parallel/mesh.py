@@ -67,8 +67,11 @@ def shard_batch(mesh: Mesh, arrays: Mapping[str, np.ndarray]) -> dict:
     Pads the batch up to a multiple of the data-axis size if needed (static
     shapes per shard); caller slices outputs back to true batch.
     """
-    ndata = mesh.shape[DATA_AXIS]
-    sharding = data_parallel_sharding(mesh)
+    # Meshes without a data axis (pipeline stage-only, expert-only)
+    # replicate the batch: every device sees the full microbatch stream.
+    ndata = int(dict(mesh.shape).get(DATA_AXIS, 1))
+    sharding = (data_parallel_sharding(mesh) if DATA_AXIS in mesh.shape
+                else replicated(mesh))
     out = {}
     for name, arr in arrays.items():
         batch = arr.shape[0]

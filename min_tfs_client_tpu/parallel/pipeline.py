@@ -41,31 +41,37 @@ def stack_stage_params(per_stage_params: list) -> object:
 def _pipeline_shard_fn(params, x_micro, *, stage_fn, axis_name, n_stages,
                        n_micro):
     """Per-device body. params: this stage's slice (leading dim 1);
-    x_micro: (n_micro, mb, ...) full microbatched input, replicated."""
+    x_micro: pytree of (n_micro, mb, ...) microbatched inputs, replicated.
+    The whole activation pytree travels stage-to-stage (so auxiliary
+    per-microbatch state — attention lengths, masks — rides along)."""
     params = jax.tree_util.tree_map(lambda p: p[0], params)
     idx = jax.lax.axis_index(axis_name)
     perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
-    mb_shape = x_micro.shape[1:]
+    tmap = jax.tree_util.tree_map
 
     def tick(carry, t):
         state, outputs = carry
         # Stage 0 pulls microbatch t (clamped during the drain phase, when
         # its compute is masked garbage anyway); others use the activation
         # handed to them by the previous stage on the last tick.
-        feed = x_micro[jnp.minimum(t, n_micro - 1)]
-        inp = jnp.where(idx == 0, feed, state)
+        feed = tmap(lambda xm: xm[jnp.minimum(t, n_micro - 1)], x_micro)
+        inp = tmap(lambda f, s: jnp.where(idx == 0, f, s), feed, state)
         out = stage_fn(params, inp)
         passed = jax.lax.ppermute(out, axis_name, perm)
         # The last stage finishes microbatch (t - n_stages + 1) at tick t.
         write_pos = t - (n_stages - 1)
-        updated = jax.lax.dynamic_update_index_in_dim(
-            outputs, out, jnp.maximum(write_pos, 0), 0)
-        outputs = jnp.where(
-            (write_pos >= 0) & (idx == n_stages - 1), updated, outputs)
+        keep = (write_pos >= 0) & (idx == n_stages - 1)
+        outputs = tmap(
+            lambda buf, o: jnp.where(
+                keep,
+                jax.lax.dynamic_update_index_in_dim(
+                    buf, o, jnp.maximum(write_pos, 0), 0),
+                buf),
+            outputs, out)
         return (passed, outputs), None
 
-    state0 = jnp.zeros(mb_shape, x_micro.dtype)
-    outputs0 = jnp.zeros((n_micro,) + mb_shape, x_micro.dtype)
+    state0 = tmap(lambda xm: jnp.zeros(xm.shape[1:], xm.dtype), x_micro)
+    outputs0 = tmap(lambda xm: jnp.zeros_like(xm), x_micro)
     (_, outputs), _ = jax.lax.scan(
         tick, (state0, outputs0), jnp.arange(n_micro + n_stages - 1))
     # Only the last stage holds real outputs (others carry zeros); one
@@ -76,18 +82,21 @@ def _pipeline_shard_fn(params, x_micro, *, stage_fn, axis_name, n_stages,
 def pipeline_apply(
     stage_fn: Callable,
     stacked_params,
-    x: jax.Array,
+    x,
     *,
     mesh: Mesh,
     axis_name: str = STAGE_AXIS,
     n_micro: int | None = None,
-) -> jax.Array:
+):
     """Run `x` through `n_stages` pipelined applications of `stage_fn`.
 
-    stage_fn(params_for_stage, activation) -> activation (same shape).
-    stacked_params: pytree with leading dim n_stages == mesh axis size.
-    x: (batch, ...); batch must divide into n_micro microbatches (default:
-    one per stage, the minimum that fills the pipeline).
+    stage_fn(params_for_stage, activation) -> activation (same structure
+    and shapes). `x` is an array or a pytree of arrays sharing a leading
+    batch dim — the whole pytree hops stage-to-stage, so per-batch
+    auxiliary state (attention lengths, masks) travels with the
+    activations. stacked_params: pytree with leading dim n_stages ==
+    mesh axis size. batch must divide into n_micro microbatches
+    (default: one per stage, the minimum that fills the pipeline).
 
     Equivalent to
         for s in range(n_stages): x = stage_fn(params[s], x)
@@ -103,10 +112,18 @@ def pipeline_apply(
             f"the {axis_name!r} mesh axis size {n_stages}")
     if n_micro is None:
         n_micro = n_stages
-    batch = x.shape[0]
+    leaves = jax.tree_util.tree_leaves(x)
+    batches = {int(leaf.shape[0]) for leaf in leaves}
+    if len(batches) != 1:
+        raise ValueError(
+            f"activation pytree leaves disagree on batch dim: "
+            f"{sorted(batches)}")
+    batch = batches.pop()
     if batch % n_micro:
         raise ValueError(f"batch {batch} not divisible by n_micro {n_micro}")
-    x_micro = x.reshape((n_micro, batch // n_micro) + x.shape[1:])
+    x_micro = jax.tree_util.tree_map(
+        lambda leaf: leaf.reshape(
+            (n_micro, batch // n_micro) + leaf.shape[1:]), x)
 
     body = partial(_pipeline_shard_fn, stage_fn=stage_fn,
                    axis_name=axis_name, n_stages=n_stages, n_micro=n_micro)
@@ -116,4 +133,5 @@ def pipeline_apply(
         body, mesh=mesh,
         in_specs=(param_specs, P()),
         out_specs=P())(stacked_params, x_micro)
-    return out.reshape((batch,) + x.shape[1:])
+    return jax.tree_util.tree_map(
+        lambda leaf: leaf.reshape((batch,) + leaf.shape[2:]), out)

@@ -22,7 +22,11 @@ from typing import Mapping, Optional, Sequence
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
-from min_tfs_client_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+from min_tfs_client_tpu.parallel.mesh import (
+    DATA_AXIS,
+    EXPERT_AXIS,
+    MODEL_AXIS,
+)
 
 # logical axis -> preferred physical mesh axis. A rule whose physical axis
 # is missing from the mesh resolves to None (replicated on that dim).
@@ -33,6 +37,7 @@ DEFAULT_RULES: dict[str, Optional[str]] = {
     "heads": MODEL_AXIS,  # attention heads / qkv output dim sharded
     "mlp": MODEL_AXIS,    # feed-forward hidden dim sharded
     "length": None,
+    "expert": EXPERT_AXIS,  # MoE expert dim (parallel/moe.py weights)
 }
 
 
@@ -113,6 +118,15 @@ def infer_transformer_specs(
 def _leaf_spec(path: tuple, sp) -> PartitionSpec:
     leaf = path[-1] if path else ""
     parent = path[-2] if len(path) >= 2 else ""
+    if parent == "moe":
+        # Switch-MoE weights (models/bert.py layer["moe"]): expert-major
+        # tensors shard their leading dim on the expert axis; the router
+        # is replicated (every device routes its own tokens).
+        if leaf in ("w_in", "w_out"):
+            return sp("expert", None, None)
+        if leaf in ("b_in", "b_out"):
+            return sp("expert", None)
+        return sp()  # router
     if leaf == "embedding":
         return sp("vocab", "embed")
     if leaf == "kernel":
