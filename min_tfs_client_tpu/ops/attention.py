@@ -218,8 +218,16 @@ def flash_attention(
 
 
 def _on_tpu() -> bool:
+    """True when the default device is a TPU. Checks the device's own
+    platform, not just the backend name: a PJRT plugin can register under
+    another name (this image's tunnel registers as "axon") while its
+    devices report platform "tpu" — matching on backend name alone would
+    silently route serving onto attention_reference on real hardware."""
     try:
-        return jax.default_backend() == "tpu"
+        if jax.default_backend() == "tpu":
+            return True
+        devices = jax.devices()
+        return bool(devices) and devices[0].platform == "tpu"
     except Exception:  # pragma: no cover
         return False
 

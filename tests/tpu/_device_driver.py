@@ -45,6 +45,19 @@ def main() -> int:
     k = jnp.asarray(rng.standard_normal((b, h, s, d)), jnp.bfloat16)
     v = jnp.asarray(rng.standard_normal((b, h, s, d)), jnp.bfloat16)
     lengths = jnp.asarray([s, s // 3], jnp.int32)
+
+    # The serving path goes through the `attention` DISPATCHER — assert it
+    # actually picks the Pallas kernel on this hardware (round-3 verdict:
+    # "confirm the served BERT path hits the flash kernel, not
+    # attention_reference"). The pallas lowering appears as a custom call.
+    from min_tfs_client_tpu.ops.attention import attention
+
+    lowered = jax.jit(
+        lambda q, k, v: attention(q, k, v, lengths=lengths)).lower(q, k, v)
+    text = lowered.as_text()
+    dispatched = "tpu_custom_call" in text or "custom_call" in text
+    emit("flash_dispatch", dispatched,
+         note="attention() lowers to a pallas custom call on this backend")
     for name, kwargs in [("plain", {}), ("causal", {"causal": True}),
                          ("lengths", {"lengths": lengths})]:
         t0 = time.perf_counter()

@@ -147,6 +147,12 @@ def test_flash_attention_on_mxu(device_results, variant):
 
 
 @pytest.mark.integration
+def test_attention_dispatcher_picks_flash_on_device(device_results):
+    rec = device_results.get("flash_dispatch")
+    assert rec is not None and rec["ok"], rec
+
+
+@pytest.mark.integration
 def test_bucketed_predict_on_device(device_results):
     rec = device_results.get("bucketed_predict")
     assert rec is not None and rec["ok"], rec
