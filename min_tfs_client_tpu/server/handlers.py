@@ -78,11 +78,16 @@ def _instrumented(api: str):
 
 class Handlers:
     def __init__(self, core: ServerCore, *,
-                 response_tensors_as_content: bool = False):
+                 response_tensors_as_content: bool = False,
+                 signature_method_name_check: bool = False):
         self.core = core
         # False = typed fields (the reference server's default serialization,
         # server_core.h:186-188 kAsProtoField); True = tensor_content.
         self._as_content = response_tensors_as_content
+        # --enable_signature_method_name_check: strict method_name match
+        # on Classify/Regress. Off (the reference default), any signature
+        # carrying Example feature specs serves either API.
+        self._method_name_check = signature_method_name_check
 
     # -- PredictionService ---------------------------------------------------
 
@@ -110,7 +115,7 @@ class Handlers:
 
     def _example_signature(self, servable, model_spec, want_method: str) -> Signature:
         signature = servable.signature(model_spec.signature_name)
-        if signature.method_name != want_method:
+        if self._method_name_check and signature.method_name != want_method:
             raise ServingError.invalid_argument(
                 f"Expected {want_method} signature method_name but got "
                 f"{signature.method_name!r}")

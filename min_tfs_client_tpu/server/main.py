@@ -76,6 +76,34 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--use_tflite_model", action="store_true",
                    help="serve <version>/model.tflite via the TFLite "
                         "importer")
+    p.add_argument("--tensorflow_session_parallelism", type=int, default=0,
+                   help="threads for running a session; fills in for "
+                        "whichever intra/inter flag is unset (main.cc:135)."
+                        " Ignored if --platform_config_file is non-empty")
+    p.add_argument("--tensorflow_intra_op_parallelism", type=int, default=0,
+                   help="reference: threads per individual op. On TPU, "
+                        "within-op parallelism is owned by XLA (SURVEY.md "
+                        "§2.11), so this is accepted and inert")
+    p.add_argument("--tensorflow_inter_op_parallelism", type=int, default=0,
+                   help="concurrently executing operations; maps to the "
+                        "executor pool that runs signature executions "
+                        "(caps --grpc_max_threads). Ignored if "
+                        "--platform_config_file is non-empty")
+    p.add_argument("--per_process_gpu_memory_fraction", type=float,
+                   default=0.0,
+                   help="N/A on TPU — there is no GPU memory pool; HBM is "
+                        "gated by the resource tracker. Accepted for CLI "
+                        "compatibility, warns if non-zero")
+    p.add_argument("--flush_filesystem_caches", type=lambda v: v != "false",
+                   default=True,
+                   help="drop OS page cache for model files after the "
+                        "initial loads (weights already live in device/"
+                        "host arrays)")
+    p.add_argument("--enable_signature_method_name_check",
+                   action="store_true",
+                   help="require Classify/Regress signatures' method_name "
+                        "to match the API called (default: any signature "
+                        "with Example feature specs serves)")
     p.add_argument("--version", action="store_true",
                    help="print the server version and exit")
     return p
@@ -115,16 +143,36 @@ def options_from_args(args) -> ServerOptions:
         allow_version_labels_for_unavailable_models=(
             args.allow_version_labels_for_unavailable_models),
         use_tflite_model=args.use_tflite_model,
+        tensorflow_session_parallelism=args.tensorflow_session_parallelism,
+        tensorflow_intra_op_parallelism=args.tensorflow_intra_op_parallelism,
+        tensorflow_inter_op_parallelism=args.tensorflow_inter_op_parallelism,
+        per_process_gpu_memory_fraction=args.per_process_gpu_memory_fraction,
+        flush_filesystem_caches=args.flush_filesystem_caches,
+        enable_signature_method_name_check=(
+            args.enable_signature_method_name_check),
     )
 
 
 def main(argv=None) -> int:
+    import os
+
     args = build_parser().parse_args(argv)
     if args.version:
         from min_tfs_client_tpu.server.version import version_string
 
         print(version_string())
         return 0
+
+    # Honor JAX_PLATFORMS even where a sitecustomize re-registers
+    # accelerator plugins after env processing: the operator's platform
+    # choice must win (a wedged accelerator tunnel otherwise hangs the
+    # server at first backend init with no recourse). After the --version
+    # early-exit so flag-only invocations never pay a jax import.
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        import jax
+
+        jax.config.update("jax_platforms", plat)
     server = Server(options_from_args(args)).build_and_start()
     ports = f"gRPC on {server.grpc_port}"
     if getattr(server, "rest_port", None):

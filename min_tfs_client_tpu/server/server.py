@@ -10,6 +10,9 @@ optional SSL, and optionally re-polls the model config file
 
 from __future__ import annotations
 
+import logging
+import os
+import pathlib
 import threading
 from concurrent import futures
 from dataclasses import dataclass, field
@@ -94,6 +97,40 @@ class ServerOptions:
     # Serve <version>/model.tflite through the TFLite importer instead of
     # the SavedModel GraphDef (main.cc use_tflite_model).
     use_tflite_model: bool = False
+    # Session threading knobs (main.cc:135-152). The reference sizes the
+    # TF Session's Eigen pools with these; here within-op parallelism is
+    # owned by XLA (SURVEY.md §2.11 "Within-op parallelism"), so
+    # intra_op is accepted-and-inert, while inter_op (concurrently
+    # executing sessions) maps to the real analogue — the gRPC executor
+    # pool that runs signature executions — by capping grpc_max_threads.
+    # session_parallelism fills in for whichever of the two is unset
+    # (bundle_factory_util GetSessionOptions semantics). All three are
+    # ignored when platform_config_file is set, like the reference.
+    tensorflow_session_parallelism: int = 0
+    tensorflow_intra_op_parallelism: int = 0
+    tensorflow_inter_op_parallelism: int = 0
+    # N/A on TPU: there is no GPU memory pool to fraction. Accepted for
+    # CLI compatibility; a non-zero value logs a warning and does nothing
+    # (main.cc per_process_gpu_memory_fraction).
+    per_process_gpu_memory_fraction: float = 0.0
+    # Drop the OS page cache for model files once the initial loads
+    # finish (main.cc flush_filesystem_caches, default true there too):
+    # params already live in HBM/host arrays, the file bytes are dead
+    # weight.
+    flush_filesystem_caches: bool = True
+    # Newer-TFS flag: when true, Classify/Regress verify the signature's
+    # method_name matches the API called; when false (the reference
+    # default) any signature with Example feature specs serves.
+    enable_signature_method_name_check: bool = False
+
+    def effective_inter_op_parallelism(self) -> int:
+        """<= 0 = auto (leave grpc_max_threads alone; TF spells auto as
+        0 and some tooling as -1)."""
+        if self.platform_config_file:
+            return 0
+        value = (self.tensorflow_inter_op_parallelism
+                 or self.tensorflow_session_parallelism)
+        return max(0, value)
 
 
 def _parse_channel_arguments(spec: str) -> list[tuple[str, object]]:
@@ -120,6 +157,29 @@ def _parse_channel_arguments(spec: str) -> list[tuple[str, object]]:
         ("grpc.max_receive_message_length", -1),
     ]
     return [d for d in defaults if d[0] not in user_keys] + out
+
+
+def _flush_model_file_caches(config) -> None:
+    """Advise the OS to drop page cache for the loaded model files
+    (main.cc flush_filesystem_caches): the weights already live as device
+    /host arrays, so the cached file bytes only crowd out memory.
+    Best-effort — unsupported platforms and racing file removals are
+    fine to ignore."""
+    for mc in config.model_config_list.config:
+        base = pathlib.Path(mc.base_path)
+        try:
+            files = [f for f in base.rglob("*") if f.is_file()]
+        except OSError:
+            continue
+        for f in files:
+            try:
+                with open(f, "rb") as fh:
+                    os.posix_fadvise(fh.fileno(), 0, 0,
+                                     os.POSIX_FADV_DONTNEED)
+            except AttributeError:
+                return  # no fadvise on this platform: nothing to do
+            except OSError:
+                continue  # racing removal / unreadable file: skip it
 
 
 def _parse_text_proto(path: str, proto_cls):
@@ -176,11 +236,27 @@ class Server:
                 opts.allow_version_labels_for_unavailable_models),
         )
 
+        if opts.flush_filesystem_caches:
+            # Initial loads finished inside the ServerCore constructor
+            # (ConnectAdaptersToManagerAndAwaitModelLoads parity), so the
+            # file bytes are now dead weight.
+            _flush_model_file_caches(config)
+        if opts.per_process_gpu_memory_fraction:
+            logging.getLogger(__name__).warning(
+                "per_process_gpu_memory_fraction=%s has no effect: TPU "
+                "HBM is gated by the resource tracker, not a GPU pool",
+                opts.per_process_gpu_memory_fraction)
+
         handlers = Handlers(
             self.core,
-            response_tensors_as_content=opts.response_tensors_as_content)
+            response_tensors_as_content=opts.response_tensors_as_content,
+            signature_method_name_check=(
+                opts.enable_signature_method_name_check))
+        inter_op = opts.effective_inter_op_parallelism()
+        grpc_threads = (min(opts.grpc_max_threads, inter_op) if inter_op
+                        else opts.grpc_max_threads)
         self._grpc_server = grpc.server(
-            futures.ThreadPoolExecutor(max_workers=opts.grpc_max_threads),
+            futures.ThreadPoolExecutor(max_workers=grpc_threads),
             options=_parse_channel_arguments(opts.grpc_channel_arguments))
         gs.add_PredictionServiceServicer_to_server(
             PredictionServiceImpl(handlers), self._grpc_server)
@@ -222,8 +298,6 @@ class Server:
             )
 
             if not start_profiler_server(opts.profiler_port):
-                import logging
-
                 logging.getLogger("min_tfs_client_tpu").warning(
                     "profiler server failed to start on port %d; trace "
                     "capture will be unavailable", opts.profiler_port)

@@ -1,0 +1,141 @@
+"""The main.cc tail flags: session threading knobs, GPU-fraction N/A,
+filesystem-cache flush, and the signature method-name check
+(main.cc:135-152, 163-169; newer-TFS enable_signature_method_name_check).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from min_tfs_client_tpu.protos import tfs_apis_pb2 as apis
+from min_tfs_client_tpu.server import main as server_main
+from min_tfs_client_tpu.server.handlers import Handlers
+from min_tfs_client_tpu.server.server import (
+    ServerOptions,
+    _flush_model_file_caches,
+)
+from min_tfs_client_tpu.servables.servable import (
+    CLASSIFY_METHOD_NAME,
+    PREDICT_METHOD_NAME,
+    Signature,
+    TensorSpec,
+)
+from min_tfs_client_tpu.tensor.example_codec import FeatureSpec
+from min_tfs_client_tpu.utils.status import ServingError
+
+
+class TestInterOpParallelism:
+    def test_inter_op_caps_executor(self):
+        opts = ServerOptions(tensorflow_inter_op_parallelism=3)
+        assert opts.effective_inter_op_parallelism() == 3
+
+    def test_session_parallelism_fills_in(self):
+        opts = ServerOptions(tensorflow_session_parallelism=5)
+        assert opts.effective_inter_op_parallelism() == 5
+        opts = ServerOptions(tensorflow_session_parallelism=5,
+                             tensorflow_inter_op_parallelism=2)
+        assert opts.effective_inter_op_parallelism() == 2
+
+    def test_ignored_with_platform_config_file(self):
+        # Reference parity: "this option is ignored if
+        # --platform_config_file is non-empty" (main.cc:139-152).
+        opts = ServerOptions(tensorflow_inter_op_parallelism=3,
+                             platform_config_file="/some/file")
+        assert opts.effective_inter_op_parallelism() == 0
+
+    def test_auto_by_default(self):
+        assert ServerOptions().effective_inter_op_parallelism() == 0
+
+    def test_negative_means_auto(self):
+        # TF tooling sometimes spells auto as -1; never hand a negative
+        # max_workers to the executor.
+        opts = ServerOptions(tensorflow_inter_op_parallelism=-1)
+        assert opts.effective_inter_op_parallelism() == 0
+
+
+class _OneSignatureServable:
+    def __init__(self, sig):
+        self._sig = sig
+
+    def signature(self, name):
+        return self._sig
+
+
+def _sig(method_name, with_specs=True):
+    return Signature(
+        fn=lambda inputs: {"scores": np.zeros((1, 2), np.float32)},
+        inputs={"x": TensorSpec(np.float32, (None,))},
+        outputs={"scores": TensorSpec(np.float32, (None, 2))},
+        method_name=method_name,
+        feature_specs={"x": FeatureSpec(np.float32)} if with_specs else None,
+    )
+
+
+class TestSignatureMethodNameCheck:
+    def test_default_lax_serves_any_example_signature(self):
+        handlers = Handlers(core=None)
+        sig = _sig(PREDICT_METHOD_NAME)
+        got = handlers._example_signature(
+            _OneSignatureServable(sig), apis.ModelSpec(),
+            CLASSIFY_METHOD_NAME)
+        assert got is sig
+
+    def test_strict_rejects_mismatch(self):
+        handlers = Handlers(core=None, signature_method_name_check=True)
+        with pytest.raises(ServingError, match="method_name"):
+            handlers._example_signature(
+                _OneSignatureServable(_sig(PREDICT_METHOD_NAME)),
+                apis.ModelSpec(), CLASSIFY_METHOD_NAME)
+
+    def test_strict_accepts_match(self):
+        handlers = Handlers(core=None, signature_method_name_check=True)
+        sig = _sig(CLASSIFY_METHOD_NAME)
+        assert handlers._example_signature(
+            _OneSignatureServable(sig), apis.ModelSpec(),
+            CLASSIFY_METHOD_NAME) is sig
+
+    def test_missing_feature_specs_always_rejected(self):
+        handlers = Handlers(core=None)
+        with pytest.raises(ServingError, match="feature specs"):
+            handlers._example_signature(
+                _OneSignatureServable(
+                    _sig(CLASSIFY_METHOD_NAME, with_specs=False)),
+                apis.ModelSpec(), CLASSIFY_METHOD_NAME)
+
+
+class TestFlagParsing:
+    def test_tail_flags_map_to_options(self):
+        args = server_main.build_parser().parse_args([
+            "--tensorflow_session_parallelism=4",
+            "--tensorflow_intra_op_parallelism=2",
+            "--tensorflow_inter_op_parallelism=8",
+            "--per_process_gpu_memory_fraction=0.5",
+            "--flush_filesystem_caches=false",
+            "--enable_signature_method_name_check",
+        ])
+        opts = server_main.options_from_args(args)
+        assert opts.tensorflow_session_parallelism == 4
+        assert opts.tensorflow_intra_op_parallelism == 2
+        assert opts.tensorflow_inter_op_parallelism == 8
+        assert opts.per_process_gpu_memory_fraction == 0.5
+        assert opts.flush_filesystem_caches is False
+        assert opts.enable_signature_method_name_check is True
+
+    def test_defaults_match_reference(self):
+        opts = server_main.options_from_args(
+            server_main.build_parser().parse_args([]))
+        assert opts.tensorflow_session_parallelism == 0  # auto
+        assert opts.flush_filesystem_caches is True
+        assert opts.enable_signature_method_name_check is False
+
+
+def test_flush_filesystem_caches_smoke(tmp_path):
+    from min_tfs_client_tpu.core.server_core import single_model_config
+
+    base = tmp_path / "m" / "1"
+    base.mkdir(parents=True)
+    (base / "weights.bin").write_bytes(b"\x00" * 4096)
+    config = single_model_config("m", str(tmp_path / "m"))
+    _flush_model_file_caches(config)  # must not raise, file intact
+    assert (base / "weights.bin").stat().st_size == 4096
