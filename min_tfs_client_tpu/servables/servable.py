@@ -390,20 +390,32 @@ class Signature:
             arrays = self._place(arrays)
         return self._execute(arrays), batch
 
-    @staticmethod
-    def _place(arrays: dict[str, np.ndarray]) -> dict:
+    # Below this, the jit arg path transfers just as fast and the
+    # device_put plumbing (~0.2 ms of pure Python) dominates; the slow
+    # chunked per-arg conversion this guards against was measured on
+    # multi-MB conv inputs.
+    _PLACE_MIN_BYTES = 256 * 1024
+
+    @classmethod
+    def _place(cls, arrays: dict[str, np.ndarray]) -> dict:
         """Explicit batched host->device transfer before dispatch. Passing
-        ndarrays straight as jit args leaves the transfer to per-argument
-        conversion inside the call, which on remote PJRT transports takes a
-        slow chunked path (measured ~50x slower than device_put for a 9.5MB
-        conv input) and even locally serializes with dispatch; one batched
-        device_put of the whole input dict overlaps the DMAs."""
+        LARGE ndarrays straight as jit args leaves the transfer to
+        per-argument conversion inside the call, which on remote PJRT
+        transports takes a slow chunked path (measured ~50x slower than
+        device_put for a 9.5MB conv input) and even locally serializes
+        with dispatch; one batched device_put of the whole input dict
+        overlaps the DMAs. Small inputs skip the explicit hop — for them
+        device_put's own Python overhead exceeds the transfer."""
         import jax
 
         dense = {k: v for k, v in arrays.items()
                  if getattr(v, "dtype", None) is not None
                  and v.dtype.kind not in "OSU"}
-        if not dense:
+        # All-or-none on TOTAL bytes: the ~0.2 ms plumbing is per call,
+        # and a placed/unplaced split would exclude arrays from the one
+        # overlapped DMA while still paying the call.
+        if not dense or sum(v.nbytes for v in dense.values()) \
+                < cls._PLACE_MIN_BYTES:
             return dict(arrays)
         placed = jax.device_put(dense)
         return {k: placed.get(k, arrays[k]) for k in arrays}
