@@ -11,11 +11,14 @@ equivalent of that capability.
 
 Representation: an eligible float leaf `w` becomes a subtree
     {"__q8__": int8[w.shape],
-     "__q8_scale__": f32[w.shape[-1]],        # per-last-dim channel
+     "__q8_scale__": f32 broadcastable against w —
+                     (w.shape[-1],) per-output-channel for dense/conv
+                     kernels, (rows, 1, ...) per-row for embeddings,
      "__q8_dt__": zeros((), original_dtype)}  # dtype sentinel
 so any pytree-path-based save/load (models/export.py flatten) round-trips
 it without special cases. `dequantize_tree` restores the original
-structure (inside jit: fused; outside: materialized).
+structure (inside jit: fused; outside: materialized) by plain broadcast
+multiply — no axis metadata needed.
 """
 
 from __future__ import annotations
@@ -38,24 +41,40 @@ def _is_quant_node(node) -> bool:
 
 
 def quantize_tree(params, *, min_size: int = DEFAULT_MIN_SIZE):
-    """Symmetric per-channel int8 quantization of large float leaves."""
+    """Symmetric per-channel int8 quantization of large float leaves.
 
-    def quant_leaf(leaf):
+    Channel axis by role: dense/conv kernels scale per OUTPUT channel
+    (the last dim — HWIO convs included), embedding tables per ROW (each
+    token's vector has its own magnitude; a shared per-feature scale
+    washes out rare high-norm rows). The scale is stored broadcastable
+    against the quantized tensor, so dequantize needs no axis metadata.
+    """
+
+    def quant_leaf(path, leaf):
         arr = np.asarray(leaf)
         if (arr.dtype.kind != "f" and str(arr.dtype) != "bfloat16") or \
                 arr.size < min_size or arr.ndim < 2:
             return leaf
         f32 = arr.astype(np.float32)
-        # Per-channel on the last dim (output features for all the dense
-        # kernels here): amax over every other axis.
-        reduce_axes = tuple(range(arr.ndim - 1))
-        amax = np.max(np.abs(f32), axis=reduce_axes)
+        leaf_name = ""
+        if path:
+            entry = path[-1]
+            leaf_name = str(getattr(entry, "key", getattr(entry, "idx", "")))
+        if leaf_name == "embedding":
+            # Per-row: amax over the feature dims, keepdims for broadcast.
+            reduce_axes = tuple(range(1, arr.ndim))
+        else:
+            # Per-output-channel on the last dim.
+            reduce_axes = tuple(range(arr.ndim - 1))
+        amax = np.max(np.abs(f32), axis=reduce_axes, keepdims=True)
         scale = np.where(amax > 0, amax / 127.0, 1.0).astype(np.float32)
         q = np.clip(np.round(f32 / scale), -127, 127).astype(np.int8)
+        if leaf_name != "embedding":
+            scale = scale.reshape(scale.shape[-1])  # legacy (cout,) layout
         return {_Q: q, _SCALE: scale,
                 _DT: np.zeros((), arr.dtype)}
 
-    return jax.tree_util.tree_map(quant_leaf, params)
+    return jax.tree_util.tree_map_with_path(quant_leaf, params)
 
 
 def _quant_aware_leaves(tree):

@@ -97,11 +97,17 @@ def infer_transformer_specs(
             return type(node)(out) if isinstance(node, tuple) else out
         if _is_quant_node(node):
             # int8-quantized leaf (models/quantize.py): the q8 tensor
-            # takes the spec its full-precision kernel would have; the
-            # per-last-dim scale follows the kernel's LAST dim sharding.
+            # takes the spec its full-precision kernel would have. A 1-D
+            # scale is the per-output-channel layout and follows the
+            # kernel's LAST dim sharding; a broadcastable (rows, 1, ...)
+            # scale (per-row embedding layout) replicates — embeddings
+            # are replicated under every rules table here, and a rank
+            # mismatch must not silently shard the scale's row dim.
             kspec = _leaf_spec(path, sp)
             rank = node[_Q].ndim
-            last = kspec[rank - 1] if len(kspec) >= rank else None
+            last = (kspec[rank - 1]
+                    if node[_SCALE].ndim == 1 and len(kspec) >= rank
+                    else None)
             return {
                 _Q: kspec,
                 _SCALE: (PartitionSpec(last) if last is not None

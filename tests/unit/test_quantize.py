@@ -248,3 +248,87 @@ class TestQuantizedServing:
         ref = np.asarray(bert.logits_fn(
             dequantize_tree(quantize_tree(params)), config, ids, mask))
         np.testing.assert_allclose(lg, ref, rtol=2e-2, atol=2e-2)
+
+
+class TestRound4Additions:
+    def test_embedding_quantized_per_row(self):
+        import jax
+
+        from min_tfs_client_tpu.models.quantize import _Q, _SCALE
+
+        rng = np.random.default_rng(0)
+        # Rows with wildly different magnitudes: a shared per-feature
+        # scale would crush the small rows; per-row keeps both.
+        table = np.concatenate([
+            rng.standard_normal((64, 128)).astype(np.float32) * 100.0,
+            rng.standard_normal((64, 128)).astype(np.float32) * 0.01,
+        ])
+        tree = {"word": {"embedding": table}}
+        q = quantize_tree(tree, min_size=1)
+        node = q["word"]["embedding"]
+        assert node[_SCALE].shape == (128, 1)  # per row, broadcastable
+        back = np.asarray(dequantize_tree(q)["word"]["embedding"])
+        # Small rows must round-trip at their own resolution.
+        small = table[64:]
+        err = np.max(np.abs(back[64:] - small)) / np.max(np.abs(small))
+        assert err < 0.01, err
+
+    def test_kernel_scale_layout_unchanged(self):
+        from min_tfs_client_tpu.models.quantize import _SCALE
+
+        w = np.random.default_rng(1).standard_normal(
+            (32, 16)).astype(np.float32)
+        q = quantize_tree({"dense": {"kernel": w}}, min_size=1)
+        assert q["dense"]["kernel"][_SCALE].shape == (16,)
+
+    def test_export_guard_passes_tiny_bert(self, tmp_path):
+        import dataclasses
+
+        import jax
+
+        from min_tfs_client_tpu.models import bert, export
+
+        config = bert.BertConfig.tiny(num_labels=3)
+        params = bert.init_params(jax.random.PRNGKey(0), config)
+        export.export_servable(
+            tmp_path / "m", 1, "bert", dataclasses.asdict(config), params,
+            {"seq_len": 8}, quantize="int8", quantize_guard=0.1)
+        assert (tmp_path / "m" / "1" / "params.npz").exists()
+
+    def test_export_guard_trips_on_impossible_threshold(self, tmp_path):
+        import dataclasses
+
+        import jax
+        import pytest
+
+        from min_tfs_client_tpu.models import bert, export
+
+        config = bert.BertConfig.tiny(num_labels=3)
+        params = bert.init_params(jax.random.PRNGKey(0), config)
+        with pytest.raises(ValueError, match="deviates"):
+            export.export_servable(
+                tmp_path / "m2", 1, "bert", dataclasses.asdict(config),
+                params, {"seq_len": 8}, quantize="int8",
+                quantize_guard=1e-9)
+        # a tripped guard leaves no servable params behind
+        assert not (tmp_path / "m2" / "1" / "params.npz").exists()
+
+    def test_export_guard_rejects_integer_only_outputs(self, tmp_path):
+        import dataclasses
+
+        import jax
+        import pytest
+
+        from min_tfs_client_tpu.models import export, t5
+
+        config = t5.T5Config.tiny()
+        params = t5.init_params(jax.random.PRNGKey(0), config)
+        # T5's default signature decodes token ids — max-rel over ids is
+        # meaningless, so the guard refuses rather than misfires.
+        with pytest.raises(ValueError, match="no\\s+continuous"):
+            export.export_servable(
+                tmp_path / "t", 1, "t5", dataclasses.asdict(config),
+                params,
+                {"seq_len": 8, "max_decode_len": 4},
+                quantize="int8", quantize_guard=0.1)
+        assert not (tmp_path / "t" / "1").exists()
