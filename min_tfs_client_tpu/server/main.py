@@ -99,6 +99,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="drop OS page cache for model files after the "
                         "initial loads (weights already live in device/"
                         "host arrays)")
+    p.add_argument("--remove_unused_fields_from_bundle_metagraph",
+                   type=lambda v: v != "false", default=True,
+                   help="reference trims unused MetaGraphDef fields after "
+                        "load; the GraphDef import here retains only the "
+                        "constants reachable from each signature by "
+                        "design, so this is inherently satisfied and the "
+                        "flag is accepted for CLI compatibility")
     p.add_argument("--enable_signature_method_name_check",
                    action="store_true",
                    help="require Classify/Regress signatures' method_name "

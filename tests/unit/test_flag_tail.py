@@ -122,6 +122,13 @@ class TestFlagParsing:
         assert opts.flush_filesystem_caches is False
         assert opts.enable_signature_method_name_check is True
 
+    def test_remove_unused_fields_flag_accepted(self):
+        # Documented no-op: the import retains only reachable constants
+        # by design; the flag must parse for CLI compatibility.
+        args = server_main.build_parser().parse_args(
+            ["--remove_unused_fields_from_bundle_metagraph=false"])
+        assert args.remove_unused_fields_from_bundle_metagraph is False
+
     def test_defaults_match_reference(self):
         opts = server_main.options_from_args(
             server_main.build_parser().parse_args([]))
