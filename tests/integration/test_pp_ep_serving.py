@@ -154,6 +154,39 @@ def test_moe_bert_serves_expert_parallel_through_server_core(tmp_path):
         core.stop()
 
 
+def test_pipelined_bert_serves_classify_examples(tmp_path):
+    """The Example surfaces share the pipelined compute path."""
+    from min_tfs_client_tpu.tensor.example_codec import (
+        build_input,
+        example_from_dict,
+    )
+
+    config = bert.BertConfig.tiny(num_layers=4, num_labels=3)
+    params = bert.init_params(jax.random.PRNGKey(3), config)
+    export.export_servable(
+        tmp_path / "ppc", 1, "bert", dataclasses.asdict(config), params,
+        {"seq_len": SEQ}, pipeline={"stages": 4})
+    core = _core(tmp_path, "ppc")
+    try:
+        handlers = Handlers(core)
+        rng = np.random.default_rng(3)
+        ids = rng.integers(0, config.vocab_size, (4, SEQ)).astype(np.int64)
+        req = apis.ClassificationRequest()
+        req.model_spec.name = "ppc"
+        req.model_spec.signature_name = "classify"
+        req.input.CopyFrom(build_input(
+            [example_from_dict({"input_ids": row}) for row in ids]))
+        resp = handlers.classify(req)
+        want = np.asarray(jax.nn.softmax(bert.logits_fn(
+            params, config, ids.astype(np.int32),
+            np.ones((4, SEQ), np.int32)), -1))
+        got = np.array([[c.score for c in cl.classes]
+                        for cl in resp.result.classifications])
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+    finally:
+        core.stop()
+
+
 def test_bad_pipeline_configs_fail_at_export(tmp_path):
     """Configs that could only fail at server load fail at export instead
     (a bad version dir would silently never become available)."""
