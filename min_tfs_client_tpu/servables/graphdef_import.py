@@ -342,6 +342,186 @@ def _log_softmax(node, inputs, lib):
                                       keepdims=True))]
 
 
+def _top_k(node, inputs, lib):
+    """TopKV2 -> (values, indices), ties broken by lowest index (TF
+    semantics; both the stable argsort and lax.top_k honor that)."""
+    x, k = inputs
+    k = int(np.asarray(k))
+    if lib is np:
+        xs = np.asarray(x)
+        key = xs
+        if xs.dtype.kind == "u":
+            # Negation wraps unsigned; promote to an ordered signed key
+            # (u8 via f64: exact below 2^53, far beyond realistic ids).
+            key = xs.astype(np.int64 if xs.dtype.itemsize < 8
+                            else np.float64)
+        idx = np.argsort(-key, axis=-1, kind="stable")[..., :k]
+        vals = np.take_along_axis(xs, idx, -1)
+    else:
+        import jax
+
+        vals, idx = jax.lax.top_k(x, k)
+    return [vals, np.asarray(idx).astype(np.int32) if lib is np
+            else idx.astype("int32")]
+
+
+# -- lookup tables (host-side; classify exports map ids -> string labels) ----
+
+
+class LookupTable:
+    """A HashTableV2 materialized at import time from the graph's
+    initializer nodes (LookupTableImportV2 / InitializeTableV2 with Const
+    keys/values, or InitializeTableFromTextFileV2 with an asset file).
+    The reference runs these ops inside the Session (main_op =
+    tables_initializer); XLA has no hash tables, so lookups execute on
+    the host — any signature that touches one serves on_host."""
+
+    def __init__(self, keys, values, value_is_string: bool):
+        self.mapping = dict(zip(keys, values))
+        self.value_is_string = value_is_string
+
+    @staticmethod
+    def _norm_key(k):
+        if isinstance(k, (bytes, np.bytes_)):
+            return bytes(k)
+        if isinstance(k, (str, np.str_)):
+            return str(k).encode()
+        return int(k)
+
+    def find(self, keys, default) -> np.ndarray:
+        keys = np.asarray(keys)
+        default = np.asarray(default).reshape(-1)[0]
+        if self.value_is_string:
+            default = self._norm_key(default)
+        flat = [self.mapping.get(self._norm_key(k), default)
+                for k in keys.reshape(-1).tolist()]
+        if self.value_is_string:
+            out = np.array(flat, dtype=object)
+        else:
+            out = np.asarray(flat)
+        return out.reshape(keys.shape)
+
+
+def _table_find(node, inputs, lib):
+    table, keys, default = inputs
+    if not isinstance(table, LookupTable):
+        raise GraphImportError(
+            f"{node.name}: LookupTableFindV2's table input is not a "
+            "resolved table handle")
+    return [table.find(keys, default)]
+
+
+def _read_vocab_column(line: str, index: int, line_no: int, delim: str,
+                       is_string: bool):
+    """One key/value per the TextFileInitializer conventions: -1 = line
+    number (always int64), -2 = whole line (always string), >=0 = the
+    delimited column, parsed per the TABLE's declared dtype."""
+    if index == -1:
+        return line_no
+    if index == -2:
+        return line.encode()
+    col = line.split(delim)[index]
+    return col.encode() if is_string else int(col)
+
+
+def build_tables(graph_def, asset_dir=None) -> dict[str, object]:
+    """Materialize every initialized hash table in the graph, keyed by
+    its HashTableV2 node name. Initializer nodes hang off the main_op,
+    unreachable from any fetch, so they are found by direct scan.
+
+    Best-effort: a table whose initializer cannot be resolved (non-Const
+    keys, missing vocab file) maps to a GraphImportError VALUE, raised
+    only if a signature actually reaches the table — unreachable broken
+    tables must not fail models that never touch them (scan parity)."""
+    from min_tfs_client_tpu.servables import example_parse
+
+    nodes = {n.name: n for n in graph_def.node}
+
+    def handle_name(ref: str) -> str:
+        name, _ = _tensor_name(ref)
+        seen = set()
+        while (name in nodes and nodes[name].op == "Identity"
+               and name not in seen):
+            seen.add(name)
+            name = _tensor_name(nodes[name].input[0])[0]
+        return name
+
+    def const(ref, what):
+        try:
+            return example_parse._const_ndarray(nodes, ref, what)
+        except example_parse.ParseSynthesisError as exc:
+            raise GraphImportError(str(exc)) from exc
+
+    def int_attr(node, key, default):
+        a = _attr(node, key)
+        return int(a.i) if a is not None else default
+
+    def table_dtype_is_string(tname, key) -> bool:
+        a = _attr(nodes.get(tname), key) if tname in nodes else None
+        return a is not None and a.type == DT_STRING
+
+    tables: dict[str, object] = {}
+    for node in graph_def.node:
+        if node.op not in ("LookupTableImportV2", "InitializeTableV2",
+                           "InitializeTableFromTextFileV2"):
+            continue
+        tname = handle_name(node.input[0])
+        try:
+            if node.op in ("LookupTableImportV2", "InitializeTableV2"):
+                keys = const(node.input[1],
+                             f"{node.name} keys").reshape(-1)
+                values = const(node.input[2],
+                               f"{node.name} values").reshape(-1)
+                value_is_string = values.dtype.kind in "OSU"
+                norm_keys = [LookupTable._norm_key(k)
+                             for k in keys.tolist()]
+                norm_vals = [LookupTable._norm_key(v) if value_is_string
+                             else v for v in values.tolist()]
+                tables[tname] = LookupTable(norm_keys, norm_vals,
+                                            value_is_string)
+            else:
+                fname = const(node.input[1], f"{node.name} filename")
+                fname = bytes(fname.reshape(-1)[0]).decode()
+                path = pathlib.Path(fname)
+                if not path.is_file() and asset_dir is not None:
+                    path = (pathlib.Path(asset_dir)
+                            / pathlib.Path(fname).name)
+                if not path.is_file():
+                    raise GraphImportError(
+                        f"{node.name}: vocabulary file {fname!r} not "
+                        "found (also tried the SavedModel assets dir)")
+                # Op defaults (strip_default_attrs may omit them):
+                # key_index=-2, value_index=-1, vocab_size=-1, delim \t.
+                key_index = int_attr(node, "key_index", -2)
+                value_index = int_attr(node, "value_index", -1)
+                vocab_size = int_attr(node, "vocab_size", -1)
+                delim_attr = _attr(node, "delimiter")
+                delim = (delim_attr.s.decode() if delim_attr is not None
+                         and delim_attr.s else "\t")
+                key_is_string = table_dtype_is_string(tname, "key_dtype")
+                value_is_string = table_dtype_is_string(tname,
+                                                        "value_dtype")
+                keys, values = [], []
+                with open(path, "r", encoding="utf-8") as fh:
+                    for line_no, line in enumerate(fh):
+                        if 0 <= vocab_size <= line_no:
+                            break
+                        line = line.rstrip("\n")
+                        keys.append(_read_vocab_column(
+                            line, key_index, line_no, delim,
+                            key_is_string))
+                        values.append(_read_vocab_column(
+                            line, value_index, line_no, delim,
+                            value_is_string))
+                tables[tname] = LookupTable(
+                    keys, values,
+                    value_index == -2 or (value_index >= 0
+                                          and value_is_string))
+        except GraphImportError as exc:
+            tables[tname] = exc
+    return tables
+
+
 OPS: dict[str, Callable] = {
     "Identity": lambda n, i, lib: [i[0]],
     "StopGradient": lambda n, i, lib: [i[0]],
@@ -453,6 +633,10 @@ OPS: dict[str, Callable] = {
                                         lib.exp(lib.minimum(i[0], 0)) - 1)],
     "LeakyRelu": _leaky_relu,
     "LogSoftmax": _log_softmax,
+    "TopKV2": _top_k,
+    "LookupTableFindV2": _table_find,
+    "LookupTableSizeV2": lambda n, i, lib: [
+        np.int64(len(i[0].mapping))],
     "ClipByValue": lambda n, i, lib: [lib.clip(i[0], i[1], i[2])],
     "AddN": lambda n, i, lib: [sum(i[1:], start=i[0])],
     "Reciprocal": lambda n, i, lib: [1 / i[0]],
@@ -770,13 +954,15 @@ class GraphFunction:
                  feed_names: Sequence[str], fetch_names: Sequence[str],
                  target_names: Sequence[str] = (),
                  variables: Mapping[str, np.ndarray] | None = None,
-                 funclib: "_FuncLib | None" = None):
+                 funclib: "_FuncLib | None" = None,
+                 tables: "Mapping[str, LookupTable] | None" = None):
         self._nodes = {n.name: n for n in graph_def.node}
         self._feeds = [_tensor_name(f) for f in feed_names]
         self._fetches = [_tensor_name(f) for f in fetch_names]
         self._targets = [_tensor_name(t)[0] for t in target_names]
         self._consts: dict[str, np.ndarray] = {}
         self._variables = _variable_lookup(variables or {})
+        self._tables = dict(tables or {})
         self._funclib = funclib or _FuncLib(
             graph_def.library if graph_def.HasField("library") else None)
         self.has_string = self._scan(graph_def)
@@ -813,6 +999,17 @@ class GraphFunction:
                 a = _attr(node, key)
                 if a is not None and a.type == DT_STRING:
                     has_string = True
+            if node.op == "HashTableV2":
+                entry = self._tables.get(name)
+                if entry is None:
+                    raise GraphImportError(
+                        f"hash table {name!r} has no resolvable "
+                        "initializer (Const or asset-file init required)")
+                if isinstance(entry, GraphImportError):
+                    raise entry  # broken init, and a signature NEEDS it
+                continue  # leaf: materialized at import
+            if node.op in ("LookupTableFindV2", "LookupTableSizeV2"):
+                has_string = True  # lookups execute host-side
             if node.op == "Const":
                 self._consts[name] = tensor_proto_to_ndarray(
                     node.attr["value"].tensor)
@@ -870,6 +1067,10 @@ class GraphFunction:
                 return memo[name]
             if name in self._consts:
                 out = [self._consts[name]]
+                memo[name] = out
+                return out
+            if name in self._tables:
+                out = [self._tables[name]]
                 memo[name] = out
                 return out
             node = self._nodes[name]
@@ -954,6 +1155,11 @@ def load_saved_model(
         meta_graph.graph_def.library
         if meta_graph.graph_def.HasField("library") else None)
 
+    # Hash tables initialize once at import (the reference's main_op =
+    # tables_initializer step, run here instead of in a Session).
+    tables = build_tables(meta_graph.graph_def,
+                          asset_dir=pathlib.Path(path) / "assets")
+
     signatures: dict[str, Signature] = {}
     for key, sig_def in meta_graph.signature_def.items():
         if not sig_def.inputs or not sig_def.outputs:
@@ -984,7 +1190,8 @@ def load_saved_model(
                 feed_names = list(bypass.dense_refs)
 
         graph_fn = GraphFunction(meta_graph.graph_def, feed_names, fetch_names,
-                                 variables=variables, funclib=funclib)
+                                 variables=variables, funclib=funclib,
+                                 tables=tables)
         on_host = graph_fn.has_string
         if feature_specs is not None and any(
                 e == DT_STRING for e in bypass.dtype_enums.values()):
@@ -1042,7 +1249,7 @@ def load_saved_model(
     # graph, GraphFunctions cached per (feeds, fetches) key.
     servable.session_runner = SessionRunner(meta_graph.graph_def,
                                             variables=variables,
-                                            funclib=funclib)
+                                            funclib=funclib, tables=tables)
     return servable
 
 
@@ -1053,12 +1260,14 @@ class SessionRunner:
 
     def __init__(self, graph_def: tf_graph_pb2.GraphDef,
                  variables: Mapping[str, np.ndarray] | None = None,
-                 funclib: _FuncLib | None = None):
+                 funclib: _FuncLib | None = None,
+                 tables: "Mapping[str, LookupTable] | None" = None):
         import collections
         import threading
 
         self._graph_def = graph_def
         self._variables = variables or {}
+        self._tables = tables
         self._funclib = funclib or _FuncLib(
             graph_def.library if graph_def.HasField("library") else None)
         self._cache: "collections.OrderedDict[tuple, GraphFunction]" = \
@@ -1078,7 +1287,7 @@ class SessionRunner:
             graph_fn = GraphFunction(
                 self._graph_def, list(sorted(feeds)), list(fetches),
                 target_names=targets, variables=self._variables,
-                funclib=self._funclib)
+                funclib=self._funclib, tables=self._tables)
             with self._cache_lock:
                 self._cache[key] = graph_fn
                 if len(self._cache) > self.MAX_CACHED_PLANS:

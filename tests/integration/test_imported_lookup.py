@@ -1,0 +1,133 @@
+"""Imported SavedModels with hash-table lookups: the standard
+estimator-style classify export maps class ids to string labels through
+HashTableV2 + LookupTableFindV2 (initialized by the main_op =
+tables_initializer, which the import replays at load). Cross-validated
+against TF's own Session output. TF runs in a subprocess."""
+
+from __future__ import annotations
+
+import pathlib
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from min_tfs_client_tpu.servables.graphdef_import import load_saved_model
+from min_tfs_client_tpu.tensor.example_codec import example_from_dict
+
+EXPORT_SCRIPT = """
+import sys
+import numpy as np
+import tensorflow as tf
+
+tf1 = tf.compat.v1
+tf1.disable_eager_execution()
+
+export_dir, examples_path, out_path = sys.argv[1:4]
+payloads = np.load(examples_path, allow_pickle=True)
+
+g = tf1.Graph()
+with g.as_default():
+    serialized = tf1.placeholder(tf.string, [None],
+                                 name="input_example_tensor")
+    features = tf1.io.parse_example(serialized, {
+        "x": tf1.io.FixedLenFeature([3], tf.float32)})
+    rng = np.random.default_rng(23)
+    w = tf1.get_variable(
+        "w", initializer=rng.standard_normal((3, 4)).astype(np.float32))
+    logits = tf.matmul(features["x"], w)
+    scores = tf.nn.softmax(logits)
+    table = tf.lookup.StaticHashTable(
+        tf.lookup.KeyValueTensorInitializer(
+            tf.constant([0, 1, 2, 3], tf.int64),
+            tf.constant([b"alpha", b"beta", b"gamma", b"delta"])),
+        default_value=b"UNK")
+    # Ranked labels: classes[i, j] is the label of the j-th best class —
+    # the estimator classification-head shape.
+    top = tf.argsort(logits, direction="DESCENDING")
+    ranked_scores = tf.sort(logits, direction="DESCENDING")
+    classes = table.lookup(tf.cast(top, tf.int64))
+    sig = tf1.saved_model.classification_signature_def(
+        examples=serialized, classes=classes, scores=scores)
+    builder = tf1.saved_model.Builder(export_dir)
+    with tf1.Session() as sess:
+        sess.run(tf1.global_variables_initializer())
+        sess.run(tf1.tables_initializer())
+        builder.add_meta_graph_and_variables(
+            sess, [tf1.saved_model.SERVING],
+            signature_def_map={"serving_default": sig},
+            main_op=tf1.tables_initializer())
+        builder.save()
+        got_scores, got_classes = sess.run(
+            [scores, classes], {serialized: list(payloads)})
+np.savez(out_path, scores=got_scores, classes=got_classes)
+print("SAVED")
+"""
+
+
+def _run_tf(script, *args):
+    return subprocess.run(
+        [sys.executable, "-c", script, *args], capture_output=True,
+        text=True, timeout=300,
+        env={"PATH": "/usr/bin:/bin:/usr/local/bin",
+             "CUDA_VISIBLE_DEVICES": "-1", "JAX_PLATFORMS": "cpu",
+             "TF_CPP_MIN_LOG_LEVEL": "3", "HOME": "/root"})
+
+
+FEATURES = [
+    {"x": np.array([0.5, -1.0, 2.0], np.float32)},
+    {"x": np.array([1.5, 0.25, -0.75], np.float32)},
+    {"x": np.array([-2.0, 0.0, 1.0], np.float32)},
+]
+
+
+@pytest.fixture(scope="module")
+def exported(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("lookup_export")
+    payloads = np.array(
+        [example_from_dict(d).SerializeToString() for d in FEATURES],
+        dtype=object)
+    ex_path = tmp / "examples.npy"
+    np.save(ex_path, payloads, allow_pickle=True)
+    version_dir = tmp / "model" / "1"
+    out_path = tmp / "tf_out.npz"
+    proc = _run_tf(EXPORT_SCRIPT, str(version_dir), str(ex_path),
+                   str(out_path))
+    if "SAVED" not in proc.stdout:
+        pytest.skip(f"tensorflow unavailable: {proc.stderr[-500:]}")
+    return version_dir, np.load(out_path, allow_pickle=True)
+
+
+@pytest.mark.integration
+def test_lookup_classify_matches_tf(exported):
+    version_dir, want = exported
+    servable = load_saved_model(str(version_dir), "lkp", 1)
+    sig = servable.signature("")
+    assert sig.on_host  # string table lookup forces the host path
+    from min_tfs_client_tpu.tensor.example_codec import decode_examples
+
+    examples = [example_from_dict(d) for d in FEATURES]
+    features = decode_examples(examples, sig.feature_specs)
+    out = sig.run(features)
+    np.testing.assert_allclose(out["scores"], want["scores"],
+                               rtol=1e-5, atol=1e-6)
+    got_classes = np.vectorize(
+        lambda b: b if isinstance(b, bytes) else bytes(b))(out["classes"])
+    np.testing.assert_array_equal(got_classes, want["classes"])
+
+
+@pytest.mark.integration
+def test_session_runner_sees_tables(exported):
+    version_dir, _ = exported
+    servable = load_saved_model(str(version_dir), "lkp", 1)
+    # Raw SessionRun over the same graph reaches the lookup too. The
+    # in-graph Example parse is host-decoded in this framework, so feed
+    # the parse node's dense output directly (interior feeds override
+    # producers, Session::Run semantics).
+    runner = servable.session_runner
+    x = FEATURES[0]["x"].reshape(1, 3)
+    outs = runner.run({"ParseExample/ParseExampleV2:0": x},
+                      ["hash_table_Lookup/LookupTableFindV2:0"])
+    assert outs[0].shape == (1, 4)
+    assert all(isinstance(v, bytes) for v in outs[0].reshape(-1))

@@ -1,0 +1,216 @@
+"""Hash-table import machinery on hand-built GraphDefs (no TF needed):
+Const-initialized tables, asset-file vocabularies, TopKV2 ties, and the
+unresolvable-initializer error."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from min_tfs_client_tpu.protos import tf_graph_pb2
+from min_tfs_client_tpu.servables.graphdef_import import (
+    GraphFunction,
+    GraphImportError,
+    build_tables,
+)
+from min_tfs_client_tpu.tensor.codec import ndarray_to_tensor_proto
+
+DT_INT64, DT_STRING = 9, 7
+
+
+def _const(gd, name, arr):
+    node = gd.node.add()
+    node.name = name
+    node.op = "Const"
+    node.attr["value"].tensor.CopyFrom(ndarray_to_tensor_proto(arr))
+    return node
+
+
+def _table_graph(*, init_op="LookupTableImportV2"):
+    gd = tf_graph_pb2.GraphDef()
+    ph = gd.node.add()
+    ph.name = "ids"
+    ph.op = "Placeholder"
+    ph.attr["dtype"].type = DT_INT64
+    table = gd.node.add()
+    table.name = "hash_table"
+    table.op = "HashTableV2"
+    table.attr["key_dtype"].type = DT_INT64
+    table.attr["value_dtype"].type = DT_STRING
+    _const(gd, "keys", np.array([0, 1, 2], np.int64))
+    _const(gd, "values", np.array([b"a", b"b", b"c"], object))
+    init = gd.node.add()
+    init.name = "init"
+    init.op = init_op
+    init.input.extend(["hash_table", "keys", "values"])
+    _const(gd, "default", np.asarray(b"UNK", object))
+    find = gd.node.add()
+    find.name = "find"
+    find.op = "LookupTableFindV2"
+    find.input.extend(["hash_table", "ids", "default"])
+    return gd
+
+
+@pytest.mark.parametrize("init_op",
+                         ["LookupTableImportV2", "InitializeTableV2"])
+def test_const_initialized_table_lookup(init_op):
+    gd = _table_graph(init_op=init_op)
+    tables = build_tables(gd)
+    assert set(tables) == {"hash_table"}
+    fn = GraphFunction(gd, ["ids:0"], ["find:0"], tables=tables)
+    assert fn.has_string  # lookups run host-side
+    out = fn([np.array([[2, 0], [7, 1]], np.int64)], np)[0]
+    np.testing.assert_array_equal(
+        out, np.array([[b"c", b"a"], [b"UNK", b"b"]], object))
+
+
+def test_uninitialized_table_fails_at_import():
+    gd = _table_graph()
+    del gd.node[[n.name for n in gd.node].index("init")]
+    with pytest.raises(GraphImportError, match="no resolvable"):
+        GraphFunction(gd, ["ids:0"], ["find:0"], tables=build_tables(gd))
+
+
+def test_unreachable_broken_table_does_not_fail_import():
+    # A table whose initializer cannot resolve must only fail signatures
+    # that actually reach it (reachability parity with _scan).
+    gd = _table_graph()
+    for node in gd.node:
+        if node.name == "keys":
+            node.op = "Placeholder"
+            node.ClearField("attr")
+            node.attr["dtype"].type = DT_INT64
+    tables = build_tables(gd)
+    assert isinstance(tables["hash_table"], GraphImportError)
+    # Fetch something that avoids the table: imports fine.
+    fn = GraphFunction(gd, ["ids:0"], ["ids:0"], tables=tables)
+    out = fn([np.array([1], np.int64)], np)[0]
+    np.testing.assert_array_equal(out, [1])
+    # Fetching through the table raises the stored error.
+    with pytest.raises(GraphImportError, match="not a Const"):
+        GraphFunction(gd, ["ids:0"], ["find:0"], tables=tables)
+
+
+def test_int64_valued_text_vocab(tmp_path):
+    # key/value dtypes come from the TABLE node, not assumed string.
+    vocab = tmp_path / "v.txt"
+    vocab.write_text("apple\t7\nbanana\t9\n")
+    gd = tf_graph_pb2.GraphDef()
+    ph = gd.node.add()
+    ph.name = "words"
+    ph.op = "Placeholder"
+    ph.attr["dtype"].type = DT_STRING
+    table = gd.node.add()
+    table.name = "t"
+    table.op = "HashTableV2"
+    table.attr["key_dtype"].type = DT_STRING
+    table.attr["value_dtype"].type = DT_INT64
+    _const(gd, "fname", np.asarray(str(vocab).encode(), object))
+    init = gd.node.add()
+    init.name = "init"
+    init.op = "InitializeTableFromTextFileV2"
+    init.input.extend(["t", "fname"])
+    init.attr["key_index"].i = 0
+    init.attr["value_index"].i = 1
+    init.attr["vocab_size"].i = -1
+    _const(gd, "default", np.asarray(-1, np.int64))
+    find = gd.node.add()
+    find.name = "find"
+    find.op = "LookupTableFindV2"
+    find.input.extend(["t", "words", "default"])
+    fn = GraphFunction(gd, ["words:0"], ["find:0"],
+                       tables=build_tables(gd))
+    out = fn([np.array([b"banana", b"kiwi", b"apple"], object)], np)[0]
+    np.testing.assert_array_equal(out, [9, -1, 7])
+    assert out.dtype.kind in "i"
+
+
+def test_topk_unsigned_input():
+    gd = tf_graph_pb2.GraphDef()
+    ph = gd.node.add()
+    ph.name = "x"
+    ph.op = "Placeholder"
+    ph.attr["dtype"].type = 4  # uint8
+    _const(gd, "k", np.asarray(1, np.int32))
+    top = gd.node.add()
+    top.name = "top"
+    top.op = "TopKV2"
+    top.input.extend(["x", "k"])
+    fn = GraphFunction(gd, ["x:0"], ["top:0", "top:1"])
+    vals, idx = fn([np.array([[5, 200]], np.uint8)], np)
+    np.testing.assert_array_equal(vals, [[200]])
+    np.testing.assert_array_equal(idx, [[1]])
+
+
+def test_text_file_vocab_table(tmp_path):
+    vocab = tmp_path / "labels.txt"
+    vocab.write_text("negative\nneutral\npositive\n")
+    gd = tf_graph_pb2.GraphDef()
+    ph = gd.node.add()
+    ph.name = "ids"
+    ph.op = "Placeholder"
+    ph.attr["dtype"].type = DT_INT64
+    table = gd.node.add()
+    table.name = "vocab_table"
+    table.op = "HashTableV2"
+    table.attr["key_dtype"].type = DT_INT64
+    table.attr["value_dtype"].type = DT_STRING
+    _const(gd, "fname", np.asarray(str(vocab).encode(), object))
+    init = gd.node.add()
+    init.name = "init"
+    init.op = "InitializeTableFromTextFileV2"
+    init.input.extend(["vocab_table", "fname"])
+    init.attr["key_index"].i = -1     # line number
+    init.attr["value_index"].i = -2   # whole line
+    init.attr["vocab_size"].i = -1
+    _const(gd, "default", np.asarray(b"UNK", object))
+    find = gd.node.add()
+    find.name = "find"
+    find.op = "LookupTableFindV2"
+    find.input.extend(["vocab_table", "ids", "default"])
+    tables = build_tables(gd)
+    fn = GraphFunction(gd, ["ids:0"], ["find:0"], tables=tables)
+    out = fn([np.array([2, 0, 9], np.int64)], np)[0]
+    np.testing.assert_array_equal(
+        out, np.array([b"positive", b"negative", b"UNK"], object))
+
+
+def test_text_file_vocab_resolved_from_assets_dir(tmp_path):
+    # Export-time absolute paths die with the exporting machine; the
+    # basename must resolve under the SavedModel's assets dir.
+    assets = tmp_path / "assets"
+    assets.mkdir()
+    (assets / "labels.txt").write_text("x\ny\n")
+    gd = tf_graph_pb2.GraphDef()
+    table = gd.node.add()
+    table.name = "t"
+    table.op = "HashTableV2"
+    _const(gd, "fname",
+           np.asarray(b"/nonexistent/export/path/labels.txt", object))
+    init = gd.node.add()
+    init.name = "init"
+    init.op = "InitializeTableFromTextFileV2"
+    init.input.extend(["t", "fname"])
+    init.attr["key_index"].i = -1
+    init.attr["value_index"].i = -2
+    init.attr["vocab_size"].i = -1
+    tables = build_tables(gd, asset_dir=assets)
+    assert tables["t"].mapping == {0: b"x", 1: b"y"}
+
+
+def test_topk_ties_break_by_lowest_index():
+    gd = tf_graph_pb2.GraphDef()
+    ph = gd.node.add()
+    ph.name = "x"
+    ph.op = "Placeholder"
+    ph.attr["dtype"].type = 1  # float32
+    _const(gd, "k", np.asarray(2, np.int32))
+    top = gd.node.add()
+    top.name = "top"
+    top.op = "TopKV2"
+    top.input.extend(["x", "k"])
+    fn = GraphFunction(gd, ["x:0"], ["top:0", "top:1"])
+    x = np.array([[1.0, 3.0, 3.0, 0.5]], np.float32)
+    vals, idx = fn([x], np)
+    np.testing.assert_array_equal(vals, [[3.0, 3.0]])
+    np.testing.assert_array_equal(idx, [[1, 2]])
