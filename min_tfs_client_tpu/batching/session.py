@@ -207,6 +207,7 @@ class BatchedSignatureRunner:
         merged = {}
         sb = self.signature.sequence_bucketing
         with trace("batching/merge"):
+            rpv = self.signature.ragged_pad_values
             for alias in batch[0].inputs:
                 columns = [t.inputs[alias] for t in batch]
                 if sb is not None and alias in sb.pad_values:
@@ -216,6 +217,11 @@ class BatchedSignatureRunner:
                     # rule (1) would un-mask the padding.
                     columns = pad_to_max(columns, sb.axis,
                                          sb.pad_values[alias])
+                elif rpv and alias in rpv:
+                    # VarLen dense views: widths differ per request by
+                    # construction; bridge with the feature's own pad
+                    # (SparseToDense default), never first-element fill.
+                    columns = pad_to_max(columns, 1, rpv[alias])
                 elif self._pad_ragged:
                     columns = pad_ragged(columns)
                 else:

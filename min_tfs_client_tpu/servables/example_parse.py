@@ -147,11 +147,10 @@ def find_parse_bypass(graph_def, serialized_ref: str) -> "ParseBypass | None":
     if consumer.op == "ParseExample":
         n_sparse = int(attrs["Nsparse"].i)
         n_dense = int(attrs["Ndense"].i)
-        if n_sparse:
-            raise ParseSynthesisError(
-                f"{consumer.name}: {n_sparse} sparse features; only "
-                "FixedLen dense features are served (VarLen is "
-                "dynamically shaped)")
+        sparse_keys = [
+            bytes(_const_ndarray(nodes, r, "sparse key").reshape(())
+                  .item()).decode()
+            for r in consumer.input[2:2 + n_sparse]]
         key_refs = consumer.input[2 + n_sparse: 2 + n_sparse + n_dense]
         keys = [bytes(_const_ndarray(nodes, r, "dense key").reshape(())
                       .item()).decode() for r in key_refs]
@@ -161,10 +160,16 @@ def find_parse_bypass(graph_def, serialized_ref: str) -> "ParseBypass | None":
     else:  # ParseExampleV2
         n_sparse = int(attrs["num_sparse"].i)
         n_ragged = len(attrs["ragged_value_types"].list.type)
-        if n_sparse or n_ragged:
+        if n_ragged:
             raise ParseSynthesisError(
-                f"{consumer.name}: {n_sparse} sparse / {n_ragged} ragged "
-                "features; only FixedLen dense features are served")
+                f"{consumer.name}: {n_ragged} ragged features; ragged "
+                "parse outputs are not served")
+        sparse_keys = []
+        if n_sparse:
+            sk_arr = _const_ndarray(nodes, consumer.input[2],
+                                    "sparse keys")
+            sparse_keys = [bytes(k).decode()
+                           for k in sk_arr.reshape(-1).tolist()]
         keys_arr = _const_ndarray(nodes, consumer.input[3], "dense keys")
         keys = [bytes(k).decode() for k in keys_arr.reshape(-1).tolist()]
         n_dense = len(keys)
@@ -201,12 +206,94 @@ def find_parse_bypass(graph_def, serialized_ref: str) -> "ParseBypass | None":
         dtype_enums[key] = int(enum)
         shapes[key] = shape
 
+    feature_order = list(keys)
+    dense_refs = [f"{consumer.name}:{dense_base + i}"
+                  for i in range(n_dense)]
+
+    # Sparse (VarLen) features: servable only through the common
+    # SparseToDense pattern — the host decodes the VarLen feature into
+    # the (batch, max-in-batch) dense view padded with the node's
+    # default, and the SparseToDense node itself is bypassed.
+    if n_sparse:
+        sparse_types = list(attrs["sparse_types"].list.type)
+        if len(sparse_types) != n_sparse or len(sparse_keys) != n_sparse:
+            raise ParseSynthesisError(
+                f"{consumer.name}: inconsistent sparse arity "
+                f"(keys={len(sparse_keys)}, types={len(sparse_types)}, "
+                f"declared={n_sparse})")
+        # One reverse pass maps every sparse output slot to its real
+        # consumers (Identity pass-throughs are transparent: their
+        # downstream use resolves back here via _follow_identities).
+        uses: dict[tuple[str, int], dict[str, dict[int, int]]] = {}
+        for node in graph_def.node:
+            if node.op == "Identity":
+                continue
+            for pos, ref in enumerate(node.input):
+                if ref.startswith("^"):
+                    continue
+                slot = _follow_identities(nodes, ref)
+                if slot[0] == consumer.name:
+                    uses.setdefault(slot, {}).setdefault(
+                        node.name, {})[pos] = slot[1]
+        for i, key in enumerate(sparse_keys):
+            spec, feed_ref = _sparse_to_dense_bypass(
+                nodes, consumer, i, n_sparse, key,
+                int(sparse_types[i]), uses)
+            specs[key] = spec
+            dtype_enums[key] = int(sparse_types[i])
+            shapes[key] = (None,)
+            feature_order.append(key)
+            dense_refs.append(feed_ref)
+
     return ParseBypass(
         node_name=consumer.name,
-        feature_order=keys,
-        dense_refs=[f"{consumer.name}:{dense_base + i}"
-                    for i in range(n_dense)],
+        feature_order=feature_order,
+        dense_refs=dense_refs,
         specs=specs,
         dtype_enums=dtype_enums,
         shapes=shapes,
     )
+
+
+def _sparse_to_dense_bypass(nodes, consumer, i: int, n_sparse: int,
+                            key: str, enum: int, uses) -> tuple:
+    """(FeatureSpec(var_len), feed ref) for sparse feature i, valid only
+    when its indices/values/shape outputs feed exactly one SparseToDense
+    node in the canonical wiring. Anything else (direct SparseTensor
+    consumption, embedding_lookup_sparse, ...) cannot be mirrored by a
+    dense host decode and is rejected. `uses` is the precomputed
+    slot -> {consumer: {pos: slot_idx}} reverse index."""
+    np_dtype = _DTYPES.get(enum)
+    if np_dtype is None:
+        raise ParseSynthesisError(
+            f"sparse feature {key!r}: unsupported dtype enum {enum}")
+    roles_by_idx = {i: "indices", n_sparse + i: "values",
+                    2 * n_sparse + i: "shape"}
+    consumers: dict[str, dict[int, str]] = {}
+    for idx, role in roles_by_idx.items():
+        for cname, positions in uses.get((consumer.name, idx), {}).items():
+            for pos in positions:
+                consumers.setdefault(cname, {})[pos] = role
+    if len(consumers) != 1:
+        raise ParseSynthesisError(
+            f"sparse feature {key!r}: expected exactly one SparseToDense "
+            f"consumer, found {sorted(consumers) or 'none'}; VarLen "
+            "features are served only through the SparseToDense pattern")
+    (cname, roles), = consumers.items()
+    cnode = nodes[cname]
+    if (cnode.op != "SparseToDense"
+            or roles != {0: "indices", 1: "shape", 2: "values"}):
+        raise ParseSynthesisError(
+            f"sparse feature {key!r}: consumer {cname!r} ({cnode.op}) "
+            "does not match the SparseToDense(indices, shape, values, "
+            "default) wiring; cannot mirror host-side")
+    default_arr = _const_ndarray(nodes, cnode.input[3],
+                                 f"pad default for {key!r}")
+    if default_arr.size != 1:
+        raise ParseSynthesisError(
+            f"sparse feature {key!r}: non-scalar SparseToDense default")
+    default = default_arr.reshape(-1)[0]
+    if np_dtype == object:
+        default = bytes(default)
+    spec = FeatureSpec(dtype=np_dtype, default=default, var_len=True)
+    return spec, f"{cname}:0"

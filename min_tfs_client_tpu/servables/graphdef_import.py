@@ -349,13 +349,14 @@ def _top_k(node, inputs, lib):
     k = int(np.asarray(k))
     if lib is np:
         xs = np.asarray(x)
-        key = xs
         if xs.dtype.kind == "u":
-            # Negation wraps unsigned; promote to an ordered signed key
-            # (u8 via f64: exact below 2^53, far beyond realistic ids).
-            key = xs.astype(np.int64 if xs.dtype.itemsize < 8
-                            else np.float64)
-        idx = np.argsort(-key, axis=-1, kind="stable")[..., :k]
+            # Negation wraps unsigned. max-x is an exact order-reversing
+            # key in the same dtype (no overflow: result >= 0), and the
+            # stable ASCENDING sort of it keeps lowest-index tie-break.
+            key = (xs.max() if xs.size else xs.dtype.type(0)) - xs
+            idx = np.argsort(key, axis=-1, kind="stable")[..., :k]
+        else:
+            idx = np.argsort(-xs, axis=-1, kind="stable")[..., :k]
         vals = np.take_along_axis(xs, idx, -1)
     else:
         import jax
@@ -379,6 +380,10 @@ class LookupTable:
     def __init__(self, keys, values, value_is_string: bool):
         self.mapping = dict(zip(keys, values))
         self.value_is_string = value_is_string
+        # Numeric value dtype for empty lookups (np.asarray([]) would
+        # default to float64) and exact output typing.
+        self.value_dtype = (None if value_is_string
+                            else np.asarray(list(values) or [0]).dtype)
 
     @staticmethod
     def _norm_key(k):
@@ -398,7 +403,7 @@ class LookupTable:
         if self.value_is_string:
             out = np.array(flat, dtype=object)
         else:
-            out = np.asarray(flat)
+            out = np.asarray(flat, dtype=self.value_dtype)
         return out.reshape(keys.shape)
 
 
@@ -519,6 +524,12 @@ def build_tables(graph_def, asset_dir=None) -> dict[str, object]:
                                           and value_is_string))
         except GraphImportError as exc:
             tables[tname] = exc
+        except (OSError, ValueError, IndexError, KeyError,
+                UnicodeDecodeError) as exc:
+            # Malformed vocab file / bad column etc.: same best-effort
+            # contract — fail only signatures that reach the table.
+            tables[tname] = GraphImportError(
+                f"{node.name}: initializer unresolvable: {exc!r}")
     return tables
 
 
@@ -1226,12 +1237,19 @@ def load_saved_model(
                 return dict(zip(out_aliases, outs))
             return fn
 
+        ragged_pad_values = None
+        if feature_specs is not None:
+            ragged_pad_values = {
+                name: spec.default
+                for name, spec in feature_specs.items() if spec.var_len
+            } or None
         signatures[key] = Signature(
             fn=make_fn(),
             inputs=in_specs,
             outputs=out_specs,
             method_name=sig_def.method_name or PREDICT_METHOD_NAME_DEFAULT,
             feature_specs=feature_specs,
+            ragged_pad_values=ragged_pad_values,
             on_host=on_host,
             batched=batched,
             batch_buckets=batch_buckets,

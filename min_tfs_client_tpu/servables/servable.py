@@ -150,6 +150,12 @@ class Signature:
     batch_buckets: Sequence[int] = DEFAULT_BATCH_BUCKETS
     # Optional class-id -> label vocabulary for classification outputs.
     class_labels: Optional[Sequence[bytes]] = None
+    # Optional alias -> pad value for inputs whose width legitimately
+    # varies per request (VarLen Example features decoded to the
+    # SparseToDense dense view): the batching merge bridges differing
+    # widths with THIS value — pad_ragged's first-element rule would
+    # inject fake valid data.
+    ragged_pad_values: Optional[dict[str, object]] = None
     # Optional alias -> dtype map: cast these inputs on the HOST before the
     # device transfer. For inputs the model immediately casts down anyway
     # (f32 images -> bf16 convs), this halves host->HBM DMA bytes without

@@ -75,14 +75,27 @@ def build_input(
 
 @dataclass(frozen=True)
 class FeatureSpec:
-    """Fixed-length dense feature expected by a servable signature."""
+    """Dense feature expected by a servable signature.
+
+    Fixed-length by default (`shape` per example, missing -> `default`,
+    length mismatch -> error: FixedLenFeature semantics). With
+    `var_len=True` (VarLenFeature semantics) each example contributes
+    any number of values; the batch decodes to (batch, max-in-batch)
+    padded with `default` — exactly the dense view the reference's
+    in-graph SparseToDense produces, so padded width matches TF's."""
 
     dtype: np.dtype                      # np.float32 / np.int64 / object (bytes)
     shape: tuple[int, ...] = ()          # per-example shape; () = scalar
     default: object | None = None        # None = feature required
+    var_len: bool = False
 
     def __post_init__(self):
         object.__setattr__(self, "dtype", np.dtype(self.dtype))
+        if self.var_len and self.shape:
+            raise ValueError("var_len features are rank-1 per example; "
+                             "shape must be ()")
+        if self.var_len and self.default is None:
+            raise ValueError("var_len features need a pad default")
 
 
 class ExampleDecodeError(ValueError):
@@ -218,6 +231,9 @@ def decode_examples(
     serialized = None
     out: dict[str, np.ndarray] = {}
     for name, spec in specs.items():
+        if spec.var_len:
+            out[name] = _decode_var_len(examples, name, spec, batch)
+            continue
         if batch and spec.dtype != object:
             if serialized is None:
                 serialized = _serialize_batch(examples)
@@ -229,6 +245,27 @@ def decode_examples(
                 continue
         out[name] = _decode_examples_python(examples, name, spec, batch)
     return out
+
+
+def _decode_var_len(examples, name: str, spec: FeatureSpec,
+                    batch: int) -> np.ndarray:
+    """VarLen -> (batch, max-in-batch) padded with spec.default (the
+    dense view SparseToDense produces; width matches TF exactly)."""
+    rows = []
+    for ex in examples:
+        feat = ex.features.feature.get(name)
+        vals = _feature_values(feat, spec, name) if feat is not None else []
+        rows.append(vals or [])
+    width = max((len(r) for r in rows), default=0)
+    if spec.dtype == object:
+        col = np.full((batch, width), coerce_to_bytes(spec.default),
+                      dtype=object)
+    else:
+        col = np.full((batch, width), spec.default, dtype=spec.dtype)
+    for i, row in enumerate(rows):
+        if row:
+            col[i, :len(row)] = row
+    return col
 
 
 def _decode_examples_python(examples, name: str, spec: FeatureSpec,

@@ -1,0 +1,122 @@
+"""Imported classify/predict over VarLen (sparse) Example features — the
+reference parses them in-graph into SparseTensors; the common export
+densifies immediately (tf.sparse.to_dense). The import recognizes that
+wiring, host-decodes the VarLen feature into the identical padded dense
+view (width = batch max, matching SparseToDense), and bypasses the
+sparse trio. Cross-validated against TF's own Session."""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from min_tfs_client_tpu.servables.graphdef_import import load_saved_model
+from min_tfs_client_tpu.tensor.example_codec import (
+    decode_examples,
+    example_from_dict,
+)
+
+EXPORT_SCRIPT = """
+import sys
+import numpy as np
+import tensorflow as tf
+
+tf1 = tf.compat.v1
+tf1.disable_eager_execution()
+
+export_dir, examples_path, out_path = sys.argv[1:4]
+payloads = np.load(examples_path, allow_pickle=True)
+
+g = tf1.Graph()
+with g.as_default():
+    serialized = tf1.placeholder(tf.string, [None],
+                                 name="input_example_tensor")
+    features = tf1.io.parse_example(serialized, {
+        "ids": tf1.io.VarLenFeature(tf.int64),
+        "bias": tf1.io.FixedLenFeature([], tf.float32,
+                                       default_value=0.5),
+    })
+    dense_ids = tf.sparse.to_dense(features["ids"], default_value=-1)
+    # Compute over the padded view: count of non-pad entries plus the sum
+    # of ids — sensitive to both values and the padded width semantics.
+    valid = tf.cast(tf.not_equal(dense_ids, -1), tf.float32)
+    score = (tf.reduce_sum(tf.cast(dense_ids, tf.float32) * valid, axis=1)
+             + tf.reduce_sum(valid, axis=1) + features["bias"])
+    outputs = tf.stack([score, -score], axis=1, name="scores_pair")
+    sig = tf1.saved_model.predict_signature_def(
+        inputs={"examples": serialized}, outputs={"scores": outputs})
+    builder = tf1.saved_model.Builder(export_dir)
+    with tf1.Session() as sess:
+        builder.add_meta_graph_and_variables(
+            sess, [tf1.saved_model.SERVING],
+            signature_def_map={"serving_default": sig})
+        builder.save()
+        got = sess.run(outputs, {serialized: list(payloads)})
+np.savez(out_path, scores=got)
+print("SAVED")
+"""
+
+
+def _run_tf(script, *args):
+    return subprocess.run(
+        [sys.executable, "-c", script, *args], capture_output=True,
+        text=True, timeout=300,
+        env={"PATH": "/usr/bin:/bin:/usr/local/bin",
+             "CUDA_VISIBLE_DEVICES": "-1", "JAX_PLATFORMS": "cpu",
+             "TF_CPP_MIN_LOG_LEVEL": "3", "HOME": "/root"})
+
+
+FEATURES = [
+    {"ids": np.array([3, 5, 8], np.int64), "bias": 1.0},
+    {"ids": np.array([2], np.int64)},              # default bias
+    {"ids": np.array([], np.int64), "bias": -2.0},  # empty VarLen row
+    {"ids": np.array([1, 1, 1, 1, 9], np.int64)},
+]
+
+
+@pytest.fixture(scope="module")
+def exported(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("varlen_export")
+    payloads = np.array(
+        [example_from_dict(d).SerializeToString() for d in FEATURES],
+        dtype=object)
+    ex_path = tmp / "examples.npy"
+    np.save(ex_path, payloads, allow_pickle=True)
+    version_dir = tmp / "model" / "1"
+    out_path = tmp / "tf_out.npz"
+    proc = _run_tf(EXPORT_SCRIPT, str(version_dir), str(ex_path),
+                   str(out_path))
+    if "SAVED" not in proc.stdout:
+        pytest.skip(f"tensorflow unavailable: {proc.stderr[-500:]}")
+    return version_dir, np.load(out_path, allow_pickle=True)
+
+
+@pytest.mark.integration
+def test_varlen_feature_specs_synthesized(exported):
+    version_dir, _ = exported
+    servable = load_saved_model(str(version_dir), "vl", 1)
+    sig = servable.signature("")
+    assert sig.feature_specs is not None
+    ids = sig.feature_specs["ids"]
+    assert ids.var_len and ids.dtype == np.int64 and ids.default == -1
+    assert not sig.feature_specs["bias"].var_len
+
+
+@pytest.mark.integration
+def test_varlen_outputs_match_tf(exported):
+    version_dir, want = exported
+    servable = load_saved_model(str(version_dir), "vl", 1)
+    sig = servable.signature("")
+    examples = [example_from_dict(d) for d in FEATURES]
+    features = decode_examples(examples, sig.feature_specs)
+    # The decoded dense view matches SparseToDense's exactly.
+    np.testing.assert_array_equal(
+        features["ids"],
+        [[3, 5, 8, -1, -1], [2, -1, -1, -1, -1],
+         [-1, -1, -1, -1, -1], [1, 1, 1, 1, 9]])
+    out = sig.run(features)
+    np.testing.assert_allclose(out["scores"], want["scores"],
+                               rtol=1e-5, atol=1e-6)

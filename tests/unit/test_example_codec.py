@@ -78,3 +78,54 @@ def test_decode_bytes_feature():
 def test_empty_input_rejected():
     with pytest.raises(ExampleDecodeError):
         flatten_input(apis.Input())
+
+
+class TestVarLenDecode:
+    def test_pads_to_batch_max_with_default(self):
+        from min_tfs_client_tpu.tensor.example_codec import (
+            FeatureSpec,
+            decode_examples,
+            example_from_dict,
+        )
+
+        examples = [example_from_dict({"ids": np.array([7, 8], np.int64)}),
+                    example_from_dict({}),
+                    example_from_dict({"ids": np.array([1], np.int64)})]
+        out = decode_examples(
+            examples, {"ids": FeatureSpec(np.int64, default=-1,
+                                          var_len=True)})
+        np.testing.assert_array_equal(
+            out["ids"], [[7, 8], [-1, -1], [1, -1]])
+
+    def test_all_empty_batch_is_zero_width(self):
+        from min_tfs_client_tpu.tensor.example_codec import (
+            FeatureSpec,
+            decode_examples,
+            example_from_dict,
+        )
+
+        out = decode_examples(
+            [example_from_dict({})],
+            {"v": FeatureSpec(np.float32, default=0.0, var_len=True)})
+        assert out["v"].shape == (1, 0)
+
+    def test_var_len_requires_pad_default(self):
+        from min_tfs_client_tpu.tensor.example_codec import FeatureSpec
+
+        with pytest.raises(ValueError, match="pad default"):
+            FeatureSpec(np.int64, var_len=True)
+
+    def test_var_len_bytes(self):
+        from min_tfs_client_tpu.tensor.example_codec import (
+            FeatureSpec,
+            decode_examples,
+            example_from_dict,
+        )
+
+        examples = [example_from_dict({"t": [b"a", b"bb"]}),
+                    example_from_dict({"t": [b"c"]})]
+        out = decode_examples(
+            examples, {"t": FeatureSpec(object, default=b"",
+                                        var_len=True)})
+        np.testing.assert_array_equal(
+            out["t"], np.array([[b"a", b"bb"], [b"c", b""]], object))

@@ -55,6 +55,7 @@ def _v1_graph(*, n_sparse=0, shapes=((3,), ()), defaults=(None, 0.25),
     for i in range(n_sparse):
         _const(gd, f"sk{i}", np.asarray(b"s%d" % i, object))
         node.input.append(f"sk{i}")
+        node.attr["sparse_types"].list.type.append(DT_INT64)
     for i, key in enumerate(keys):
         _const(gd, f"dk{i}", np.asarray(key.encode(), object))
         node.input.append(f"dk{i}")
@@ -184,3 +185,49 @@ def test_reshaped_default_folded():
             node.input[-1] = "dd1r:0"
     bp = example_parse.find_parse_bypass(gd, "serialized:0")
     np.testing.assert_allclose(np.asarray(bp.specs["bias"].default), [0.5])
+
+
+def _v1_sparse_to_dense_graph():
+    gd = _v1_graph(n_sparse=1)
+    _const(gd, "std_default", np.asarray(-1, np.int64))
+    std = gd.node.add()
+    std.name = "densify"
+    std.op = "SparseToDense"
+    std.input.extend(["parse:0", "parse:2", "parse:1", "std_default"])
+    return gd
+
+
+def test_v1_sparse_to_dense_bypass():
+    # With Nsparse=1 the outputs are indices:0, values:1, shape:2 and the
+    # dense outputs start at 3.
+    gd = _v1_sparse_to_dense_graph()
+    bp = example_parse.find_parse_bypass(gd, "serialized:0")
+    assert bp.feature_order == ["x", "bias", "s0"]
+    assert bp.dense_refs == ["parse:3", "parse:4", "densify:0"]
+    spec = bp.specs["s0"]
+    assert spec.var_len and spec.dtype == np.int64 and spec.default == -1
+    assert bp.shapes["s0"] == (None,)
+
+
+def test_v1_sparse_without_densify_rejected():
+    gd = _v1_graph(n_sparse=1)
+    with pytest.raises(example_parse.ParseSynthesisError,
+                       match="SparseToDense"):
+        example_parse.find_parse_bypass(gd, "serialized:0")
+
+
+def test_v1_sparse_with_second_consumer_rejected():
+    gd = _v1_sparse_to_dense_graph()
+    extra = gd.node.add()
+    extra.name = "also_reads_values"
+    extra.op = "Identity"
+    extra.input.append("parse:1")
+    # The Identity itself is transparent, but a real second consumer of
+    # the VALUES breaks the mirror:
+    shp = gd.node.add()
+    shp.name = "consumer2"
+    shp.op = "Shape"
+    shp.input.append("also_reads_values")
+    with pytest.raises(example_parse.ParseSynthesisError,
+                       match="exactly one"):
+        example_parse.find_parse_bypass(gd, "serialized:0")

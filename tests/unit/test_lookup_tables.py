@@ -214,3 +214,31 @@ def test_topk_ties_break_by_lowest_index():
     vals, idx = fn([x], np)
     np.testing.assert_array_equal(vals, [[3.0, 3.0]])
     np.testing.assert_array_equal(idx, [[1, 2]])
+
+
+def test_topk_uint64_exact_above_2_53():
+    gd = tf_graph_pb2.GraphDef()
+    ph = gd.node.add()
+    ph.name = "x"
+    ph.op = "Placeholder"
+    ph.attr["dtype"].type = 23  # uint64
+    _const(gd, "k", np.asarray(1, np.int32))
+    top = gd.node.add()
+    top.name = "top"
+    top.op = "TopKV2"
+    top.input.extend(["x", "k"])
+    fn = GraphFunction(gd, ["x:0"], ["top:0", "top:1"])
+    # Differ only in the low bit above 2^53: a float64 key would tie.
+    x = np.array([[2 ** 60, 2 ** 60 + 1]], np.uint64)
+    vals, idx = fn([x], np)
+    np.testing.assert_array_equal(vals, [[2 ** 60 + 1]])
+    np.testing.assert_array_equal(idx, [[1]])
+
+
+def test_empty_key_lookup_keeps_value_dtype():
+    from min_tfs_client_tpu.servables.graphdef_import import LookupTable
+
+    table = LookupTable([b"a"], [7], value_is_string=False)
+    out = table.find(np.array([], object), np.int64(-1))
+    assert out.shape == (0,)
+    assert out.dtype.kind in "i", out.dtype
