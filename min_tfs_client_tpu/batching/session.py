@@ -71,7 +71,10 @@ def apply_batch_buckets(servable, params: BatchingParameters | dict) -> dict:
     if isinstance(params, BatchingParameters):
         params = params_from_proto(params)
     for signature in servable.signatures.values():
-        if signature.batched and not signature.on_host:
+        if signature.batched and (not signature.on_host
+                                  or signature.partition is not None):
+            # Host signatures with a partitioned device interior bucket
+            # their interior jit cache on the allowed sizes too.
             signature.batch_buckets = resolve_allowed_batch_sizes(
                 signature, params)
     return params
@@ -155,7 +158,7 @@ class BatchedSignatureRunner:
     # -- caller side ---------------------------------------------------------
 
     def run(self, inputs, output_filter=()) -> dict[str, np.ndarray]:
-        if not self.signature.batched or self.signature.on_host:
+        if not self.signature.batched:
             return self._inner_run(inputs, output_filter)
         # Reject bad requests BEFORE they join a batch: a malformed request
         # must fail alone with INVALID_ARGUMENT, never its batch-mates.
@@ -254,6 +257,17 @@ class BatchedSignatureRunner:
         except Exception:  # pragma: no cover - metrics must not break serving
             pass
 
+        # Outputs must be batch-major to split back to callers — the
+        # reference's batching_session errors on a mismatched 0th dim
+        # rather than handing each caller an arbitrary slice (imported
+        # host graphs can emit batch-free outputs, e.g. a vocab tensor).
+        for k, v in outputs.items():
+            if np.ndim(v) == 0 or np.shape(v)[0] != total:
+                raise ServingError.internal(
+                    f"batched output {k!r} has leading dim "
+                    f"{np.shape(v)[0] if np.ndim(v) else 'scalar'}, "
+                    f"expected the merged batch {total}; this signature "
+                    "cannot be served through the batching front-end")
         offset = 0
         for task, size in zip(batch, sizes):
             task.outputs = {k: v[offset:offset + size]
@@ -274,8 +288,12 @@ def maybe_wrap_servable(servable, params: BatchingParameters | dict | None,
     if isinstance(params, BatchingParameters):
         params = params_from_proto(params)
     scheduler = scheduler or _default_scheduler()
+    # Batching is signature-level in the reference, not device-conditional
+    # (batching_session.h:47-99): host signatures coalesce too — merge ->
+    # run ONCE -> split amortizes the per-request Python, and a
+    # partitioned import additionally amortizes its interior dispatch.
     for key, signature in servable.signatures.items():
-        if not signature.batched or signature.on_host:
+        if not signature.batched:
             continue
         runner = BatchedSignatureRunner(
             signature, scheduler,

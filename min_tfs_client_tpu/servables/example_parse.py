@@ -12,15 +12,16 @@ for IMPORTED SavedModels this module recovers the same specs from the
 the node: the signature feeds the node's dense output tensors, everything
 upstream of them (the string placeholder, the parse op) never executes.
 
-Scope: FixedLen dense features only (float32 / int64 / bytes), matching
-what the host decoder implements. Sparse and ragged outputs are rejected
-with a clear error — VarLen features batch as dynamically-shaped sparse
-tensors, which the static-shape device path does not serve.
+Scope: FixedLen dense features (float32 / int64 / bytes) plus VarLen
+(sparse) features two ways — the SparseToDense dense view when the graph
+densifies immediately, or the TF-exact sparse triple (indices/values/
+shape slots fed directly) for graphs that consume the SparseTensor
+itself, e.g. estimator feature columns. Ragged outputs are rejected.
 """
 
 from __future__ import annotations
 
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 
 import numpy as np
 
@@ -49,6 +50,10 @@ class ParseBypass:
     specs: dict[str, FeatureSpec]  # keyed by feature name
     dtype_enums: dict[str, int]    # feature -> DT_* enum (for TensorSpec)
     shapes: dict[str, tuple[int, ...]]
+    # Aliases whose TensorSpec shape is NOT (batch, *per_example_shape):
+    # the sparse-triple pseudo-aliases ('f#indices' [None, 2],
+    # 'f#shape' [2]) carry their full shape here.
+    raw_shapes: dict[str, tuple] = field(default_factory=dict)
 
 
 def _tensor_name(ref: str) -> tuple[str, int]:
@@ -90,6 +95,18 @@ def _const_ndarray(nodes: dict, ref: str, what: str,
         return value.reshape(tuple(int(d) for d in shape.reshape(-1)))
     if node.op in ("ExpandDims", "Squeeze"):
         return _const_ndarray(nodes, node.input[0], what, _depth + 1)
+    if node.op == "Cast":
+        # vocabulary_list tables route their values through Cast(Range).
+        from min_tfs_client_tpu.tensor.dtypes import DataType
+
+        value = _const_ndarray(nodes, node.input[0], what, _depth + 1)
+        dst = node.attr["DstT"].type
+        return value.astype(DataType(int(dst)).numpy_dtype)
+    if node.op == "Range":
+        start, limit, delta = (
+            _const_ndarray(nodes, node.input[i], what, _depth + 1)
+            for i in range(3))
+        return np.arange(start.item(), limit.item(), delta.item())
     raise ParseSynthesisError(
         f"{what} (tensor {ref!r}) is produced by {node.op!r}, not a "
         "Const; cannot synthesize a host parse spec")
@@ -210,11 +227,19 @@ def find_parse_bypass(graph_def, serialized_ref: str) -> "ParseBypass | None":
     dense_refs = [f"{consumer.name}:{dense_base + i}"
                   for i in range(n_dense)]
 
-    # Sparse (VarLen) features: servable only through the common
-    # SparseToDense pattern — the host decodes the VarLen feature into
-    # the (batch, max-in-batch) dense view padded with the node's
-    # default, and the SparseToDense node itself is bypassed.
+    # Sparse (VarLen) features. Two servable wirings:
+    #  (a) the common SparseToDense pattern — the host decodes the
+    #      VarLen feature into the (batch, max-in-batch) dense view
+    #      padded with the node's default and the SparseToDense node is
+    #      bypassed;
+    #  (b) anything else (estimator feature columns consuming the real
+    #      SparseTensor: embedding_lookup_sparse, indicator columns,
+    #      reference python/ops/embedding_ops.py:373-478) — the host
+    #      decodes the TF-exact sparse triple and feeds the parse
+    #      node's indices/values/shape output slots directly.
+    raw_shapes: dict[str, tuple] = {}
     if n_sparse:
+        DT_INT64 = tf_tensor_pb2.DT_INT64
         sparse_types = list(attrs["sparse_types"].list.type)
         if len(sparse_types) != n_sparse or len(sparse_keys) != n_sparse:
             raise ParseSynthesisError(
@@ -236,11 +261,30 @@ def find_parse_bypass(graph_def, serialized_ref: str) -> "ParseBypass | None":
                     uses.setdefault(slot, {}).setdefault(
                         node.name, {})[pos] = slot[1]
         for i, key in enumerate(sparse_keys):
-            spec, feed_ref = _sparse_to_dense_bypass(
-                nodes, consumer, i, n_sparse, key,
-                int(sparse_types[i]), uses)
+            enum = int(sparse_types[i])
+            np_dtype = _DTYPES.get(enum)
+            if np_dtype is None:
+                raise ParseSynthesisError(
+                    f"sparse feature {key!r}: unsupported dtype enum "
+                    f"{enum}")
+            try:
+                spec, feed_ref = _sparse_to_dense_bypass(
+                    nodes, consumer, i, n_sparse, key, enum, uses)
+            except ParseSynthesisError:
+                specs[key] = FeatureSpec(dtype=np_dtype,
+                                         sparse_triple=True)
+                for suffix, slot, a_enum, a_shape in (
+                        ("indices", i, DT_INT64, (None, 2)),
+                        ("values", n_sparse + i, enum, (None,)),
+                        ("shape", 2 * n_sparse + i, DT_INT64, (2,))):
+                    alias = f"{key}#{suffix}"
+                    feature_order.append(alias)
+                    dense_refs.append(f"{consumer.name}:{slot}")
+                    dtype_enums[alias] = int(a_enum)
+                    raw_shapes[alias] = a_shape
+                continue
             specs[key] = spec
-            dtype_enums[key] = int(sparse_types[i])
+            dtype_enums[key] = enum
             shapes[key] = (None,)
             feature_order.append(key)
             dense_refs.append(feed_ref)
@@ -252,6 +296,7 @@ def find_parse_bypass(graph_def, serialized_ref: str) -> "ParseBypass | None":
         specs=specs,
         dtype_enums=dtype_enums,
         shapes=shapes,
+        raw_shapes=raw_shapes,
     )
 
 

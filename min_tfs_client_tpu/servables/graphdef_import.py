@@ -79,10 +79,23 @@ def _unary(name):
 
 def _matmul(node, inputs, lib):
     a, b = inputs
-    if _attr(node, "transpose_a") and _attr(node, "transpose_a").b:
-        a = lib.swapaxes(a, -1, -2)
-    if _attr(node, "transpose_b") and _attr(node, "transpose_b").b:
-        b = lib.swapaxes(b, -1, -2)
+
+    def flagged(key):
+        attr = _attr(node, key)
+        return attr is not None and attr.b
+
+    # MatMul's transpose_a/b are plain transposes; BatchMatMul*'s
+    # adj_x/y are adjoints (conjugate transpose for complex inputs).
+    def apply(x, transpose_key, adjoint_key):
+        if flagged(transpose_key):
+            return lib.swapaxes(x, -1, -2)
+        if flagged(adjoint_key):
+            x = lib.swapaxes(x, -1, -2)
+            return lib.conjugate(x) if np.iscomplexobj(x) else x
+        return x
+
+    a = apply(a, "transpose_a", "adj_x")
+    b = apply(b, "transpose_b", "adj_y")
     return [lib.matmul(a, b)]
 
 
@@ -349,11 +362,18 @@ def _top_k(node, inputs, lib):
     k = int(np.asarray(k))
     if lib is np:
         xs = np.asarray(x)
-        if xs.dtype.kind == "u":
-            # Negation wraps unsigned. max-x is an exact order-reversing
-            # key in the same dtype (no overflow: result >= 0), and the
-            # stable ASCENDING sort of it keeps lowest-index tie-break.
-            key = (xs.max() if xs.size else xs.dtype.type(0)) - xs
+        if xs.dtype.kind in "iu":
+            # Negation wraps integers (INT_MIN negates to itself, so
+            # argsort(-x) would rank it LARGEST; unsigned wraps all
+            # over). Map to an order-preserving unsigned view (sign-bit
+            # flip for signed), where max-u is an exact order-reversing
+            # key (no overflow: result >= 0) and the stable ASCENDING
+            # sort of it keeps the lowest-index tie-break.
+            u = np.ascontiguousarray(xs).view(
+                np.dtype(f"uint{8 * xs.dtype.itemsize}"))
+            if xs.dtype.kind == "i":
+                u = u ^ u.dtype.type(2 ** (8 * xs.dtype.itemsize - 1))
+            key = (u.max() if u.size else u.dtype.type(0)) - u
             idx = np.argsort(key, axis=-1, kind="stable")[..., :k]
         else:
             idx = np.argsort(-xs, axis=-1, kind="stable")[..., :k]
@@ -366,6 +386,157 @@ def _top_k(node, inputs, lib):
             else idx.astype("int32")]
 
 
+# -- sparse / dynamic-shape host ops (estimator feature columns) -------------
+# These produce data-dependent shapes, so they always evaluate on host
+# (the reference's placer pins them to CPU the same way); the partitioner
+# (servables/partition.py) recovers the dense interior around them.
+# Kernels match: core/kernels/segment_reduction_ops.cc, sparse ops in
+# core/kernels/, string_to_hash_bucket_op.cc, embedding wiring per
+# python/ops/embedding_ops.py:373-478.
+
+
+def _string_to_hash_bucket(node, inputs, lib):
+    from min_tfs_client_tpu.utils.farmhash import string_to_hash_bucket_fast
+
+    num = int(node.attr["num_buckets"].i)
+    return [string_to_hash_bucket_fast(np.asarray(inputs[0]), num)]
+
+
+def _where(node, inputs, lib):
+    return [np.argwhere(np.asarray(inputs[0])).astype(np.int64)]
+
+
+def _unique(node, inputs, lib):
+    """Unique values in FIRST-OCCURRENCE order (TF semantics; np.unique
+    alone sorts, so the result is re-permuted by first index)."""
+    x = np.asarray(inputs[0])
+    a = _attr(node, "out_idx")
+    idx_dtype = (DataType(int(a.type)).numpy_dtype if a is not None
+                 and a.type else np.int32)
+    _, first, inv = np.unique(x, return_index=True, return_inverse=True)
+    order = np.argsort(first, kind="stable")
+    y = x[first[order]]
+    rank = np.empty(order.size, dtype=np.int64)
+    rank[order] = np.arange(order.size)
+    return [y, rank[inv].astype(idx_dtype)]
+
+
+def _sparse_fill_empty_rows(node, inputs, lib):
+    """-> (output_indices, output_values, empty_row_indicator,
+    reverse_index_map). Rows of the dense shape with no entry get one
+    default entry at column 0; output stays row-major; reverse map gives
+    each ORIGINAL value's position in the output."""
+    indices = np.asarray(inputs[0], dtype=np.int64)
+    values = np.asarray(inputs[1])
+    dense_shape = np.asarray(inputs[2], dtype=np.int64).reshape(-1)
+    default = np.asarray(inputs[3]).reshape(-1)[:1]
+    rank = dense_shape.size
+    indices = indices.reshape(-1, rank)
+    nrows = int(dense_shape[0]) if rank else 0
+    rows = indices[:, 0] if indices.size else np.zeros(0, np.int64)
+    counts = np.bincount(rows, minlength=nrows) if nrows else \
+        np.zeros(0, np.int64)
+    empty = counts == 0
+    out_counts = np.where(empty, 1, counts)
+    row_start = np.zeros(nrows, dtype=np.int64)
+    if nrows:
+        np.cumsum(out_counts[:-1], out=row_start[1:])
+    n_out = int(out_counts.sum())
+    out_indices = np.zeros((n_out, rank), dtype=np.int64)
+    if values.dtype == object:
+        out_values = np.full(n_out, default[0] if default.size else b"",
+                             dtype=object)
+    else:
+        out_values = np.full(n_out, default[0] if default.size else 0,
+                             dtype=values.dtype)
+    empty_rows = np.nonzero(empty)[0]
+    out_indices[row_start[empty_rows], 0] = empty_rows
+    # Originals: stable row sort, then contiguous placement per row.
+    order = np.argsort(rows, kind="stable")
+    srows = rows[order]
+    starts_sorted = np.zeros(nrows, dtype=np.int64)
+    if nrows:
+        np.cumsum(counts[:-1], out=starts_sorted[1:])
+    pos = (row_start[srows]
+           + (np.arange(srows.size, dtype=np.int64) - starts_sorted[srows]))
+    out_indices[pos] = indices[order]
+    out_values[pos] = values[order]
+    reverse = np.empty(rows.size, dtype=np.int64)
+    reverse[order] = pos
+    return [out_indices, out_values, empty.astype(bool), reverse]
+
+
+def _sparse_reshape(node, inputs, lib):
+    indices = np.asarray(inputs[0], dtype=np.int64)
+    in_shape = np.asarray(inputs[1], dtype=np.int64).reshape(-1)
+    new_shape = np.asarray(inputs[2], dtype=np.int64).reshape(-1).copy()
+    total = int(np.prod(in_shape)) if in_shape.size else 0
+    if (new_shape == -1).any():
+        known = int(np.prod(new_shape[new_shape != -1]))
+        new_shape[new_shape == -1] = total // max(known, 1)
+    indices = indices.reshape(-1, in_shape.size)
+    if indices.shape[0] == 0:
+        out = np.zeros((0, new_shape.size), np.int64)
+    else:
+        linear = np.ravel_multi_index(
+            tuple(indices.T), tuple(int(d) for d in in_shape))
+        out = np.stack(np.unravel_index(
+            linear, tuple(int(d) for d in new_shape)), axis=1)
+    return [out.astype(np.int64), new_shape]
+
+
+def _sparse_segment(combiner):
+    def impl(node, inputs, lib):
+        data = np.asarray(inputs[0])
+        idx = np.asarray(inputs[1], dtype=np.int64).reshape(-1)
+        seg = np.asarray(inputs[2], dtype=np.int64).reshape(-1)
+        nseg = int(seg[-1]) + 1 if seg.size else 0
+        out = np.zeros((nseg,) + data.shape[1:], dtype=data.dtype)
+        np.add.at(out, seg, data[idx])
+        if combiner != "sum" and nseg:
+            counts = np.bincount(seg, minlength=nseg).astype(data.dtype)
+            counts = counts.reshape((-1,) + (1,) * (data.ndim - 1))
+            div = counts if combiner == "mean" else np.sqrt(counts)
+            out = np.where(counts > 0, out / np.maximum(div, 1), 0)
+        return [out.astype(data.dtype, copy=False)]
+    return impl
+
+
+def _segment_reduce(combiner):
+    def impl(node, inputs, lib):
+        data = np.asarray(inputs[0])
+        seg = np.asarray(inputs[1], dtype=np.int64).reshape(-1)
+        nseg = int(seg[-1]) + 1 if seg.size else 0
+        out = np.zeros((nseg,) + data.shape[1:], dtype=data.dtype)
+        np.add.at(out, seg, data)
+        if combiner == "mean" and nseg:
+            counts = np.bincount(seg, minlength=nseg).astype(data.dtype)
+            counts = counts.reshape((-1,) + (1,) * (data.ndim - 1))
+            out = np.where(counts > 0, out / np.maximum(counts, 1), 0)
+        return [out.astype(data.dtype, copy=False)]
+    return impl
+
+
+def _sparse_to_dense(node, inputs, lib):
+    indices = np.asarray(inputs[0], dtype=np.int64)
+    shape = tuple(int(d) for d in
+                  np.asarray(inputs[1], dtype=np.int64).reshape(-1))
+    values = np.asarray(inputs[2])
+    default = np.asarray(inputs[3]).reshape(-1)
+    fill = default[0] if default.size else 0
+    if values.dtype == object:
+        out = np.full(shape, fill, dtype=object)
+    else:
+        out = np.full(shape, fill, dtype=values.dtype)
+    if indices.size:
+        if indices.ndim == 1 and len(shape) == 1:
+            out[indices] = values
+        else:
+            out[tuple(indices.reshape(-1, len(shape)).T)] = \
+                values.reshape(-1)
+    return [out]
+
+
 # -- lookup tables (host-side; classify exports map ids -> string labels) ----
 
 
@@ -375,15 +546,54 @@ class LookupTable:
     keys/values, or InitializeTableFromTextFileV2 with an asset file).
     The reference runs these ops inside the Session (main_op =
     tables_initializer); XLA has no hash tables, so lookups execute on
-    the host — any signature that touches one serves on_host."""
+    the host — any signature that touches one serves on_host.
+
+    find() is vectorized: binary search (np.searchsorted) over sorted
+    key arrays, so a vocab lookup at batch x seq scale is a few C passes
+    rather than a Python dict probe per element. Bytes keys sort in an
+    'S' array when exact (S-dtype pads with NULs, so keys with trailing
+    \\x00 fall back to an object array with byte-exact comparisons)."""
 
     def __init__(self, keys, values, value_is_string: bool):
-        self.mapping = dict(zip(keys, values))
+        keys = [self._norm_key(k) for k in keys]
         self.value_is_string = value_is_string
+        self.key_is_string = bool(keys) and isinstance(keys[0], bytes)
         # Numeric value dtype for empty lookups (np.asarray([]) would
         # default to float64) and exact output typing.
         self.value_dtype = (None if value_is_string
                             else np.asarray(list(values) or [0]).dtype)
+        if value_is_string:
+            val_arr = np.array([self._norm_key(v) for v in values],
+                               dtype=object)
+        else:
+            val_arr = np.asarray(list(values), dtype=self.value_dtype)
+        if self.key_is_string:
+            self._exact_s = not any(k.endswith(b"\x00") for k in keys)
+            key_arr = (np.array(keys, dtype="S") if self._exact_s and keys
+                       else np.array(keys, dtype=object))
+        else:
+            self._exact_s = True
+            key_arr = np.asarray(keys, dtype=np.int64)
+        # Sort; for duplicate keys the LAST import wins (dict(zip(...))
+        # semantics): the stable sort keeps insertion order within a run
+        # of equal keys, so dropping all but the run's last entry is it.
+        order = np.argsort(key_arr, kind="stable")
+        sk, sv = key_arr[order], val_arr[order]
+        if sk.size:
+            keep = np.ones(len(sk), dtype=bool)
+            keep[:-1] = sk[:-1] != sk[1:]
+            sk, sv = sk[keep], sv[keep]
+        self._sorted_keys = sk
+        self._sorted_values = sv
+        self.size = int(sk.size)
+
+    @property
+    def mapping(self) -> dict:
+        """Introspection/debug view (not used by find)."""
+        return dict(zip(
+            (bytes(k) for k in self._sorted_keys.tolist())
+            if self.key_is_string else self._sorted_keys.tolist(),
+            self._sorted_values.tolist()))
 
     @staticmethod
     def _norm_key(k):
@@ -393,18 +603,71 @@ class LookupTable:
             return str(k).encode()
         return int(k)
 
+    def _norm_query(self, flat: np.ndarray
+                    ) -> tuple[np.ndarray, np.ndarray | None]:
+        """Vectorized query normalization to the key array's domain.
+        Returns (array, forced-miss mask or None): S-dtype storage strips
+        a query's TRAILING NULs, so such queries — which can never equal
+        the NUL-free keys of an _exact_s table byte-exactly — are marked
+        as guaranteed misses instead of false-matching the stripped key."""
+        if not self.key_is_string:
+            return np.asarray(
+                [int(v) for v in flat.tolist()] if flat.dtype.kind == "O"
+                else flat, dtype=np.int64), None
+        if flat.dtype.kind == "U":
+            # U storage is NUL-padded like S: a trailing NUL was already
+            # lost when the caller built the array, so no detection here.
+            arr = np.char.encode(flat, "utf-8") if flat.size \
+                else flat.astype("S")
+            return (arr.astype(object) if not self._exact_s else arr), None
+        if not self._exact_s:
+            # Object-keyed table (keys with trailing NULs): keep queries
+            # byte-exact — an S round-trip would strip query NULs.
+            return np.array([self._norm_key(k) for k in flat.tolist()],
+                            dtype=object), None
+        if flat.dtype.kind == "S":
+            return flat, None  # trailing NULs already lost at creation
+        # Object arrays: astype('S') is a C pass for bytes elements
+        # (raises for non-ascii str), else normalize per element. The
+        # round-trip check loops only over anomalous entries (str
+        # elements compare unequal to bytes; trailing-NUL bytes shrink).
+        normed = None
+        try:
+            arr = flat.astype("S")
+        except (UnicodeEncodeError, SystemError, ValueError):
+            normed = [self._norm_key(k) for k in flat.tolist()]
+            arr = np.array(normed, dtype="S")
+        restored = arr.astype(object)
+        miss = np.zeros(flat.shape, dtype=bool)
+        if normed is None:
+            for i in np.nonzero(restored != flat)[0]:
+                if self._norm_key(flat[i]) != restored[i]:
+                    miss[i] = True
+        else:
+            for i, n in enumerate(normed):
+                if n != restored[i]:
+                    miss[i] = True
+        return arr, (miss if miss.any() else None)
+
     def find(self, keys, default) -> np.ndarray:
         keys = np.asarray(keys)
         default = np.asarray(default).reshape(-1)[0]
         if self.value_is_string:
             default = self._norm_key(default)
-        flat = [self.mapping.get(self._norm_key(k), default)
-                for k in keys.reshape(-1).tolist()]
-        if self.value_is_string:
-            out = np.array(flat, dtype=object)
-        else:
-            out = np.asarray(flat, dtype=self.value_dtype)
-        return out.reshape(keys.shape)
+        flat, forced_miss = self._norm_query(keys.reshape(-1))
+        out_dtype = object if self.value_is_string else self.value_dtype
+        if self._sorted_keys.size == 0 or flat.size == 0:
+            out = np.full(flat.shape, default, dtype=out_dtype)
+            return out.reshape(keys.shape)
+        idx = np.searchsorted(self._sorted_keys, flat)
+        idx_c = np.minimum(idx, self._sorted_keys.size - 1)
+        hit = self._sorted_keys[idx_c] == flat
+        if forced_miss is not None:
+            hit &= ~forced_miss
+        out = np.where(hit, self._sorted_values[idx_c],
+                       np.asarray(default, dtype=out_dtype)
+                       if out_dtype is not object else default)
+        return out.astype(out_dtype, copy=False).reshape(keys.shape)
 
 
 def _table_find(node, inputs, lib):
@@ -497,6 +760,15 @@ def build_tables(graph_def, asset_dir=None) -> dict[str, object]:
                         "found (also tried the SavedModel assets dir)")
                 # Op defaults (strip_default_attrs may omit them):
                 # key_index=-2, value_index=-1, vocab_size=-1, delim \t.
+                offset = int_attr(node, "offset", 0)
+                if offset:
+                    # Newer-TF exporters can skip a file prefix; silently
+                    # ignoring it would shift the whole vocab. Fail loudly
+                    # (raised only if a signature reaches this table).
+                    raise GraphImportError(
+                        f"{node.name}: InitializeTableFromTextFileV2 "
+                        f"offset={offset} is not supported; the vocab "
+                        "mapping would be shifted")
                 key_index = int_attr(node, "key_index", -2)
                 value_index = int_attr(node, "value_index", -1)
                 vocab_size = int_attr(node, "vocab_size", -1)
@@ -608,6 +880,11 @@ OPS: dict[str, Callable] = {
     "Slice": _slice_op,
     "Gather": lambda n, i, lib: [lib.take(i[0], lib.asarray(i[1]), axis=0)],
     "GatherV2": _gather_v2,
+    # Resource-variable gather (TF2-compat exports): the variable handle
+    # resolves to its checkpoint tensor during _scan, so this is a plain
+    # axis-0 take of the resolved value.
+    "ResourceGather": lambda n, i, lib: [
+        lib.take(i[0], lib.asarray(i[1]), axis=0)],
     "Shape": lambda n, i, lib: [np.asarray(np.shape(i[0]), np.int32)],
     "Size": lambda n, i, lib: [np.asarray(np.size(i[0]), np.int32)],
     "Rank": lambda n, i, lib: [np.asarray(np.ndim(i[0]), np.int32)],
@@ -645,9 +922,20 @@ OPS: dict[str, Callable] = {
     "LeakyRelu": _leaky_relu,
     "LogSoftmax": _log_softmax,
     "TopKV2": _top_k,
+    # sparse / string / dynamic-shape host family (estimator exports)
+    "StringToHashBucketFast": _string_to_hash_bucket,
+    "Where": _where,
+    "Unique": _unique,
+    "SparseFillEmptyRows": _sparse_fill_empty_rows,
+    "SparseReshape": _sparse_reshape,
+    "SparseSegmentSum": _sparse_segment("sum"),
+    "SparseSegmentMean": _sparse_segment("mean"),
+    "SparseSegmentSqrtN": _sparse_segment("sqrtn"),
+    "SegmentSum": _segment_reduce("sum"),
+    "SegmentMean": _segment_reduce("mean"),
+    "SparseToDense": _sparse_to_dense,
     "LookupTableFindV2": _table_find,
-    "LookupTableSizeV2": lambda n, i, lib: [
-        np.int64(len(i[0].mapping))],
+    "LookupTableSizeV2": lambda n, i, lib: [np.int64(i[0].size)],
     "ClipByValue": lambda n, i, lib: [lib.clip(i[0], i[1], i[2])],
     "AddN": lambda n, i, lib: [sum(i[1:], start=i[0])],
     "Reciprocal": lambda n, i, lib: [1 / i[0]],
@@ -663,6 +951,15 @@ OPS: dict[str, Callable] = {
 
 _VARIABLE_OPS = ("VariableV2", "Variable", "VarHandleOp")
 _CKPT_VALUE_SUFFIX = "/.ATTRIBUTES/VARIABLE_VALUE"
+
+# Data-dependent output shapes (or host-only kernels): any signature
+# reaching one evaluates on the host path — XLA needs static shapes —
+# and the partitioner then recovers the dense interior around them.
+_DYNAMIC_HOST_OPS = frozenset({
+    "StringToHashBucketFast", "Where", "Unique", "SparseFillEmptyRows",
+    "SparseReshape", "SparseSegmentSum", "SparseSegmentMean",
+    "SparseSegmentSqrtN", "SegmentSum", "SegmentMean", "SparseToDense",
+})
 
 # TF2 function-calling graphs (loader.cc:166-324 loads these through the
 # FunctionLibraryRuntime; here the FunctionDefLibrary is interpreted
@@ -681,6 +978,10 @@ _OP_OUTPUT_ARGS: dict[str, tuple[str, ...]] = {
     "Split": ("output",),
     "SplitV": ("output",),
     "Unpack": ("output",),
+    "Unique": ("y", "idx"),
+    "SparseFillEmptyRows": ("output_indices", "output_values",
+                            "empty_row_indicator", "reverse_index_map"),
+    "SparseReshape": ("output_indices", "output_shape"),
     "FusedBatchNorm": ("y", "batch_mean", "batch_variance",
                        "reserve_space_1", "reserve_space_2"),
     "FusedBatchNormV2": ("y", "batch_mean", "batch_variance",
@@ -795,6 +1096,9 @@ class _FunctionEvaluator:
                     a = _attr(node, key)
                     if a is not None and a.type == DT_STRING:
                         self.has_string = True
+                if node.op in _DYNAMIC_HOST_OPS or node.op in (
+                        "LookupTableFindV2", "LookupTableSizeV2"):
+                    self.has_string = True
                 if node.op == "Const":
                     self._consts[node.name] = tensor_proto_to_ndarray(
                         node.attr["value"].tensor)
@@ -918,8 +1222,26 @@ def _scan_node_functions(node, funclib: _FuncLib):
     return None
 
 
+_STATIC_TYPES = (np.ndarray, np.generic, int, float, bool, bytes,
+                 LookupTable)
+
+
+def _all_static(args) -> bool:
+    """True when every arg is host data (no jax array/tracer)."""
+    return all(isinstance(a, _STATIC_TYPES) for a in args)
+
+
 def _dispatch(node, args, lib, funclib) -> list[object]:
-    """Shared op dispatch for graph- and function-body evaluation."""
+    """Shared op dispatch for graph- and function-body evaluation.
+
+    Const folding: on the device path, a node whose inputs are ALL
+    static host values evaluates with numpy so its result stays static.
+    Shape-math subgraphs (Pack(Shape slice, const) -> Reshape target)
+    need this — the op impls read shape operands as Python ints, which
+    a traced constant cannot provide, and XLA wants static shapes
+    anyway."""
+    if lib is not np and _all_static(args):
+        lib = np
     op = node.op
     if op in _FUNCTION_CALL_OPS:
         return funclib.call(_func_attr_name(node, "f"), args, lib)
@@ -1021,6 +1343,8 @@ class GraphFunction:
                 continue  # leaf: materialized at import
             if node.op in ("LookupTableFindV2", "LookupTableSizeV2"):
                 has_string = True  # lookups execute host-side
+            if node.op in _DYNAMIC_HOST_OPS:
+                has_string = True  # dynamic shapes cannot jit; host path
             if node.op == "Const":
                 self._consts[name] = tensor_proto_to_ndarray(
                     node.attr["value"].tensor)
@@ -1186,6 +1510,7 @@ def load_saved_model(
         # Examples instead (XLA has no string kernels), so recover the
         # parse spec from the node and feed its dense outputs directly.
         feature_specs = None
+        serialized_alias = None
         if (len(in_aliases) == 1
                 and int(sig_def.inputs[in_aliases[0]].dtype) == DT_STRING):
             from min_tfs_client_tpu.servables import example_parse
@@ -1197,6 +1522,11 @@ def load_saved_model(
                     f"signature {key!r}: {exc}") from exc
             if bypass is not None:
                 feature_specs = bypass.specs
+                # Keep the original alias servable via Predict: a
+                # reference-compatible client feeding the serialized-
+                # Example string tensor decodes host-side (predict_util
+                # parity; Signature.validate routes it).
+                serialized_alias = in_aliases[0]
                 in_aliases = list(bypass.feature_order)
                 feed_names = list(bypass.dense_refs)
 
@@ -1212,10 +1542,15 @@ def load_saved_model(
             on_host = True
 
         if feature_specs is not None:
-            # Parse-result tensors: leading batch dim + the FixedLen shape.
+            # Parse-result tensors: leading batch dim + the FixedLen
+            # shape; sparse-triple pseudo-aliases carry their full shape
+            # in raw_shapes (indices [None, 2], shape [2]).
             in_specs = {
-                name: TensorSpec(DataType(bypass.dtype_enums[name]),
-                                 (None, *bypass.shapes[name]))
+                name: TensorSpec(
+                    DataType(bypass.dtype_enums[name]),
+                    bypass.raw_shapes[name]
+                    if name in bypass.raw_shapes
+                    else (None, *bypass.shapes[name]))
                 for name in in_aliases}
         else:
             in_specs = {a: _spec_from_tensor_info(sig_def.inputs[a])
@@ -1225,6 +1560,25 @@ def load_saved_model(
         # Batched iff every input has a polymorphic leading dim.
         batched = bool(in_specs) and all(
             spec.shape and spec.shape[0] is None for spec in in_specs.values())
+
+        # String/table signatures: try the placer-style split (host pre ->
+        # jitted dense interior -> host post; servables/partition.py). The
+        # signature stays on_host at the Signature level (its fn is not
+        # wholesale-jittable), but the MXU work inside runs on device —
+        # the reference's CPU-string/device-dense placement
+        # (common_runtime/placer.h:55).
+        partition = None
+        if on_host:
+            from min_tfs_client_tpu.servables import partition as part_mod
+
+            string_feeds = frozenset(
+                feed_names[i]
+                for i, a in enumerate(in_aliases)
+                if in_specs[a].dtype.is_string)
+            partition = part_mod.try_partition(
+                meta_graph.graph_def, feed_names, fetch_names,
+                variables=variables, funclib=funclib, tables=tables,
+                string_feed_refs=string_feeds)
 
         def make_fn(graph_fn=graph_fn, in_aliases=in_aliases,
                     out_aliases=out_aliases, on_host=on_host):
@@ -1243,17 +1597,42 @@ def load_saved_model(
                 name: spec.default
                 for name, spec in feature_specs.items() if spec.var_len
             } or None
-        signatures[key] = Signature(
+        signatures[key] = sig = Signature(
             fn=make_fn(),
             inputs=in_specs,
             outputs=out_specs,
             method_name=sig_def.method_name or PREDICT_METHOD_NAME_DEFAULT,
             feature_specs=feature_specs,
+            serialized_alias=serialized_alias,
             ragged_pad_values=ragged_pad_values,
             on_host=on_host,
             batched=batched,
             batch_buckets=batch_buckets,
         )
+        if partition is not None:
+            def make_part_fn(partition=partition, sig=sig, host_fn=sig.fn,
+                             in_aliases=in_aliases, out_aliases=out_aliases):
+                from min_tfs_client_tpu.servables.partition import (
+                    PartitionError,
+                )
+
+                def fn(inputs: Mapping[str, object]) -> dict[str, object]:
+                    try:
+                        outs = partition.run(
+                            [inputs[a] for a in in_aliases],
+                            # Late-bound: BatchingParameters may re-bucket
+                            # the signature (apply_batch_buckets).
+                            sig.batch_buckets)
+                    except PartitionError:
+                        # Runtime shape surprises (e.g. a shape operand
+                        # that turns out to be real data): the all-host
+                        # evaluation is always correct.
+                        return host_fn(inputs)
+                    return dict(zip(out_aliases, outs))
+                return fn
+
+            sig.fn = make_part_fn()
+            sig.partition = partition
 
     if not signatures:
         raise ServingError.failed_precondition(

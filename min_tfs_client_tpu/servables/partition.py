@@ -1,0 +1,528 @@
+"""Host/device partitioning of imported GraphDef signatures.
+
+The reference's placer assigns string/table kernels to CPU and the dense
+interior to the accelerator *within one graph*
+(reference tensorflow/core/common_runtime/placer.h:55, placer.cc; the
+classifier runs its compute on the device,
+tensorflow_serving/servables/tensorflow/classifier.h:16-90). The previous
+import was all-or-nothing: one lookup table or bytes feature anywhere put
+the entire signature on numpy. This module re-creates the placer's split
+the TPU way: the signature's node set is partitioned at string/table
+boundaries into
+
+    host-pre  (numpy)  ->  dense interior (ONE jax.jit)  ->  host-post (numpy)
+
+using GraphFunction's interior-feed mechanism for the cut tensors (feeds
+shield everything upstream, exactly like Session::Run feed overrides).
+One device segment runs jitted: nodes group into segments by host/device
+alternation depth and the segment holding the most MXU work wins —
+device-capable ops trapped between host stages (the dynamic-shape gather
+soup inside embedding_lookup_sparse, say) evaluate on host, which is
+always correct. The interior pads its batch to the signature's buckets so
+the jit cache stays bounded (the batching_session.h:66-99 round-up rule).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import numpy as np
+
+from min_tfs_client_tpu.servables.servable import fetch_outputs
+
+# Ops that must run on host regardless of their dtype attrs (string
+# processing, hash tables, Example parsing). Mirrors the kernel classes
+# the reference's placer pins to CPU.
+HOST_ONLY_OPS = frozenset({
+    "LookupTableFindV2", "LookupTableSizeV2", "HashTableV2",
+    "LookupTableImportV2", "InitializeTableV2",
+    "InitializeTableFromTextFileV2",
+    "ParseExample", "ParseExampleV2",
+    "StringToHashBucketFast", "StringToHashBucket",
+    "StringToHashBucketStrong", "AsString", "StringJoin", "StringSplit",
+    "StringLower", "StringUpper", "StringStrip", "Substr", "RegexReplace",
+    "StaticRegexReplace", "DecodeBase64", "EncodeBase64", "StringFormat",
+    "StringLength", "ReduceJoin", "StringToNumber", "DecodeRaw",
+    # Data-dependent output shapes: correct only on host (a jit would
+    # recompile per request shape) — the dynamic soup inside
+    # embedding_lookup_sparse / feature-column blocks.
+    "SparseToDense", "Where", "Unique", "UniqueV2", "SparseFillEmptyRows",
+    "SparseReshape", "SparseSegmentSum", "SparseSegmentMean",
+    "SparseSegmentSqrtN", "SegmentSum", "SegmentMean", "SegmentMax",
+    "DynamicPartition", "DynamicStitch", "ParallelDynamicStitch",
+})
+
+# FLOP-bearing ops: partitioning only pays when the interior holds MXU
+# work; a lookup-only toy graph stays host.
+FLOP_OPS = frozenset({
+    "MatMul", "BatchMatMul", "BatchMatMulV2", "Conv2D",
+    "DepthwiseConv2dNative", "Einsum",
+})
+
+_NEUTRAL_OPS = frozenset({
+    "Const", "Placeholder", "PlaceholderWithDefault", "NoOp",
+    "VariableV2", "Variable", "VarHandleOp",
+})
+
+DT_STRING = 7
+
+# Semantic value-input positions the op registry reads as STATIC Python
+# ints (shape/axis operands). -1 = last value input (ConcatV2's axis).
+# An interior input reaching one of these — directly or through interior
+# shape math — must be a compile-time constant.
+_STATIC_ARG_POS: dict[str, tuple[int, ...]] = {
+    "Reshape": (1,), "ExpandDims": (1,), "Tile": (1,), "Fill": (0,),
+    "Range": (0, 1, 2), "Transpose": (1,), "Slice": (1, 2),
+    "StridedSlice": (1, 2, 3), "Split": (0,), "SplitV": (1, 2),
+    "OneHot": (1,), "ArgMax": (1,), "ArgMin": (1,), "Mean": (1,),
+    "Sum": (1,), "Max": (1,), "Min": (1,), "Prod": (1,),
+    "Pad": (1,), "PadV2": (1,), "TopKV2": (1,), "GatherV2": (2,),
+    "ConcatV2": (-1,),
+}
+
+
+class PartitionError(Exception):
+    """The graph cannot (or should not) be split; caller falls back to
+    all-host evaluation, which is always correct."""
+
+
+def _tensor_name(ref: str) -> tuple[str, int]:
+    if ":" in ref:
+        node, idx = ref.rsplit(":", 1)
+        return node, int(idx)
+    return ref, 0
+
+
+def _attr_has_string(node) -> bool:
+    for a in node.attr.values():
+        if a.type == DT_STRING:
+            return True
+        if a.list.type and DT_STRING in a.list.type:
+            return True
+    return False
+
+
+class GraphPartition:
+    """The three execution stages of one partitioned signature.
+
+    Built by `try_partition`; holds three GraphFunctions over the same
+    GraphDef (shared funclib/tables/variables — GraphFunction decodes
+    only the constants its own cone reaches) plus the cut-tensor refs
+    that carry values between stages.
+    """
+
+    # Value-specialized jit cache bound (one entry per distinct static
+    # shape-operand content — batch buckets in practice).
+    MAX_JIT_SPECIALIZATIONS = 32
+    # A "static" interior input larger than this is real data, not shape
+    # math; specializing on it would recompile per request.
+    MAX_STATIC_ELEMENTS = 64
+
+    def __init__(self, *, pre, interior, post, feed_names, used_feed_idx,
+                 cut_in_refs, interior_out_refs, static_flags, stats):
+        self.pre = pre                       # GraphFunction | None
+        self.interior = interior             # GraphFunction (device, jitted)
+        self.post = post                     # GraphFunction
+        self.feed_names = list(feed_names)
+        # Indices of the signature feeds the interior consumes — only
+        # these become jit arguments (string feeds the host stages use
+        # are not valid jax arrays).
+        self.used_feed_idx = list(used_feed_idx)
+        self.cut_in_refs = list(cut_in_refs)
+        self.interior_out_refs = list(interior_out_refs)
+        # Aligned with used_feed_idx + cut_in_refs: True = the value is
+        # consumed as a SHAPE operand inside the interior (Reshape
+        # target, Tile multiples, ...) and must be a compile-time
+        # constant — the jit is specialized per value, LRU-bounded.
+        self.static_flags = list(static_flags)
+        self.stats = dict(stats)             # op-name lists per stage
+        import collections
+
+        self._jit_cache: "collections.OrderedDict[tuple, Callable]" = \
+            collections.OrderedDict()
+
+    def _split_static(self, values: list[np.ndarray]):
+        """-> (dynamic values, static values, hashable static key)."""
+        dyn, stat, key = [], [], []
+        for flag, v in zip(self.static_flags, values):
+            if not flag:
+                dyn.append(v)
+                continue
+            sv = np.asarray(v)
+            if sv.dtype.kind in "OSU" or sv.size > self.MAX_STATIC_ELEMENTS:
+                raise PartitionError(
+                    "interior shape operand is not specializable "
+                    f"(dtype {sv.dtype}, {sv.size} elements)")
+            stat.append(sv)
+            key.append((sv.dtype.str, sv.shape, sv.tobytes()))
+        return dyn, stat, tuple(key)
+
+    def _weave(self, dyn: list, stat: list) -> list:
+        out, di, si = [], 0, 0
+        for flag in self.static_flags:
+            if flag:
+                out.append(stat[si])
+                si += 1
+            else:
+                out.append(dyn[di])
+                di += 1
+        return out
+
+    def interior_jitted(self, static_vals: list, static_key: tuple
+                        ) -> Callable:
+        fn = self._jit_cache.get(static_key)
+        if fn is not None:
+            self._jit_cache.move_to_end(static_key)
+            return fn
+        import jax
+        import jax.numpy as jnp
+
+        interior = self.interior
+
+        def traced(dyn_feeds):
+            return interior(self._weave(dyn_feeds, static_vals), jnp)
+
+        fn = jax.jit(traced)
+        self._jit_cache[static_key] = fn
+        if len(self._jit_cache) > self.MAX_JIT_SPECIALIZATIONS:
+            self._jit_cache.popitem(last=False)
+        return fn
+
+    def interior_jaxpr_text(self, feed_values: Sequence[object]) -> str:
+        """The interior's jaxpr for given example feeds (ALL interior
+        inputs, dynamic and static) — lets tests assert the dense
+        compute really traces to device ops (dot_general etc.) instead
+        of running in numpy."""
+        import jax
+        import jax.numpy as jnp
+
+        interior = self.interior
+        dyn, stat, _ = self._split_static(
+            [np.asarray(v) for v in feed_values])
+        return str(jax.make_jaxpr(
+            lambda d: interior(self._weave(d, stat), jnp))(dyn))
+
+    # -- execution -----------------------------------------------------------
+
+    def run(self, feed_values: Sequence[object],
+            batch_buckets: Sequence[int]) -> list[object]:
+        """feed_values aligned with feed_names; returns fetch values."""
+        feed_values = [np.asarray(v) for v in feed_values]
+        cut_values = []
+        if self.cut_in_refs:
+            cut_values = [np.asarray(v)
+                          for v in self.pre(feed_values, np)]
+            for ref, v in zip(self.cut_in_refs, cut_values):
+                if v.dtype.kind in "OSU":
+                    raise PartitionError(
+                        f"cut tensor {ref} is string-typed at runtime; "
+                        "partition invalid")
+        interior_feeds = [feed_values[i]
+                          for i in self.used_feed_idx] + cut_values
+        dyn, stat, static_key = self._split_static(interior_feeds)
+        if static_key:
+            # Static shape operands encode true sizes (often the batch);
+            # padding the data around them would contradict the encoded
+            # shapes, so the jit specializes per (static values, shapes)
+            # instead — the LRU bound caps the cache.
+            padded, batch, bucket = dyn, None, None
+        else:
+            padded, batch, bucket = _pad_interior(dyn, batch_buckets)
+        outs = self.interior_jitted(stat, static_key)(padded)
+        fetched = fetch_outputs(dict(enumerate(outs)))
+        outs = [fetched[i] for i in range(len(outs))]
+        if bucket is not None and bucket != batch:
+            outs = [o[:batch]
+                    if o.ndim and o.shape[0] == bucket else o
+                    for o in outs]
+        post_feeds = feed_values + cut_values + [np.asarray(o) for o in outs]
+        results = self.post(post_feeds, np)
+        if bucket is not None and bucket != batch:
+            # Post ops driven by a Shape VALUE computed inside the padded
+            # interior (tf.shape -> Tile is the classic classify labels
+            # wiring) emit bucket-sized rows; slice those back too.
+            results = [np.asarray(r)[:batch]
+                       if np.ndim(r) and np.shape(r)[0] == bucket else r
+                       for r in results]
+        return results
+
+
+def _pad_interior(values: list[np.ndarray], buckets: Sequence[int]):
+    """Round the shared leading batch dim up to a bucket (repeat row 0 —
+    valid data keeps XLA out of NaN paths, batching_session.h:94-99).
+    Padding only applies when EVERY rank>=1 feed agrees on dim 0 (the
+    batched-signature contract); otherwise shapes pass through and jit
+    caches per shape."""
+    dims = {v.shape[0] for v in values if v.ndim}
+    if len(dims) != 1:
+        return values, None, None
+    batch = dims.pop()
+    bucket = None
+    for b in buckets:
+        if b >= batch:
+            bucket = int(b)
+            break
+    if bucket is None or bucket == batch:
+        return values, batch, batch
+    padded = [np.concatenate([v, np.repeat(v[:1], bucket - batch, axis=0)])
+              if v.ndim else v for v in values]
+    return padded, batch, bucket
+
+
+def try_partition(graph_def, feed_names: Sequence[str],
+                  fetch_names: Sequence[str], *, variables=None,
+                  funclib=None, tables=None,
+                  string_feed_refs: frozenset[str] = frozenset()):
+    """Build a GraphPartition for the signature, or return None when the
+    graph should stay all-host (no FLOP-bearing segment anywhere, or
+    string feeds consumed by the chosen dense segment).
+
+    Raises nothing on unsupported shapes — every failure path returns
+    None so the caller keeps the always-correct host fallback.
+    """
+    from min_tfs_client_tpu.servables.graphdef_import import (
+        GraphFunction,
+        GraphImportError,
+        _scan_node_functions,
+    )
+
+    nodes = {n.name: n for n in graph_def.node}
+    feeds = [_tensor_name(f) for f in feed_names]
+    fed_names = {name for name, _ in feeds}
+    fetches = [_tensor_name(f) for f in fetch_names]
+
+    # -- reachable set + per-node input refs (feeds prune the walk) ----------
+    # Entries are (dep_name, dep_idx, is_control): control deps count for
+    # reachability/ordering but carry no value, so they never become cuts.
+    reachable: dict[str, list[tuple[str, int, bool]]] = {}
+    stack = [name for name, _ in fetches]
+    while stack:
+        name = stack.pop()
+        if name in reachable or name in fed_names:
+            continue
+        node = nodes.get(name)
+        if node is None:
+            return None  # unknown node; let GraphFunction raise later
+        ins = []
+        for ref in node.input:
+            is_ctrl = ref.startswith("^")
+            dep_name, dep_idx = _tensor_name(ref[1:] if is_ctrl else ref)
+            ins.append((dep_name, dep_idx, is_ctrl))
+            stack.append(dep_name)
+        reachable[name] = ins
+
+    # -- classify ------------------------------------------------------------
+    def classify(node) -> str:
+        if node.op in HOST_ONLY_OPS:
+            return "H"
+        if node.op in _NEUTRAL_OPS:
+            return "H" if _attr_has_string(node) else "N"
+        called = None
+        try:
+            called = _scan_node_functions(node, funclib) \
+                if funclib is not None else None
+        except GraphImportError:
+            return "H"
+        if called is not None:
+            return "H" if called else "D"
+        return "H" if _attr_has_string(node) else "D"
+
+    klass = {name: classify(nodes[name]) for name in reachable}
+    H = {n for n, k in klass.items() if k == "H"}
+    D = {n for n, k in klass.items() if k == "D"}
+    if not H or not D:
+        return None  # pure host or pure device: nothing to split
+
+    # -- topo order over the reachable subgraph ------------------------------
+    order: list[str] = []
+    state: dict[str, int] = {}
+    for root in reachable:
+        if root in state:
+            continue
+        dfs = [(root, iter(reachable[root]))]
+        state[root] = 1
+        while dfs:
+            name, it = dfs[-1]
+            advanced = False
+            for dep_name, _, _ in it:
+                if dep_name in fed_names or dep_name not in reachable:
+                    continue
+                s = state.get(dep_name)
+                if s == 1:
+                    return None  # cycle (Merge/NextIteration): no partition
+                if s is None:
+                    state[dep_name] = 1
+                    dfs.append((dep_name, iter(reachable[dep_name])))
+                    advanced = True
+                    break
+            if not advanced:
+                state[name] = 2
+                order.append(name)
+                dfs.pop()
+
+    # -- segment indices -----------------------------------------------------
+    # seg(n) counts host<->device class alternations along the deepest
+    # path from the feeds; it is monotone along edges, so every ancestor
+    # of a node has seg <= its own. Device nodes group into segments by
+    # seg value; ONE segment (the one with the most MXU work) runs as
+    # the jitted interior and every other node — including device-capable
+    # ops trapped between host stages, e.g. the dynamic-shape gathers of
+    # an embedding_lookup_sparse block — evaluates on host, which is
+    # always correct.
+    seg: dict[str, int] = {}
+    for name in order:
+        my_cls = klass[name]
+        best = 0
+        for dep_name, _, _ in reachable.get(name, ()):
+            if dep_name not in seg:
+                continue  # feed or neutral leaf: segment 0
+            d_cls = klass.get(dep_name)
+            bump = (1 if my_cls in ("H", "D") and d_cls in ("H", "D")
+                    and my_cls != d_cls else 0)
+            best = max(best, seg[dep_name] + bump)
+        seg[name] = best
+
+    flops_by_seg: dict[int, int] = {}
+    for name in D:
+        if nodes[name].op in FLOP_OPS:
+            flops_by_seg[seg[name]] = flops_by_seg.get(seg[name], 0) + 1
+    if not flops_by_seg:
+        return None  # no MXU work: the device round-trip would cost more
+    # Most FLOP ops wins; tie prefers the LATER segment (the model head).
+    s_chosen = max(flops_by_seg, key=lambda s: (flops_by_seg[s], s))
+    interior = {n for n in D if seg[n] == s_chosen}
+
+    # String feeds may only feed host stages. Ref-level (name, idx): a
+    # bypassed ParseExample node exposes string AND numeric slots under
+    # one node name, and only the string slots are off-limits.
+    string_refs = {_tensor_name(r) for r in string_feed_refs}
+    for name in interior:
+        for dep_name, dep_idx, is_ctrl in reachable[name]:
+            if not is_ctrl and (dep_name, dep_idx) in string_refs:
+                return None
+
+    # -- cut tensors ---------------------------------------------------------
+    # Producers of interior inputs always have seg < s_chosen (monotone
+    # seg + class transition rules), so the pre-stage cone can never
+    # contain an interior node.
+    cut_in: list[tuple[str, int]] = []       # host/pre -> interior
+    interior_out: list[tuple[str, int]] = []  # interior -> host/post, fetch
+    seen_in: set[tuple[str, int]] = set()
+    seen_out: set[tuple[str, int]] = set()
+    for name in interior:
+        for dep_name, dep_idx, is_ctrl in reachable[name]:
+            if is_ctrl:
+                if dep_name in reachable and dep_name not in interior:
+                    # A control dep from outside the segment would make
+                    # the jit trace the host op. Rare; bail.
+                    return None
+                continue
+            ref = (dep_name, dep_idx)
+            if dep_name in reachable and dep_name not in interior \
+                    and klass.get(dep_name) in ("H", "D") \
+                    and ref not in seen_in:
+                seen_in.add(ref)
+                cut_in.append(ref)
+    consumers_of_interior = set(reachable) - interior
+    for name in consumers_of_interior:
+        for dep_name, dep_idx, is_ctrl in reachable.get(name, ()):
+            ref = (dep_name, dep_idx)
+            if not is_ctrl and dep_name in interior \
+                    and ref not in seen_out:
+                seen_out.add(ref)
+                interior_out.append(ref)
+    for ref in fetches:
+        if ref[0] in interior and ref not in seen_out:
+            seen_out.add(ref)
+            interior_out.append(ref)
+    if not interior_out:
+        return None
+
+    def ref_str(ref: tuple[str, int]) -> str:
+        return f"{ref[0]}:{ref[1]}"
+
+    cut_in_refs = [ref_str(r) for r in cut_in]
+    interior_out_refs = [ref_str(r) for r in interior_out]
+
+    # Signature feeds the interior actually consumes: only these become
+    # jit arguments (host-only string feeds are not jax arrays).
+    used_refs = {(dep_name, dep_idx)
+                 for name in interior
+                 for dep_name, dep_idx, is_ctrl in reachable[name]
+                 if not is_ctrl and dep_name in fed_names}
+    # Ref-level (node, slot) match: a bypassed ParseExample node exposes
+    # ALL feeds under one node name — matching by name would drag every
+    # sibling slot (string ones included) in as jit arguments.
+    used_feed_idx = [i for i, ref in enumerate(feeds) if ref in used_refs]
+    used_feed_names = [feed_names[i] for i in used_feed_idx]
+
+    # -- static shape operands -----------------------------------------------
+    # Backward pass (reverse topo): an interior node consumed at a shape
+    # position needs its whole input cone static; interior inputs (sig
+    # feeds / cuts) reached by the walk are jit-specialized by VALUE
+    # rather than passed as traced arguments.
+    static_nodes: set[str] = set()
+    static_in_refs: set[tuple[str, int]] = set()
+    for name in reversed(order):
+        if name not in interior:
+            continue
+        node = nodes[name]
+        pos_spec = _STATIC_ARG_POS.get(node.op, ())
+        value_ins = [(d, i) for d, i, c in reachable[name] if not c]
+        static_pos = {p % len(value_ins) for p in pos_spec} \
+            if value_ins else set()
+        # Shape/Size/Rank outputs are static under tracing no matter
+        # what feeds them — needing THEIR value static says nothing
+        # about their data input, so the walk stops there.
+        self_static = (name in static_nodes
+                       and node.op not in ("Shape", "Size", "Rank"))
+        for pos, (dep_name, dep_idx) in enumerate(value_ins):
+            need = pos in static_pos or self_static
+            if not need:
+                continue
+            if dep_name in interior:
+                static_nodes.add(dep_name)
+            elif dep_name in fed_names or dep_name not in reachable \
+                    or klass.get(dep_name) in ("H", "D"):
+                static_in_refs.add((dep_name, dep_idx))
+    # (Neutral consts in static position are already static — the refs
+    # set only matters for feeds and cuts, filtered below.)
+
+    # -- build the three stage functions -------------------------------------
+    try:
+        pre = (GraphFunction(graph_def, feed_names, cut_in_refs,
+                             variables=variables, funclib=funclib,
+                             tables=tables)
+               if cut_in_refs else None)
+        interior_fn = GraphFunction(
+            graph_def, used_feed_names + cut_in_refs, interior_out_refs,
+            variables=variables, funclib=funclib, tables=tables)
+        post = GraphFunction(
+            graph_def, list(feed_names) + cut_in_refs + interior_out_refs,
+            fetch_names, variables=variables, funclib=funclib,
+            tables=tables)
+    except GraphImportError:
+        return None
+    if interior_fn.has_string:
+        return None  # a string sneaked into the dense cone: stay host
+
+    static_flags = ([feeds[i] in static_in_refs for i in used_feed_idx]
+                    + [r in static_in_refs for r in cut_in])
+
+    host_side = set(reachable) - interior
+    stats = {
+        "host_pre_ops": sorted({nodes[n].op for n in host_side
+                                if seg[n] < s_chosen}),
+        "interior_ops": sorted({nodes[n].op for n in interior}),
+        "host_post_ops": sorted({nodes[n].op for n in host_side
+                                 if seg[n] >= s_chosen}),
+        "n_interior": len(interior),
+        "n_host": len(host_side) - sum(
+            1 for n in host_side if klass[n] == "N"),
+        "segment": s_chosen,
+    }
+    return GraphPartition(
+        pre=pre, interior=interior_fn, post=post, feed_names=feed_names,
+        used_feed_idx=used_feed_idx, cut_in_refs=cut_in_refs,
+        interior_out_refs=interior_out_refs, static_flags=static_flags,
+        stats=stats)

@@ -142,6 +142,12 @@ class Signature:
     method_name: str = PREDICT_METHOD_NAME
     # Example parsing spec for Classify/Regress/MultiInference surfaces.
     feature_specs: Optional[dict[str, FeatureSpec]] = None
+    # When the import rewrote a serialized-Example string input into its
+    # parsed feature aliases (the ParseExample bypass), the ORIGINAL
+    # alias: Predict requests feeding that single string tensor (which
+    # work on the reference — the graph parses it) decode host-side into
+    # the feature aliases instead of failing with unknown-alias.
+    serialized_alias: Optional[str] = None
     # Host signatures run eagerly on numpy (string ops). Device signatures
     # are jitted with bucketed static shapes.
     on_host: bool = False
@@ -163,6 +169,12 @@ class Signature:
     transfer_casts: Optional[dict[str, object]] = None
     # Optional sequence-length bucketing (see SequenceBucketing).
     sequence_bucketing: Optional[SequenceBucketing] = None
+    # Imported host/device-partitioned signatures carry their
+    # GraphPartition here (servables/partition.py) — fn routes through
+    # partition.run; exposed for introspection/tests (interior jaxpr,
+    # stage op lists).
+    partition: Optional[object] = dc_field(default=None, repr=False,
+                                           compare=False)
     # Optional jax.sharding.Mesh: formed batches are device_put with the
     # batch dim sharded over the mesh's "data" axis before execution
     # (TP'd params carry their own shardings; GSPMD emits the ICI
@@ -247,6 +259,21 @@ class Signature:
         """Per-request checks, shared by the direct and batched paths (the
         batched path must reject a bad request BEFORE it joins a batch, or
         one caller's mistake fails every co-batched caller)."""
+        if (self.serialized_alias is not None
+                and self.feature_specs is not None
+                and self.serialized_alias not in self.inputs
+                and set(inputs) == {self.serialized_alias}):
+            from min_tfs_client_tpu.tensor.example_codec import (
+                ExampleDecodeError,
+                decode_serialized,
+            )
+
+            arr = np.asarray(inputs[self.serialized_alias])
+            if arr.dtype.kind in "OSU":
+                try:
+                    inputs = decode_serialized(arr, self.feature_specs)
+                except ExampleDecodeError as exc:
+                    raise ServingError.invalid_argument(str(exc))
         missing = set(self.inputs) - set(inputs)
         if missing:
             raise ServingError.invalid_argument(

@@ -79,14 +79,17 @@ def _instrumented(api: str):
 class Handlers:
     def __init__(self, core: ServerCore, *,
                  response_tensors_as_content: bool = False,
-                 signature_method_name_check: bool = False):
+                 signature_method_name_check: bool = True):
         self.core = core
         # False = typed fields (the reference server's default serialization,
         # server_core.h:186-188 kAsProtoField); True = tensor_content.
         self._as_content = response_tensors_as_content
-        # --enable_signature_method_name_check: strict method_name match
-        # on Classify/Regress. Off (the reference default), any signature
-        # carrying Example feature specs serves either API.
+        # Strict method_name match on Classify/Regress, ON by default: the
+        # reference checks unconditionally (classifier.cc:296-312,
+        # regressor.cc:231) — e.g. Regress against a classify signature is
+        # InvalidArgument. --enable_signature_method_name_check=false
+        # relaxes it so any signature carrying Example feature specs
+        # serves either API (a this-framework extension).
         self._method_name_check = signature_method_name_check
 
     # -- PredictionService ---------------------------------------------------

@@ -13,6 +13,13 @@ import sys
 from min_tfs_client_tpu.server.server import Server, ServerOptions
 
 
+def _flag_bool(v: str) -> bool:
+    """TF-style bool flag values, case-insensitive: false/0/no disable
+    (the reference's flag parser accepts e.g. =False; a value that only
+    matched lowercase "false" would silently leave the flag ON)."""
+    return str(v).strip().lower() not in ("false", "0", "no")
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser("tpu_model_server")
     p.add_argument("--port", type=int, default=8500,
@@ -41,7 +48,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--num_load_threads", type=int, default=2)
     p.add_argument("--num_unload_threads", type=int, default=2)
     p.add_argument("--grpc_max_threads", type=int, default=16)
-    p.add_argument("--enable_model_warmup", type=lambda v: v != "false",
+    p.add_argument("--enable_model_warmup", type=_flag_bool,
                    default=True)
     p.add_argument("--num_request_iterations_for_warmup", type=int, default=1,
                    help="replay count per warmup record (ModelWarmupOptions."
@@ -94,23 +101,26 @@ def build_parser() -> argparse.ArgumentParser:
                    help="N/A on TPU — there is no GPU memory pool; HBM is "
                         "gated by the resource tracker. Accepted for CLI "
                         "compatibility, warns if non-zero")
-    p.add_argument("--flush_filesystem_caches", type=lambda v: v != "false",
+    p.add_argument("--flush_filesystem_caches", type=_flag_bool,
                    default=True,
                    help="drop OS page cache for model files after the "
                         "initial loads (weights already live in device/"
                         "host arrays)")
     p.add_argument("--remove_unused_fields_from_bundle_metagraph",
-                   type=lambda v: v != "false", default=True,
+                   type=_flag_bool, default=True,
                    help="reference trims unused MetaGraphDef fields after "
                         "load; the GraphDef import here retains only the "
                         "constants reachable from each signature by "
                         "design, so this is inherently satisfied and the "
                         "flag is accepted for CLI compatibility")
     p.add_argument("--enable_signature_method_name_check",
-                   action="store_true",
+                   nargs="?", const=True, default=True,
+                   type=_flag_bool,
                    help="require Classify/Regress signatures' method_name "
-                        "to match the API called (default: any signature "
-                        "with Example feature specs serves)")
+                        "to match the API called (default: true, matching "
+                        "the reference's unconditional check; pass =false "
+                        "to let any signature with Example feature specs "
+                        "serve either API)")
     p.add_argument("--version", action="store_true",
                    help="print the server version and exit")
     return p

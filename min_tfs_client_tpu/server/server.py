@@ -118,10 +118,11 @@ class ServerOptions:
     # params already live in HBM/host arrays, the file bytes are dead
     # weight.
     flush_filesystem_caches: bool = True
-    # Newer-TFS flag: when true, Classify/Regress verify the signature's
-    # method_name matches the API called; when false (the reference
-    # default) any signature with Example feature specs serves.
-    enable_signature_method_name_check: bool = False
+    # When true (the default — the reference checks unconditionally,
+    # classifier.cc:296-312, regressor.cc:231), Classify/Regress verify
+    # the signature's method_name matches the API called; false relaxes
+    # it so any signature with Example feature specs serves either API.
+    enable_signature_method_name_check: bool = True
 
     def effective_inter_op_parallelism(self) -> int:
         """<= 0 = auto (leave grpc_max_threads alone; TF spells auto as

@@ -88,9 +88,21 @@ class FeatureSpec:
     shape: tuple[int, ...] = ()          # per-example shape; () = scalar
     default: object | None = None        # None = feature required
     var_len: bool = False
+    # VarLen decoded as the REAL SparseTensor triple instead of a padded
+    # dense view: decode emits three arrays under '<name>#indices'
+    # ([nnz, 2] int64 row-major), '<name>#values' ([nnz]) and
+    # '<name>#shape' ([2] = batch, max len) — byte-exact with TF's
+    # parse_example sparse outputs, for graphs that consume the
+    # SparseTensor itself (estimator feature columns).
+    sparse_triple: bool = False
 
     def __post_init__(self):
         object.__setattr__(self, "dtype", np.dtype(self.dtype))
+        if self.sparse_triple:
+            if self.shape:
+                raise ValueError("sparse features are rank-1 per example; "
+                                 "shape must be ()")
+            return
         if self.var_len and self.shape:
             raise ValueError("var_len features are rank-1 per example; "
                              "shape must be ()")
@@ -123,17 +135,28 @@ def flatten_input(inp: Input) -> list[Example]:
     raise ExampleDecodeError("Input proto has no example_list")
 
 
+def _expected_kind(spec: FeatureSpec) -> str:
+    if spec.dtype == object:
+        return "bytes_list"
+    return "float_list" if spec.dtype.kind == "f" else "int64_list"
+
+
 def _feature_values(feat: tf_example_pb2.Feature, spec: FeatureSpec, name: str):
     kind = feat.WhichOneof("kind")
+    if kind is None:
+        return None  # empty Feature: treated as missing/empty
+    expected = _expected_kind(spec)
+    if kind != expected:
+        # TF's parser raises a kind-mismatch error (a float_list for an
+        # int64 feature must not silently truncate into the dense view).
+        raise ExampleDecodeError(
+            f"feature {name!r}: wire kind {kind} does not match the "
+            f"spec dtype {spec.dtype} (expected {expected})")
     if kind == "bytes_list":
-        vals = list(feat.bytes_list.value)
-    elif kind == "float_list":
-        vals = list(feat.float_list.value)
-    elif kind == "int64_list":
-        vals = list(feat.int64_list.value)
-    else:
-        vals = None
-    return vals
+        return list(feat.bytes_list.value)
+    if kind == "float_list":
+        return list(feat.float_list.value)
+    return list(feat.int64_list.value)
 
 
 def _apply_default(col: np.ndarray, i: int, name: str, spec: FeatureSpec,
@@ -231,6 +254,12 @@ def decode_examples(
     serialized = None
     out: dict[str, np.ndarray] = {}
     for name, spec in specs.items():
+        if spec.sparse_triple:
+            idx, vals, shp = _decode_sparse_triple(examples, name, spec)
+            out[f"{name}#indices"] = idx
+            out[f"{name}#values"] = vals
+            out[f"{name}#shape"] = shp
+            continue
         if spec.var_len:
             out[name] = _decode_var_len(examples, name, spec, batch)
             continue
@@ -245,6 +274,32 @@ def decode_examples(
                 continue
         out[name] = _decode_examples_python(examples, name, spec, batch)
     return out
+
+
+def _decode_sparse_triple(examples, name: str, spec: FeatureSpec):
+    """VarLen -> TF's sparse parse outputs: indices [nnz, 2] in row-major
+    (example, position) order, values [nnz], dense_shape [2] = (batch,
+    longest example)."""
+    indices: list[tuple[int, int]] = []
+    values: list[object] = []
+    width = 0
+    for i, ex in enumerate(examples):
+        feat = ex.features.feature.get(name)
+        vals = _feature_values(feat, spec, name) if feat is not None else []
+        vals = vals or []
+        width = max(width, len(vals))
+        for j, v in enumerate(vals):
+            indices.append((i, j))
+            values.append(v)
+    idx = (np.asarray(indices, dtype=np.int64).reshape(-1, 2)
+           if indices else np.zeros((0, 2), np.int64))
+    if spec.dtype == object:
+        vals_arr = np.array([coerce_to_bytes(v) for v in values],
+                            dtype=object)
+    else:
+        vals_arr = np.asarray(values, dtype=spec.dtype)
+    shape = np.asarray([len(examples), width], dtype=np.int64)
+    return idx, vals_arr, shape
 
 
 def _decode_var_len(examples, name: str, spec: FeatureSpec,
@@ -295,3 +350,22 @@ def decode_input(
     """Input proto -> (dense feature batch, num_examples)."""
     examples = flatten_input(inp)
     return decode_examples(examples, specs), len(examples)
+
+
+def decode_serialized(
+    arr: np.ndarray, specs: Mapping[str, FeatureSpec]
+) -> dict[str, np.ndarray]:
+    """A tensor of serialized Example bytes -> dense feature batch.
+
+    The Predict-compatibility path for imported parse-bypass signatures:
+    a reference client feeding the graph's original DT_STRING input via
+    Predict (works on the reference, predict_util.cc — the graph's own
+    ParseExample parses it) gets the same host decode Classify uses."""
+    flat = np.asarray(arr).reshape(-1)
+    try:
+        examples = [Example.FromString(coerce_to_bytes(v))
+                    for v in flat.tolist()]
+    except Exception as exc:
+        raise ExampleDecodeError(
+            f"input is not a tensor of serialized Examples: {exc}") from exc
+    return decode_examples(examples, specs)

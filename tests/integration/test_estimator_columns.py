@@ -1,0 +1,241 @@
+"""Estimator feature-column exports (VERDICT round-5 #3) — the canonical
+Zendesk-class workload min-tfs-client exists to query.
+
+tf.estimator itself is gone from the installed TF (removed in 2.16), so
+the export builds the estimator's exact serving graph the way
+DNNClassifier did: tf.compat.v1.feature_column.input_layer over
+ * categorical_column_with_hash_bucket -> embedding_column
+   (StringToHashBucketFast -> SparseFillEmptyRows -> Unique ->
+    embedding gather -> SparseSegmentMean; reference
+    python/ops/embedding_ops.py:373-478,
+    core/kernels/segment_reduction_ops.cc),
+ * categorical_column_with_vocabulary_list -> indicator_column
+   (vocab hash table -> SparseToDense -> one-hot sum),
+ * numeric_column,
+then a dense head and a string-label classify signature. The import
+serves Classify end-to-end, numerics cross-validated against TF's own
+Session for the same serialized Examples; the VarLen features decode as
+TF-exact sparse triples, and the dense head still partitions onto the
+device."""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from min_tfs_client_tpu.client import TensorServingClient
+from min_tfs_client_tpu.server.server import Server, ServerOptions
+from min_tfs_client_tpu.servables.graphdef_import import load_saved_model
+from min_tfs_client_tpu.tensor.example_codec import example_from_dict
+
+EXPORT_SCRIPT = """
+import sys
+import numpy as np
+import tensorflow as tf
+
+tf1 = tf.compat.v1
+tf1.disable_eager_execution()
+
+export_dir, examples_path, out_path = sys.argv[1:4]
+payloads = np.load(examples_path, allow_pickle=True)
+
+fc = tf1.feature_column
+cols = [
+    fc.embedding_column(
+        fc.categorical_column_with_hash_bucket("words", 100), 8),
+    fc.indicator_column(
+        fc.categorical_column_with_vocabulary_list(
+            "kind", ["a", "b", "c"])),
+    fc.numeric_column("score"),
+]
+spec = fc.make_parse_example_spec(cols)
+
+g = tf1.Graph()
+with g.as_default():
+    tf1.set_random_seed(11)
+    serialized = tf1.placeholder(tf.string, [None],
+                                 name="input_example_tensor")
+    features = tf1.io.parse_example(serialized, spec)
+    net = fc.input_layer(features, cols)          # [B, 12]
+    rng = np.random.default_rng(29)
+    w = tf1.get_variable(
+        "w", initializer=(rng.standard_normal((12, 3)) * 0.5
+                          ).astype(np.float32))
+    b = tf1.get_variable(
+        "b", initializer=rng.standard_normal((3,)).astype(np.float32))
+    logits = tf.matmul(net, w) + b
+    scores = tf.nn.softmax(logits)
+    table = tf.lookup.StaticHashTable(
+        tf.lookup.KeyValueTensorInitializer(
+            tf.constant([0, 1, 2], tf.int64),
+            tf.constant([b"neg", b"neu", b"pos"])),
+        default_value=b"UNK")
+    ranked = tf.argsort(logits, direction="DESCENDING")
+    classes = table.lookup(tf.cast(ranked, tf.int64))
+
+    sig = tf1.saved_model.classification_signature_def(
+        examples=serialized, classes=classes, scores=scores)
+    builder = tf1.saved_model.Builder(export_dir)
+    with tf1.Session() as sess:
+        sess.run(tf1.global_variables_initializer())
+        sess.run(tf1.tables_initializer())
+        builder.add_meta_graph_and_variables(
+            sess, [tf1.saved_model.SERVING],
+            signature_def_map={"serving_default": sig},
+            main_op=tf1.tables_initializer())
+        builder.save()
+        got_scores, got_classes, got_net = sess.run(
+            [scores, classes, net], {serialized: list(payloads)})
+np.savez(out_path, scores=got_scores, classes=got_classes, net=got_net)
+print("SAVED")
+"""
+
+
+def _run_tf(script, *args):
+    return subprocess.run(
+        [sys.executable, "-c", script, *args], capture_output=True,
+        text=True, timeout=300,
+        env={"PATH": "/usr/bin:/bin:/usr/local/bin",
+             "CUDA_VISIBLE_DEVICES": "-1", "JAX_PLATFORMS": "cpu",
+             "TF_CPP_MIN_LOG_LEVEL": "3", "HOME": "/root"})
+
+
+# Mixed shapes on purpose: multi-token examples, an example with NO
+# words (SparseFillEmptyRows path), unknown vocab ("zzz" -> OOV), and a
+# missing kind.
+FEATURES = [
+    {"words": [b"alpha", b"beta", b"gamma"], "kind": [b"a"],
+     "score": [0.5]},
+    {"words": [b"delta"], "kind": [b"c"], "score": [-1.0]},
+    {"kind": [b"zzz"], "score": [2.0]},                  # no words, OOV kind
+    {"words": [b"alpha", b"alpha"], "score": [0.0]},     # dup words, no kind
+]
+
+
+@pytest.fixture(scope="module")
+def exported(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("estimator_export")
+    payloads = np.array(
+        [example_from_dict(d).SerializeToString() for d in FEATURES],
+        dtype=object)
+    ex_path = tmp / "examples.npy"
+    np.save(ex_path, payloads, allow_pickle=True)
+    version_dir = tmp / "model" / "1"
+    out_path = tmp / "tf_out.npz"
+    proc = _run_tf(EXPORT_SCRIPT, str(version_dir), str(ex_path),
+                   str(out_path))
+    if "SAVED" not in proc.stdout:
+        pytest.skip(f"tensorflow unavailable: {proc.stderr[-800:]}")
+    return version_dir, np.load(out_path, allow_pickle=True)
+
+
+@pytest.mark.integration
+def test_feature_columns_import_shape(exported):
+    version_dir, _ = exported
+    servable = load_saved_model(str(version_dir), "est", 1)
+    sig = servable.signature("")
+    specs = sig.feature_specs
+    assert specs is not None
+    assert specs["words"].sparse_triple
+    assert specs["kind"].sparse_triple
+    assert not specs["score"].sparse_triple
+    # Sparse features surface as TF-exact triples in the input specs.
+    assert "words#indices" in sig.inputs
+    assert sig.inputs["words#shape"].shape == (2,)
+    assert sig.on_host
+
+
+@pytest.mark.integration
+def test_feature_columns_match_tf(exported):
+    version_dir, want = exported
+    servable = load_saved_model(str(version_dir), "est", 1)
+    sig = servable.signature("")
+    from min_tfs_client_tpu.tensor.example_codec import decode_examples
+
+    feats = decode_examples([example_from_dict(d) for d in FEATURES],
+                            sig.feature_specs)
+    out = sig.run(feats)
+    np.testing.assert_allclose(out["scores"], want["scores"],
+                               rtol=1e-4, atol=1e-5)
+    got_classes = np.vectorize(
+        lambda v: v if isinstance(v, bytes) else bytes(v))(out["classes"])
+    np.testing.assert_array_equal(got_classes, want["classes"])
+
+
+@pytest.mark.integration
+def test_dense_head_partitions_to_device(exported):
+    version_dir, _ = exported
+    servable = load_saved_model(str(version_dir), "est", 1)
+    sig = servable.signature("")
+    part = sig.partition
+    assert part is not None, \
+        "the dense head must run jitted around the sparse host block"
+    assert "MatMul" in part.stats["interior_ops"]
+    # The sparse feature machinery stays host-side.
+    host_ops = set(part.stats["host_pre_ops"]) \
+        | set(part.stats["host_post_ops"])
+    assert "StringToHashBucketFast" in host_ops
+    assert "SparseSegmentMean" in host_ops
+
+
+@pytest.mark.integration
+def test_classify_serves_end_to_end(exported):
+    version_dir, want = exported
+    srv = Server(ServerOptions(
+        grpc_port=0, model_name="est",
+        model_base_path=str(version_dir.parent),
+        file_system_poll_wait_seconds=0)).build_and_start()
+    try:
+        with TensorServingClient("127.0.0.1", srv.grpc_port) as client:
+            resp = client.classification_request("est", FEATURES,
+                                                 timeout=120)
+            result = resp.result
+            assert len(result.classifications) == len(FEATURES)
+            for i, cl in enumerate(result.classifications):
+                np.testing.assert_allclose(
+                    [c.score for c in cl.classes], want["scores"][i],
+                    rtol=1e-4, atol=1e-5)
+                assert [c.label for c in cl.classes] == [
+                    lb.decode() for lb in want["classes"][i]]
+    finally:
+        srv.stop()
+
+
+@pytest.mark.integration
+def test_farmhash_goldens_match_tf(exported):
+    """Golden cross-validation of the Fingerprint64 reimplementation
+    against TF's own StringToHashBucketFast kernel."""
+    script = """
+import json, sys
+import numpy as np
+import tensorflow as tf
+tf1 = tf.compat.v1
+tf1.disable_eager_execution()
+rng = np.random.default_rng(3)
+strs = [b""] + [bytes(rng.integers(1, 255, size=n, dtype=np.uint8))
+                for n in (1, 3, 7, 8, 15, 16, 17, 31, 32, 33, 63, 64,
+                          65, 100, 128, 200, 1000)]
+g = tf1.Graph()
+with g.as_default():
+    ph = tf1.placeholder(tf.string, [None])
+    h = tf1.strings.to_hash_bucket_fast(ph, 1 << 62)
+    m = tf1.strings.to_hash_bucket_fast(ph, 999983)
+    with tf1.Session() as sess:
+        v, w = sess.run([h, m], {ph: strs})
+print(json.dumps([[s.hex(), int(a), int(b)]
+                  for s, a, b in zip(strs, v, w)]))
+"""
+    proc = _run_tf(script)
+    if not proc.stdout.strip().startswith("["):
+        pytest.skip(f"tensorflow unavailable: {proc.stderr[-300:]}")
+    import json
+
+    from min_tfs_client_tpu.utils.farmhash import fingerprint64
+
+    for hex_s, mod62, mod_p in json.loads(proc.stdout.strip()):
+        h = fingerprint64(bytes.fromhex(hex_s))
+        assert h % (1 << 62) == mod62
+        assert h % 999983 == mod_p

@@ -173,3 +173,29 @@ def test_missing_required_feature_rejected(exported):
                     "clf", [{"bias_in": 1.0}], timeout=120)
     finally:
         srv.stop()
+
+
+@pytest.mark.integration
+def test_predict_with_original_serialized_alias(exported):
+    # Reference parity (predict_util.cc): Predict feeding the graph's
+    # original DT_STRING input (serialized Examples) works even though
+    # the import rewrote the signature to parsed feature aliases — the
+    # host decodes through the same FeatureSpecs.
+    base, want = exported
+    servable = load_saved_model(str(base / "1"), "clf", 1)
+    sig = servable.signature("")
+    assert sig.serialized_alias == "inputs"
+    payloads = np.array(
+        [example_from_dict(d).SerializeToString() for d in FEATURE_DICTS],
+        dtype=object)
+    out = sig.run({"inputs": payloads})
+    np.testing.assert_allclose(out["scores"], want["scores"],
+                               rtol=1e-5, atol=1e-6)
+    # The parsed-alias surface keeps working side by side.
+    from min_tfs_client_tpu.tensor.example_codec import decode_examples
+
+    feats = decode_examples([example_from_dict(d) for d in FEATURE_DICTS],
+                            sig.feature_specs)
+    out2 = sig.run(feats)
+    np.testing.assert_allclose(out2["scores"], want["scores"],
+                               rtol=1e-5, atol=1e-6)

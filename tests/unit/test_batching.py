@@ -357,3 +357,70 @@ class TestRaggedPadValues:
         assert any(s[0] >= 2 and s[1] == 3 for s in merged_shapes), \
             merged_shapes
         runner.close()
+
+
+class TestHostPathBatching:
+    """VERDICT round-5 #6: on_host signatures join the batching
+    front-end (merge -> run ONCE -> split) — batching is signature-level
+    in the reference (batching_session.h:47-99), not device-conditional."""
+
+    def _host_sig(self, executed):
+        def fn(inputs):
+            executed.append(np.asarray(inputs["x"]).shape[0])
+            return {"y": np.asarray(inputs["x"]) * 3.0}
+
+        return Signature(
+            fn=fn,
+            inputs={"x": TensorSpec(np.float32, (None,))},
+            outputs={"y": TensorSpec(np.float32, (None,))},
+            on_host=True,
+        )
+
+    def test_concurrent_host_callers_coalesce(self, scheduler):
+        executed = []
+        sig = self._host_sig(executed)
+        runner = BatchedSignatureRunner(
+            sig, scheduler, max_batch_size=8, batch_timeout_s=0.2)
+        results = {}
+
+        def call(i):
+            results[i] = runner.run({"x": np.array([float(i)], np.float32)})
+
+        n = 6
+        threads = [threading.Thread(target=call, args=(i,))
+                   for i in range(n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10)
+        for i in range(n):
+            np.testing.assert_array_equal(results[i]["y"], [3.0 * i])
+        # <= ceil(N / max_batch_size) host runs, not one per request.
+        assert len(executed) <= -(-n // 8)
+        assert sum(executed) == n
+        runner.close()
+
+    def test_maybe_wrap_includes_host_signatures(self, scheduler):
+        executed = []
+        sig = self._host_sig(executed)
+        servable = Servable("m", 1, {"serving_default": sig})
+        maybe_wrap_servable(
+            servable, {"max_batch_size": 4, "batch_timeout_s": 0.1},
+            scheduler)
+        assert getattr(servable, "_batch_runners", []), \
+            "host signature must be wrapped"
+        results = {}
+
+        def call(i):
+            results[i] = sig.run({"x": np.array([float(i)], np.float32)})
+
+        threads = [threading.Thread(target=call, args=(i,))
+                   for i in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10)
+        for i in range(3):
+            np.testing.assert_array_equal(results[i]["y"], [3.0 * i])
+        assert len(executed) <= 1 + 3 // 4
+        servable.unload()

@@ -129,3 +129,66 @@ class TestVarLenDecode:
                                         var_len=True)})
         np.testing.assert_array_equal(
             out["t"], np.array([[b"a", b"bb"], [b"c", b""]], object))
+
+
+class TestKindValidation:
+    """The wire kind must match the spec dtype — TF's parser raises a
+    kind-mismatch error; silent truncation (float_list into an int64
+    VarLen view) is a wrong-answer bug."""
+
+    def test_var_len_kind_mismatch_raises(self):
+        from min_tfs_client_tpu.tensor.example_codec import (
+            ExampleDecodeError,
+            FeatureSpec,
+            decode_examples,
+            example_from_dict,
+        )
+
+        ex = example_from_dict({"ids": np.array([1.5, 2.5], np.float32)})
+        spec = {"ids": FeatureSpec(np.int64, default=0, var_len=True)}
+        with pytest.raises(ExampleDecodeError, match="kind"):
+            decode_examples([ex], spec)
+
+    def test_fixed_len_kind_mismatch_raises(self):
+        from min_tfs_client_tpu.tensor.example_codec import (
+            ExampleDecodeError,
+            FeatureSpec,
+            decode_examples,
+            example_from_dict,
+        )
+
+        ex = example_from_dict({"x": np.array([1, 2], np.int64)})
+        spec = {"x": FeatureSpec(np.float32, (2,))}
+        with pytest.raises(ExampleDecodeError, match="kind"):
+            decode_examples([ex], spec)
+
+    def test_empty_feature_still_treated_missing(self):
+        from min_tfs_client_tpu.protos import tf_example_pb2
+        from min_tfs_client_tpu.tensor.example_codec import (
+            FeatureSpec,
+            decode_examples,
+        )
+
+        ex = tf_example_pb2.Example()
+        ex.features.feature["x"].SetInParent()  # present, no kind set
+        out = decode_examples(
+            [ex], {"x": FeatureSpec(np.float32, (), default=3.0)})
+        np.testing.assert_array_equal(out["x"], [3.0])
+
+
+def test_decode_serialized_tensor():
+    from min_tfs_client_tpu.tensor.example_codec import (
+        ExampleDecodeError,
+        FeatureSpec,
+        decode_serialized,
+        example_from_dict,
+    )
+
+    exs = [example_from_dict({"x": np.array([1.0, 2.0], np.float32)}),
+           example_from_dict({"x": np.array([3.0, 4.0], np.float32)})]
+    arr = np.array([e.SerializeToString() for e in exs], object)
+    out = decode_serialized(arr, {"x": FeatureSpec(np.float32, (2,))})
+    np.testing.assert_array_equal(out["x"], [[1.0, 2.0], [3.0, 4.0]])
+    with pytest.raises(ExampleDecodeError, match="serialized"):
+        decode_serialized(np.array([b"\xff\xffgarbage!"], object),
+                          {"x": FeatureSpec(np.float32, (2,))})

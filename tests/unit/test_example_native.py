@@ -86,12 +86,13 @@ def test_arity_mismatch_error_matches_python_path():
         ec.decode_examples(examples, {"a": ec.FeatureSpec(np.int64, (2,))})
 
 
-def test_kind_mismatch_falls_back_to_python_cast():
-    # float_list under an int spec: native reports kind mismatch, Python
-    # fallback casts — decode_examples must keep the cast behavior.
+def test_kind_mismatch_raises_like_tf():
+    # float_list under an int spec: native reports kind mismatch, the
+    # Python fallback raises — TF's parser errors on data-type mismatch
+    # rather than silently casting.
     examples = [ec.example_from_dict({"a": [1.0, 2.0]})]
-    got = ec.decode_examples(examples, {"a": ec.FeatureSpec(np.int64, (2,))})
-    np.testing.assert_array_equal(got["a"], [[1, 2]])
+    with pytest.raises(ec.ExampleDecodeError, match="kind"):
+        ec.decode_examples(examples, {"a": ec.FeatureSpec(np.int64, (2,))})
 
 
 def test_narrow_int_overflow_raises_like_python():

@@ -82,12 +82,6 @@ def test_v1_dense_synthesis():
     np.testing.assert_allclose(np.asarray(bp.specs["bias"].default), [0.25])
 
 
-def test_v1_sparse_rejected():
-    gd = _v1_graph(n_sparse=1)
-    with pytest.raises(example_parse.ParseSynthesisError, match="sparse"):
-        example_parse.find_parse_bypass(gd, "serialized:0")
-
-
 def test_v2_dense_base_is_sparse_slots_only():
     # V2 output order puts dense_values BEFORE ragged outputs, so the
     # dense base is 3*num_sparse only (0 here). Sparse/ragged graphs are
@@ -209,14 +203,22 @@ def test_v1_sparse_to_dense_bypass():
     assert bp.shapes["s0"] == (None,)
 
 
-def test_v1_sparse_without_densify_rejected():
+def test_v1_sparse_without_densify_feeds_triple():
+    # No SparseToDense consumer: the sparse feature serves as the REAL
+    # SparseTensor — the host decodes the triple and the parse node's
+    # indices/values/shape slots are fed directly (estimator wiring).
     gd = _v1_graph(n_sparse=1)
-    with pytest.raises(example_parse.ParseSynthesisError,
-                       match="SparseToDense"):
-        example_parse.find_parse_bypass(gd, "serialized:0")
+    bp = example_parse.find_parse_bypass(gd, "serialized:0")
+    assert bp.feature_order == [
+        "x", "bias", "s0#indices", "s0#values", "s0#shape"]
+    assert bp.dense_refs == [
+        "parse:3", "parse:4", "parse:0", "parse:1", "parse:2"]
+    assert bp.specs["s0"].sparse_triple
+    assert bp.raw_shapes["s0#indices"] == (None, 2)
+    assert bp.raw_shapes["s0#shape"] == (2,)
 
 
-def test_v1_sparse_with_second_consumer_rejected():
+def test_v1_sparse_with_second_consumer_feeds_triple():
     gd = _v1_sparse_to_dense_graph()
     extra = gd.node.add()
     extra.name = "also_reads_values"
@@ -228,6 +230,8 @@ def test_v1_sparse_with_second_consumer_rejected():
     shp.name = "consumer2"
     shp.op = "Shape"
     shp.input.append("also_reads_values")
-    with pytest.raises(example_parse.ParseSynthesisError,
-                       match="exactly one"):
-        example_parse.find_parse_bypass(gd, "serialized:0")
+    # A second consumer of the VALUES breaks the dense mirror; the
+    # triple feed serves it instead of rejecting the model.
+    bp = example_parse.find_parse_bypass(gd, "serialized:0")
+    assert bp.specs["s0"].sparse_triple
+    assert "s0#values" in bp.feature_order

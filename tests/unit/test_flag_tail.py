@@ -73,8 +73,17 @@ def _sig(method_name, with_specs=True):
 
 
 class TestSignatureMethodNameCheck:
-    def test_default_lax_serves_any_example_signature(self):
+    def test_default_strict_rejects_mismatch(self):
+        # The reference checks unconditionally (classifier.cc:296-312,
+        # regressor.cc:231): the default must reject, not serve.
         handlers = Handlers(core=None)
+        with pytest.raises(ServingError, match="method_name"):
+            handlers._example_signature(
+                _OneSignatureServable(_sig(PREDICT_METHOD_NAME)),
+                apis.ModelSpec(), CLASSIFY_METHOD_NAME)
+
+    def test_lax_opt_out_serves_any_example_signature(self):
+        handlers = Handlers(core=None, signature_method_name_check=False)
         sig = _sig(PREDICT_METHOD_NAME)
         got = handlers._example_signature(
             _OneSignatureServable(sig), apis.ModelSpec(),
@@ -134,6 +143,14 @@ class TestFlagParsing:
             server_main.build_parser().parse_args([]))
         assert opts.tensorflow_session_parallelism == 0  # auto
         assert opts.flush_filesystem_caches is True
+        # The reference checks method_name unconditionally
+        # (classifier.cc:296-312): strict is the default.
+        assert opts.enable_signature_method_name_check is True
+
+    def test_method_name_check_opt_out(self):
+        args = server_main.build_parser().parse_args(
+            ["--enable_signature_method_name_check=false"])
+        opts = server_main.options_from_args(args)
         assert opts.enable_signature_method_name_check is False
 
 

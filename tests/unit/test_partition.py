@@ -1,0 +1,277 @@
+"""Unit tests for the host/device graph partitioner on hand-built
+GraphDefs (no TF, no SavedModel): stage classification, cut tensors,
+batch-bucket padding, and the fallback rules."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from min_tfs_client_tpu.protos import tf_graph_pb2
+from min_tfs_client_tpu.servables.graphdef_import import (
+    GraphFunction,
+    LookupTable,
+    _FuncLib,
+)
+from min_tfs_client_tpu.servables.partition import try_partition
+from min_tfs_client_tpu.tensor.codec import ndarray_to_tensor_proto
+
+DT_FLOAT, DT_STRING, DT_INT64, DT_INT32 = 1, 7, 9, 3
+
+
+def _const(gd, name, arr):
+    node = gd.node.add()
+    node.name = name
+    node.op = "Const"
+    node.attr["value"].tensor.CopyFrom(ndarray_to_tensor_proto(arr))
+    return node
+
+
+def _classify_graph():
+    """x -> MatMul(w) -> Softmax -> ArgMax -> table lookup (string).
+
+    The canonical classify-with-labels shape: dense interior + host
+    label lookup at the end.
+    """
+    gd = tf_graph_pb2.GraphDef()
+    ph = gd.node.add()
+    ph.name = "x"
+    ph.op = "Placeholder"
+    ph.attr["dtype"].type = DT_FLOAT
+    _const(gd, "w", np.arange(12, dtype=np.float32).reshape(3, 4) * 0.1)
+    mm = gd.node.add()
+    mm.name = "logits"
+    mm.op = "MatMul"
+    mm.input.extend(["x", "w"])
+    sm = gd.node.add()
+    sm.name = "scores"
+    sm.op = "Softmax"
+    sm.input.append("logits")
+    _const(gd, "axis", np.asarray(1, np.int32))
+    am = gd.node.add()
+    am.name = "best"
+    am.op = "ArgMax"
+    am.input.extend(["logits", "axis"])
+    table = gd.node.add()
+    table.name = "tbl"
+    table.op = "HashTableV2"
+    table.attr["key_dtype"].type = DT_INT64
+    table.attr["value_dtype"].type = DT_STRING
+    _const(gd, "default", np.asarray(b"UNK", object))
+    find = gd.node.add()
+    find.name = "label"
+    find.op = "LookupTableFindV2"
+    find.input.extend(["tbl", "best", "default"])
+    return gd
+
+
+def _tables():
+    return {"tbl": LookupTable([0, 1, 2, 3],
+                               [b"a", b"b", b"c", b"d"], True)}
+
+
+def test_classify_graph_partitions():
+    gd = _classify_graph()
+    part = try_partition(gd, ["x:0"], ["scores:0", "label:0"],
+                         funclib=_FuncLib(None), tables=_tables())
+    assert part is not None
+    assert "MatMul" in part.stats["interior_ops"]
+    assert "LookupTableFindV2" in part.stats["host_post_ops"]
+    assert part.cut_in_refs == []
+    # ArgMax is numeric -> interior; its output is the host cut.
+    assert set(part.interior_out_refs) >= {"scores:0", "best:0"}
+
+    x = np.array([[1.0, 0.0, 2.0], [0.5, 0.5, 0.5], [0.0, 3.0, 1.0]],
+                 np.float32)
+    outs = part.run([x], batch_buckets=(4, 8))
+    ref_fn = GraphFunction(gd, ["x:0"], ["scores:0", "label:0"],
+                           tables=_tables())
+    want = ref_fn([x], np)
+    np.testing.assert_allclose(outs[0], want[0], rtol=1e-5)
+    np.testing.assert_array_equal(np.asarray(outs[1], object), want[1])
+
+
+def test_padding_rounds_to_bucket_and_slices_back():
+    gd = _classify_graph()
+    part = try_partition(gd, ["x:0"], ["scores:0", "label:0"],
+                         funclib=_FuncLib(None), tables=_tables())
+    assert part is not None
+    x = np.ones((3, 3), np.float32)
+    outs = part.run([x], batch_buckets=(8,))
+    assert np.asarray(outs[0]).shape == (3, 4)
+    assert np.asarray(outs[1]).shape == (3,)
+
+
+def test_pure_device_graph_returns_none():
+    # Fetching only the dense outputs: no host node reachable, nothing
+    # to split — the regular jitted device path already covers it.
+    gd = _classify_graph()
+    part = try_partition(gd, ["x:0"], ["scores:0"],
+                         funclib=_FuncLib(None), tables=_tables())
+    assert part is None
+
+
+def test_jaxpr_shows_device_dots():
+    gd = _classify_graph()
+    part = try_partition(gd, ["x:0"], ["scores:0", "label:0"],
+                         funclib=_FuncLib(None), tables=_tables())
+    text = part.interior_jaxpr_text([np.ones((2, 3), np.float32)])
+    assert "dot_general" in text
+
+
+def test_no_flops_returns_none():
+    # Lookup-only graph: nothing for the MXU, partition refuses.
+    gd = tf_graph_pb2.GraphDef()
+    ph = gd.node.add()
+    ph.name = "ids"
+    ph.op = "Placeholder"
+    ph.attr["dtype"].type = DT_INT64
+    table = gd.node.add()
+    table.name = "tbl"
+    table.op = "HashTableV2"
+    _const(gd, "default", np.asarray(b"UNK", object))
+    find = gd.node.add()
+    find.name = "label"
+    find.op = "LookupTableFindV2"
+    find.input.extend(["tbl", "ids", "default"])
+    part = try_partition(gd, ["ids:0"], ["label:0"],
+                         funclib=_FuncLib(None), tables=_tables())
+    assert part is None
+
+
+def test_host_pre_cut_feeds_interior():
+    """string feed -> host hash-ish lookup (int values) -> MatMul: the
+    pre stage computes the cut, the interior consumes it."""
+    gd = tf_graph_pb2.GraphDef()
+    ph = gd.node.add()
+    ph.name = "tok"
+    ph.op = "Placeholder"
+    ph.attr["dtype"].type = DT_STRING
+    table = gd.node.add()
+    table.name = "tbl"
+    table.op = "HashTableV2"
+    table.attr["key_dtype"].type = DT_STRING
+    table.attr["value_dtype"].type = DT_INT64
+    _const(gd, "default", np.asarray(0, np.int64))
+    find = gd.node.add()
+    find.name = "ids"
+    find.op = "LookupTableFindV2"
+    find.input.extend(["tbl", "tok", "default"])
+    cast = gd.node.add()
+    cast.name = "idsf"
+    cast.op = "Cast"
+    cast.input.append("ids")
+    cast.attr["SrcT"].type = DT_INT64
+    cast.attr["DstT"].type = DT_FLOAT
+    _const(gd, "w", np.eye(2, dtype=np.float32))
+    mm = gd.node.add()
+    mm.name = "out"
+    mm.op = "MatMul"
+    mm.input.extend(["idsf", "w"])
+    tables = {"tbl": LookupTable([b"x", b"y"], [3, 5], False)}
+    part = try_partition(gd, ["tok:0"], ["out:0"],
+                         funclib=_FuncLib(None), tables=tables,
+                         string_feed_refs=frozenset(["tok:0"]))
+    assert part is not None
+    assert part.cut_in_refs == ["ids:0"]
+    assert "LookupTableFindV2" in part.stats["host_pre_ops"]
+    tok = np.array([[b"x", b"y"], [b"y", b"y"]], object)
+    outs = part.run([tok], batch_buckets=(2,))
+    np.testing.assert_allclose(outs[0], [[3.0, 5.0], [5.0, 5.0]])
+
+
+def test_alternating_host_device_host_device_picks_one_segment():
+    """D -> H (int-valued lookup) -> D again: two device segments; the
+    partitioner keeps ONE on device (tie prefers the later = the head)
+    and evaluates the other on host — numerics must match the all-host
+    reference."""
+    gd = tf_graph_pb2.GraphDef()
+    ph = gd.node.add()
+    ph.name = "x"
+    ph.op = "Placeholder"
+    ph.attr["dtype"].type = DT_FLOAT
+    _const(gd, "w", np.eye(3, dtype=np.float32))
+    mm = gd.node.add()
+    mm.name = "h1"
+    mm.op = "MatMul"
+    mm.input.extend(["x", "w"])
+    _const(gd, "axis", np.asarray(1, np.int32))
+    am = gd.node.add()
+    am.name = "best"
+    am.op = "ArgMax"
+    am.input.extend(["h1", "axis"])
+    table = gd.node.add()
+    table.name = "tbl"
+    table.op = "HashTableV2"
+    table.attr["key_dtype"].type = DT_INT64
+    table.attr["value_dtype"].type = DT_INT64
+    _const(gd, "default", np.asarray(0, np.int64))
+    find = gd.node.add()
+    find.name = "mapped"
+    find.op = "LookupTableFindV2"
+    find.input.extend(["tbl", "best", "default"])
+    cast = gd.node.add()
+    cast.name = "mf"
+    cast.op = "Cast"
+    cast.input.append("mapped")
+    cast.attr["SrcT"].type = DT_INT64
+    cast.attr["DstT"].type = DT_FLOAT
+    oh = gd.node.add()
+    oh.name = "mf2"
+    oh.op = "ExpandDims"
+    oh.input.extend(["mf", "axis"])
+    _const(gd, "w2", np.asarray([[1.0, 2.0, 3.0]], np.float32))
+    mm2 = gd.node.add()
+    mm2.name = "h2"
+    mm2.op = "MatMul"
+    mm2.input.extend(["mf2", "w2"])
+    tables = {"tbl": LookupTable([0, 1, 2], [7, 8, 9], False)}
+    part = try_partition(gd, ["x:0"], ["h2:0"],
+                         funclib=_FuncLib(None), tables=tables)
+    assert part is not None
+    assert part.stats["segment"] == 2  # the later MatMul segment won
+    assert "MatMul" in part.stats["interior_ops"]
+    assert "MatMul" in part.stats["host_pre_ops"]  # h1 demoted to host
+    x = np.array([[0.1, 2.0, 0.3]], np.float32)
+    outs = part.run([x], batch_buckets=(1, 2))
+    ref = GraphFunction(gd, ["x:0"], ["h2:0"], tables=tables)
+    np.testing.assert_allclose(outs[0], ref([x], np)[0], rtol=1e-6)
+
+
+def test_multi_slot_fed_node_uses_only_consumed_slots():
+    """Feeds sharing one node name (the ParseExample bypass shape): the
+    interior must take ONLY the slot it consumes as a jit argument — a
+    string sibling slot fed to a host lookup must not leak in."""
+    gd = tf_pb2 = tf_graph_pb2.GraphDef()
+    # "parse" stands in for a bypassed multi-output node: both feeds are
+    # slots of it (never evaluated — fed), so no op/attrs needed.
+    parse = gd.node.add()
+    parse.name = "parse"
+    parse.op = "Placeholder"
+    table = gd.node.add()
+    table.name = "tbl"
+    table.op = "HashTableV2"
+    table.attr["key_dtype"].type = DT_STRING
+    table.attr["value_dtype"].type = DT_STRING
+    _const(gd, "default", np.asarray(b"UNK", object))
+    find = gd.node.add()
+    find.name = "label"
+    find.op = "LookupTableFindV2"
+    find.input.extend(["tbl", "parse:1", "default"])
+    _const(gd, "w", np.eye(2, dtype=np.float32))
+    mm = gd.node.add()
+    mm.name = "logits"
+    mm.op = "MatMul"
+    mm.input.extend(["parse:0", "w"])
+    tables = {"tbl": LookupTable([b"x"], [b"X"], True)}
+    part = try_partition(
+        gd, ["parse:0", "parse:1"], ["logits:0", "label:0"],
+        funclib=_FuncLib(None), tables=tables,
+        string_feed_refs=frozenset(["parse:1"]))
+    assert part is not None
+    assert part.used_feed_idx == [0]  # slot 0 only, not the string slot
+    x = np.array([[1.0, 2.0]], np.float32)
+    toks = np.array([b"x"], object)
+    outs = part.run([x, toks], batch_buckets=(1, 2))
+    np.testing.assert_allclose(outs[0], x)
+    np.testing.assert_array_equal(np.asarray(outs[1], object), [b"X"])
