@@ -534,6 +534,11 @@ for line in sys.stdin:
     ts.sort()
     print(json.dumps({{"p50_ms": ts[len(ts)//2]}}), flush=True)
 """)
+# str.replace silently no-ops when the template drifts, which would leave
+# the 300-iter one-shot script running under the stdin protocol (parent
+# blocks until the watchdog kills it, yardstick silently lost).
+assert _TF_YARDSTICK_SERVER_CODE != _TF_YARDSTICK_CODE, \
+    "yardstick server template drifted: replace() matched nothing"
 
 
 def _chunk_p50(call, n: int) -> float:

@@ -96,6 +96,27 @@ def pad_to_max(arrays: list[np.ndarray], axis: int,
     return out
 
 
+def _slice_sparse_triple(arrays: dict, chunk: dict, name: str,
+                         start: int, end: int) -> None:
+    """Replace the naive row slices of a sparse triple in `chunk` with
+    the correct example-range restriction: rows in [start, end) keep
+    their values with re-based row ids; the chunk dense_shape is
+    [end-start, chunk's own max width]."""
+    ia, va, sa = f"{name}#indices", f"{name}#values", f"{name}#shape"
+    if ia not in arrays:
+        return
+    idx = np.asarray(arrays[ia], dtype=np.int64).reshape(-1, 2)
+    rows = idx[:, 0] if idx.size else np.zeros(0, np.int64)
+    keep = (rows >= start) & (rows < end)
+    sub = idx[keep].copy()
+    if sub.size:
+        sub[:, 0] -= start
+    chunk[ia] = sub
+    chunk[va] = np.asarray(arrays[va])[keep]
+    width = int(sub[:, 1].max()) + 1 if sub.size else 0
+    chunk[sa] = np.asarray([end - start, width], np.int64)
+
+
 def pad_ragged(arrays: list[np.ndarray]) -> list[np.ndarray]:
     """Pad non-batch dims to the per-batch max (batching_util.cc semantics:
     rank 1-6, pad value = tensor's first element)."""
@@ -169,11 +190,9 @@ class BatchedSignatureRunner:
         # first-element rule); the merge then only bridges bucket gaps.
         true_seq = self.signature._true_seq_len(arrays)
         arrays = self.signature._pad_seq(arrays)
-        sizes = {a.shape[0] for a in arrays.values() if a.ndim}
-        if len(sizes) != 1:
-            raise ServingError.invalid_argument(
-                "inconsistent batch dims across inputs")
-        n = sizes.pop()
+        # Example count, not dim 0 of everything: sparse-triple aliases
+        # lead with nnz and carry the batch in '<f>#shape'[0].
+        n = self.signature.request_batch(arrays)
         if n == 0:
             raise ServingError.invalid_argument("empty batch")
         if n >= self._max_batch_size:
@@ -195,8 +214,10 @@ class BatchedSignatureRunner:
         """Split a large request into max-size chunks run directly."""
         outs: list[dict] = []
         for start in range(0, n, self._max_batch_size):
-            chunk = {k: a[start:start + self._max_batch_size]
-                     for k, a in arrays.items()}
+            end = min(start + self._max_batch_size, n)
+            chunk = {k: a[start:end] for k, a in arrays.items()}
+            for name in self.signature.sparse_feature_names():
+                _slice_sparse_triple(arrays, chunk, name, start, end)
             outs.append(self._inner_run(chunk, output_filter))
         return {k: np.concatenate([o[k] for o in outs]) for k in outs[0]}
 
@@ -209,9 +230,35 @@ class BatchedSignatureRunner:
         total = sum(sizes)
         merged = {}
         sb = self.signature.sequence_bucketing
+        # Sparse-triple features merge as SparseTensors: indices rows
+        # offset by each task's example offset, values concatenate,
+        # dense_shape becomes [total, max width] — exactly the triple a
+        # single decode of the concatenated Examples would produce.
+        sparse_handled: set[str] = set()
+        for name in self.signature.sparse_feature_names():
+            ia, va, sa = (f"{name}#indices", f"{name}#values",
+                          f"{name}#shape")
+            if ia not in batch[0].inputs:
+                continue
+            idx_cols, off = [], 0
+            for t, size in zip(batch, sizes):
+                idx = np.array(t.inputs[ia], dtype=np.int64, copy=True)
+                if idx.size:
+                    idx[:, 0] += off
+                idx_cols.append(idx.reshape(-1, 2))
+                off += size
+            merged[ia] = np.concatenate(idx_cols, axis=0)
+            merged[va] = np.concatenate(
+                [t.inputs[va] for t in batch], axis=0)
+            width = max((int(np.asarray(t.inputs[sa]).reshape(-1)[1])
+                         for t in batch), default=0)
+            merged[sa] = np.asarray([total, width], np.int64)
+            sparse_handled.update((ia, va, sa))
         with trace("batching/merge"):
             rpv = self.signature.ragged_pad_values
             for alias in batch[0].inputs:
+                if alias in sparse_handled:
+                    continue
                 columns = [t.inputs[alias] for t in batch]
                 if sb is not None and alias in sb.pad_values:
                     # Tasks arrive at (different) allowed bucket lengths;

@@ -164,6 +164,94 @@ def encode(params: dict, config: T5Config, input_ids: jax.Array,
     return nn.rms_norm(params["encoder"]["final_norm"], x)
 
 
+# -- pipeline-parallel serving (encoder stack; SURVEY.md §2.11 PP row) -------
+
+
+def build_pipeline_state(params: dict, config: T5Config, *, mesh) -> dict:
+    """Regroup T5 params for a pipelined ENCODER: the encoder layers
+    split into `stage` contiguous groups stacked with a leading stage dim
+    (sharded over the mesh's stage axis — each device holds exactly its
+    stage's weights); everything else — shared embedding, relative-bias
+    table, final norm, the whole decoder — replicates under "rest" (the
+    decoder runs outside the pipeline on every device). Mirrors
+    bert.build_pipeline_state."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from min_tfs_client_tpu.parallel.pipeline import (
+        STAGE_AXIS,
+        stack_stage_params,
+    )
+
+    n_stages = int(mesh.shape[STAGE_AXIS])
+    if config.num_encoder_layers % n_stages:
+        raise ValueError(
+            f"num_encoder_layers {config.num_encoder_layers} not "
+            f"divisible by {n_stages} pipeline stages")
+    group = config.num_encoder_layers // n_stages
+    enc_layers = params["encoder"]["layers"]
+    stacked = stack_stage_params(
+        [{"layers": enc_layers[i * group:(i + 1) * group]}
+         for i in range(n_stages)])
+    stacked = jax.tree_util.tree_map(
+        lambda p: jax.device_put(jnp.asarray(p),
+                                 NamedSharding(mesh, P(STAGE_AXIS))),
+        stacked)
+    replicate = NamedSharding(mesh, P())
+    rest = {
+        "shared_embedding": params["shared_embedding"],
+        "decoder": params["decoder"],
+        "encoder": {k: v for k, v in params["encoder"].items()
+                    if k != "layers"},
+    }
+    rest = jax.tree_util.tree_map(
+        lambda p: jax.device_put(jnp.asarray(p), replicate), rest)
+    return {"stages": stacked, "rest": rest}
+
+
+def pipelined_encode(pp_params: dict, config: T5Config,
+                     input_ids: jax.Array, lengths: jax.Array, *,
+                     mesh, n_micro: int | None = None) -> jax.Array:
+    """encode() over stage-sharded params: embedding + relative bias on
+    every device, the encoder layer stack as a GPipe microbatch pipeline
+    (one ICI hop per stage), final norm on the drained outputs. Matches
+    encode() numerics exactly — same layers, different residency."""
+    import math
+
+    from min_tfs_client_tpu.parallel.pipeline import (
+        STAGE_AXIS,
+        pipeline_apply,
+    )
+
+    rest = pp_params["rest"]
+    b, s = input_ids.shape
+    x = nn.embed(rest["shared_embedding"], input_ids)
+    bias = relative_bias(rest["encoder"]["rel_bias"], config, s, s,
+                         bidirectional=True)
+    # pipeline_apply microbatches dim 0 of every carried leaf: broadcast
+    # the (1, heads, s, s) bias so it can travel with the activations.
+    bias = jnp.broadcast_to(bias, (b,) + bias.shape[1:])
+
+    def stage_fn(stage_tree, carry):
+        x, lengths, bias = carry
+        for layer in stage_tree["layers"]:
+            h = nn.rms_norm(layer["self_norm"], x)
+            attn, _ = nn.mha(layer["self_attention"], h,
+                             num_heads=config.num_heads, lengths=lengths,
+                             bias=bias, scale=1.0)
+            x = x + attn
+            h = nn.rms_norm(layer["mlp_norm"], x)
+            x = x + nn.mlp(layer["mlp"], h, activation=jax.nn.relu)
+        return (x, lengths, bias)
+
+    requested = n_micro or int(mesh.shape[STAGE_AXIS])
+    x, _, _ = pipeline_apply(
+        stage_fn, pp_params["stages"], (x, lengths, bias), mesh=mesh,
+        # gcd keeps the microbatch schedule legal for small batch buckets
+        # (batch is static under jit).
+        n_micro=math.gcd(b, requested))
+    return nn.rms_norm(rest["encoder"]["final_norm"], x)
+
+
 # -- decoder -----------------------------------------------------------------
 
 
@@ -215,12 +303,17 @@ def _decoder_step(params: dict, config: T5Config, token: jax.Array,
 
 
 def greedy_decode(params: dict, config: T5Config, input_ids: jax.Array,
-                  lengths: jax.Array, *, max_decode_len: int
+                  lengths: jax.Array, *, max_decode_len: int,
+                  encoded: jax.Array | None = None
                   ) -> tuple[jax.Array, jax.Array]:
     """Full generation in one traced program. Returns (output_ids
-    (B, max_decode_len) padded with pad_id after EOS, output_lengths (B,))."""
+    (B, max_decode_len) padded with pad_id after EOS, output_lengths (B,)).
+    `encoded` lets a caller inject encoder outputs computed elsewhere
+    (the pipelined encoder); `params` then only needs the decoder +
+    shared embedding."""
     b = input_ids.shape[0]
-    encoded = encode(params, config, input_ids, lengths)
+    if encoded is None:
+        encoded = encode(params, config, input_ids, lengths)
     d_head = config.d_kv
     caches = [{"self": nn.init_cache(b, config.num_heads, max_decode_len,
                                      d_head)}
@@ -584,19 +677,66 @@ def build_signatures(params: dict, config: T5Config, *, seq_len: int,
                      sampling_top_p: bool = False,
                      session_sampling: bool = False,
                      beam_size: int = 0,
-                     beam_length_penalty: float = 1.0) -> dict:
+                     beam_length_penalty: float = 1.0,
+                     pipeline_mesh=None,
+                     pipeline_n_micro: int | None = None) -> dict:
     from min_tfs_client_tpu.servables.servable import Signature, TensorSpec
 
-    def decode_fn(params, inputs):
-        ids = jnp.asarray(inputs["input_ids"], jnp.int32)
-        lengths = jnp.sum((ids != config.pad_id).astype(jnp.int32), axis=-1)
-        output_ids, out_lengths = greedy_decode(
-            params, config, ids, lengths, max_decode_len=max_decode_len)
-        return {"output_ids": output_ids, "output_lengths": out_lengths}
+    # With `pipeline_mesh` (a Mesh carrying a "stage" axis) the ENCODER
+    # stack serves pipeline-parallel for decode/serving_default/encode:
+    # stage-resident encoder weights, GPipe microbatch schedule, decoder
+    # replicated (it runs the autoregressive scan on every device). The
+    # remaining surfaces (sampled/beam/speculative/sessions) keep the
+    # standard replicated tree — correctness first; their encode can be
+    # pipelined the same way later.
+    if pipeline_mesh is not None:
+        pp_params = build_pipeline_state(params, config, mesh=pipeline_mesh)
+
+        def decode_fn(pp, inputs):
+            ids = jnp.asarray(inputs["input_ids"], jnp.int32)
+            lengths = jnp.sum((ids != config.pad_id).astype(jnp.int32),
+                              axis=-1)
+            encoded = pipelined_encode(pp, config, ids, lengths,
+                                       mesh=pipeline_mesh,
+                                       n_micro=pipeline_n_micro)
+            output_ids, out_lengths = greedy_decode(
+                pp["rest"], config, ids, lengths,
+                max_decode_len=max_decode_len, encoded=encoded)
+            return {"output_ids": output_ids,
+                    "output_lengths": out_lengths}
+
+        def encode_sig_fn(pp, inputs):
+            ids = jnp.asarray(inputs["input_ids"], jnp.int32)
+            lengths = jnp.sum((ids != config.pad_id).astype(jnp.int32),
+                              axis=-1)
+            return {"encodings": pipelined_encode(
+                pp, config, ids, lengths, mesh=pipeline_mesh,
+                n_micro=pipeline_n_micro).astype(jnp.float32)}
+
+        sig_params = pp_params
+    else:
+        def decode_fn(params, inputs):
+            ids = jnp.asarray(inputs["input_ids"], jnp.int32)
+            lengths = jnp.sum((ids != config.pad_id).astype(jnp.int32),
+                              axis=-1)
+            output_ids, out_lengths = greedy_decode(
+                params, config, ids, lengths,
+                max_decode_len=max_decode_len)
+            return {"output_ids": output_ids,
+                    "output_lengths": out_lengths}
+
+        def encode_sig_fn(params, inputs):
+            ids = jnp.asarray(inputs["input_ids"], jnp.int32)
+            lengths = jnp.sum((ids != config.pad_id).astype(jnp.int32),
+                              axis=-1)
+            return {"encodings": encode(params, config, ids,
+                                        lengths).astype(jnp.float32)}
+
+        sig_params = params
 
     decode_sig = Signature(
         fn=decode_fn,
-        params=params,
+        params=sig_params,
         inputs={"input_ids": TensorSpec(np.int32, (None, seq_len))},
         outputs={"output_ids": TensorSpec(np.int32, (None, max_decode_len)),
                  "output_lengths": TensorSpec(np.int32, (None,))},
@@ -604,15 +744,9 @@ def build_signatures(params: dict, config: T5Config, *, seq_len: int,
         batch_buckets=(1, 4, 16, 32),
     )
 
-    def encode_sig_fn(params, inputs):
-        ids = jnp.asarray(inputs["input_ids"], jnp.int32)
-        lengths = jnp.sum((ids != config.pad_id).astype(jnp.int32), axis=-1)
-        return {"encodings": encode(params, config, ids, lengths).astype(
-            jnp.float32)}
-
     encode_sig = Signature(
         fn=encode_sig_fn,
-        params=params,
+        params=sig_params,
         inputs={"input_ids": TensorSpec(np.int32, (None, seq_len))},
         outputs={"encodings": TensorSpec(
             np.float32, (None, seq_len, config.d_model))},

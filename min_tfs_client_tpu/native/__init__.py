@@ -55,5 +55,14 @@ def load() -> ctypes.CDLL | None:
             ctypes.c_uint64,                      # per-example value count
             ctypes.POINTER(ctypes.c_int64),       # per-example found counts
         ]
+        lib.tpuserve_hash_buckets.restype = None
+        lib.tpuserve_hash_buckets.argtypes = [
+            ctypes.c_char_p,                      # concatenated strings
+            ctypes.POINTER(ctypes.c_uint64),      # offsets
+            ctypes.POINTER(ctypes.c_uint64),      # lengths
+            ctypes.c_long,                        # n strings
+            ctypes.c_uint64,                      # num_buckets
+            ctypes.POINTER(ctypes.c_int64),       # out buckets
+        ]
         _lib = lib
         return _lib

@@ -11,6 +11,7 @@
 //   masked crc        TFRecord's rotated+offset masking
 //   tfrecord framing  batch scan of [len][lencrc][data][datacrc] records
 //   pad_rows          batched row-padding memcpy kernel (batch assembly)
+//   farmhash64        FarmHash Fingerprint64 batch hash-bucketing
 //   example parsing   protobuf wire-format scan of tensorflow.Example
 //                     batches into dense numeric columns (the reference
 //                     parses Examples with the in-graph ParseExample op,
@@ -24,6 +25,7 @@
 #include <cstring>
 #include <cstddef>
 #include <mutex>
+#include <utility>
 
 namespace {
 
@@ -306,9 +308,168 @@ long ParseExampleFeature(const uint8_t* p, const uint8_t* end,
   return count;
 }
 
+// ---------------------------------------------------------------------------
+// FarmHash Fingerprint64 (the na::Hash64 variant TF's StringToHashBucketFast
+// is defined by; frozen public-domain algorithm — constants are the
+// contract). Mirrors utils/farmhash.py, which is golden-validated against
+// TF's own kernel; this is the batch fast path for host-side hash-bucket
+// features at serving scale.
+
+namespace farmhash {
+
+constexpr uint64_t kK0 = 0xc3a5c85c97cb3127ULL;
+constexpr uint64_t kK1 = 0xb492b66fbe98f273ULL;
+constexpr uint64_t kK2 = 0x9ae16a3b2f90404fULL;
+
+inline uint64_t Fetch64(const uint8_t* p) {
+  uint64_t v;
+  std::memcpy(&v, p, 8);
+  return v;  // little-endian hosts only (x86/arm64)
+}
+
+inline uint32_t Fetch32(const uint8_t* p) {
+  uint32_t v;
+  std::memcpy(&v, p, 4);
+  return v;
+}
+
+inline uint64_t Rot(uint64_t v, int n) { return (v >> n) | (v << (64 - n)); }
+
+inline uint64_t ShiftMix(uint64_t v) { return v ^ (v >> 47); }
+
+inline uint64_t HashLen16(uint64_t u, uint64_t v, uint64_t mul) {
+  uint64_t a = (u ^ v) * mul;
+  a ^= a >> 47;
+  uint64_t b = (v ^ a) * mul;
+  b ^= b >> 47;
+  return b * mul;
+}
+
+inline uint64_t HashLen0to16(const uint8_t* s, size_t n) {
+  if (n >= 8) {
+    uint64_t mul = kK2 + n * 2;
+    uint64_t a = Fetch64(s) + kK2;
+    uint64_t b = Fetch64(s + n - 8);
+    uint64_t c = Rot(b, 37) * mul + a;
+    uint64_t d = (Rot(a, 25) + b) * mul;
+    return HashLen16(c, d, mul);
+  }
+  if (n >= 4) {
+    uint64_t mul = kK2 + n * 2;
+    uint64_t a = Fetch32(s);
+    return HashLen16(n + (a << 3), Fetch32(s + n - 4), mul);
+  }
+  if (n > 0) {
+    uint64_t a = s[0], b = s[n >> 1], c = s[n - 1];
+    uint64_t y = a + (b << 8);
+    uint64_t z = n + (c << 2);
+    return ShiftMix(y * kK2 ^ z * kK0) * kK2;
+  }
+  return kK2;
+}
+
+inline uint64_t HashLen17to32(const uint8_t* s, size_t n) {
+  uint64_t mul = kK2 + n * 2;
+  uint64_t a = Fetch64(s) * kK1;
+  uint64_t b = Fetch64(s + 8);
+  uint64_t c = Fetch64(s + n - 8) * mul;
+  uint64_t d = Fetch64(s + n - 16) * kK2;
+  return HashLen16(Rot(a + b, 43) + Rot(c, 30) + d,
+                   a + Rot(b + kK2, 18) + c, mul);
+}
+
+inline uint64_t HashLen33to64(const uint8_t* s, size_t n) {
+  uint64_t mul = kK2 + n * 2;
+  uint64_t a = Fetch64(s) * kK2;
+  uint64_t b = Fetch64(s + 8);
+  uint64_t c = Fetch64(s + n - 8) * mul;
+  uint64_t d = Fetch64(s + n - 16) * kK2;
+  uint64_t y = Rot(a + b, 43) + Rot(c, 30) + d;
+  uint64_t z = HashLen16(y, a + Rot(b + kK2, 18) + c, mul);
+  uint64_t e = Fetch64(s + 16) * mul;
+  uint64_t f = Fetch64(s + 24);
+  uint64_t g = (y + Fetch64(s + n - 32)) * mul;
+  uint64_t h = (z + Fetch64(s + n - 24)) * mul;
+  return HashLen16(Rot(e + f, 43) + Rot(g, 30) + h,
+                   e + Rot(f + a, 18) + g, mul);
+}
+
+struct U128 {
+  uint64_t first, second;
+};
+
+inline U128 WeakHash32Seeds(uint64_t w, uint64_t x, uint64_t y, uint64_t z,
+                            uint64_t a, uint64_t b) {
+  a += w;
+  b = Rot(b + a + z, 21);
+  uint64_t c = a;
+  a += x;
+  a += y;
+  b += Rot(a, 44);
+  return {a + z, b + c};
+}
+
+inline U128 WeakHash32(const uint8_t* s, uint64_t a, uint64_t b) {
+  return WeakHash32Seeds(Fetch64(s), Fetch64(s + 8), Fetch64(s + 16),
+                         Fetch64(s + 24), a, b);
+}
+
+uint64_t Fingerprint64(const uint8_t* s, size_t n) {
+  if (n <= 16) return HashLen0to16(s, n);
+  if (n <= 32) return HashLen17to32(s, n);
+  if (n <= 64) return HashLen33to64(s, n);
+  const uint64_t seed = 81;
+  uint64_t x = seed;
+  uint64_t y = seed * kK1 + 113;
+  uint64_t z = ShiftMix(y * kK2 + 113) * kK2;
+  U128 v{0, 0}, w{0, 0};
+  x = x * kK2 + Fetch64(s);
+  const uint8_t* end = s + ((n - 1) / 64) * 64;
+  const uint8_t* last64 = end + ((n - 1) & 63) - 63;
+  do {
+    x = Rot(x + y + v.first + Fetch64(s + 8), 37) * kK1;
+    y = Rot(y + v.second + Fetch64(s + 48), 42) * kK1;
+    x ^= w.second;
+    y += v.first + Fetch64(s + 40);
+    z = Rot(z + w.first, 33) * kK1;
+    v = WeakHash32(s, v.second * kK1, x + w.first);
+    w = WeakHash32(s + 32, z + w.second, y + Fetch64(s + 16));
+    std::swap(z, x);
+    s += 64;
+  } while (s != end);
+  uint64_t mul = kK1 + ((z & 0xff) << 1);
+  s = last64;
+  w.first += (n - 1) & 63;
+  v.first += w.first;
+  w.first += v.first;
+  x = Rot(x + y + v.first + Fetch64(s + 8), 37) * mul;
+  y = Rot(y + v.second + Fetch64(s + 48), 42) * mul;
+  x ^= w.second * 9;
+  y += v.first * 9 + Fetch64(s + 40);
+  z = Rot(z + w.first, 33) * mul;
+  v = WeakHash32(s, v.second * mul, x + w.first);
+  w = WeakHash32(s + 32, z + w.second, y + Fetch64(s + 16));
+  std::swap(z, x);
+  return HashLen16(HashLen16(v.first, w.first, mul) + ShiftMix(y) * kK0 + z,
+                   HashLen16(v.second, w.second, mul) + x, mul);
+}
+
+}  // namespace farmhash
+
 }  // namespace
 
 extern "C" {
+
+// Batch StringToHashBucketFast: Fingerprint64(s) % num_buckets per string
+// (strings concatenated in buf, addressed by offsets/lengths).
+void tpuserve_hash_buckets(const uint8_t* buf, const uint64_t* offsets,
+                           const uint64_t* lengths, long n,
+                           uint64_t num_buckets, int64_t* out) {
+  for (long i = 0; i < n; ++i) {
+    uint64_t h = farmhash::Fingerprint64(buf + offsets[i], lengths[i]);
+    out[i] = static_cast<int64_t>(h % num_buckets);
+  }
+}
 
 uint32_t tpuserve_crc32c(const uint8_t* data, size_t n) {
   return Extend(0, data, n);

@@ -399,6 +399,12 @@ def _string_to_hash_bucket(node, inputs, lib):
     from min_tfs_client_tpu.utils.farmhash import string_to_hash_bucket_fast
 
     num = int(node.attr["num_buckets"].i)
+    if num < 1:
+        # TF's op registration requires >= 1; a malformed export must
+        # fail loudly here, not SIGFPE in the native modulo.
+        raise GraphImportError(
+            f"{node.name}: StringToHashBucketFast num_buckets={num} "
+            "(must be >= 1)")
     return [string_to_hash_bucket_fast(np.asarray(inputs[0]), num)]
 
 
@@ -1557,9 +1563,14 @@ def load_saved_model(
                         for a in in_aliases}
         out_specs = {a: _spec_from_tensor_info(sig_def.outputs[a])
                      for a in out_aliases}
-        # Batched iff every input has a polymorphic leading dim.
+        # Batched iff every input has a polymorphic leading dim —
+        # sparse-triple pseudo-aliases (raw_shapes) don't lead with the
+        # batch (indices/values lead with nnz, shape is [2]); their
+        # batching semantics live in the sparse merge instead.
+        pseudo = bypass.raw_shapes if feature_specs is not None else {}
         batched = bool(in_specs) and all(
-            spec.shape and spec.shape[0] is None for spec in in_specs.values())
+            spec.shape and spec.shape[0] is None
+            for name, spec in in_specs.items() if name not in pseudo)
 
         # String/table signatures: try the placer-style split (host pre ->
         # jitted dense interior -> host post; servables/partition.py). The

@@ -28,6 +28,7 @@ from typing import Callable, Sequence
 
 import numpy as np
 
+from min_tfs_client_tpu.protos import tf_tensor_pb2
 from min_tfs_client_tpu.servables.servable import fetch_outputs
 
 # Ops that must run on host regardless of their dtype attrs (string
@@ -64,7 +65,7 @@ _NEUTRAL_OPS = frozenset({
     "VariableV2", "Variable", "VarHandleOp",
 })
 
-DT_STRING = 7
+DT_STRING = tf_tensor_pb2.DT_STRING
 
 # Semantic value-input positions the op registry reads as STATIC Python
 # ints (shape/axis operands). -1 = last value input (ConcatV2's axis).
@@ -87,10 +88,13 @@ class PartitionError(Exception):
 
 
 def _tensor_name(ref: str) -> tuple[str, int]:
-    if ":" in ref:
-        node, idx = ref.rsplit(":", 1)
-        return node, int(idx)
-    return ref, 0
+    # One splitting rule with the importer (lazy import: graphdef_import
+    # imports this module inside load_saved_model).
+    from min_tfs_client_tpu.servables.graphdef_import import (
+        _tensor_name as impl,
+    )
+
+    return impl(ref)
 
 
 def _attr_has_string(node) -> bool:
@@ -140,6 +144,14 @@ class GraphPartition:
 
         self._jit_cache: "collections.OrderedDict[tuple, Callable]" = \
             collections.OrderedDict()
+        # Which interior outputs / post results are batch-major, learned
+        # from a batch-1 calibration run the first time padding applies:
+        # slicing by "leading dim == bucket" alone would truncate a
+        # fixed-size output (a (16,) vocab constant, say) whenever the
+        # bucket coincides with its length. None = not yet calibrated
+        # (fall back to the dim-match heuristic).
+        self._interior_batch_major: list[bool] | None = None
+        self._result_batch_major: list[bool] | None = None
 
     def _split_static(self, values: list[np.ndarray]):
         """-> (dynamic values, static values, hashable static key)."""
@@ -228,23 +240,60 @@ class GraphPartition:
             padded, batch, bucket = dyn, None, None
         else:
             padded, batch, bucket = _pad_interior(dyn, batch_buckets)
+        sliced = bucket is not None and bucket != batch
+        if sliced and self._interior_batch_major is None:
+            self._calibrate(feed_values)
         outs = self.interior_jitted(stat, static_key)(padded)
         fetched = fetch_outputs(dict(enumerate(outs)))
         outs = [fetched[i] for i in range(len(outs))]
-        if bucket is not None and bucket != batch:
+        if sliced:
             outs = [o[:batch]
-                    if o.ndim and o.shape[0] == bucket else o
-                    for o in outs]
+                    if self._is_batch_major(self._interior_batch_major,
+                                            i, o, bucket) else o
+                    for i, o in enumerate(outs)]
         post_feeds = feed_values + cut_values + [np.asarray(o) for o in outs]
         results = self.post(post_feeds, np)
-        if bucket is not None and bucket != batch:
+        if sliced:
             # Post ops driven by a Shape VALUE computed inside the padded
             # interior (tf.shape -> Tile is the classic classify labels
             # wiring) emit bucket-sized rows; slice those back too.
             results = [np.asarray(r)[:batch]
-                       if np.ndim(r) and np.shape(r)[0] == bucket else r
-                       for r in results]
+                       if self._is_batch_major(self._result_batch_major,
+                                               i, np.asarray(r), bucket)
+                       else r
+                       for i, r in enumerate(results)]
         return results
+
+    @staticmethod
+    def _is_batch_major(flags: "list[bool] | None", i: int, arr,
+                        bucket: int) -> bool:
+        if not (np.ndim(arr) and np.shape(arr)[0] == bucket):
+            return False
+        if flags is None or i >= len(flags):
+            return True  # uncalibrated: dim-match heuristic
+        return flags[i]
+
+    def _calibrate(self, feed_values: list[np.ndarray]) -> None:
+        """Batch-1 probe through all three stages: outputs whose leading
+        dim follows the batch are batch-major (a fixed (1, ...) output
+        mis-marked here is harmless — [:batch] of one row with batch>=1
+        is the identity). Failures leave the heuristic in place."""
+        try:
+            one = [v[:1] if np.ndim(v) else v for v in feed_values]
+            cuts = ([np.asarray(v) for v in self.pre(one, np)]
+                    if self.cut_in_refs else [])
+            interior_feeds = [one[i] for i in self.used_feed_idx] + cuts
+            dyn, stat, key = self._split_static(interior_feeds)
+            outs = [np.asarray(o)
+                    for o in self.interior_jitted(stat, key)(dyn)]
+            interior_flags = [bool(o.ndim and o.shape[0] == 1)
+                              for o in outs]
+            results = self.post(one + cuts + outs, np)
+            self._result_batch_major = [
+                bool(np.ndim(r) and np.shape(r)[0] == 1) for r in results]
+            self._interior_batch_major = interior_flags
+        except Exception:  # pragma: no cover - keep the heuristic
+            pass
 
 
 def _pad_interior(values: list[np.ndarray], buckets: Sequence[int]):

@@ -303,7 +303,64 @@ class Signature:
                         f"input {alias!r}: {exc}")
             spec.validate(arr, alias)
             arrays[alias] = arr
+        self._validate_sparse_triples(arrays)
         return arrays
+
+    def _validate_sparse_triples(self, arrays: dict) -> None:
+        """Internal consistency of sparse-triple features, enforced
+        BEFORE a request can join a batch (a malformed triple must fail
+        alone with INVALID_ARGUMENT, never its co-batched callers deep
+        inside a host kernel)."""
+        for name in self.sparse_feature_names():
+            ia, va, sa = (f"{name}#indices", f"{name}#values",
+                          f"{name}#shape")
+            if ia not in arrays or va not in arrays or sa not in arrays:
+                continue
+            idx = np.asarray(arrays[ia]).reshape(-1, 2)
+            vals = np.asarray(arrays[va]).reshape(-1)
+            shp = np.asarray(arrays[sa]).reshape(-1)
+            if idx.shape[0] != vals.shape[0]:
+                raise ServingError.invalid_argument(
+                    f"sparse feature {name!r}: {idx.shape[0]} index rows "
+                    f"vs {vals.shape[0]} values")
+            if shp.size != 2 or (shp < 0).any():
+                raise ServingError.invalid_argument(
+                    f"sparse feature {name!r}: dense_shape must be two "
+                    f"non-negative dims, got {shp.tolist()}")
+            if idx.size and (
+                    (idx < 0).any()
+                    or (idx[:, 0] >= shp[0]).any()
+                    or (idx[:, 1] >= shp[1]).any()):
+                raise ServingError.invalid_argument(
+                    f"sparse feature {name!r}: indices out of bounds for "
+                    f"dense_shape {shp.tolist()}")
+
+    def sparse_feature_names(self) -> list[str]:
+        """Features decoded as TF sparse triples ('<f>#indices/#values/
+        #shape' aliases) — the batching merge treats them specially."""
+        return [n for n, s in (self.feature_specs or {}).items()
+                if getattr(s, "sparse_triple", False)]
+
+    def request_batch(self, arrays: Mapping[str, np.ndarray]) -> int:
+        """Example count of a validated request. Dense aliases carry it
+        as dim 0; sparse-triple aliases carry it in '<f>#shape'[0]
+        (indices/values lead with nnz, not batch). Raises on
+        inconsistency so a bad request fails alone."""
+        sparse_aliases: set[str] = set()
+        batches: set[int] = set()
+        for name in self.sparse_feature_names():
+            sparse_aliases.update(
+                (f"{name}#indices", f"{name}#values", f"{name}#shape"))
+            shp = arrays.get(f"{name}#shape")
+            if shp is not None:
+                batches.add(int(np.asarray(shp).reshape(-1)[0]))
+        for alias, arr in arrays.items():
+            if alias not in sparse_aliases and np.ndim(arr):
+                batches.add(int(np.shape(arr)[0]))
+        if len(batches) != 1:
+            raise ServingError.invalid_argument(
+                f"inconsistent batch dims across inputs: {sorted(batches)}")
+        return batches.pop()
 
     def run(
         self,

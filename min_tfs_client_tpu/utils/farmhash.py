@@ -165,16 +165,53 @@ def fingerprint64(s: bytes) -> int:
         (_hash_len_16(v[1], w[1], mul) + x) & _M, mul)
 
 
-def string_to_hash_bucket_fast(values, num_buckets: int) -> np.ndarray:
-    """TF StringToHashBucketFast: Fingerprint64(s) % num_buckets, int64
-    (kernel: core/kernels/string_to_hash_bucket_op.h)."""
-    arr = np.asarray(values)
-    flat = arr.reshape(-1)
-    out = np.empty(flat.shape, dtype=np.uint64)
-    for i, v in enumerate(flat.tolist()):
+def _as_bytes_list(flat) -> list[bytes]:
+    out = []
+    for v in flat.tolist():
         if isinstance(v, str):
             v = v.encode("utf-8")
         elif not isinstance(v, bytes):
             v = bytes(v)
+        out.append(v)
+    return out
+
+
+def string_to_hash_bucket_fast(values, num_buckets: int) -> np.ndarray:
+    """TF StringToHashBucketFast: Fingerprint64(s) % num_buckets, int64
+    (kernel: core/kernels/string_to_hash_bucket_op.h). Batch path runs
+    the native C++ hash (native/tpuserve.cpp tpuserve_hash_buckets — one
+    C pass over the concatenated strings); the Python implementation is
+    the always-available fallback."""
+    arr = np.asarray(values)
+    if num_buckets < 1:
+        raise ValueError(f"num_buckets must be >= 1, got {num_buckets}")
+    strings = _as_bytes_list(arr.reshape(-1))
+    native_out = _hash_buckets_native(strings, num_buckets)
+    if native_out is not None:
+        return native_out.reshape(arr.shape)
+    out = np.empty((len(strings),), dtype=np.uint64)
+    for i, v in enumerate(strings):
         out[i] = fingerprint64(v) % num_buckets
     return out.astype(np.int64).reshape(arr.shape)
+
+
+def _hash_buckets_native(strings: list[bytes],
+                         num_buckets: int) -> np.ndarray | None:
+    import ctypes
+
+    from min_tfs_client_tpu import native
+
+    lib = native.load()
+    if lib is None or not strings:
+        return None if lib is None else np.zeros((0,), np.int64)
+    lengths = np.array([len(s) for s in strings], dtype=np.uint64)
+    offsets = np.zeros_like(lengths)
+    np.cumsum(lengths[:-1], out=offsets[1:])
+    out = np.empty((len(strings),), dtype=np.int64)
+    lib.tpuserve_hash_buckets(
+        b"".join(strings),
+        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        lengths.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        len(strings), num_buckets,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+    return out

@@ -182,10 +182,22 @@ def test_dense_head_partitions_to_device(exported):
 
 
 @pytest.mark.integration
+def test_estimator_signature_joins_batching(exported):
+    version_dir, _ = exported
+    servable = load_saved_model(str(version_dir), "est", 1)
+    sig = servable.signature("")
+    # Sparse pseudo-aliases must not block coalescing: the sparse merge
+    # (batching/session.py) owns their batching semantics.
+    assert sig.batched
+
+
+@pytest.mark.integration
 def test_classify_serves_end_to_end(exported):
+    # --enable_batching on: the request crosses the batching front-end
+    # including the sparse-triple merge path.
     version_dir, want = exported
     srv = Server(ServerOptions(
-        grpc_port=0, model_name="est",
+        grpc_port=0, model_name="est", enable_batching=True,
         model_base_path=str(version_dir.parent),
         file_system_poll_wait_seconds=0)).build_and_start()
     try:
@@ -239,3 +251,111 @@ print(json.dumps([[s.hex(), int(a), int(b)]
         h = fingerprint64(bytes.fromhex(hex_s))
         assert h % (1 << 62) == mod62
         assert h % 999983 == mod_p
+
+
+WEIGHTED_EXPORT_SCRIPT = """
+import sys
+import numpy as np
+import tensorflow as tf
+
+tf1 = tf.compat.v1
+tf1.disable_eager_execution()
+
+export_dir, examples_path, out_path = sys.argv[1:4]
+payloads = np.load(examples_path, allow_pickle=True)
+
+fc = tf1.feature_column
+col = fc.weighted_categorical_column(
+    fc.categorical_column_with_hash_bucket("tags", 50), "tag_weights")
+emb = fc.embedding_column(col, 4, combiner="sum")
+spec = fc.make_parse_example_spec([emb])
+
+g = tf1.Graph()
+with g.as_default():
+    tf1.set_random_seed(5)
+    serialized = tf1.placeholder(tf.string, [None],
+                                 name="input_example_tensor")
+    features = tf1.io.parse_example(serialized, spec)
+    net = fc.input_layer(features, [emb])       # [B, 4]
+    rng = np.random.default_rng(13)
+    w = tf1.get_variable(
+        "w", initializer=(rng.standard_normal((4, 1)) * 0.5
+                          ).astype(np.float32))
+    outputs = tf.reshape(tf.matmul(net, w), [-1], name="predictions")
+    sig = tf1.saved_model.regression_signature_def(
+        examples=serialized, predictions=outputs)
+    builder = tf1.saved_model.Builder(export_dir)
+    with tf1.Session() as sess:
+        sess.run(tf1.global_variables_initializer())
+        builder.add_meta_graph_and_variables(
+            sess, [tf1.saved_model.SERVING],
+            signature_def_map={"serving_default": sig})
+        builder.save()
+        got = sess.run(outputs, {serialized: list(payloads)})
+np.savez(out_path, outputs=got)
+print("SAVED")
+"""
+
+WEIGHTED_FEATURES = [
+    {"tags": [b"urgent", b"billing"], "tag_weights": [2.0, 0.5]},
+    {"tags": [b"spam"], "tag_weights": [1.5]},
+    {},                                              # empty-row path
+    {"tags": [b"urgent", b"urgent", b"other"],
+     "tag_weights": [1.0, 1.0, 3.0]},                # dup key, weights add
+]
+
+
+@pytest.fixture(scope="module")
+def weighted_exported(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("weighted_export")
+    payloads = np.array(
+        [example_from_dict(d).SerializeToString()
+         for d in WEIGHTED_FEATURES], dtype=object)
+    ex_path = tmp / "examples.npy"
+    np.save(ex_path, payloads, allow_pickle=True)
+    version_dir = tmp / "model" / "1"
+    out_path = tmp / "tf_out.npz"
+    proc = _run_tf(WEIGHTED_EXPORT_SCRIPT, str(version_dir), str(ex_path),
+                   str(out_path))
+    if "SAVED" not in proc.stdout:
+        pytest.skip(f"tensorflow unavailable: {proc.stderr[-800:]}")
+    return version_dir, np.load(out_path, allow_pickle=True)
+
+
+@pytest.mark.integration
+def test_weighted_categorical_regress_matches_tf(weighted_exported):
+    """fc.weighted_categorical_column (VERDICT round-5 #3 'weighted
+    categoricals'): per-value weights ride a second VarLen feature; the
+    embedding combines weighted (combiner='sum' -> SegmentSum of
+    weight-scaled gathers). Served as Regress, cross-validated."""
+    version_dir, want = weighted_exported
+    servable = load_saved_model(str(version_dir), "wgt", 1)
+    sig = servable.signature("")
+    assert sig.feature_specs["tags"].sparse_triple
+    assert sig.feature_specs["tag_weights"].sparse_triple
+    from min_tfs_client_tpu.tensor.example_codec import decode_examples
+
+    feats = decode_examples(
+        [example_from_dict(d) for d in WEIGHTED_FEATURES],
+        sig.feature_specs)
+    out = sig.run(feats)
+    got = np.asarray(out["outputs"]).reshape(-1)
+    np.testing.assert_allclose(got, want["outputs"], rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.integration
+def test_weighted_categorical_serves_regress(weighted_exported):
+    version_dir, want = weighted_exported
+    srv = Server(ServerOptions(
+        grpc_port=0, model_name="wgt",
+        model_base_path=str(version_dir.parent),
+        file_system_poll_wait_seconds=0)).build_and_start()
+    try:
+        with TensorServingClient("127.0.0.1", srv.grpc_port) as client:
+            resp = client.regression_request("wgt", WEIGHTED_FEATURES,
+                                             timeout=120)
+            got = [r.value for r in resp.result.regressions]
+            np.testing.assert_allclose(got, want["outputs"],
+                                       rtol=1e-4, atol=1e-5)
+    finally:
+        srv.stop()

@@ -424,3 +424,143 @@ class TestHostPathBatching:
             np.testing.assert_array_equal(results[i]["y"], [3.0 * i])
         assert len(executed) <= 1 + 3 // 4
         servable.unload()
+
+
+class TestSparseTripleBatching:
+    """Estimator-class signatures (VarLen decoded as TF sparse triples)
+    coalesce too: indices rows offset per task, values concatenate,
+    dense_shape becomes [total, max width] — identical to one decode of
+    the concatenated Examples."""
+
+    def _sparse_sig(self, executed):
+        from min_tfs_client_tpu.tensor.example_codec import FeatureSpec
+
+        def fn(inputs):
+            idx = np.asarray(inputs["f#indices"], np.int64).reshape(-1, 2)
+            vals = np.asarray(inputs["f#values"], np.float32)
+            batch = int(np.asarray(inputs["f#shape"]).reshape(-1)[0])
+            executed.append(batch)
+            out = np.zeros((batch,), np.float32)
+            np.add.at(out, idx[:, 0], vals)
+            return {"sums": out + np.asarray(inputs["x"],
+                                             np.float32).reshape(-1)}
+
+        return Signature(
+            fn=fn,
+            inputs={
+                "x": TensorSpec(np.float32, (None,)),
+                "f#indices": TensorSpec(np.int64, (None, 2)),
+                "f#values": TensorSpec(np.float32, (None,)),
+                "f#shape": TensorSpec(np.int64, (2,)),
+            },
+            outputs={"sums": TensorSpec(np.float32, (None,))},
+            feature_specs={
+                "f": FeatureSpec(np.float32, sparse_triple=True),
+                "x": FeatureSpec(np.float32, (1,)),
+            },
+            on_host=True,
+        )
+
+    @staticmethod
+    def _req(x_vals, rows_vals):
+        idx = np.array([[r, i] for r, row in enumerate(rows_vals)
+                        for i in range(len(row))],
+                       np.int64).reshape(-1, 2)
+        vals = np.array([v for row in rows_vals for v in row], np.float32)
+        width = max((len(r) for r in rows_vals), default=0)
+        return {
+            "x": np.asarray(x_vals, np.float32),
+            "f#indices": idx,
+            "f#values": vals,
+            "f#shape": np.array([len(rows_vals), width], np.int64),
+        }
+
+    def test_concurrent_sparse_callers_merge_exactly(self, scheduler):
+        executed = []
+        sig = self._sparse_sig(executed)
+        runner = BatchedSignatureRunner(
+            sig, scheduler, max_batch_size=8, batch_timeout_s=0.2)
+        results = {}
+
+        def call(key, req):
+            results[key] = runner.run(req)
+
+        reqs = {
+            "a": self._req([10.0, 20.0], [[1.0, 2.0], [3.0]]),
+            "b": self._req([30.0], [[5.0, 6.0, 7.0]]),
+            "c": self._req([40.0, 50.0], [[], [4.0]]),
+        }
+        threads = [threading.Thread(target=call, args=(k, r))
+                   for k, r in reqs.items()]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10)
+        np.testing.assert_allclose(results["a"]["sums"], [13.0, 23.0])
+        np.testing.assert_allclose(results["b"]["sums"], [48.0])
+        np.testing.assert_allclose(results["c"]["sums"], [40.0, 54.0])
+        # One merged host run for all 5 examples.
+        assert executed == [5]
+        runner.close()
+
+    def test_oversized_sparse_request_chunks_by_example(self, scheduler):
+        executed = []
+        sig = self._sparse_sig(executed)
+        runner = BatchedSignatureRunner(
+            sig, scheduler, max_batch_size=2, batch_timeout_s=0.0)
+        req = self._req([1.0, 2.0, 3.0, 4.0, 5.0],
+                        [[1.0], [2.0, 2.0], [], [4.0], [0.5]])
+        out = runner.run(req)
+        np.testing.assert_allclose(out["sums"],
+                                   [2.0, 6.0, 3.0, 8.0, 5.5])
+        assert executed == [2, 2, 1]  # example-range chunks
+        runner.close()
+
+
+class TestSparseTripleValidation:
+    """A malformed sparse triple fails ALONE with INVALID_ARGUMENT at
+    validate time — before it can join a batch and fail its co-batched
+    callers deep inside a host kernel."""
+
+    def _sig(self):
+        from min_tfs_client_tpu.tensor.example_codec import FeatureSpec
+
+        return Signature(
+            fn=lambda inputs: {"y": np.zeros((1,), np.float32)},
+            inputs={
+                "f#indices": TensorSpec(np.int64, (None, 2)),
+                "f#values": TensorSpec(np.float32, (None,)),
+                "f#shape": TensorSpec(np.int64, (2,)),
+            },
+            outputs={"y": TensorSpec(np.float32, (None,))},
+            feature_specs={"f": FeatureSpec(np.float32,
+                                            sparse_triple=True)},
+            on_host=True,
+        )
+
+    def test_row_id_out_of_bounds(self):
+        sig = self._sig()
+        with pytest.raises(ServingError, match="out of bounds"):
+            sig.validate({
+                "f#indices": np.array([[7, 0]], np.int64),
+                "f#values": np.array([1.0], np.float32),
+                "f#shape": np.array([2, 3], np.int64),
+            })
+
+    def test_arity_mismatch(self):
+        sig = self._sig()
+        with pytest.raises(ServingError, match="index rows"):
+            sig.validate({
+                "f#indices": np.array([[0, 0], [1, 0]], np.int64),
+                "f#values": np.array([1.0], np.float32),
+                "f#shape": np.array([2, 1], np.int64),
+            })
+
+    def test_valid_triple_passes(self):
+        sig = self._sig()
+        out = sig.validate({
+            "f#indices": np.array([[0, 0], [1, 1]], np.int64),
+            "f#values": np.array([1.0, 2.0], np.float32),
+            "f#shape": np.array([2, 2], np.int64),
+        })
+        assert set(out) == {"f#indices", "f#values", "f#shape"}

@@ -275,3 +275,26 @@ def test_multi_slot_fed_node_uses_only_consumed_slots():
     outs = part.run([x, toks], batch_buckets=(1, 2))
     np.testing.assert_allclose(outs[0], x)
     np.testing.assert_array_equal(np.asarray(outs[1], object), [b"X"])
+
+
+def test_fixed_size_output_not_truncated_by_bucket_padding():
+    """A fixed-size fetch (vocab-style Const passthrough) whose length
+    equals the padding bucket must NOT be sliced to the true batch —
+    the batch-1 calibration learns which outputs are batch-major."""
+    gd = _classify_graph()
+    # Fixed fetch of length 4 == the bucket used below.
+    _const(gd, "vocab", np.arange(4, dtype=np.float32))
+    vid = gd.node.add()
+    vid.name = "vocab_out"
+    vid.op = "Identity"
+    vid.input.append("vocab")
+    vid.attr["T"].type = DT_FLOAT
+    part = try_partition(gd, ["x:0"],
+                         ["scores:0", "label:0", "vocab_out:0"],
+                         funclib=_FuncLib(None), tables=_tables())
+    assert part is not None
+    x = np.ones((3, 3), np.float32)  # batch 3 -> bucket 4
+    outs = part.run([x], batch_buckets=(4,))
+    assert np.asarray(outs[0]).shape == (3, 4)   # batch-major: sliced
+    assert np.asarray(outs[2]).shape == (4,)     # fixed: NOT sliced
+    np.testing.assert_allclose(outs[2], [0.0, 1.0, 2.0, 3.0])

@@ -165,3 +165,20 @@ class TestHashBucket:
         arr = np.array([b"hello"], object)
         out = string_to_hash_bucket_fast(arr, 997)
         assert out[0] == fingerprint64(b"hello") % 997
+
+
+def test_native_hash_matches_python():
+    # The C++ batch path and the Python Fingerprint64 must agree on
+    # every length branch (goldens vs TF's kernel live in the
+    # integration tier).
+    from min_tfs_client_tpu.utils.farmhash import _hash_buckets_native
+
+    strs = [b"", b"a", b"hello", b"x" * 17, b"y" * 33, b"z" * 65,
+            b"w" * 200, bytes(range(256))]
+    native = _hash_buckets_native(strs, 1 << 62)
+    if native is None:
+        import pytest
+
+        pytest.skip("native toolchain unavailable")
+    for s, nv in zip(strs, native):
+        assert nv == fingerprint64(s) % (1 << 62)
