@@ -49,11 +49,12 @@ BASELINE_FILE = REPO / "bench_baseline.json"
 # explicit stale provenance) instead of losing it entirely.
 LASTGOOD_FILE = REPO / "bench_lastgood.json"
 
-ACCEL_CONFIGS = ["bert", "resnet", "bert_int8", "matmul", "use", "t5"]
+ACCEL_CONFIGS = ["bert", "resnet", "bert_int8", "matmul", "use", "t5",
+                 "imported"]
 # CPU fallback: BERT-base is ~7.6 s/call on this host's CPU and never
 # finished inside the budget in any round; the stale accelerator record
 # carries the BERT story instead.
-CPU_CONFIGS = ["matmul", "use", "t5"]
+CPU_CONFIGS = ["matmul", "use", "imported", "t5"]
 
 BUDGET = float(os.environ.get("BENCH_BUDGET", 240))
 _START = time.monotonic()
@@ -1139,9 +1140,58 @@ def bench_resnet(max_iters: int) -> dict:
             "unit": "ms", "extra": extra}
 
 
+def bench_imported(max_iters: int) -> dict:
+    """Beyond-BASELINE leg: an IMPORTED SavedModel — TF-Serving's bread
+    and butter — served through the round-5 partitioned path (Example
+    decode + string-label lookup on host, the transformer interior as
+    ONE jitted device function). The fixture is built with this
+    package's own protos (tests/fixtures.py), so the leg needs no TF at
+    bench time and runs wherever the chip is."""
+    import numpy as np
+
+    from min_tfs_client_tpu.client import TensorServingClient
+    from tests import fixtures
+
+    seq, labels, batch = 64, 8, 16
+    base = pathlib.Path(tempfile.mkdtemp(prefix="tpu_bench_")) / "imported"
+    fixtures.write_imported_transformer_classify(
+        base, seq=seq, labels=labels)
+
+    client = TensorServingClient(f"tpu://{base}")
+    # Placement evidence for the record, read from the servable the
+    # channel just loaded (importing twice would burn child budget): the
+    # signature must actually be partitioned — a silent all-host
+    # fallback would make the number meaningless.
+    from min_tfs_client_tpu.client.inprocess import _registry
+    from min_tfs_client_tpu.protos import tfs_apis_pb2 as apis
+
+    spec = apis.ModelSpec()
+    spec.name = "imported"
+    with _registry[str(base)].core.servable_handle(spec) as handle:
+        part = handle.servable.signature("").partition
+    partitioned = part is not None
+    interior_ops = part.stats["interior_ops"] if partitioned else []
+    rng = np.random.default_rng(0)
+    feats = [{"ids": rng.integers(0, 2048, seq)} for _ in range(batch)]
+
+    def call():
+        resp = client.classification_request("imported", feats, timeout=120)
+        assert len(resp.result.classifications) == batch
+
+    stats = _measure(call, max_iters)
+    extra = {"model": "imported-transformer-classify", "batch": batch,
+             "seq_len": seq, "p99_ms": round(stats["p99"], 4),
+             "qps": round(1000.0 / stats["p50"] * batch, 1),
+             "iters": stats["iters"], "partitioned": partitioned,
+             "interior_has_matmul": "BatchMatMulV2" in interior_ops}
+    return {"metric": f"imported_classify_p50_b{batch}",
+            "value": stats["p50"], "unit": "ms", "extra": extra}
+
+
 _CONFIG_FNS = {"bert": bench_bert, "bert_int8": bench_bert_int8,
                "matmul": bench_matmul, "use": bench_use,
-               "t5": bench_t5, "resnet": bench_resnet}
+               "t5": bench_t5, "resnet": bench_resnet,
+               "imported": bench_imported}
 
 
 def child_main(out: pathlib.Path, configs: list[str]) -> None:

@@ -386,7 +386,8 @@ def sample_decode(params: dict, config: T5Config, input_ids: jax.Array,
                   lengths: jax.Array, *, max_decode_len: int,
                   temperature: jax.Array, seed: jax.Array,
                   top_k: int = 0,
-                  top_p: jax.Array | None = None
+                  top_p: jax.Array | None = None,
+                  encoded: jax.Array | None = None
                   ) -> tuple[jax.Array, jax.Array]:
     """Sampled generation: greedy_decode's scan with a categorical draw
     per step. temperature (B,) f32 per example (<= 0 -> greedy for that
@@ -394,7 +395,8 @@ def sample_decode(params: dict, config: T5Config, input_ids: jax.Array,
     int32 per example — identical seeds give identical streams.
     Returns (output_ids (B, max_decode_len), output_lengths (B,))."""
     b = input_ids.shape[0]
-    encoded = encode(params, config, input_ids, lengths)
+    if encoded is None:
+        encoded = encode(params, config, input_ids, lengths)
     caches = [{"self": nn.init_cache(b, config.num_heads, max_decode_len,
                                      config.d_kv)}
               for _ in range(config.num_decoder_layers)]
@@ -424,6 +426,7 @@ def sample_decode(params: dict, config: T5Config, input_ids: jax.Array,
 def beam_decode(params: dict, config: T5Config, input_ids: jax.Array,
                 lengths: jax.Array, *, max_decode_len: int,
                 beam_size: int = 4, length_penalty: float = 1.0,
+                encoded: jax.Array | None = None,
                 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Beam search over the decoder: returns the highest-scoring finished
     sequence per example (GNMT length penalty ((5+len)/6)^alpha), falling
@@ -439,7 +442,8 @@ def beam_decode(params: dict, config: T5Config, input_ids: jax.Array,
     k = beam_size
     neg = -1e9  # python float: stays concrete under jit tracing
 
-    encoded = encode(params, config, input_ids, lengths)
+    if encoded is None:
+        encoded = encode(params, config, input_ids, lengths)
     # Beams share the prompt: tile encoder state to (B*K, ...).
     enc_k = jnp.repeat(encoded, k, axis=0)
     len_k = jnp.repeat(lengths, k, axis=0)
@@ -683,56 +687,48 @@ def build_signatures(params: dict, config: T5Config, *, seq_len: int,
     from min_tfs_client_tpu.servables.servable import Signature, TensorSpec
 
     # With `pipeline_mesh` (a Mesh carrying a "stage" axis) the ENCODER
-    # stack serves pipeline-parallel for decode/serving_default/encode:
+    # stack serves pipeline-parallel for the whole-generation surfaces
+    # (decode/serving_default, encode, decode_sampled, decode_beam):
     # stage-resident encoder weights, GPipe microbatch schedule, decoder
-    # replicated (it runs the autoregressive scan on every device). The
-    # remaining surfaces (sampled/beam/speculative/sessions) keep the
-    # standard replicated tree — correctness first; their encode can be
-    # pipelined the same way later.
+    # replicated (it runs the autoregressive scan on every device).
+    # Speculative decoding and sessions keep the standard replicated
+    # tree (their prefill/step state machinery owns the param layout).
     if pipeline_mesh is not None:
-        pp_params = build_pipeline_state(params, config, mesh=pipeline_mesh)
+        sig_params = build_pipeline_state(params, config,
+                                          mesh=pipeline_mesh)
 
-        def decode_fn(pp, inputs):
-            ids = jnp.asarray(inputs["input_ids"], jnp.int32)
-            lengths = jnp.sum((ids != config.pad_id).astype(jnp.int32),
-                              axis=-1)
-            encoded = pipelined_encode(pp, config, ids, lengths,
-                                       mesh=pipeline_mesh,
-                                       n_micro=pipeline_n_micro)
-            output_ids, out_lengths = greedy_decode(
-                pp["rest"], config, ids, lengths,
-                max_decode_len=max_decode_len, encoded=encoded)
-            return {"output_ids": output_ids,
-                    "output_lengths": out_lengths}
+        def run_encode(tree, ids, lengths):
+            return pipelined_encode(tree, config, ids, lengths,
+                                    mesh=pipeline_mesh,
+                                    n_micro=pipeline_n_micro)
 
-        def encode_sig_fn(pp, inputs):
-            ids = jnp.asarray(inputs["input_ids"], jnp.int32)
-            lengths = jnp.sum((ids != config.pad_id).astype(jnp.int32),
-                              axis=-1)
-            return {"encodings": pipelined_encode(
-                pp, config, ids, lengths, mesh=pipeline_mesh,
-                n_micro=pipeline_n_micro).astype(jnp.float32)}
-
-        sig_params = pp_params
+        def dec_tree(tree):
+            return tree["rest"]
     else:
-        def decode_fn(params, inputs):
-            ids = jnp.asarray(inputs["input_ids"], jnp.int32)
-            lengths = jnp.sum((ids != config.pad_id).astype(jnp.int32),
-                              axis=-1)
-            output_ids, out_lengths = greedy_decode(
-                params, config, ids, lengths,
-                max_decode_len=max_decode_len)
-            return {"output_ids": output_ids,
-                    "output_lengths": out_lengths}
-
-        def encode_sig_fn(params, inputs):
-            ids = jnp.asarray(inputs["input_ids"], jnp.int32)
-            lengths = jnp.sum((ids != config.pad_id).astype(jnp.int32),
-                              axis=-1)
-            return {"encodings": encode(params, config, ids,
-                                        lengths).astype(jnp.float32)}
-
         sig_params = params
+
+        def run_encode(tree, ids, lengths):
+            return encode(tree, config, ids, lengths)
+
+        def dec_tree(tree):
+            return tree
+
+    def decode_fn(tree, inputs):
+        ids = jnp.asarray(inputs["input_ids"], jnp.int32)
+        lengths = jnp.sum((ids != config.pad_id).astype(jnp.int32),
+                          axis=-1)
+        output_ids, out_lengths = greedy_decode(
+            dec_tree(tree), config, ids, lengths,
+            max_decode_len=max_decode_len,
+            encoded=run_encode(tree, ids, lengths))
+        return {"output_ids": output_ids, "output_lengths": out_lengths}
+
+    def encode_sig_fn(tree, inputs):
+        ids = jnp.asarray(inputs["input_ids"], jnp.int32)
+        lengths = jnp.sum((ids != config.pad_id).astype(jnp.int32),
+                          axis=-1)
+        return {"encodings": run_encode(tree, ids,
+                                        lengths).astype(jnp.float32)}
 
     decode_sig = Signature(
         fn=decode_fn,
@@ -753,16 +749,18 @@ def build_signatures(params: dict, config: T5Config, *, seq_len: int,
         batch_buckets=(1, 4, 16, 32),
     )
 
-    def sampled_fn(params, inputs):
+    def sampled_fn(tree, inputs):
         ids = jnp.asarray(inputs["input_ids"], jnp.int32)
         lens = jnp.sum((ids != config.pad_id).astype(jnp.int32), axis=-1)
         out_ids, out_lengths = sample_decode(
-            params, config, ids, lens, max_decode_len=max_decode_len,
+            dec_tree(tree), config, ids, lens,
+            max_decode_len=max_decode_len,
             temperature=jnp.asarray(inputs["temperature"], jnp.float32),
             seed=jnp.asarray(inputs["seed"], jnp.int32),
             top_k=sampling_top_k,
             top_p=(jnp.asarray(inputs["top_p"], jnp.float32)
-                   if sampling_top_p else None))
+                   if sampling_top_p else None),
+            encoded=run_encode(tree, ids, lens))
         return {"output_ids": out_ids, "output_lengths": out_lengths}
 
     sampled_inputs = {"input_ids": TensorSpec(np.int32, (None, seq_len)),
@@ -774,7 +772,7 @@ def build_signatures(params: dict, config: T5Config, *, seq_len: int,
         sampled_inputs["top_p"] = TensorSpec(np.float32, (None,))
     sampled_sig = Signature(
         fn=sampled_fn,
-        params=params,
+        params=sig_params,
         inputs=sampled_inputs,
         outputs={"output_ids": TensorSpec(np.int32, (None, max_decode_len)),
                  "output_lengths": TensorSpec(np.int32, (None,))},
@@ -785,19 +783,21 @@ def build_signatures(params: dict, config: T5Config, *, seq_len: int,
                   "decode_sampled": sampled_sig, "encode": encode_sig}
 
     if beam_size:
-        def beam_fn(params, inputs):
+        def beam_fn(tree, inputs):
             ids = jnp.asarray(inputs["input_ids"], jnp.int32)
             lens = jnp.sum((ids != config.pad_id).astype(jnp.int32),
                            axis=-1)
             out_ids, out_lengths, scores = beam_decode(
-                params, config, ids, lens, max_decode_len=max_decode_len,
-                beam_size=beam_size, length_penalty=beam_length_penalty)
+                dec_tree(tree), config, ids, lens,
+                max_decode_len=max_decode_len,
+                beam_size=beam_size, length_penalty=beam_length_penalty,
+                encoded=run_encode(tree, ids, lens))
             return {"output_ids": out_ids, "output_lengths": out_lengths,
                     "scores": scores}
 
         signatures["decode_beam"] = Signature(
             fn=beam_fn,
-            params=params,
+            params=sig_params,
             inputs={"input_ids": TensorSpec(np.int32, (None, seq_len))},
             outputs={"output_ids": TensorSpec(
                          np.int32, (None, max_decode_len)),

@@ -281,6 +281,21 @@ def test_pipelined_t5_encoder_serves_through_server_core(tmp_path):
             tensor_proto_to_ndarray(resp.outputs["output_lengths"]),
             want_lens)
 
+        # decode_sampled at temperature 0 must equal greedy on the
+        # SAME pipelined params tree (superset contract holds under PP).
+        req3 = apis.PredictRequest()
+        req3.model_spec.name = "ppt5"
+        req3.model_spec.signature_name = "decode_sampled"
+        req3.inputs["input_ids"].CopyFrom(ndarray_to_tensor_proto(ids))
+        req3.inputs["temperature"].CopyFrom(
+            ndarray_to_tensor_proto(np.zeros((8,), np.float32)))
+        req3.inputs["seed"].CopyFrom(
+            ndarray_to_tensor_proto(np.arange(8, dtype=np.int32)))
+        np.testing.assert_array_equal(
+            tensor_proto_to_ndarray(
+                handlers.predict(req3).outputs["output_ids"]),
+            want_ids)
+
         spec = apis.ModelSpec()
         spec.name = "ppt5"
         spec.signature_name = "encode"

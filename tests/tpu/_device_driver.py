@@ -136,6 +136,34 @@ def main() -> int:
     except Exception as exc:  # noqa: BLE001 - per-check isolation
         emit("int8_predict", False, error=repr(exc)[:500])
 
+    # -- 4b. partitioned imported SavedModel: interior on the chip ---------
+    try:
+        from min_tfs_client_tpu.servables.graphdef_import import (
+            load_saved_model,
+        )
+
+        ibase = (pathlib.Path(tempfile.mkdtemp(prefix="tpu_tier_"))
+                 / "imported")
+        fixtures.write_imported_transformer_classify(ibase, seq=32,
+                                                     d_model=64, layers=1)
+        probe = load_saved_model(str(ibase / "1"), "imported", 1)
+        part = probe.signature("").partition
+        iclient = TensorServingClient(f"tpu://{ibase}")
+        feats = [{"ids": rng.integers(0, 2048, 32)} for _ in range(3)]
+        iresp = iclient.classification_request("imported", feats,
+                                               timeout=300)
+        labels_ok = all(
+            cl.classes[0].label.startswith("class_")
+            for cl in iresp.result.classifications)
+        emit("partitioned_import_classify",
+             bool(part is not None and labels_ok
+                  and len(iresp.result.classifications) == 3),
+             partitioned=part is not None,
+             interior_ops=(part.stats["interior_ops"][:6]
+                           if part else []))
+    except Exception as exc:  # noqa: BLE001 - per-check isolation
+        emit("partitioned_import_classify", False, error=repr(exc)[:500])
+
     # -- 5. continuous-batching decode sessions on device ------------------
     try:
         from min_tfs_client_tpu.models import t5

@@ -171,6 +171,14 @@ def test_int8_predict_on_device(device_results):
 
 
 @pytest.mark.integration
+def test_partitioned_import_classify_on_device(device_results):
+    # Round-5: an imported SavedModel's dense interior jitted on the
+    # chip while Example decode + label lookup stay host.
+    rec = device_results.get("partitioned_import_classify")
+    assert rec is not None and rec["ok"], rec
+
+
+@pytest.mark.integration
 def test_continuous_batching_decode_on_device(device_results):
     rec = device_results.get("continuous_batching_decode")
     assert rec is not None and rec["ok"], rec

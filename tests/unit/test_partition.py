@@ -298,3 +298,46 @@ def test_fixed_size_output_not_truncated_by_bucket_padding():
     assert np.asarray(outs[0]).shape == (3, 4)   # batch-major: sliced
     assert np.asarray(outs[2]).shape == (4,)     # fixed: NOT sliced
     np.testing.assert_allclose(outs[2], [0.0, 1.0, 2.0, 3.0])
+
+
+def test_imported_transformer_fixture_partitions_and_serves():
+    """The no-TF transformer classify fixture (tests/fixtures.py) used
+    by the bench 'imported' leg and the on-device tier: must import,
+    partition (jitted interior with the attention matmuls), and serve
+    ranked labels deterministically."""
+    import tempfile
+    import pathlib
+
+    from tests import fixtures
+    from min_tfs_client_tpu.servables.graphdef_import import (
+        load_saved_model,
+    )
+    from min_tfs_client_tpu.tensor.example_codec import (
+        decode_examples,
+        example_from_dict,
+    )
+
+    base = pathlib.Path(tempfile.mkdtemp()) / "imported"
+    fixtures.write_imported_transformer_classify(
+        base, seq=16, d_model=32, layers=1, vocab=128, labels=4)
+    servable = load_saved_model(str(base / "1"), "imported", 1)
+    sig = servable.signature("")
+    assert sig.method_name == "tensorflow/serving/classify"
+    assert sig.partition is not None
+    assert "BatchMatMulV2" in sig.partition.stats["interior_ops"]
+    assert "LookupTableFindV2" in sig.partition.stats["host_post_ops"]
+
+    rng = np.random.default_rng(1)
+    feats = [{"ids": rng.integers(0, 128, 16)} for _ in range(3)]
+    dec = decode_examples([example_from_dict(f) for f in feats],
+                          sig.feature_specs)
+    out = sig.run(dec)
+    classes = np.asarray(out["classes"], object)
+    scores = np.asarray(out["scores"])
+    assert classes.shape == (3, 4) and scores.shape == (3, 4)
+    assert all(bytes(c).startswith(b"class_")
+               for c in classes.reshape(-1))
+    # Ranked: scores descending per example.
+    assert (np.diff(scores, axis=1) <= 1e-6).all()
+    out2 = sig.run(dec)
+    np.testing.assert_array_equal(scores, np.asarray(out2["scores"]))
