@@ -341,3 +341,39 @@ def test_imported_transformer_fixture_partitions_and_serves():
     assert (np.diff(scores, axis=1) <= 1e-6).all()
     out2 = sig.run(dec)
     np.testing.assert_array_equal(scores, np.asarray(out2["scores"]))
+
+
+def test_runtime_partition_error_falls_back_to_host(monkeypatch):
+    """A PartitionError at serve time (e.g. a shape operand that turns
+    out to be unspecializable) must fall back to the always-correct
+    all-host path, not fail the request (graphdef_import.make_part_fn)."""
+    import pathlib
+    import tempfile
+
+    from tests import fixtures
+    from min_tfs_client_tpu.servables import partition as part_mod
+    from min_tfs_client_tpu.servables.graphdef_import import (
+        load_saved_model,
+    )
+    from min_tfs_client_tpu.tensor.example_codec import (
+        decode_examples,
+        example_from_dict,
+    )
+
+    base = pathlib.Path(tempfile.mkdtemp()) / "imported"
+    fixtures.write_imported_transformer_classify(
+        base, seq=8, d_model=16, layers=1, vocab=32, labels=4)
+    servable = load_saved_model(str(base / "1"), "imported", 1)
+    sig = servable.signature("")
+    assert sig.partition is not None
+
+    def boom(self, feed_values, batch_buckets):
+        raise part_mod.PartitionError("forced for test")
+
+    monkeypatch.setattr(part_mod.GraphPartition, "run", boom)
+    feats = [{"ids": np.arange(8, dtype=np.int64) % 32}]
+    dec = decode_examples([example_from_dict(f) for f in feats],
+                          sig.feature_specs)
+    out = sig.run(dec)  # host fallback, not an error
+    assert np.asarray(out["classes"]).shape == (1, 4)
+    assert np.isclose(np.asarray(out["scores"]).sum(), 1.0, atol=1e-4)
