@@ -1200,12 +1200,24 @@ def child_main(out: pathlib.Path, configs: list[str]) -> None:
 
     measured_platform = jax.devices()[0].platform
     max_iters = int(os.environ.get("BENCH_ITERS", 50))
+    breakdown = os.environ.get("BENCH_BREAKDOWN", "") not in ("", "0")
     with out.open("a") as sink:
         for name in configs:
             try:
+                if breakdown:
+                    # Per-leg per-stage table: every request in this leg
+                    # lands in the tracing ring; clear between legs so
+                    # each record aggregates only its own traffic.
+                    from min_tfs_client_tpu.observability import tracing
+
+                    tracing.ring_clear()
                 rec = _CONFIG_FNS[name](max_iters)
                 rec.setdefault("extra", {})[
                     "measured_platform"] = measured_platform
+                if breakdown:
+                    table = tracing.stage_breakdown()
+                    if table:
+                        rec["extra"]["stage_breakdown"] = table
                 sink.write(json.dumps(rec) + "\n")
                 sink.flush()
                 print(f"bench child: {name} -> "
@@ -1220,7 +1232,14 @@ if __name__ == "__main__":
     parser.add_argument("--child", action="store_true")
     parser.add_argument("--out", type=pathlib.Path)
     parser.add_argument("--configs", type=str, default="bert")
+    parser.add_argument(
+        "--breakdown", action="store_true",
+        help="attach a per-stage p50/p99 latency table (from the request-"
+             "tracing ring) to each leg's extra.stage_breakdown, so the "
+             "emitted JSON line carries the stage attribution")
     ns = parser.parse_args()
+    if ns.breakdown:
+        os.environ["BENCH_BREAKDOWN"] = "1"  # children inherit via env
     if ns.child:
         child_main(ns.out, ns.configs.split(","))
     else:

@@ -37,6 +37,13 @@ class BatchTask:
     # Which outputs this caller wants; () = all. The processor fetches the
     # union across the batch.
     output_filter: tuple = ()
+    # The caller's RequestTrace, handed across the caller->scheduler thread
+    # boundary so the processor can account queue-wait / merge / execute
+    # back to every rider (observability/tracing.py fanout).
+    trace: object | None = None
+    # perf_counter twin of enqueue_time: span timestamps must share the
+    # spans' clock (time.monotonic and perf_counter may differ in epoch).
+    enqueue_pc: float = field(default_factory=time.perf_counter)
     # filled by the processor:
     outputs: dict | None = None
     error: Exception | None = None
@@ -82,6 +89,11 @@ class BatchQueue:
             self._batches[-1].append(task)
             self._open_size += task.size
             self._report_depth_locked()
+
+    def depth(self) -> int:
+        """Batches currently queued (including the open tail)."""
+        with self._lock:
+            return len(self._batches)
 
     def _report_depth_locked(self) -> None:
         """Publish under self._lock so depths cannot race out of order

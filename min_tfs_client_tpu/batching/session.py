@@ -16,8 +16,11 @@ Parity with BatchingSession (batching/batching_session.{h,cc}):
 
 from __future__ import annotations
 
+import time
+
 import numpy as np
 
+from min_tfs_client_tpu.observability import tracing
 from min_tfs_client_tpu.batching.scheduler import (
     BatchQueue,
     BatchTask,
@@ -113,7 +116,13 @@ def _slice_sparse_triple(arrays: dict, chunk: dict, name: str,
         sub[:, 0] -= start
     chunk[ia] = sub
     chunk[va] = np.asarray(arrays[va])[keep]
-    width = int(sub[:, 1].max()) + 1 if sub.size else 0
+    # Carry the request's DECLARED width into every chunk — recomputing it
+    # from the surviving indices shrinks width-dependent outputs
+    # (SparseToDense views, indicator columns) when the declared width
+    # exceeds max-index+1, and can differ per chunk, breaking the final
+    # concatenate. The merge path preserves declared widths; chunking
+    # must agree with it.
+    width = int(np.asarray(arrays[sa]).reshape(-1)[1])
     chunk[sa] = np.asarray([end - start, width], np.int64)
 
 
@@ -198,8 +207,16 @@ class BatchedSignatureRunner:
         if n >= self._max_batch_size:
             return self.signature._slice_seq_outputs(
                 self._run_oversized(arrays, output_filter, n), true_seq)
+        # Hand the request's trace across the thread boundary: the
+        # scheduler thread accounts queue-wait / merge / execute back to
+        # this caller (and annotates the queue it rode and the depth it
+        # saw at enqueue).
+        trace = tracing.current_trace()
+        if trace is not None:
+            trace.annotate(queue=self._queue.name,
+                           queue_depth=self._queue.depth())
         task = BatchTask(inputs=arrays, size=n,
-                         output_filter=tuple(output_filter))
+                         output_filter=tuple(output_filter), trace=trace)
         self._scheduler.schedule(self._queue, task)
         task.done.wait()
         if task.error is not None:
@@ -224,8 +241,19 @@ class BatchedSignatureRunner:
     # -- scheduler side ------------------------------------------------------
 
     def _process(self, batch: list[BatchTask]) -> None:
-        from min_tfs_client_tpu.server.profiler import trace
+        # Account the queue to every rider, then activate a fanout so the
+        # merged execution's spans (merge, execute, and the inner
+        # signature's pad/device stages) land on each rider's trace.
+        now = time.perf_counter()
+        traces = [t.trace for t in batch if t.trace is not None]
+        for task in batch:
+            if task.trace is not None:
+                task.trace.add_span("batching/queue_wait",
+                                    task.enqueue_pc, now)
+        with tracing.activate(tracing.fanout(traces)):
+            self._process_batch(batch)
 
+    def _process_batch(self, batch: list[BatchTask]) -> None:
         sizes = [t.size for t in batch]
         total = sum(sizes)
         merged = {}
@@ -254,7 +282,7 @@ class BatchedSignatureRunner:
                          for t in batch), default=0)
             merged[sa] = np.asarray([total, width], np.int64)
             sparse_handled.update((ia, va, sa))
-        with trace("batching/merge"):
+        with tracing.span("batching/merge"):
             rpv = self.signature.ragged_pad_values
             for alias in batch[0].inputs:
                 if alias in sparse_handled:
@@ -292,7 +320,7 @@ class BatchedSignatureRunner:
             union: tuple = ()
         else:
             union = tuple(sorted({name for f in filters for name in f}))
-        with trace("batching/execute"):
+        with tracing.span("batching/execute"):
             outputs = self._inner_run(merged, union)
 
         try:
@@ -301,6 +329,17 @@ class BatchedSignatureRunner:
             bucket = self.signature.round_up_batch(total)
             metrics.batch_padding_ratio.observe(
                 bucket / max(1, total), self._queue.name)
+            # Occupancy + padding waste of THIS formed batch (the queue
+            # telemetry Orca/Clipper-style policies key on).
+            metrics.safe_set(metrics.batch_occupancy,
+                             total / max(1, bucket), self._queue.name)
+            if bucket > total:
+                metrics.padding_wasted_examples.increment(
+                    self._queue.name, by=bucket - total)
+            tracing.annotate(batch_size=total, padding_bucket=bucket,
+                             batch_tasks=len(batch),
+                             padding_waste_fraction=round(
+                                 (bucket - total) / max(1, bucket), 4))
         except Exception:  # pragma: no cover - metrics must not break serving
             pass
 

@@ -17,6 +17,8 @@ from typing import Callable, Optional
 
 import grpc
 
+from min_tfs_client_tpu.observability import tracing
+
 TPU_SCHEME = "tpu://"
 
 _registry_lock = threading.Lock()
@@ -70,7 +72,10 @@ class _UnaryUnary:
         self._method = method
 
     def __call__(self, request, timeout: Optional[float] = None, **kwargs):
-        return self._invoker.invoke(self._method, request, timeout)
+        # Tag traces opened by the handlers with this entry point, so the
+        # timeline distinguishes tpu:// in-process calls from gRPC/REST.
+        with tracing.transport("tpu"):
+            return self._invoker.invoke(self._method, request, timeout)
 
 
 class InProcessChannel:

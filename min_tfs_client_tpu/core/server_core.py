@@ -240,10 +240,15 @@ class ServerCore:
         return None
 
     def servable_handle(self, model_spec: tfs_apis_pb2.ModelSpec) -> ServableHandle:
-        if not model_spec.name:
-            raise ServingError.invalid_argument("Missing ModelSpec.name")
-        version = self.resolve_version(model_spec)
-        return self.manager.get_servable_handle(model_spec.name, version)
+        from min_tfs_client_tpu.observability import tracing
+
+        # Version resolution + manager lookup take locks; give them their
+        # own stage so handle acquisition is visible on request timelines.
+        with tracing.span("serving/resolve"):
+            if not model_spec.name:
+                raise ServingError.invalid_argument("Missing ModelSpec.name")
+            version = self.resolve_version(model_spec)
+            return self.manager.get_servable_handle(model_spec.name, version)
 
     def model_version_states(
         self, name: str, version: Optional[int] = None

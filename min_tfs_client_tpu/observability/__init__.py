@@ -1,0 +1,12 @@
+"""Observability: the request-tracing spine + its export surfaces.
+
+`tracing` carries one RequestTrace per served request from the transport
+entry point (gRPC / REST / tpu:// in-process) through batching, device
+execution, and marshalling, and fans the recorded spans out to three
+sinks: the metrics registry (Prometheus), a bounded in-memory ring served
+as Chrome-trace JSON by `/monitoring/traces`, and (optionally) the JAX
+profiler's TraceAnnotation stream so XProf captures show the same stage
+names.
+"""
+
+from min_tfs_client_tpu.observability import tracing  # noqa: F401

@@ -1,0 +1,479 @@
+"""Per-request tracing spine: Span / RequestTrace + three export sinks.
+
+The reference stack threads `profiler::TraceMe` annotations and the
+monitoring registry through every hot-path stage (shared_batch_scheduler.h:39,
+util/prometheus_exporter.cc); this module is the cross-layer equivalent,
+connecting them into ONE per-request timeline:
+
+ * `request_trace(api, ...)` opens a RequestTrace at the transport entry
+   point (server/handlers.py `_instrumented`) and publishes it in a
+   contextvar;
+ * `span(name)` wraps each hot-path stage (deserialize, queue-wait,
+   batch-form, host->device, execute, device->host, serialize) and records
+   a (name, start, end, args) tuple on the current trace;
+ * the batching queue hands a request's trace across the caller->scheduler
+   thread boundary explicitly (BatchTask.trace); the scheduler thread
+   activates a `fanout` over every co-batched trace so one merged
+   execution is accounted to each caller that rode in the batch.
+
+Sinks, fed when a trace finishes:
+
+ 1. metrics registry — per-stage latency samplers, batch-occupancy gauge,
+    padding-waste counter, queue-depth gauge (server/metrics.py; exported
+    by the existing Prometheus text exporter);
+ 2. a bounded ring of recent traces, rendered as Chrome-trace/Perfetto
+    JSON by the `/monitoring/traces` debug endpoint (server/rest.py);
+ 3. optional `jax.profiler.TraceAnnotation` bridging (`bridge_profiler`),
+    so on-demand XProf captures show the same stage names. Off by
+    default: a TraceAnnotation object per span costs ~1us of pure Python
+    even with no capture active, which is real money at toy-model
+    latencies.
+
+Clocks: spans record `time.perf_counter()` (CLOCK_MONOTONIC — comparable
+across threads); Chrome-trace `ts` values are microseconds relative to one
+process-wide epoch so concurrent requests align on a single timeline.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import contextvars
+import itertools
+import os
+import threading
+import time
+
+_current: contextvars.ContextVar = contextvars.ContextVar(
+    "request_trace", default=None)
+_transport: contextvars.ContextVar = contextvars.ContextVar(
+    "request_transport", default="")
+
+_EPOCH = time.perf_counter()
+_ids = itertools.count(1)
+
+_enabled = True
+_bridge = os.environ.get("TPU_SERVING_TRACE_XPROF", "") not in ("", "0")
+_ann_cls = None  # lazily resolved jax.profiler.TraceAnnotation; False = n/a
+
+# The canonical stage names, in pipeline order. Anything recording a new
+# stage should reuse these where they apply so dashboards/bench breakdowns
+# aggregate across models (docs/OBSERVABILITY.md documents them).
+STAGES = (
+    "serving/resolve",
+    "serving/deserialize",
+    "serving/parse_examples",
+    "serving/validate",
+    "batching/queue_wait",
+    "batching/merge",
+    "batching/execute",
+    "serving/pad",
+    "device/host_to_device",
+    "device/execute",
+    "device/device_to_host",
+    "host/execute",
+    "partition/pre",
+    "partition/post",
+    "serving/serialize",
+)
+
+
+def enable(on: bool) -> None:
+    """Process-wide switch. Disabled: request_trace/span become no-ops
+    (used by the overhead smoke test and as the operator kill switch)."""
+    global _enabled
+    _enabled = bool(on)
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def bridge_profiler(on: bool) -> None:
+    """Mirror every span into a jax.profiler.TraceAnnotation so XProf /
+    TensorBoard captures show the serving stage names alongside the XLA
+    timeline. Optional — costs ~1us/span even with no capture running."""
+    global _bridge
+    _bridge = bool(on)
+
+
+def _annotation(name: str):
+    global _ann_cls
+    if _ann_cls is None:
+        try:
+            import jax
+
+            _ann_cls = jax.profiler.TraceAnnotation
+        except Exception:  # pragma: no cover - profiler lib unavailable
+            _ann_cls = False
+    return _ann_cls(name) if _ann_cls else None
+
+
+class RequestTrace:
+    """One request's timeline: spans + metadata, filled as it flows.
+
+    Deliberately lock-free on the recording path: `spans.append` of a
+    pre-built tuple is atomic under the GIL, and the only cross-thread
+    writer (the batch scheduler) finishes before the caller's
+    `task.done.wait()` returns. Readers copy the list (`list(spans)`),
+    which is likewise GIL-safe against a concurrent append.
+    """
+
+    __slots__ = ("id", "api", "model", "signature", "transport", "status",
+                 "start", "end", "spans", "meta")
+
+    def __init__(self, api: str, model: str = "", signature: str = "",
+                 transport: str = ""):
+        self.id = next(_ids)
+        self.api = api
+        self.model = model
+        self.signature = signature
+        self.transport = transport
+        self.status = "0"
+        self.start = time.perf_counter()
+        self.end: float | None = None
+        self.spans: list[tuple] = []  # (name, t0, t1, args|None)
+        self.meta: dict = {}
+
+    def add_span(self, name: str, t0: float, t1: float,
+                 args: dict | None = None) -> None:
+        self.spans.append((name, t0, t1, args))
+
+    def annotate(self, **kv) -> None:
+        """Attach request metadata (batch size, padding bucket, queue...).
+        Values are coerced to plain JSON-able scalars so the Chrome-trace
+        encoder never chokes on a numpy int."""
+        for k, v in kv.items():
+            if isinstance(v, (int, float, str, bool, type(None))):
+                self.meta[k] = v
+            else:
+                try:
+                    self.meta[k] = float(v)
+                except (TypeError, ValueError):
+                    self.meta[k] = str(v)
+
+    def duration_s(self) -> float:
+        return (self.end if self.end is not None
+                else time.perf_counter()) - self.start
+
+    def stage_durations(self) -> dict[str, float]:
+        """name -> summed duration in seconds (a stage may repeat, e.g.
+        per-chunk executes of an oversized request)."""
+        out: dict[str, float] = {}
+        for name, t0, t1, _ in list(self.spans):
+            out[name] = out.get(name, 0.0) + (t1 - t0)
+        return out
+
+    def finish(self, status: str = "0") -> None:
+        self.end = time.perf_counter()
+        self.status = status
+        _ring.record(self)
+        # Metrics export (8+ histogram observations, gauge/counter updates)
+        # is deferred to the drain thread — ~12us of registry bookkeeping
+        # that should not ride the request's critical path. Readers get
+        # read-your-writes through flush_metrics() (prometheus_text calls
+        # it before serializing).
+        _pending.append(self)
+        if _drain_thread is None or not _drain_thread.is_alive():
+            _ensure_drain_thread()
+
+
+class _Fanout:
+    """Trace-like target multiplexing span/annotate onto every co-batched
+    caller's trace (the scheduler thread runs ONE merged execution on
+    behalf of N callers)."""
+
+    __slots__ = ("traces",)
+
+    def __init__(self, traces):
+        self.traces = list(traces)
+
+    def add_span(self, name, t0, t1, args=None):
+        for tr in self.traces:
+            tr.add_span(name, t0, t1, args)
+
+    def annotate(self, **kv):
+        for tr in self.traces:
+            tr.annotate(**kv)
+
+
+def current_trace():
+    """The RequestTrace (or batch fanout) active on this thread, or None."""
+    return _current.get()
+
+
+def annotate(**kv) -> None:
+    tr = _current.get()
+    if tr is not None:
+        tr.annotate(**kv)
+
+
+@contextlib.contextmanager
+def activate(trace):
+    """Make `trace` (a RequestTrace or _Fanout) current for the block —
+    the explicit thread-handoff used by the batch scheduler."""
+    token = _current.set(trace)
+    try:
+        yield trace
+    finally:
+        _current.reset(token)
+
+
+def fanout(traces) -> _Fanout:
+    return _Fanout(traces)
+
+
+class transport:
+    """Tag traces opened inside the block with the entry-point transport
+    ("grpc", "rest", "tpu"). Class-based: this wraps every request."""
+
+    __slots__ = ("_name", "_token")
+
+    def __init__(self, name: str):
+        self._name = name
+
+    def __enter__(self):
+        self._token = _transport.set(self._name)
+        return self
+
+    def __exit__(self, *exc):
+        _transport.reset(self._token)
+        return False
+
+
+class request_trace:
+    """Open a RequestTrace for one handler invocation (context manager).
+    Enters yielding the trace (None when tracing is disabled); always
+    finishes + exports it on exit, with the ServingError code as status
+    when the handler raised. A plain class, not @contextmanager — this
+    wraps every request and generator machinery costs ~1us per use."""
+
+    __slots__ = ("_trace", "_token", "_ann")
+
+    def __init__(self, api: str, model: str = "", signature: str = ""):
+        if not _enabled:
+            self._trace = None
+            return
+        self._trace = RequestTrace(api, model=model, signature=signature,
+                                   transport=_transport.get())
+        self._ann = _annotation(f"serving/{api}") if _bridge else None
+
+    def __enter__(self):
+        if self._trace is None:
+            return None
+        self._token = _current.set(self._trace)
+        if self._ann is not None:
+            self._ann.__enter__()
+        return self._trace
+
+    def __exit__(self, exc_type, exc, tb):
+        if self._trace is None:
+            return False
+        if self._ann is not None:
+            self._ann.__exit__(exc_type, exc, tb)
+        _current.reset(self._token)
+        self._trace.finish(
+            status="0" if exc is None else str(getattr(exc, "code", 2)))
+        return False
+
+
+class span:
+    """Context manager recording one named stage on the current trace.
+
+    Deliberately slim — this sits on the hot path of every request. The
+    profiler bridge (TraceAnnotation) only engages when bridge_profiler
+    turned it on.
+    """
+
+    __slots__ = ("name", "args", "_t0", "_ann")
+
+    def __init__(self, name: str, **args):
+        self.name = name
+        self.args = args or None
+
+    def __enter__(self):
+        self._ann = _annotation(self.name) if _bridge else None
+        if self._ann is not None:
+            self._ann.__enter__()
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        t1 = time.perf_counter()
+        if self._ann is not None:
+            self._ann.__exit__(exc_type, exc, tb)
+        tr = _current.get()
+        if tr is not None:
+            tr.add_span(self.name, self._t0, t1, self.args)
+        return False
+
+
+# ---------------------------------------------------------------------------
+# Sink 1: metrics registry (exported off the request path by a drain
+# thread; flush_metrics() gives synchronous readers read-your-writes)
+
+_pending: collections.deque = collections.deque()
+_drain_thread: threading.Thread | None = None
+_drain_start_lock = threading.Lock()
+
+
+def _ensure_drain_thread() -> None:
+    global _drain_thread
+    with _drain_start_lock:
+        # Re-check under the lock; also revives the thread after a fork
+        # (daemon threads do not survive into the child).
+        if _drain_thread is None or not _drain_thread.is_alive():
+            _drain_thread = threading.Thread(
+                target=_drain_loop, name="trace-metrics-export", daemon=True)
+            _drain_thread.start()
+
+
+def _drain_loop() -> None:  # pragma: no cover - exercised via flush
+    # Polled, NOT signalled per trace: waking a thread per request makes
+    # it contend for the GIL mid-request, which costs the hot path far
+    # more than the deferred bookkeeping saves. A scrape still sees fresh
+    # samples — prometheus_text flushes synchronously.
+    while True:
+        time.sleep(0.5)
+        flush_metrics()
+
+
+def flush_metrics() -> None:
+    """Drain every pending trace into the metrics registry. Called by the
+    drain thread, and synchronously by the Prometheus exporter so a
+    scrape right after a request still sees that request's samples."""
+    while True:
+        try:
+            trace = _pending.popleft()
+        except IndexError:
+            return
+        _export_metrics(trace)
+
+
+def _export_metrics(trace: RequestTrace) -> None:
+    try:
+        from min_tfs_client_tpu.server import metrics
+
+        stages = trace.stage_durations()
+        if stages:
+            metrics.stage_latency.observe_many(
+                {(stage,): dur * 1e6 for stage, dur in stages.items()})
+        meta = trace.meta
+        batch = meta.get("batch_size")
+        bucket = meta.get("padding_bucket")
+        # Occupancy/waste for requests that rode a batching queue are
+        # recorded ONCE per formed batch by the scheduler (session.py);
+        # exporting them again per rider would overcount the shared batch
+        # N+1 times. Traces export them only for queue-less direct
+        # execution, labeled by model (the "queue" of size 1).
+        if batch and bucket and "queue" not in meta:
+            label = trace.model or "unknown"
+            metrics.safe_set(metrics.batch_occupancy,
+                             float(batch) / float(bucket), label)
+            waste = max(0, int(bucket) - int(batch))
+            if waste:
+                metrics.padding_wasted_examples.increment(label, by=waste)
+            # Unbatched direct execution: the request saw no queue.
+            metrics.safe_set(metrics.batch_queue_depth, 0.0, label)
+    except Exception:  # pragma: no cover - metrics must not break serving
+        pass
+
+
+# ---------------------------------------------------------------------------
+# Sink 2: bounded ring of recent traces + Chrome-trace rendering
+
+
+class _Ring:
+    def __init__(self, capacity: int):
+        self._lock = threading.Lock()
+        self._traces: collections.deque = collections.deque(maxlen=capacity)
+
+    def record(self, trace: RequestTrace) -> None:
+        with self._lock:
+            self._traces.append(trace)
+
+    def snapshot(self, limit: int | None = None) -> list[RequestTrace]:
+        with self._lock:
+            traces = list(self._traces)
+        return traces[-limit:] if limit else traces
+
+    def clear(self) -> None:
+        with self._lock:
+            self._traces.clear()
+
+
+def _ring_capacity() -> int:
+    """TPU_SERVING_TRACE_RING, defaulting (not crashing the server at
+    import) on malformed values; floor of 1."""
+    try:
+        return max(1, int(os.environ.get("TPU_SERVING_TRACE_RING", "256")))
+    except ValueError:
+        return 256
+
+
+_ring = _Ring(_ring_capacity())
+
+
+def ring_snapshot(limit: int | None = None) -> list[RequestTrace]:
+    return _ring.snapshot(limit)
+
+
+def ring_clear() -> None:
+    _ring.clear()
+
+
+def _us(t: float) -> float:
+    return round((t - _EPOCH) * 1e6, 3)
+
+
+def chrome_trace(traces=None, limit: int | None = None) -> dict:
+    """Recent traces as a Chrome-trace (chrome://tracing / Perfetto
+    "trace event") JSON object: one pid for the server, one tid per
+    request, complete ("X") events for the request envelope and every
+    stage span, plus thread_name metadata so the timeline is labelled."""
+    if traces is None:
+        traces = _ring.snapshot(limit)
+    events = []
+    for tr in traces:
+        end = tr.end if tr.end is not None else tr.start
+        events.append({
+            "name": "thread_name", "ph": "M", "pid": 1, "tid": tr.id,
+            "args": {"name": f"{tr.api} {tr.model} #{tr.id}".strip()},
+        })
+        args = dict(tr.meta)
+        args.update(model=tr.model, signature=tr.signature,
+                    transport=tr.transport, status=tr.status)
+        events.append({
+            "name": f"request/{tr.api}", "cat": "request", "ph": "X",
+            "pid": 1, "tid": tr.id, "ts": _us(tr.start),
+            "dur": round(max(0.0, end - tr.start) * 1e6, 3), "args": args,
+        })
+        for name, t0, t1, sargs in list(tr.spans):
+            events.append({
+                "name": name, "cat": "stage", "ph": "X", "pid": 1,
+                "tid": tr.id, "ts": _us(t0),
+                "dur": round(max(0.0, t1 - t0) * 1e6, 3),
+                "args": dict(sargs or {}),
+            })
+    return {"traceEvents": events, "displayTimeUnit": "ms",
+            "otherData": {"source": "min_tfs_client_tpu /monitoring/traces"}}
+
+
+def stage_breakdown(traces=None) -> dict[str, dict]:
+    """Aggregate per-stage p50/p99 (ms) over `traces` (default: the ring).
+    The bench's --breakdown table and the debug endpoint's summary."""
+    if traces is None:
+        traces = _ring.snapshot()
+    by_stage: dict[str, list[float]] = {}
+    for tr in traces:
+        for stage, dur in tr.stage_durations().items():
+            by_stage.setdefault(stage, []).append(dur * 1e3)
+    out: dict[str, dict] = {}
+    for stage, xs in sorted(by_stage.items()):
+        xs.sort()
+        out[stage] = {
+            "p50_ms": round(xs[len(xs) // 2], 4),
+            "p99_ms": round(xs[min(len(xs) - 1, int(len(xs) * 0.99))], 4),
+            "n": len(xs),
+        }
+    return out

@@ -28,6 +28,7 @@ from typing import Callable, Sequence
 
 import numpy as np
 
+from min_tfs_client_tpu.observability import tracing
 from min_tfs_client_tpu.protos import tf_tensor_pb2
 from min_tfs_client_tpu.servables.servable import fetch_outputs
 
@@ -152,6 +153,9 @@ class GraphPartition:
         # (fall back to the dim-match heuristic).
         self._interior_batch_major: list[bool] | None = None
         self._result_batch_major: list[bool] | None = None
+        # Latched on the first failed probe so a persistent failure is
+        # recorded once, not per padded request.
+        self._calibration_failed = False
 
     def _split_static(self, values: list[np.ndarray]):
         """-> (dynamic values, static values, hashable static key)."""
@@ -222,8 +226,9 @@ class GraphPartition:
         feed_values = [np.asarray(v) for v in feed_values]
         cut_values = []
         if self.cut_in_refs:
-            cut_values = [np.asarray(v)
-                          for v in self.pre(feed_values, np)]
+            with tracing.span("partition/pre"):
+                cut_values = [np.asarray(v)
+                              for v in self.pre(feed_values, np)]
             for ref, v in zip(self.cut_in_refs, cut_values):
                 if v.dtype.kind in "OSU":
                     raise PartitionError(
@@ -241,10 +246,17 @@ class GraphPartition:
         else:
             padded, batch, bucket = _pad_interior(dyn, batch_buckets)
         sliced = bucket is not None and bucket != batch
-        if sliced and self._interior_batch_major is None:
+        if sliced and self._interior_batch_major is None \
+                and not self._calibration_failed:
             self._calibrate(feed_values)
-        outs = self.interior_jitted(stat, static_key)(padded)
-        fetched = fetch_outputs(dict(enumerate(outs)))
+        if sliced:
+            tracing.annotate(batch_size=batch, padding_bucket=bucket,
+                             padding_waste_fraction=round(
+                                 (bucket - batch) / bucket, 4))
+        with tracing.span("device/execute"):
+            outs = self.interior_jitted(stat, static_key)(padded)
+        with tracing.span("device/device_to_host"):
+            fetched = fetch_outputs(dict(enumerate(outs)))
         outs = [fetched[i] for i in range(len(outs))]
         if sliced:
             outs = [o[:batch]
@@ -252,7 +264,8 @@ class GraphPartition:
                                             i, o, bucket) else o
                     for i, o in enumerate(outs)]
         post_feeds = feed_values + cut_values + [np.asarray(o) for o in outs]
-        results = self.post(post_feeds, np)
+        with tracing.span("partition/post"):
+            results = self.post(post_feeds, np)
         if sliced:
             # Post ops driven by a Shape VALUE computed inside the padded
             # interior (tf.shape -> Tile is the classic classify labels
@@ -277,13 +290,58 @@ class GraphPartition:
         """Batch-1 probe through all three stages: outputs whose leading
         dim follows the batch are batch-major (a fixed (1, ...) output
         mis-marked here is harmless — [:batch] of one row with batch>=1
-        is the identity). Failures leave the heuristic in place."""
+        is the identity). Failures keep the dim-match heuristic, but are
+        RECORDED (metric + log) — a silent failure here can mean a
+        fixed-size output whose length coincides with the padding bucket
+        gets truncated by the [:batch] slice."""
         try:
-            one = [v[:1] if np.ndim(v) else v for v in feed_values]
+            # The batch reference comes from the DYNAMIC interior-consumed
+            # signature feeds — the set _pad_interior actually pads (a
+            # host-only side feed of a different length, e.g. a label
+            # table the post stage consumes, must neither be sliced nor
+            # block calibration; static shape operands never pad). Then
+            # slice exactly the feeds sharing that dim: slicing a
+            # non-batch-major feed to one row would probe the stages with
+            # a semantically wrong input. Ambiguity means the probe
+            # cannot know which feeds follow the batch — a recorded
+            # calibration failure, never a probe at full batch learning
+            # flags against the wrong reference.
+            n_used = len(self.used_feed_idx)
+            ref = [feed_values[i]
+                   for flag, i in zip(self.static_flags,
+                                      self.used_feed_idx) if not flag]
+            if not ref and self.cut_in_refs:
+                # Interior fed only by cut tensors (string-feed graphs):
+                # the batch reference is the dynamic cuts themselves,
+                # computed once at full batch by the host pre stage.
+                cut_flags = self.static_flags[n_used:]
+                ref = [np.asarray(v)
+                       for flag, v in zip(cut_flags,
+                                          self.pre(feed_values, np))
+                       if not flag]
+            dims = {v.shape[0] for v in ref if np.ndim(v)}
+            if len(dims) != 1:
+                raise PartitionError(
+                    f"ambiguous batch dim across interior feeds: "
+                    f"{sorted(dims)}")
+            batch = dims.pop()
+            one = [v[:1] if np.ndim(v) and v.shape[0] == batch else v
+                   for v in feed_values]
             cuts = ([np.asarray(v) for v in self.pre(one, np)]
                     if self.cut_in_refs else [])
             interior_feeds = [one[i] for i in self.used_feed_idx] + cuts
             dyn, stat, key = self._split_static(interior_feeds)
+            # HARD invariant: the flags are learned by comparing output
+            # leading dims to 1, so the probe's dynamic interior inputs
+            # must actually BE batch-1. If slicing the signature feeds
+            # did not propagate (a pre stage that reshapes the batch
+            # away, a feed set nothing matched), fail the calibration
+            # loudly rather than learn flags against the wrong batch.
+            probe_dims = {np.shape(v)[0] for v in dyn if np.ndim(v)}
+            if probe_dims and probe_dims != {1}:
+                raise PartitionError(
+                    f"probe did not reach batch 1 (interior dims "
+                    f"{sorted(probe_dims)})")
             outs = [np.asarray(o)
                     for o in self.interior_jitted(stat, key)(dyn)]
             interior_flags = [bool(o.ndim and o.shape[0] == 1)
@@ -292,7 +350,27 @@ class GraphPartition:
             self._result_batch_major = [
                 bool(np.ndim(r) and np.shape(r)[0] == 1) for r in results]
             self._interior_batch_major = interior_flags
-        except Exception:  # pragma: no cover - keep the heuristic
+        except Exception:  # keep the heuristic, but say so
+            self._record_calibration_failure()
+
+    def _record_calibration_failure(self) -> None:
+        # Once per partition: _run retries while _interior_batch_major is
+        # None, so without the latch a persistent failure would log a
+        # traceback and bump the counter on EVERY padded request.
+        self._calibration_failed = True
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "partition batch-1 calibration failed; keeping the dim-match "
+            "slice heuristic (fixed-size outputs matching the padding "
+            "bucket may be truncated)", exc_info=True)
+        try:
+            from min_tfs_client_tpu.server import metrics
+
+            tr = tracing.current_trace()
+            model = getattr(tr, "model", "") or "unknown"
+            metrics.partition_calibration_failures.increment(model)
+        except Exception:  # pragma: no cover - metrics must not break serving
             pass
 
 
@@ -458,7 +536,9 @@ def try_partition(graph_def, feed_names: Sequence[str],
     interior_out: list[tuple[str, int]] = []  # interior -> host/post, fetch
     seen_in: set[tuple[str, int]] = set()
     seen_out: set[tuple[str, int]] = set()
-    for name in interior:
+    # Topo order, not set order, for the same determinism reason as the
+    # consumer walk below.
+    for name in (n for n in order if n in interior):
         for dep_name, dep_idx, is_ctrl in reachable[name]:
             if is_ctrl:
                 if dep_name in reachable and dep_name not in interior:
@@ -472,8 +552,14 @@ def try_partition(graph_def, feed_names: Sequence[str],
                     and ref not in seen_in:
                 seen_in.add(ref)
                 cut_in.append(ref)
-    consumers_of_interior = set(reachable) - interior
-    for name in consumers_of_interior:
+    # Iterate consumers in topo `order` (never the raw set): the set's
+    # iteration order depends on hash randomization, which would make
+    # interior_out_refs — and with it partition stats, the stage
+    # GraphFunction fetch order, and jit cache keys — differ across
+    # processes.
+    for name in order:
+        if name in interior:
+            continue
         for dep_name, dep_idx, is_ctrl in reachable.get(name, ()):
             ref = (dep_name, dep_idx)
             if not is_ctrl and dep_name in interior \

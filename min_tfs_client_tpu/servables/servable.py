@@ -23,6 +23,7 @@ from typing import Callable, Mapping, Optional, Sequence
 
 import numpy as np
 
+from min_tfs_client_tpu.observability import tracing
 from min_tfs_client_tpu.protos import tf_graph_pb2, tfs_apis_pb2
 from min_tfs_client_tpu.tensor.dtypes import DataType
 from min_tfs_client_tpu.tensor.example_codec import FeatureSpec
@@ -368,12 +369,24 @@ class Signature:
         output_filter: Sequence[str] = (),
     ) -> dict[str, np.ndarray]:
         """Validate, pad, execute, slice, return alias-keyed outputs."""
-        arrays = self.validate(inputs, output_filter)
+        with tracing.span("serving/validate"):
+            arrays = self.validate(inputs, output_filter)
         keys = list(output_filter) if output_filter else list(self.outputs)
 
         if self.on_host:
-            outputs = (self._device_fn()(self.params, arrays)
-                       if self.params is not None else self.fn(arrays))
+            if self.partition is not None:
+                # The partitioned path emits its own stage spans
+                # (partition/pre, device/execute, device/device_to_host,
+                # partition/post) — an enveloping host/execute span would
+                # double-count them in stage sums and misfile device time
+                # under a host stage.
+                outputs = (self._device_fn()(self.params, arrays)
+                           if self.params is not None else self.fn(arrays))
+            else:
+                with tracing.span("host/execute"):
+                    outputs = (self._device_fn()(self.params, arrays)
+                               if self.params is not None
+                               else self.fn(arrays))
             self._check_produced(outputs, keys)
             return {k: np.asarray(outputs[k]) for k in keys}
 
@@ -386,7 +399,8 @@ class Signature:
         # sequential DMAs collapse to one round trip — on remote/tunneled
         # PJRT transports each synchronous fetch costs a full RTT, and even
         # locally the DMAs overlap.
-        result = fetch_outputs({k: outputs[k] for k in keys}, batch)
+        with tracing.span("device/device_to_host"):
+            result = fetch_outputs({k: outputs[k] for k in keys}, batch)
         return self._slice_seq_outputs(result, true_seq)
 
     def _true_seq_len(self, arrays: Mapping[str, np.ndarray]) -> Optional[int]:
@@ -452,33 +466,49 @@ class Signature:
         self, arrays: dict[str, np.ndarray]
     ) -> tuple[dict[str, object], Optional[int]]:
         """Execute on device; returns (device outputs, true batch or None)."""
-        arrays = self._pad_seq(arrays)
         if not self.batched or not arrays:
-            return self._execute(
-                self._place(self._cast_transfers(arrays))), None
-        batch = next(iter(arrays.values())).shape[0]
-        for alias, arr in arrays.items():
-            if arr.shape[0] != batch:
-                raise ServingError.invalid_argument(
-                    f"input {alias!r}: inconsistent batch dim "
-                    f"{arr.shape[0]} != {batch}")
-        # Cast BEFORE padding: the pad concat then moves half the bytes and
-        # no second full-bucket copy is made.
-        arrays = self._cast_transfers(arrays)
-        padded_batch = self.round_up_batch(batch)
-        if padded_batch != batch:
-            arrays = {
-                alias: np.concatenate(
-                    # Pad with a repeat of row 0 (valid data keeps XLA out of
-                    # NaN paths — the batching_session.h:94-99 trick).
-                    [arr, np.repeat(arr[:1], padded_batch - batch, axis=0)])
-                for alias, arr in arrays.items()
-            }
-        if self.mesh is not None:
-            arrays = self._shard_inputs(arrays)
-        else:
-            arrays = self._place(arrays)
-        return self._execute(arrays), batch
+            with tracing.span("serving/pad"):
+                arrays = self._cast_transfers(self._pad_seq(arrays))
+            with tracing.span("device/host_to_device"):
+                arrays = self._place(arrays)
+            with tracing.span("device/execute"):
+                return self._execute(arrays), None
+        with tracing.span("serving/pad"):
+            arrays = self._pad_seq(arrays)
+            batch = next(iter(arrays.values())).shape[0]
+            for alias, arr in arrays.items():
+                if arr.shape[0] != batch:
+                    raise ServingError.invalid_argument(
+                        f"input {alias!r}: inconsistent batch dim "
+                        f"{arr.shape[0]} != {batch}")
+            # Cast BEFORE padding: the pad concat then moves half the bytes
+            # and no second full-bucket copy is made.
+            arrays = self._cast_transfers(arrays)
+            padded_batch = self.round_up_batch(batch)
+            if padded_batch != batch:
+                arrays = {
+                    alias: np.concatenate(
+                        # Pad with a repeat of row 0 (valid data keeps XLA
+                        # out of NaN paths — the batching_session.h:94-99
+                        # trick).
+                        [arr, np.repeat(arr[:1], padded_batch - batch,
+                                        axis=0)])
+                    for alias, arr in arrays.items()
+                }
+        tracing.annotate(batch_size=batch, padding_bucket=padded_batch,
+                         padding_waste_fraction=round(
+                             (padded_batch - batch) / max(1, padded_batch),
+                             4))
+        with tracing.span("device/host_to_device"):
+            if self.mesh is not None:
+                arrays = self._shard_inputs(arrays)
+            else:
+                arrays = self._place(arrays)
+        # Dispatch is async on real accelerators: this span is submit time;
+        # the device wait shows up in device/device_to_host (and on the
+        # XProf timeline when profiling).
+        with tracing.span("device/execute"):
+            return self._execute(arrays), batch
 
     # Below this, the jit arg path transfers just as fast and the
     # device_put plumbing (~0.2 ms of pure Python) dominates; the slow

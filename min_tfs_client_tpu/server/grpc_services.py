@@ -19,8 +19,11 @@ from min_tfs_client_tpu.utils.status import (
 
 
 def _guard(handler_fn, request, context):
+    from min_tfs_client_tpu.observability import tracing
+
     try:
-        return handler_fn(request)
+        with tracing.transport("grpc"):
+            return handler_fn(request)
     except Exception as exc:  # noqa: BLE001 - mapped onto the wire
         err = error_from_exception(exc)
         context.abort(to_grpc_code(err.code), err.message)

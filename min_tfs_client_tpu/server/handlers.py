@@ -22,6 +22,7 @@ import time
 import numpy as np
 
 from min_tfs_client_tpu.core.server_core import ServerCore
+from min_tfs_client_tpu.observability import tracing
 from min_tfs_client_tpu.protos import tfs_apis_pb2 as apis
 from min_tfs_client_tpu.servables.servable import (
     CLASSIFY_METHOD_NAME,
@@ -51,17 +52,27 @@ def _effective_spec(target, model_spec, version: int, signature_name: str) -> No
 
 def _instrumented(api: str):
     """Request count/latency instrumentation (the serving-path metrics the
-    reference records in servables/tensorflow/util.cc:36-71)."""
+    reference records in servables/tensorflow/util.cc:36-71) + the
+    request-trace envelope: every transport (gRPC, REST, tpu://) funnels
+    through these methods, so opening the RequestTrace here puts ALL entry
+    points on the tracing spine."""
 
     def wrap(fn):
         @functools.wraps(fn)
         def inner(self, request):
             from min_tfs_client_tpu.server import metrics
-            from min_tfs_client_tpu.server.profiler import trace
 
+            spec = getattr(request, "model_spec", None)
+            if spec is None:
+                tasks = getattr(request, "tasks", None)
+                spec = tasks[0].model_spec if tasks else None
             start = time.perf_counter()
             try:
-                with trace(f"serving/{api}"):
+                with tracing.request_trace(
+                        api,
+                        model=spec.name if spec is not None else "",
+                        signature=(spec.signature_name
+                                   if spec is not None else "")):
                     response = fn(self, request)
             except Exception as exc:
                 err = ServingError if isinstance(exc, ServingError) else None
@@ -96,20 +107,23 @@ class Handlers:
 
     @_instrumented("predict")
     def predict(self, request: apis.PredictRequest) -> apis.PredictResponse:
+        from min_tfs_client_tpu.tensor.codec import tensor_protos_to_dict
+
         with self.core.servable_handle(request.model_spec) as handle:
             servable = handle.servable
+            tracing.annotate(version=handle.id.version)
             sig_name = request.model_spec.signature_name
             signature = servable.signature(sig_name)
-            inputs = {k: tensor_proto_to_ndarray(v, writable=False)
-                      for k, v in request.inputs.items()}
+            inputs = tensor_protos_to_dict(request.inputs, writable=False)
             outputs = signature.run(inputs, tuple(request.output_filter))
             response = apis.PredictResponse()
-            _effective_spec(response.model_spec, request.model_spec,
-                            handle.id.version,
-                            request.model_spec.signature_name)
-            for alias, arr in outputs.items():
-                response.outputs[alias].CopyFrom(ndarray_to_tensor_proto(
-                    arr, use_tensor_content=self._as_content))
+            with tracing.span("serving/serialize"):
+                _effective_spec(response.model_spec, request.model_spec,
+                                handle.id.version,
+                                request.model_spec.signature_name)
+                for alias, arr in outputs.items():
+                    response.outputs[alias].CopyFrom(ndarray_to_tensor_proto(
+                        arr, use_tensor_content=self._as_content))
             self.core.request_logger.maybe_log(
                 request.model_spec.name,
                 lambda: _predict_log(request, response),
@@ -131,7 +145,8 @@ class Handlers:
                       model_name: str = ""):
         from min_tfs_client_tpu.server import metrics
 
-        features, n = decode_input(request_input, signature.feature_specs)
+        with tracing.span("serving/parse_examples"):
+            features, n = decode_input(request_input, signature.feature_specs)
         if n == 0:
             raise ServingError.invalid_argument("Input is empty")
         if model_name:
@@ -151,8 +166,9 @@ class Handlers:
             _effective_spec(response.model_spec, request.model_spec,
                             handle.id.version,
                             request.model_spec.signature_name)
-            _assemble_classifications(
-                response.result, outputs, n, signature.class_labels)
+            with tracing.span("serving/serialize"):
+                _assemble_classifications(
+                    response.result, outputs, n, signature.class_labels)
             self.core.request_logger.maybe_log(
                 request.model_spec.name,
                 lambda: _classify_log(request, response),
@@ -170,7 +186,8 @@ class Handlers:
             _effective_spec(response.model_spec, request.model_spec,
                             handle.id.version,
                             request.model_spec.signature_name)
-            _assemble_regressions(response.result, outputs, n)
+            with tracing.span("serving/serialize"):
+                _assemble_regressions(response.result, outputs, n)
             self.core.request_logger.maybe_log(
                 request.model_spec.name,
                 lambda: _regress_log(request, response),

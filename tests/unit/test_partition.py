@@ -139,9 +139,9 @@ def test_no_flops_returns_none():
     assert part is None
 
 
-def test_host_pre_cut_feeds_interior():
-    """string feed -> host hash-ish lookup (int values) -> MatMul: the
-    pre stage computes the cut, the interior consumes it."""
+def _string_cut_graph():
+    """string feed -> host lookup (int values) -> MatMul: the pre stage
+    computes the cut, the interior consumes ONLY cuts (no direct feed)."""
     gd = tf_graph_pb2.GraphDef()
     ph = gd.node.add()
     ph.name = "tok"
@@ -169,6 +169,11 @@ def test_host_pre_cut_feeds_interior():
     mm.op = "MatMul"
     mm.input.extend(["idsf", "w"])
     tables = {"tbl": LookupTable([b"x", b"y"], [3, 5], False)}
+    return gd, tables
+
+
+def test_host_pre_cut_feeds_interior():
+    gd, tables = _string_cut_graph()
     part = try_partition(gd, ["tok:0"], ["out:0"],
                          funclib=_FuncLib(None), tables=tables,
                          string_feed_refs=frozenset(["tok:0"]))
@@ -377,3 +382,222 @@ def test_runtime_partition_error_falls_back_to_host(monkeypatch):
     out = sig.run(dec)  # host fallback, not an error
     assert np.asarray(out["classes"]).shape == (1, 4)
     assert np.isclose(np.asarray(out["scores"]).sum(), 1.0, atol=1e-4)
+
+
+def test_cut_lists_deterministic_across_hash_seeds():
+    """interior_out_refs / cut_in_refs / stats must not depend on set
+    iteration order (hash randomization): two processes with different
+    PYTHONHASHSEED must produce identical partitions, or partition
+    stats, stage fetch order, and jit cache keys diverge across
+    processes (ADVICE r5 low)."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    code = """
+import json
+import numpy as np
+from tests.unit.test_partition import _classify_graph, _tables
+from min_tfs_client_tpu.servables.graphdef_import import _FuncLib
+from min_tfs_client_tpu.servables.partition import try_partition
+
+gd = _classify_graph()
+# Extra fetches widen the consumer set so ordering differences would show.
+part = try_partition(gd, ["x:0"], ["scores:0", "label:0", "best:0"],
+                     funclib=_FuncLib(None), tables=_tables())
+print(json.dumps({
+    "cut_in": part.cut_in_refs,
+    "interior_out": part.interior_out_refs,
+    "used_feed_idx": part.used_feed_idx,
+    "stats": part.stats,
+}, sort_keys=True))
+"""
+    outs = []
+    for seed in ("0", "1", "42"):
+        env = dict(os.environ, PYTHONHASHSEED=seed, JAX_PLATFORMS="cpu")
+        res = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, text=True,
+            timeout=120, cwd=os.path.dirname(os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__)))), env=env)
+        assert res.returncode == 0, res.stderr[-2000:]
+        outs.append(json.loads(res.stdout.strip().splitlines()[-1]))
+    assert outs[0] == outs[1] == outs[2]
+
+
+def test_calibration_failure_is_recorded_not_silent():
+    """A failing batch-1 calibration probe keeps the dim-match heuristic
+    but must RECORD the failure (metric + log) instead of passing
+    silently (ADVICE r5: a bare except here can hide truncation of
+    fixed-size outputs that coincide with the padding bucket)."""
+    from min_tfs_client_tpu.server import metrics
+
+    gd = _classify_graph()
+    part = try_partition(gd, ["x:0"], ["scores:0", "label:0"],
+                         funclib=_FuncLib(None), tables=_tables())
+    assert part is not None
+
+    def boom(*a, **k):
+        raise RuntimeError("forced probe failure")
+
+    part.interior_jitted = boom
+    before = metrics.partition_calibration_failures.value("unknown")
+    part._calibrate([np.ones((3, 3), np.float32)])
+    assert part._interior_batch_major is None  # heuristic retained
+    after = metrics.partition_calibration_failures.value("unknown")
+    assert after == before + 1
+
+
+def test_calibration_probe_slices_only_batch_major_feeds():
+    """The batch-1 probe must slice exactly the feeds sharing the batch
+    dim (the _pad_interior criterion) — slicing a fixed-size side feed
+    would probe the graph with a semantically wrong input."""
+    gd = _classify_graph()
+    part = try_partition(gd, ["x:0"], ["scores:0", "label:0"],
+                         funclib=_FuncLib(None), tables=_tables())
+    assert part is not None
+    seen = []
+    real_jitted = part.interior_jitted
+
+    def spy(stat, key):
+        fn = real_jitted(stat, key)
+
+        def wrapped(dyn):
+            seen.append([np.asarray(v).shape for v in dyn])
+            return fn(dyn)
+
+        return wrapped
+
+    part.interior_jitted = spy
+    # Feeds share batch dim 3 -> the probe slices to 1 row.
+    part._calibrate([np.ones((3, 3), np.float32)])
+    assert part._interior_batch_major is not None
+    assert seen and seen[0][0][0] == 1
+
+
+def test_calibration_ambiguous_batch_dims_is_a_recorded_failure():
+    """INTERIOR feeds that disagree on the leading dim leave the probe
+    with no batch reference: it must record a calibration failure and
+    keep the heuristic, never probe at full batch and learn wrong
+    flags. (A host-only side feed of a different length is fine — the
+    criterion runs over the interior-consumed feeds, like
+    _pad_interior.)"""
+    from min_tfs_client_tpu.server import metrics
+
+    gd = _classify_graph()
+    part = try_partition(gd, ["x:0"], ["scores:0", "label:0"],
+                         funclib=_FuncLib(None), tables=_tables())
+    assert part is not None
+    part.used_feed_idx = [0, 1]  # two interior feeds with mixed dims
+    part.static_flags = [False, False]
+    before = metrics.partition_calibration_failures.value("unknown")
+    part._calibrate([np.ones((3, 3), np.float32),
+                     np.ones((7,), np.float32)])
+    assert part._interior_batch_major is None
+    assert part._result_batch_major is None
+    assert part._calibration_failed
+    assert metrics.partition_calibration_failures.value("unknown") \
+        == before + 1
+
+
+def test_calibration_ignores_host_only_side_feed_dims():
+    """A feed the interior does not consume (a host-only side input of a
+    different length) must neither block calibration nor be sliced: the
+    batch reference comes from the interior-consumed feeds only, like
+    _pad_interior's padding decision."""
+    gd = _classify_graph()
+    side = gd.node.add()
+    side.name = "side"
+    side.op = "Placeholder"
+    side.attr["dtype"].type = DT_INT64
+    find = gd.node.add()
+    find.name = "side_label"   # host-only consumer; side never reaches
+    find.op = "LookupTableFindV2"  # the jitted interior
+    find.input.extend(["tbl", "side", "default"])
+    part = try_partition(gd, ["x:0", "side:0"],
+                         ["scores:0", "label:0", "side_label:0"],
+                         funclib=_FuncLib(None), tables=_tables())
+    assert part is not None
+    assert part.used_feed_idx == [0]  # interior consumes only x
+    x = np.ones((3, 3), np.float32)       # batch 3 -> bucket 4
+    side_v = np.arange(7, dtype=np.int64)    # length != batch
+    outs = part.run([x, side_v], batch_buckets=(4,))
+    assert part._interior_batch_major is not None  # calibration ran
+    assert not part._calibration_failed
+    assert np.asarray(outs[0]).shape == (3, 4)  # sliced back
+    assert np.asarray(outs[2]).shape == (7,)    # side output untouched
+
+
+def test_calibration_failure_latches_and_records_once(scheduler=None):
+    """A persistently failing probe is recorded ONCE: later padded
+    requests keep the heuristic without re-probing, re-logging, or
+    re-incrementing the failure counter per request."""
+    from min_tfs_client_tpu.server import metrics
+
+    gd = _classify_graph()
+    part = try_partition(gd, ["x:0"], ["scores:0", "label:0"],
+                         funclib=_FuncLib(None), tables=_tables())
+    assert part is not None
+    real_jitted = part.interior_jitted
+
+    def probe_poison(stat, key):
+        fn = real_jitted(stat, key)
+
+        def wrapped(dyn):
+            if np.asarray(dyn[0]).shape[0] == 1:  # the batch-1 probe
+                raise RuntimeError("forced probe failure")
+            return fn(dyn)
+
+        return wrapped
+
+    part.interior_jitted = probe_poison
+    before = metrics.partition_calibration_failures.value("unknown")
+    x = np.ones((3, 3), np.float32)  # batch 3 -> bucket 4: sliced path
+    for _ in range(3):
+        outs = part.run([x], batch_buckets=(4,))
+        assert np.asarray(outs[0]).shape == (3, 4)  # heuristic slicing
+    assert metrics.partition_calibration_failures.value("unknown") \
+        == before + 1  # once, despite three padded requests
+
+
+def test_calibration_with_cut_only_interior_uses_cut_dims():
+    """When the interior consumes ONLY cut tensors (string-feed graphs:
+    used_feed_idx is empty), the calibration batch reference must come
+    from the cuts _pad_interior actually pads — not from all signature
+    feeds — so the probe still calibrates instead of latching failure."""
+    gd, tables = _string_cut_graph()
+    part = try_partition(gd, ["tok:0"], ["out:0"],
+                         funclib=_FuncLib(None), tables=tables,
+                         string_feed_refs=frozenset(["tok:0"]))
+    assert part is not None
+    assert part.used_feed_idx == []
+    tok = np.array([[b"x", b"y"], [b"y", b"y"], [b"x", b"x"]], object)
+    outs = part.run([tok], batch_buckets=(4,))  # batch 3 -> bucket 4
+    assert not part._calibration_failed
+    assert part._interior_batch_major is not None  # probe succeeded
+    np.testing.assert_allclose(
+        outs[0], [[3.0, 5.0], [5.0, 5.0], [3.0, 3.0]])
+
+
+def test_calibration_refuses_full_batch_probe():
+    """If slicing the signature feeds does not propagate to the interior
+    inputs (e.g. a pre stage that reshapes the batch away), the probe
+    must fail loudly and keep the heuristic — never learn batch-major
+    flags from a full-batch run (outputs' leading dim != 1 would mark
+    every batch-major output as fixed, leaking padded rows)."""
+    from min_tfs_client_tpu.server import metrics
+
+    gd, tables = _string_cut_graph()
+    part = try_partition(gd, ["tok:0"], ["out:0"],
+                         funclib=_FuncLib(None), tables=tables,
+                         string_feed_refs=frozenset(["tok:0"]))
+    assert part is not None
+    tok = np.array([[b"x", b"y"], [b"y", b"y"], [b"x", b"x"]], object)
+    real_pre = part.pre
+    part.pre = lambda feeds, lib: real_pre([tok], lib)  # ignores slicing
+    before = metrics.partition_calibration_failures.value("unknown")
+    part._calibrate([tok])
+    assert part._interior_batch_major is None
+    assert part._calibration_failed
+    assert metrics.partition_calibration_failures.value("unknown") \
+        == before + 1
