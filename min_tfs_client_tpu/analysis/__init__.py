@@ -1,0 +1,37 @@
+"""servelint: AST-based hot-path static analysis for the serving stack.
+
+Four rule families (docs/STATIC_ANALYSIS.md), a comment-annotation
+vocabulary (`# guarded_by:`, `# servelint: sync-ok|lock-ok|jit-ok|
+span-ok|holds`), and a checked-in baseline ratchet. Gated in tier-1 via
+tests/unit/test_static_analysis.py; CLI via `servelint` /
+`python -m min_tfs_client_tpu.analysis`.
+"""
+
+from min_tfs_client_tpu.analysis.baseline import (
+    diff_baseline,
+    load_baseline,
+    save_baseline,
+)
+from min_tfs_client_tpu.analysis.core import AnalysisConfig, Finding
+from min_tfs_client_tpu.analysis.runner import (
+    ALL_RULES,
+    Report,
+    analyze_paths,
+    default_baseline_path,
+    default_package_root,
+    run_analysis,
+)
+
+__all__ = [
+    "ALL_RULES",
+    "AnalysisConfig",
+    "Finding",
+    "Report",
+    "analyze_paths",
+    "default_baseline_path",
+    "default_package_root",
+    "diff_baseline",
+    "load_baseline",
+    "run_analysis",
+    "save_baseline",
+]

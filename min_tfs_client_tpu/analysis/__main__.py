@@ -1,0 +1,89 @@
+"""servelint CLI.
+
+    python -m min_tfs_client_tpu.analysis [--baseline B] [paths...]
+    servelint [--baseline B] [paths...]            (console entry point)
+
+Exit status: 0 when the run is clean (no findings beyond the baseline and
+no stale baseline entries), 1 otherwise, 2 on usage errors. Default path
+is the installed package; default baseline is the checked-in
+analysis/baseline.json next to this module.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from min_tfs_client_tpu.analysis.baseline import save_baseline
+from min_tfs_client_tpu.analysis.runner import (
+    default_baseline_path,
+    default_package_root,
+    run_analysis,
+)
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="servelint",
+        description="AST-based hot-path analysis for the TPU serving "
+                    "stack: host-sync, recompile-hazard, lock-discipline "
+                    "and span-discipline rules (docs/STATIC_ANALYSIS.md).")
+    parser.add_argument("paths", nargs="*",
+                        help="files/directories to analyze "
+                             "(default: the installed package)")
+    parser.add_argument("--baseline", default=None, metavar="FILE",
+                        help="baseline JSON (default: the package's "
+                             "analysis/baseline.json); 'none' disables")
+    parser.add_argument("--write-baseline", action="store_true",
+                        help="rewrite the baseline from current findings "
+                             "and exit 0")
+    parser.add_argument("--format", choices=("text", "json"),
+                        default="text")
+    parser.add_argument("--list", action="store_true", dest="list_all",
+                        help="print every finding (including baselined)")
+    args = parser.parse_args(argv)
+
+    paths = args.paths or [default_package_root()]
+    baseline = args.baseline
+    if baseline is None:
+        baseline = default_baseline_path()
+    elif baseline == "none":
+        baseline = None
+
+    report = run_analysis(paths, baseline_path=baseline)
+
+    if args.write_baseline:
+        if baseline is None:
+            # `--baseline none --write-baseline` must NOT silently fall
+            # back to clobbering the checked-in package baseline.
+            parser.error("--write-baseline requires a baseline path "
+                         "(--baseline none disables the baseline)")
+        save_baseline(baseline, report.findings,
+                      required_guards=report.declared_guards)
+        print(f"servelint: wrote {len(report.findings)} entries and "
+              f"{len(report.declared_guards)} required guards to "
+              f"{baseline}")
+        return 0
+
+    if args.format == "json":
+        payload = {
+            "files_scanned": report.files_scanned,
+            "clean": report.clean,
+            "new": [vars(f) | {"key": f.key()} for f in report.diff.new],
+            "stale": report.diff.stale,
+            "all_findings": [vars(f) | {"key": f.key()}
+                             for f in report.findings] if args.list_all
+            else None,
+        }
+        print(json.dumps(payload, indent=2))
+    else:
+        if args.list_all:
+            for f in report.findings:
+                print("      " + f.render())
+        print(report.render())
+    return 0 if report.clean else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,102 @@
+"""Baseline: grandfather accepted findings, fail on new or stale ones.
+
+The baseline is a checked-in JSON file mapping line-number-independent
+finding keys (Finding.key(): path::code::scope::detail) to accepted
+counts. A run fails when
+
+  * a finding's observed count exceeds its baselined count (NEW), or
+  * a baselined key observes fewer findings than accepted (STALE — the
+    code was fixed; the entry must be deleted so the debt ledger never
+    overstates itself).
+
+This is the ratchet: the suite can only get cleaner. `--write-baseline`
+regenerates the file from the current findings (reviewed, committed).
+
+The file also pins `required_guards`: the ids of every `# guarded_by:`
+declaration the repo is expected to carry. Deleting an annotation would
+otherwise silently disable its checks; with the pin, the run fails with
+LK004 until the annotation is restored (or the entry consciously
+retired).
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+from dataclasses import dataclass, field
+
+from min_tfs_client_tpu.analysis.core import Finding
+
+BASELINE_VERSION = 1
+
+
+@dataclass
+class BaselineDiff:
+    new: list[Finding] = field(default_factory=list)
+    stale: list[str] = field(default_factory=list)   # keys fixed but listed
+    matched: int = 0
+
+    @property
+    def clean(self) -> bool:
+        return not self.new and not self.stale
+
+
+@dataclass
+class Baseline:
+    entries: dict[str, int] = field(default_factory=dict)
+    required_guards: list[str] = field(default_factory=list)
+
+
+def load_baseline(path: str | None) -> Baseline:
+    if path is None:
+        return Baseline()
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            data = json.load(f)
+    except FileNotFoundError:
+        return Baseline()
+    if not isinstance(data, dict) or data.get("version") != BASELINE_VERSION:
+        raise ValueError(
+            f"baseline {path}: unsupported format (want version "
+            f"{BASELINE_VERSION})")
+    entries = data.get("entries", {})
+    if isinstance(entries, list):  # tolerate the list-of-keys form
+        entries = {k: 1 for k in entries}
+    return Baseline(
+        entries={str(k): int(v) for k, v in entries.items()},
+        required_guards=[str(g) for g in data.get("required_guards", [])])
+
+
+def save_baseline(path: str, findings: list[Finding],
+                  required_guards=()) -> None:
+    counts = collections.Counter(f.key() for f in findings
+                                 if f.code != "LK004")
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump({"version": BASELINE_VERSION,
+                   "entries": dict(sorted(counts.items())),
+                   "required_guards": sorted(required_guards)}, f, indent=2,
+                  sort_keys=False)
+        f.write("\n")
+
+
+def diff_baseline(findings: list[Finding],
+                  baseline: Baseline | dict) -> BaselineDiff:
+    if isinstance(baseline, Baseline):
+        baseline = baseline.entries
+    diff = BaselineDiff()
+    by_key: dict[str, list[Finding]] = collections.defaultdict(list)
+    for f in findings:
+        by_key[f.key()].append(f)
+    for key, group in sorted(by_key.items()):
+        accepted = baseline.get(key, 0)
+        diff.matched += min(accepted, len(group))
+        if len(group) > accepted:
+            # Oldest entries grandfathered; the overflow (by line order)
+            # is new.
+            diff.new.extend(
+                sorted(group, key=lambda f: f.line)[accepted:])
+    for key, accepted in sorted(baseline.items()):
+        if len(by_key.get(key, ())) < accepted:
+            diff.stale.append(key)
+    diff.new.sort(key=lambda f: (f.path, f.line, f.code))
+    return diff

@@ -1,0 +1,311 @@
+"""HS: host-sync detector — device->host coercions in hot-path modules.
+
+A jax Array is an asynchronous handle; `np.asarray`, `float()`, `int()`,
+`.tolist()`, implicit `bool`, f-strings and `.block_until_ready()` all
+BLOCK until the device catches up, serializing the pipeline exactly where
+it must stay overlapped (the learned-TPU-cost-model line of work treats
+silent host syncs as first-order perf bugs). The C++ reference makes the
+hop visible in the type system; here we recover it with a per-function
+taint pass:
+
+  seeds      results of `self._execute(...)`, `self._run_device(...)`,
+             `self.jitted()(...)`, `self.interior_jitted(...)(...)`,
+             `jax.jit(f)` callables (by name or `self.<attr>`, tracked
+             module-wide), `jax.device_put(...)`, and any `x` probed via
+             `getattr(x, "copy_to_host_async", ...)`;
+  flows      assignments, subscripts, container displays, comprehensions,
+             `.items()/.values()/.get()` accessors, arithmetic;
+  sinks      the coercions above -> finding; `fetch_outputs(...)` is the
+             sanctioned overlapped fetch and clears taint.
+
+Findings only fire in modules the config marks hot-path; a legitimate
+sync point carries `# servelint: sync-ok <why>` on its line.
+
+  HS001  device->host coercion (np.asarray/float/int/bool/.tolist/.item)
+  HS002  .block_until_ready() on the hot path (flagged taint or not)
+  HS003  implicit bool on a device value (if/while/assert)
+  HS004  f-string formats a device value
+"""
+
+from __future__ import annotations
+
+import ast
+
+from min_tfs_client_tpu.analysis.core import (
+    AnalysisConfig,
+    Finding,
+    ModuleInfo,
+    bound_names,
+    collect_jit_bindings,
+    dotted,
+    walk_function_nodes,
+    walk_scopes,
+)
+
+RULE = "host-sync"
+
+# Coercion sinks. Builtins take the value as first positional arg;
+# np-style functions likewise; methods coerce their receiver.
+_COERCION_BUILTINS = {"float", "int", "bool"}
+_COERCION_FUNCS = {
+    "np.asarray", "np.array", "np.ascontiguousarray", "np.copy",
+    "numpy.asarray", "numpy.array", "numpy.ascontiguousarray", "numpy.copy",
+}
+_COERCION_METHODS = {"tolist", "item"}
+# Accessor methods that hand back (parts of) a tainted container.
+_PROPAGATING_METHODS = {"items", "values", "get", "copy", "pop", "popleft",
+                        "setdefault"}
+_PROPAGATING_BUILTINS = {"dict", "list", "tuple", "enumerate", "zip",
+                         "sorted", "reversed", "iter", "next"}
+# getattr probes that prove a value is a device array.
+_DEVICE_PROBE_ATTRS = {"copy_to_host_async", "block_until_ready",
+                       "addressable_shards", "on_device_size_in_bytes"}
+# Factory attrs whose RESULT is a device-executing callable (flagged only
+# when immediately invoked: self.jitted()(x)).
+_CALLABLE_FACTORY_ATTRS = {"jitted", "interior_jitted"}
+
+
+def check(module: ModuleInfo, config: AnalysisConfig) -> list[Finding]:
+    if not config.is_hot(module.path):
+        return []
+    jit_names, jit_attrs = collect_jit_bindings(module.tree,
+                                                config.jit_factories)
+    findings: list[Finding] = []
+    for qualname, func in walk_scopes(module.tree):
+        findings.extend(
+            _check_function(module, config, qualname, func,
+                            jit_names, jit_attrs))
+    return findings
+
+
+class _Taint:
+    """Flow-insensitive name taint for one function scope."""
+
+    def __init__(self, config: AnalysisConfig, jit_names: set,
+                 jit_attrs: set):
+        self.config = config
+        self.jit_names = set(jit_names)
+        self.jit_attrs = set(jit_attrs)
+        self.tainted: set[str] = set()
+
+    # -- seeds ---------------------------------------------------------------
+
+    def is_device_call(self, call: ast.Call) -> bool:
+        func = call.func
+        # self.jitted()(x) / self.interior_jitted(...)(...) / jax.jit(f)(x)
+        if isinstance(func, ast.Call):
+            inner = dotted(func.func) or ""
+            if inner in self.config.jit_factories:
+                return True
+            if isinstance(func.func, ast.Attribute) and \
+                    func.func.attr in _CALLABLE_FACTORY_ATTRS:
+                return True
+        name = dotted(func) or ""
+        if name in self.config.device_call_names:
+            return True
+        if isinstance(func, ast.Attribute) and \
+                func.attr in self.config.device_call_attrs:
+            return True
+        # A name (or self.<attr>) previously bound to a jit factory result.
+        if isinstance(func, ast.Name) and func.id in self.jit_names:
+            return True
+        if isinstance(func, ast.Attribute) and \
+                isinstance(func.value, ast.Name) and \
+                func.value.id == "self" and func.attr in self.jit_attrs:
+            return True
+        return False
+
+    # -- expression taint ----------------------------------------------------
+
+    def is_tainted(self, node: ast.AST) -> bool:
+        if isinstance(node, ast.Name):
+            return node.id in self.tainted
+        if isinstance(node, (ast.Subscript, ast.Starred, ast.Await)):
+            return self.is_tainted(node.value)
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            return any(self.is_tainted(e) for e in node.elts)
+        if isinstance(node, ast.Dict):
+            return any(self.is_tainted(v) for v in node.values
+                       if v is not None)
+        if isinstance(node, ast.IfExp):
+            return self.is_tainted(node.body) or self.is_tainted(node.orelse)
+        if isinstance(node, ast.BoolOp):
+            return any(self.is_tainted(v) for v in node.values)
+        if isinstance(node, ast.BinOp):
+            return self.is_tainted(node.left) or self.is_tainted(node.right)
+        if isinstance(node, ast.UnaryOp):
+            return self.is_tainted(node.operand)
+        if isinstance(node, ast.NamedExpr):
+            return self.is_tainted(node.value)
+        if isinstance(node, (ast.ListComp, ast.SetComp, ast.GeneratorExp)):
+            return (any(self.is_tainted(g.iter) for g in node.generators)
+                    or self.is_tainted(node.elt))
+        if isinstance(node, ast.DictComp):
+            return (any(self.is_tainted(g.iter) for g in node.generators)
+                    or self.is_tainted(node.value))
+        if isinstance(node, ast.Call):
+            return self._call_taints(node)
+        return False
+
+    def _call_taints(self, call: ast.Call) -> bool:
+        if self.is_device_call(call):
+            return True
+        name = dotted(call.func) or ""
+        # Sanctioned fetch and the coercions themselves return HOST data.
+        if name.rsplit(".", 1)[-1] in self.config.sanctioned_fetches:
+            return False
+        if name in _COERCION_FUNCS or name in _COERCION_BUILTINS:
+            return False
+        if isinstance(call.func, ast.Attribute):
+            if call.func.attr in _COERCION_METHODS:
+                return False
+            if call.func.attr in _PROPAGATING_METHODS:
+                return self.is_tainted(call.func.value)
+        if isinstance(call.func, ast.Name) and \
+                call.func.id in _PROPAGATING_BUILTINS:
+            return any(self.is_tainted(a) for a in call.args)
+        return False
+
+    # -- fixpoint over a function scope --------------------------------------
+
+    def run(self, func: ast.AST) -> None:
+        for _ in range(10):  # fixpoint; depth bounded by assignment chains
+            before = len(self.tainted)
+            for node in walk_function_nodes(func):
+                self._absorb(node)
+            if len(self.tainted) == before:
+                return
+
+    def _absorb(self, node: ast.AST) -> None:
+        if isinstance(node, ast.Assign):
+            if self.is_tainted(node.value):
+                for target in node.targets:
+                    self._bind(target)
+            self._absorb_jit_binding(node)
+        elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+            if node.value is not None and self.is_tainted(node.value):
+                self._bind(node.target)
+        elif isinstance(node, ast.NamedExpr):
+            if self.is_tainted(node.value):
+                self._bind(node.target)
+        elif isinstance(node, (ast.For, ast.AsyncFor)):
+            if self.is_tainted(node.iter):
+                self._bind(node.target)
+        elif isinstance(node, ast.withitem):
+            if node.optional_vars is not None and \
+                    self.is_tainted(node.context_expr):
+                self._bind(node.optional_vars)
+        elif isinstance(node, ast.Call):
+            self._absorb_probe(node)
+
+    def _absorb_jit_binding(self, node: ast.Assign) -> None:
+        """`fn = jax.jit(...)` inside a function: calling fn executes on
+        device (module-wide bindings come in via collect_jit_bindings)."""
+        if isinstance(node.value, ast.Call) and \
+                (dotted(node.value.func) or "") in self.config.jit_factories:
+            for target in node.targets:
+                self.jit_names.update(bound_names(target))
+
+    def _absorb_probe(self, call: ast.Call) -> None:
+        """getattr(x, "copy_to_host_async", ...) proves x is a device
+        array — the JAX-specific inference that catches fetch helpers."""
+        if isinstance(call.func, ast.Name) and call.func.id == "getattr" \
+                and len(call.args) >= 2 \
+                and isinstance(call.args[1], ast.Constant) \
+                and call.args[1].value in _DEVICE_PROBE_ATTRS \
+                and isinstance(call.args[0], ast.Name):
+            if call.args[0].id not in self.tainted:
+                self.tainted.add(call.args[0].id)
+
+    def _bind(self, target: ast.AST) -> None:
+        for name in bound_names(target):
+            self.tainted.add(name)
+
+
+def _check_function(module: ModuleInfo, config: AnalysisConfig,
+                    qualname: str, func: ast.AST,
+                    jit_names: set, jit_attrs: set) -> list[Finding]:
+    taint = _Taint(config, jit_names, jit_attrs)
+    taint.run(func)
+    findings: list[Finding] = []
+
+    def add(node: ast.AST, stmt: ast.stmt, code: str, message: str,
+            hint: str, detail: str) -> None:
+        if module.suppressed(node, "sync-ok", stmt):
+            return
+        findings.append(Finding(
+            path=module.path, line=node.lineno, rule=RULE, code=code,
+            message=message, hint=hint, scope=qualname, detail=detail))
+
+    def visit(node: ast.AST, stmt: ast.stmt) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return
+        if isinstance(node, ast.stmt):
+            stmt = node
+        if isinstance(node, ast.Call):
+            _check_call(node, stmt)
+        elif isinstance(node, (ast.If, ast.While)):
+            _check_test(node, stmt)
+        elif isinstance(node, ast.Assert):
+            _check_bare(node.test, node, "assert")
+        elif isinstance(node, ast.FormattedValue):
+            if isinstance(node.value, ast.Name) and \
+                    taint.is_tainted(node.value):
+                add(node.value, stmt, "HS004",
+                    f"f-string formats device value "
+                    f"'{node.value.id}' (forces a device->host sync)",
+                    "format after fetch_outputs(), or annotate "
+                    "`# servelint: sync-ok <why>`", f"fstr:{node.value.id}")
+        for child in ast.iter_child_nodes(node):
+            visit(child, stmt)
+
+    def _check_call(call: ast.Call, stmt: ast.stmt) -> None:
+        func_d = dotted(call.func) or ""
+        target = None
+        if func_d in _COERCION_BUILTINS and call.args:
+            target = call.args[0]
+        elif func_d in _COERCION_FUNCS and call.args:
+            target = call.args[0]
+        elif isinstance(call.func, ast.Attribute) and \
+                call.func.attr in _COERCION_METHODS:
+            target = call.func.value
+        if isinstance(call.func, ast.Attribute) and \
+                call.func.attr == "block_until_ready":
+            add(call, stmt, "HS002",
+                "block_until_ready() forces a full device sync on the "
+                "hot path",
+                "let the dispatch stay async; fetch via fetch_outputs() "
+                "or annotate `# servelint: sync-ok <why>`",
+                "block_until_ready")
+            return
+        if target is not None and taint.is_tainted(target):
+            name = dotted(target) or type(target).__name__
+            coercer = (func_d or
+                       getattr(call.func, "attr", "coercion"))
+            add(call, stmt, "HS001",
+                f"device->host coercion {coercer}() on device value "
+                f"'{name}' in a hot-path module",
+                "fetch once via fetch_outputs() off the critical "
+                "section, or annotate `# servelint: sync-ok <why>`",
+                f"{coercer}:{name}")
+
+    def _check_test(node, stmt) -> None:
+        _check_bare(node.test, stmt,
+                    "if" if isinstance(node, ast.If) else "while")
+
+    def _check_bare(test: ast.AST, stmt: ast.stmt, kind: str) -> None:
+        inner = test
+        if isinstance(inner, ast.UnaryOp) and isinstance(inner.op, ast.Not):
+            inner = inner.operand
+        if isinstance(inner, ast.Name) and taint.is_tainted(inner):
+            add(inner, stmt, "HS003",
+                f"implicit bool({inner.id}) in `{kind}` blocks on the "
+                "device (jax arrays synchronize under truth tests)",
+                "test a host-side flag, or fetch explicitly first",
+                f"{kind}:{inner.id}")
+
+    for child in ast.iter_child_nodes(func):
+        if isinstance(child, ast.stmt):
+            visit(child, child)
+    return findings

@@ -1,0 +1,358 @@
+"""LK: lock-discipline checker — a Python GUARDED_BY analogue.
+
+The reference's batching_session/manager state is protected by clang
+thread-safety annotations (`GUARDED_BY(mu_)`, checked at compile time).
+Here the declaration is a comment on the attribute's initialising
+assignment, and the checker enforces that every OTHER access in the
+declaring class happens lexically inside `with <lock>:`:
+
+    class BatchQueue:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._batches = deque()      # guarded_by: self._lock
+
+Module-level state works the same way with a module-level lock name:
+
+    _pending = deque()                   # guarded_by: _pending_lock
+
+Escape hatches (all carry a why):
+  * `# servelint: holds self._lock` on a `def` line — the method's
+    contract is caller-holds-the-lock (EXCLUSIVE_LOCKS_REQUIRED);
+  * a `_locked` name suffix — same contract, by convention;
+  * `# servelint: lock-ok <why>` on an access line — reviewed benign
+    (e.g. a GIL-atomic read feeding a heuristic).
+
+`__init__`/`__post_init__`/`__del__` and module top-level code are exempt
+(single-threaded construction), as are accesses through objects other
+than `self` (cross-object discipline is the owner class's contract).
+
+  LK001  unguarded read of a guarded attribute
+  LK002  unguarded write of a guarded attribute
+  LK003  guarded_by names a lock never acquired anywhere in the module
+"""
+
+from __future__ import annotations
+
+import ast
+
+from min_tfs_client_tpu.analysis.core import (
+    AnalysisConfig,
+    Finding,
+    ModuleInfo,
+    dotted,
+    walk_function_nodes,
+)
+
+RULE = "locks"
+
+_EXEMPT_METHODS = {"__init__", "__post_init__", "__del__", "__enter__"}
+
+
+def check(module: ModuleInfo, config: AnalysisConfig) -> list[Finding]:
+    findings: list[Finding] = []
+    acquired = _all_acquired_locks(module)
+
+    # Module-level guarded names.
+    mod_guards = _module_guards(module)
+    for name, (lock, line) in mod_guards.items():
+        if not _is_acquired(lock, acquired):
+            findings.append(Finding(
+                path=module.path, line=line, rule=RULE, code="LK003",
+                message=f"'{name}' is guarded_by {lock}, but {lock} is "
+                        "never acquired in this module",
+                hint="fix the lock name in the annotation, or add the "
+                     "`with` blocks",
+                scope="<module>", detail=f"decl:{name}"))
+    if mod_guards:
+        findings.extend(_check_module_guards(module, mod_guards))
+
+    # Class-level guarded attributes.
+    for classdef, prefix in _walk_classes(module.tree):
+        guards = _class_guards(module, classdef)
+        if not guards:
+            continue
+        for attr, (lock, line) in guards.items():
+            if not _is_acquired(lock, acquired):
+                findings.append(Finding(
+                    path=module.path, line=line, rule=RULE, code="LK003",
+                    message=f"'self.{attr}' is guarded_by {lock}, but "
+                            f"{lock} is never acquired in this module",
+                    hint="fix the lock name in the annotation, or add "
+                         "the `with` blocks",
+                    scope=f"{prefix}{classdef.name}",
+                    detail=f"decl:{attr}"))
+        findings.extend(
+            _check_class(module, classdef, f"{prefix}{classdef.name}",
+                         {a: l for a, (l, _) in guards.items()}))
+    return findings
+
+
+def collect_declared_guards(module: ModuleInfo) -> set[str]:
+    """Stable ids of every guarded_by declaration in the module:
+    `path::Class.attr` / `path::<module>.name`. The baseline's
+    required_guards list pins these — deleting a seeded annotation (which
+    would silently disable its checks) then fails the run with LK004."""
+    declared: set[str] = set()
+    for name in _module_guards(module):
+        declared.add(f"{module.path}::<module>.{name}")
+    for classdef, prefix in _walk_classes(module.tree):
+        for attr in _class_guards(module, classdef):
+            declared.add(f"{module.path}::{prefix}{classdef.name}.{attr}")
+    return declared
+
+
+def missing_guard_findings(required: list[str],
+                           declared: set[str]) -> list[Finding]:
+    findings = []
+    for guard in sorted(set(required) - declared):
+        path, _, scope = guard.partition("::")
+        findings.append(Finding(
+            path=path, line=1, rule=RULE, code="LK004",
+            message=f"required guarded_by declaration '{scope}' is "
+                    "missing — its lock-discipline checks are silently "
+                    "disabled",
+            hint="restore the `# guarded_by: <lock>` annotation (or, if "
+                 "the state was intentionally retired, remove the entry "
+                 "from required_guards in the baseline)",
+            scope=scope, detail=f"required:{scope}"))
+    return findings
+
+
+def _walk_classes(tree: ast.Module):
+    def visit(node, prefix):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                yield child, prefix
+                yield from visit(child, f"{prefix}{child.name}.")
+            elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield from visit(child, prefix)
+            else:
+                yield from visit(child, prefix)
+    yield from visit(tree, "")
+
+
+def _all_acquired_locks(module: ModuleInfo) -> set[str]:
+    """Every lock expression acquired via `with` anywhere in the module,
+    plus locks named by `# servelint: holds` contracts. Used only for the
+    LK003 typo check, so matching is by final attribute segment — a
+    cross-object path like `self._scheduler._cv` matches the owning
+    class's `with self._cv:`."""
+    locks: set[str] = set()
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.withitem):
+            d = dotted(node.context_expr)
+            if d:
+                locks.add(d)
+    for line in module.comments:
+        locks |= module.holds_locks(line)
+    return {lock.rsplit(".", 1)[-1] for lock in locks}
+
+
+def _is_acquired(lock: str, acquired_tails: set[str]) -> bool:
+    return lock.rsplit(".", 1)[-1] in acquired_tails
+
+
+def _decl_on(module: ModuleInfo, stmt) -> str | None:
+    """The guarded_by annotation anywhere on the statement's line span
+    (multi-line initializers put the comment on the closing line)."""
+    for line in range(stmt.lineno, (stmt.end_lineno or stmt.lineno) + 1):
+        lock = module.guarded_decl(line)
+        if lock:
+            return lock
+    return None
+
+
+def _module_guards(module: ModuleInfo) -> dict[str, tuple[str, int]]:
+    """Top-level `name = ...  # guarded_by: <lock>` declarations."""
+    guards: dict[str, tuple[str, int]] = {}
+    for stmt in module.tree.body:
+        if not isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+            continue
+        lock = _decl_on(module, stmt)
+        if not lock:
+            continue
+        targets = stmt.targets if isinstance(stmt, ast.Assign) \
+            else [stmt.target]
+        for t in targets:
+            if isinstance(t, ast.Name):
+                guards[t.id] = (lock, stmt.lineno)
+    return guards
+
+
+def _class_guards(module: ModuleInfo, classdef: ast.ClassDef
+                  ) -> dict[str, tuple[str, int]]:
+    """`self.X = ...  # guarded_by: <lock>` declarations anywhere in the
+    class (typically __init__), plus annotated class-level AnnAssigns."""
+    guards: dict[str, tuple[str, int]] = {}
+    for node in ast.walk(classdef):
+        if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            lock = _decl_on(module, node)
+            if not lock:
+                continue
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for t in targets:
+                if isinstance(t, ast.Attribute) and \
+                        isinstance(t.value, ast.Name) and t.value.id == "self":
+                    guards[t.attr] = (lock, node.lineno)
+                elif isinstance(t, ast.Name) and not isinstance(
+                        node, ast.AugAssign) and _is_class_level(
+                            classdef, node):
+                    guards[t.id] = (lock, node.lineno)
+    return guards
+
+
+def _is_class_level(classdef: ast.ClassDef, stmt) -> bool:
+    return any(child is stmt for child in classdef.body)
+
+
+def _function_preheld(module: ModuleInfo, func) -> set[str] | None:
+    """Locks a method declares it is called with; None = exempt."""
+    if func.name in _EXEMPT_METHODS:
+        return None
+    if func.name.endswith("_locked"):
+        return None  # caller-holds by naming convention
+    held = set()
+    start = min([d.lineno for d in func.decorator_list],
+                default=func.lineno)
+    end = func.body[0].lineno if func.body else func.lineno
+    for line in range(start, end + 1):
+        held |= module.holds_locks(line)
+    line = start - 1  # contiguous comment block above the def/decorators
+    while line in module.comments:
+        held |= module.holds_locks(line)
+        line -= 1
+    return held
+
+
+def _class_functions(classdef: ast.ClassDef):
+    """Every def nested anywhere under the class (closures included —
+    a worker loop defined inside a method runs on another thread and is
+    subject to the same lock contract), except inside nested classes,
+    which carry their own guard tables."""
+
+    def visit(node, prefix):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                continue
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield child, f"{prefix}{child.name}"
+                yield from visit(child, f"{prefix}{child.name}.")
+            else:
+                yield from visit(child, prefix)
+
+    yield from visit(classdef, "")
+
+
+def _check_class(module: ModuleInfo, classdef: ast.ClassDef, qualname: str,
+                 guards: dict[str, str]) -> list[Finding]:
+    findings: list[Finding] = []
+    for func, name_path in _class_functions(classdef):
+        preheld = _function_preheld(module, func)
+        if preheld is None:
+            continue
+        findings.extend(_check_body(
+            module, func, f"{qualname}.{name_path}", guards,
+            preheld, attr_mode=True))
+    return findings
+
+
+def _check_module_guards(module: ModuleInfo, guards) -> list[Finding]:
+    findings: list[Finding] = []
+    plain = {name: lock for name, (lock, _) in guards.items()}
+    for node in ast.walk(module.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            preheld = _function_preheld(module, node)
+            if preheld is None:
+                continue
+            relevant = _names_checked_in(node, plain)
+            if relevant:
+                findings.extend(_check_body(
+                    module, node, node.name,
+                    {n: plain[n] for n in relevant}, preheld,
+                    attr_mode=False))
+    return findings
+
+
+def _names_checked_in(func, guards: dict[str, str]) -> set[str]:
+    """Module guards visible in this function: skip names shadowed by
+    params or plain local assignment (without a `global` declaration)."""
+    params = {a.arg for a in (func.args.posonlyargs + func.args.args +
+                              func.args.kwonlyargs)}
+    globals_decl: set[str] = set()
+    assigned: set[str] = set()
+    for node in walk_function_nodes(func):
+        if isinstance(node, ast.Global):
+            globals_decl.update(node.names)
+        elif isinstance(node, ast.Name) and \
+                isinstance(node.ctx, (ast.Store, ast.Del)):
+            assigned.add(node.id)
+    out = set()
+    for name in guards:
+        if name in params:
+            continue
+        if name in assigned and name not in globals_decl:
+            continue  # function-local shadow
+        out.add(name)
+    return out
+
+
+def _check_body(module: ModuleInfo, func, qualname: str,
+                guards: dict[str, str], preheld: set[str],
+                attr_mode: bool) -> list[Finding]:
+    findings: list[Finding] = []
+
+    def add(node, stmt, attr, lock, is_write):
+        if module.suppressed(node, "lock-ok", stmt):
+            return
+        code = "LK002" if is_write else "LK001"
+        verb = "write to" if is_write else "read of"
+        label = f"self.{attr}" if attr_mode else attr
+        findings.append(Finding(
+            path=module.path, line=node.lineno, rule=RULE, code=code,
+            message=f"unguarded {verb} {label} (guarded_by {lock}) "
+                    f"outside `with {lock}`",
+            hint=f"wrap the access in `with {lock}:`, annotate the "
+                 f"method `# servelint: holds {lock}`, or "
+                 "`# servelint: lock-ok <why>` the line",
+            scope=qualname, detail=f"{'store' if is_write else 'load'}:"
+                                   f"{attr}"))
+
+    def visit(node: ast.AST, stmt: ast.stmt, held: frozenset) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return  # nested scopes judged on their own annotations
+        if isinstance(node, ast.stmt):
+            stmt = node
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            newly = set()
+            for item in node.items:
+                d = dotted(item.context_expr)
+                if d:
+                    newly.add(d)
+                visit(item.context_expr, stmt, held)
+                if item.optional_vars is not None:
+                    visit(item.optional_vars, stmt, held)
+            inner = frozenset(held | newly)
+            for child in node.body:
+                visit(child, child, inner)
+            return
+        if attr_mode and isinstance(node, ast.Attribute) and \
+                isinstance(node.value, ast.Name) and \
+                node.value.id == "self" and node.attr in guards:
+            lock = guards[node.attr]
+            if lock not in held:
+                add(node, stmt, node.attr, lock,
+                    isinstance(node.ctx, (ast.Store, ast.Del)))
+        if not attr_mode and isinstance(node, ast.Name) and \
+                node.id in guards:
+            lock = guards[node.id]
+            if lock not in held:
+                add(node, stmt, node.id, lock,
+                    isinstance(node.ctx, (ast.Store, ast.Del)))
+        for child in ast.iter_child_nodes(node):
+            visit(child, stmt, held)
+
+    for child in func.body:
+        visit(child, child, frozenset(preheld))
+    return findings

@@ -1,0 +1,346 @@
+"""RC: recompile-hazard detector — jit call sites that defeat the cache.
+
+jax.jit's executable cache is keyed on (function object, static argument
+values, argument shapes/dtypes). Serving code that (a) constructs the jit
+per call, (b) feeds unhashable or per-request-varying static arguments, or
+(c) branches Python-side on tracer values, either crashes under trace or
+silently compiles a fresh XLA executable per request — a recompile storm
+that turns sub-ms serving into multi-second stalls (PAPERS: "A Learned
+Performance Model for TPUs" treats compile-bucket misses as first-order).
+
+  RC001  jax.jit(...) constructed AND invoked in one expression
+  RC002  jax.jit(...) inside a loop without attribute/subscript caching
+  RC003  unhashable literal (list/dict/set) passed in a static position
+  RC004  static argument derived from an enclosing function's parameter
+         (per-request-varying -> one executable per distinct value)
+  RC005  Python `if`/`while` on a tracer value inside a jitted function
+  RC006  shape-derived Python control flow inside a jitted function
+  RC007  f-string / str() on a tracer value inside a jitted function
+
+Suppress with `# servelint: jit-ok <why>` (e.g. a cold-path health probe
+that deliberately compiles a throwaway kernel).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from min_tfs_client_tpu.analysis.core import (
+    AnalysisConfig,
+    Finding,
+    ModuleInfo,
+    dotted,
+    walk_function_nodes,
+    walk_scopes,
+)
+
+RULE = "recompile"
+
+
+def check(module: ModuleInfo, config: AnalysisConfig) -> list[Finding]:
+    findings: list[Finding] = []
+    jitted_funcs = _collect_jitted_functions(module, config)
+    for qualname, func in walk_scopes(module.tree):
+        findings.extend(_check_jit_call_sites(module, config, qualname, func))
+        statics = jitted_funcs.get(func.name) if isinstance(
+            func, (ast.FunctionDef, ast.AsyncFunctionDef)) else None
+        if statics is not None and _is_this_jitted(func, jitted_funcs):
+            findings.extend(
+                _check_tracer_hazards(module, qualname, func, statics))
+    return findings
+
+
+def _is_jit_factory(call: ast.Call, config: AnalysisConfig) -> bool:
+    return (dotted(call.func) or "") in config.jit_factories
+
+
+def _jit_decoration(func, config: AnalysisConfig):
+    """(is_jitted, static_names) from decorators: @jax.jit or
+    @functools.partial(jax.jit, static_arg...)."""
+    for dec in func.decorator_list:
+        if (dotted(dec) or "") in config.jit_factories:
+            return True, set()
+        if isinstance(dec, ast.Call):
+            d = dotted(dec.func) or ""
+            if d in config.jit_factories:
+                return True, _static_names(dec, func)
+            if d.rsplit(".", 1)[-1] == "partial" and dec.args and \
+                    (dotted(dec.args[0]) or "") in config.jit_factories:
+                return True, _static_names(dec, func)
+    return False, set()
+
+
+def _static_names(jit_call: ast.Call, func) -> set:
+    """Parameter names marked static via static_argnames/static_argnums."""
+    names: set[str] = set()
+    params = [a.arg for a in (func.args.posonlyargs + func.args.args)] \
+        if func is not None else []
+    for kw in jit_call.keywords:
+        if kw.arg == "static_argnames":
+            for node in ast.walk(kw.value):
+                if isinstance(node, ast.Constant) and \
+                        isinstance(node.value, str):
+                    names.add(node.value)
+        elif kw.arg == "static_argnums":
+            for node in ast.walk(kw.value):
+                if isinstance(node, ast.Constant) and \
+                        isinstance(node.value, int):
+                    if 0 <= node.value < len(params):
+                        names.add(params[node.value])
+    return names
+
+
+def _collect_jitted_functions(module: ModuleInfo, config: AnalysisConfig
+                              ) -> dict[str, set]:
+    """name -> static param names, for functions that are jitted either by
+    decorator or by being passed (by name) to a jit factory in this
+    module."""
+    funcs: dict[str, ast.AST] = {}
+    for _, func in walk_scopes(module.tree):
+        funcs.setdefault(func.name, func)
+    jitted: dict[str, set] = {}
+    for name, func in funcs.items():
+        is_jit, statics = _jit_decoration(func, config)
+        if is_jit:
+            jitted[name] = statics
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.Call) and _is_jit_factory(node, config) \
+                and node.args and isinstance(node.args[0], ast.Name):
+            fname = node.args[0].id
+            if fname in funcs:
+                jitted.setdefault(fname, set()).update(
+                    _static_names(node, funcs[fname]))
+    return jitted
+
+
+def _is_this_jitted(func, jitted: dict) -> bool:
+    return func.name in jitted
+
+
+def _check_jit_call_sites(module: ModuleInfo, config: AnalysisConfig,
+                          qualname: str, func) -> list[Finding]:
+    findings: list[Finding] = []
+    param_names = set()
+    if isinstance(func, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        a = func.args
+        param_names = {p.arg for p in
+                       a.posonlyargs + a.args + a.kwonlyargs} - {"self", "cls"}
+
+    def add(node, stmt, code, message, hint, detail):
+        if module.suppressed(node, "jit-ok", stmt):
+            return
+        findings.append(Finding(
+            path=module.path, line=node.lineno, rule=RULE, code=code,
+            message=message, hint=hint, scope=qualname, detail=detail))
+
+    # Map statically-bound jit names in this scope to their static params
+    # so RC003/RC004 can check call sites of `fn = jax.jit(g, static_...)`.
+    local_static: dict[str, tuple[set, list]] = {}
+
+    def visit(node: ast.AST, stmt: ast.stmt, loop_depth: int) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return
+        if isinstance(node, ast.stmt):
+            stmt = node
+        if isinstance(node, (ast.For, ast.While, ast.AsyncFor)):
+            for child in ast.iter_child_nodes(node):
+                in_body = child in node.body or child in getattr(
+                    node, "orelse", [])
+                visit(child, stmt, loop_depth + (1 if in_body else 0))
+            return
+        if isinstance(node, ast.Assign):
+            _note_static_binding(node)
+        if isinstance(node, ast.Call):
+            _check_call(node, stmt, loop_depth)
+        for child in ast.iter_child_nodes(node):
+            visit(child, stmt, loop_depth)
+
+    def _note_static_binding(assign: ast.Assign) -> None:
+        v = assign.value
+        if isinstance(v, ast.Call) and _is_jit_factory(v, config) and \
+                any(kw.arg in ("static_argnums", "static_argnames")
+                    for kw in v.keywords):
+            inner = v.args[0] if v.args else None
+            inner_func = None
+            if isinstance(inner, ast.Lambda):
+                inner_func = inner
+            statics = _static_names(v, _LambdaShim(inner_func)
+                                    if inner_func else None)
+            nums = [n.value for kw in v.keywords
+                    if kw.arg == "static_argnums"
+                    for n in ast.walk(kw.value)
+                    if isinstance(n, ast.Constant)
+                    and isinstance(n.value, int)]
+            for target in assign.targets:
+                if isinstance(target, ast.Name):
+                    local_static[target.id] = (statics, nums)
+
+    def _check_call(call: ast.Call, stmt: ast.stmt, loop_depth: int) -> None:
+        # RC001: jax.jit(...)(...) — compiled executable thrown away.
+        if isinstance(call.func, ast.Call) and \
+                _is_jit_factory(call.func, config):
+            add(call, stmt, "RC001",
+                "jax.jit(...) constructed and invoked in one expression — "
+                "the compile cache is keyed by function object, so every "
+                "call recompiles",
+                "hoist the jit to module/init scope (or an lru-bounded "
+                "cache keyed on the specialization)",
+                "jit-per-call")
+        # RC002: jit factory inside a loop without caching the result.
+        if _is_jit_factory(call, config) and loop_depth > 0:
+            cached = isinstance(stmt, ast.Assign) and any(
+                isinstance(t, (ast.Attribute, ast.Subscript))
+                for t in stmt.targets)
+            if not cached:
+                add(call, stmt, "RC002",
+                    "jax.jit(...) constructed inside a loop without "
+                    "caching — one fresh compile per iteration",
+                    "bind the jitted callable once outside the loop, or "
+                    "store it in a keyed cache",
+                    "jit-in-loop")
+        # RC003/RC004: static-arg hazards at call sites of locally bound
+        # statically-parameterized jits.
+        if isinstance(call.func, ast.Name) and \
+                call.func.id in local_static:
+            statics, nums = local_static[call.func.id]
+            hazard_args = [call.args[i] for i in nums if i < len(call.args)]
+            hazard_args += [kw.value for kw in call.keywords
+                            if kw.arg in statics]
+            for arg in hazard_args:
+                if isinstance(arg, (ast.List, ast.Dict, ast.Set)):
+                    add(arg, stmt, "RC003",
+                        "unhashable literal passed as a static jit "
+                        "argument — jax raises (or, via tuple-coercion "
+                        "wrappers, recompiles) on every call",
+                        "pass a tuple / frozen value, or make the "
+                        "argument a traced operand",
+                        "unhashable-static")
+                elif any(isinstance(n, ast.Name) and n.id in param_names
+                         for n in ast.walk(arg)):
+                    add(arg, stmt, "RC004",
+                        "static jit argument derived from a per-request "
+                        "parameter — every distinct value compiles a "
+                        "fresh executable",
+                        "bucket the value (batch/seq buckets) or trace it",
+                        "varying-static")
+
+    for child in ast.iter_child_nodes(func):
+        if isinstance(child, ast.stmt):
+            visit(child, child, 0)
+    return findings
+
+
+class _LambdaShim:
+    """Adapts a Lambda to _static_names' .args expectations."""
+
+    def __init__(self, lam: ast.Lambda):
+        self.args = lam.args
+
+
+def _check_tracer_hazards(module: ModuleInfo, qualname: str, func,
+                          statics: set) -> list[Finding]:
+    a = func.args
+    tracers = {p.arg for p in a.posonlyargs + a.args + a.kwonlyargs}
+    tracers -= statics | {"self", "cls"}
+    findings: list[Finding] = []
+
+    def add(node, stmt, code, message, hint, detail):
+        if module.suppressed(node, "jit-ok", stmt):
+            return
+        findings.append(Finding(
+            path=module.path, line=node.lineno, rule=RULE, code=code,
+            message=message, hint=hint, scope=qualname, detail=detail))
+
+    def tracer_name(node) -> str | None:
+        if isinstance(node, ast.Name) and node.id in tracers:
+            return node.id
+        return None
+
+    def value_test_hazard(test) -> str | None:
+        """A truth test that concretizes a tracer VALUE (not metadata)."""
+        if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+            return value_test_hazard(test.operand)
+        if tracer_name(test):
+            return tracer_name(test)
+        if isinstance(test, ast.Compare):
+            ok_ops = (ast.Is, ast.IsNot)
+            if all(isinstance(op, ok_ops) for op in test.ops):
+                return None  # `x is None` guards are host-side identity
+            for side in [test.left, *test.comparators]:
+                name = tracer_name(side)
+                if name:
+                    return name
+        if isinstance(test, ast.BoolOp):
+            for v in test.values:
+                name = value_test_hazard(v)
+                if name:
+                    return name
+        return None
+
+    def shape_test_hazard(test) -> str | None:
+        """Control flow keyed on a tracer's shape — legal, but each shape
+        compiles its own executable; serving must route through buckets."""
+        for node in ast.walk(test):
+            if isinstance(node, ast.Attribute) and \
+                    node.attr in ("shape", "ndim", "size") and \
+                    tracer_name(node.value):
+                return f"{node.value.id}.{node.attr}"
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Name) and \
+                    node.func.id == "len" and node.args and \
+                    tracer_name(node.args[0]):
+                return f"len({node.args[0].id})"
+        return None
+
+    def visit(node: ast.AST, stmt: ast.stmt) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return
+        if isinstance(node, ast.stmt):
+            stmt = node
+        if isinstance(node, (ast.If, ast.While)):
+            kind = "if" if isinstance(node, ast.If) else "while"
+            name = value_test_hazard(node.test)
+            if name:
+                add(node, stmt, "RC005",
+                    f"Python `{kind}` on tracer value '{name}' inside a "
+                    "jitted function — raises TracerBoolConversionError "
+                    "under trace",
+                    "use jnp.where / lax.cond, or mark the argument "
+                    "static and bucket it",
+                    f"{kind}:{name}")
+            else:
+                shape = shape_test_hazard(node.test)
+                if shape:
+                    add(node, stmt, "RC006",
+                        f"shape-derived Python control flow on "
+                        f"'{shape}' inside a jitted function — one "
+                        "executable per distinct shape",
+                        "route shapes through the batch/sequence "
+                        "buckets so the cache stays bounded",
+                        f"shape:{shape}")
+        elif isinstance(node, ast.FormattedValue):
+            name = tracer_name(node.value)
+            if name:
+                add(node, stmt, "RC007",
+                    f"f-string formats tracer '{name}' inside a jitted "
+                    "function — concretizes (or traces an error) per call",
+                    "log outside the jitted function",
+                    f"fstr:{name}")
+        elif isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Name) and \
+                node.func.id == "str" and node.args and \
+                tracer_name(node.args[0]):
+            add(node, stmt, "RC007",
+                f"str() on tracer '{node.args[0].id}' inside a jitted "
+                "function",
+                "log outside the jitted function",
+                f"str:{node.args[0].id}")
+        for child in ast.iter_child_nodes(node):
+            visit(child, stmt)
+
+    for child in ast.iter_child_nodes(func):
+        if isinstance(child, ast.stmt):
+            visit(child, child)
+    return findings

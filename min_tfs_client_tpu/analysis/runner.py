@@ -1,0 +1,147 @@
+"""servelint runner: file discovery + rule orchestration + reporting."""
+
+from __future__ import annotations
+
+import functools
+import os
+from dataclasses import dataclass, field
+
+from min_tfs_client_tpu.analysis import host_sync, locks, recompile, spans
+from min_tfs_client_tpu.analysis.baseline import (
+    BaselineDiff,
+    diff_baseline,
+    load_baseline,
+)
+from min_tfs_client_tpu.analysis.core import (
+    AnalysisConfig,
+    Finding,
+    parse_module,
+)
+
+ALL_RULES = (host_sync, recompile, locks, spans)
+
+
+@dataclass
+class Report:
+    findings: list[Finding] = field(default_factory=list)
+    diff: BaselineDiff = field(default_factory=BaselineDiff)
+    files_scanned: int = 0
+    declared_guards: set = field(default_factory=set)
+    scanned_paths: set = field(default_factory=set)
+
+    @property
+    def clean(self) -> bool:
+        return self.diff.clean
+
+    def render(self) -> str:
+        lines = []
+        for f in self.diff.new:
+            lines.append("NEW   " + f.render())
+        for key in self.diff.stale:
+            lines.append(f"STALE baseline entry with no matching finding: "
+                         f"{key}  [fix: delete it from the baseline]")
+        lines.append(
+            f"servelint: {self.files_scanned} files, "
+            f"{len(self.findings)} findings "
+            f"({len(self.diff.new)} new, {self.diff.matched} baselined, "
+            f"{len(self.diff.stale)} stale)")
+        return "\n".join(lines)
+
+
+@functools.lru_cache(maxsize=4096)
+def _anchor_base(dirpath: str) -> str:
+    """Base directory for a file's relpath: its directory, walked up past
+    any enclosing packages (directories with __init__.py). Anchoring is
+    PER FILE, not per CLI argument, so `servelint .`,
+    `servelint min_tfs_client_tpu/batching` and the canonical
+    package-root invocation all key the same file as
+    `min_tfs_client_tpu/...` — hot-path matching and baseline /
+    required-guard keys never change with the invocation shape."""
+    base = dirpath
+    while os.path.isfile(os.path.join(base, "__init__.py")):
+        parent = os.path.dirname(base)
+        if parent == base:
+            break
+        base = parent
+    return base
+
+
+def iter_py_files(paths: list[str]):
+    """(abspath, relpath) pairs. Directories walk recursively; each
+    file's relpath is anchored at its topmost enclosing package (see
+    _anchor_base)."""
+
+    def rel(full: str) -> str:
+        base = _anchor_base(os.path.dirname(full))
+        return os.path.relpath(full, base).replace(os.sep, "/")
+
+    for path in paths:
+        path = os.path.abspath(path)
+        if os.path.isfile(path):
+            yield path, rel(path)
+            continue
+        for dirpath, dirnames, filenames in os.walk(path):
+            dirnames[:] = sorted(d for d in dirnames
+                                 if d != "__pycache__"
+                                 and not d.startswith("."))
+            for fname in sorted(filenames):
+                if not fname.endswith(".py"):
+                    continue
+                full = os.path.join(dirpath, fname)
+                yield full, rel(full)
+
+
+def analyze_paths(paths: list[str],
+                  config: AnalysisConfig | None = None,
+                  rules=ALL_RULES) -> Report:
+    config = config or AnalysisConfig()
+    report = Report()
+    for abspath, relpath in iter_py_files(paths):
+        module = parse_module(abspath, relpath)
+        if module is None:
+            continue
+        report.files_scanned += 1
+        report.scanned_paths.add(relpath)
+        for rule in rules:
+            report.findings.extend(rule.check(module, config))
+        report.declared_guards |= locks.collect_declared_guards(module)
+    report.findings.sort(key=lambda f: (f.path, f.line, f.code))
+    return report
+
+
+def run_analysis(paths: list[str],
+                 baseline_path: str | None = None,
+                 config: AnalysisConfig | None = None,
+                 rules=ALL_RULES) -> Report:
+    """Analyze `paths`, diff against the baseline, return the Report.
+    `report.clean` is the gate predicate: no new findings, no stale
+    baseline entries."""
+    report = analyze_paths(paths, config=config, rules=rules)
+    baseline = load_baseline(baseline_path)
+    # A deleted guarded_by annotation silently disables its checks; the
+    # baseline pins the expected declarations so deletion is a failure.
+    # Only guards of files actually scanned are enforced — a partial run
+    # (`servelint min_tfs_client_tpu/batching`) must not fail over files
+    # it never looked at.
+    required = [g for g in baseline.required_guards
+                if g.partition("::")[0] in report.scanned_paths]
+    report.findings.extend(locks.missing_guard_findings(
+        required, report.declared_guards))
+    # Same scoping for the stale check: an entry for an unscanned file is
+    # not stale, it is out of this run's view.
+    entries = {k: v for k, v in baseline.entries.items()
+               if k.partition("::")[0] in report.scanned_paths}
+    report.diff = diff_baseline(report.findings, entries)
+    return report
+
+
+def default_package_root() -> str:
+    """The installed min_tfs_client_tpu package directory (the default
+    analysis target)."""
+    import min_tfs_client_tpu
+
+    return os.path.dirname(os.path.abspath(min_tfs_client_tpu.__file__))
+
+
+def default_baseline_path() -> str:
+    return os.path.join(default_package_root(), "analysis", "baseline.json")

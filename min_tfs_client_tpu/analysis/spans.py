@@ -1,0 +1,174 @@
+"""SP: span-discipline checker for the request-tracing spine.
+
+observability/tracing.py's contract (docs/OBSERVABILITY.md): spans are
+opened ONLY as context managers, and a RequestTrace crosses a thread
+boundary ONLY through the sanctioned BatchTask handoff (BatchTask(...,
+trace=...) -> scheduler-thread `tracing.activate(fanout(...))`). A span
+held open across `submit()`/`Thread()` records garbage timings (its
+`__exit__` runs on the wrong thread's clock context) and a trace leaked
+into an unrelated thread outlives its request.
+
+  SP001  span()/request_trace() constructed outside a `with` statement
+  SP002  trace/span handed to a thread boundary outside the BatchTask API
+
+The implementing module(s) (config.span_exempt) are skipped — they
+necessarily build spans imperatively. `# servelint: span-ok <why>`
+suppresses a reviewed line.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from min_tfs_client_tpu.analysis.core import (
+    AnalysisConfig,
+    Finding,
+    ModuleInfo,
+    bound_names,
+    dotted,
+    walk_function_nodes,
+    walk_scopes,
+)
+
+RULE = "spans"
+
+_SPAN_FACTORIES = {"span", "tracing.span", "request_trace",
+                   "tracing.request_trace"}
+_TRACE_SOURCES = _SPAN_FACTORIES | {"current_trace", "tracing.current_trace",
+                                    "fanout", "tracing.fanout"}
+# Calls that cross a thread boundary.
+_THREAD_CALLS = {"Thread", "threading.Thread", "start_new_thread"}
+_THREAD_METHODS = {"submit", "map", "apply_async"}
+# The sanctioned handoff: a BatchTask construction may carry the trace.
+_SANCTIONED_CTORS = {"BatchTask"}
+
+
+def check(module: ModuleInfo, config: AnalysisConfig) -> list[Finding]:
+    if config.is_span_exempt(module.path):
+        return []
+    findings: list[Finding] = []
+    with_contexts = _with_context_calls(module.tree)
+    findings.extend(_check_span_construction(module, with_contexts))
+    for qualname, func in walk_scopes(module.tree):
+        findings.extend(_check_thread_handoff(module, qualname, func))
+    findings.extend(_check_thread_handoff(module, "<module>", module.tree))
+    return findings
+
+
+def _with_context_calls(tree: ast.Module) -> set[int]:
+    """ids of Call nodes used directly as `with` context expressions."""
+    ok: set[int] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.withitem):
+            expr = node.context_expr
+            if isinstance(expr, ast.Call):
+                ok.add(id(expr))
+    return ok
+
+
+def _enclosing_scope(tree: ast.Module) -> dict[int, str]:
+    scope_of: dict[int, str] = {}
+    for qualname, func in walk_scopes(tree):
+        for node in walk_function_nodes(func):
+            scope_of.setdefault(id(node), qualname)
+    return scope_of
+
+
+def _check_span_construction(module: ModuleInfo, with_ok: set[int]
+                             ) -> list[Finding]:
+    findings: list[Finding] = []
+    scope_of = _enclosing_scope(module.tree)
+    stmt_of: dict[int, ast.stmt] = {}
+    for stmt in ast.walk(module.tree):
+        if isinstance(stmt, ast.stmt):
+            for node in ast.walk(stmt):
+                stmt_of.setdefault(id(node), stmt)
+    for node in ast.walk(module.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = dotted(node.func) or ""
+        if name not in _SPAN_FACTORIES:
+            continue
+        if id(node) in with_ok:
+            continue
+        stmt = stmt_of.get(id(node))
+        if module.suppressed(node, "span-ok", stmt):
+            continue
+        findings.append(Finding(
+            path=module.path, line=node.lineno, rule=RULE, code="SP001",
+            message=f"{name}(...) constructed outside a `with` statement "
+                    "— spans must be scoped context managers",
+            hint="use `with tracing.span(...):` so __exit__ always runs "
+                 "on the opening thread",
+            scope=scope_of.get(id(node), "<module>"),
+            detail=f"ctor:{name}"))
+    return findings
+
+
+def _check_thread_handoff(module: ModuleInfo, qualname: str, func
+                          ) -> list[Finding]:
+    findings: list[Finding] = []
+    trace_vars: set[str] = set()
+    # walk_function_nodes prunes nested def/class bodies for Module and
+    # FunctionDef alike — each scope is scanned exactly once.
+    nodes = list(walk_function_nodes(func))
+
+    for node in nodes:
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            if (dotted(node.value.func) or "") in _TRACE_SOURCES:
+                for target in node.targets:
+                    trace_vars.update(bound_names(target))
+    if not trace_vars:
+        return findings
+
+    def crosses_thread(call: ast.Call) -> bool:
+        name = dotted(call.func) or ""
+        if name in _THREAD_CALLS or name.rsplit(".", 1)[-1] in _THREAD_CALLS:
+            return True
+        return (isinstance(call.func, ast.Attribute)
+                and call.func.attr in _THREAD_METHODS)
+
+    stmt_of: dict[int, ast.stmt] = {}
+    body = func.body if hasattr(func, "body") else []
+    for stmt in body:
+        for node in ast.walk(stmt):
+            stmt_of.setdefault(id(node), stmt)
+
+    for node in nodes:
+        if not isinstance(node, ast.Call):
+            continue
+        name = dotted(node.func) or ""
+        last = name.rsplit(".", 1)[-1]
+        if last in _SANCTIONED_CTORS:
+            continue  # BatchTask(..., trace=...) is the sanctioned handoff
+        if not crosses_thread(node):
+            # Storing a live trace on shared state leaks it past the
+            # request; only the BatchTask field is sanctioned.
+            continue
+        passed = [a for a in node.args if isinstance(a, ast.Name)
+                  and a.id in trace_vars]
+        passed += [kw.value for kw in node.keywords
+                   if isinstance(kw.value, ast.Name)
+                   and kw.value.id in trace_vars]
+        # args=(trace, ...) tuples of Thread(...)
+        for kw in node.keywords:
+            if isinstance(kw.value, (ast.Tuple, ast.List)):
+                passed += [e for e in kw.value.elts
+                           if isinstance(e, ast.Name) and e.id in trace_vars]
+        for a in node.args:
+            if isinstance(a, (ast.Tuple, ast.List)):
+                passed += [e for e in a.elts
+                           if isinstance(e, ast.Name) and e.id in trace_vars]
+        for arg in passed:
+            stmt = stmt_of.get(id(node))
+            if module.suppressed(arg, "span-ok", stmt):
+                continue
+            findings.append(Finding(
+                path=module.path, line=arg.lineno, rule=RULE, code="SP002",
+                message=f"trace/span '{arg.id}' handed across a thread "
+                        "boundary outside the BatchTask handoff API",
+                hint="carry it via BatchTask(..., trace=...) and "
+                     "re-activate with tracing.activate(fanout(...)) on "
+                     "the worker",
+                scope=qualname, detail=f"handoff:{arg.id}"))
+    return findings

@@ -66,9 +66,10 @@ class BatchQueue:
         self.options = options
         self.process = process
         self._lock = threading.Lock()
-        self._batches: collections.deque[list[BatchTask]] = collections.deque()
-        self._open_size = 0
-        self.closed = False
+        self._batches: collections.deque[list[BatchTask]] = (
+            collections.deque())                   # guarded_by: self._lock
+        self._open_size = 0                        # guarded_by: self._lock
+        self.closed = False                        # guarded_by: self._lock
 
     def schedule(self, task: BatchTask) -> None:
         if task.size > self.options.max_batch_size:
@@ -145,10 +146,10 @@ class SharedBatchScheduler:
     def __init__(self, num_threads: int | None = None):
         if num_threads is None:
             num_threads = _default_thread_count()
-        self._queues: list[BatchQueue] = []
+        self._queues: list[BatchQueue] = []        # guarded_by: self._lock
         self._lock = threading.Condition()
-        self._stop = False
-        self._rr = 0  # round-robin cursor
+        self._stop = False                         # guarded_by: self._lock
+        self._rr = 0  # round-robin cursor         # guarded_by: self._lock
         self._threads = [
             threading.Thread(target=self._worker, name=f"batch-worker-{i}",
                              daemon=True)
@@ -203,7 +204,7 @@ class SharedBatchScheduler:
                 for task in batch:
                     task.done.set()
 
-    def _find_mature(self, now: float):
+    def _find_mature(self, now: float):  # servelint: holds self._lock
         n = len(self._queues)
         for i in range(n):
             queue = self._queues[(self._rr + i) % n]
@@ -213,7 +214,8 @@ class SharedBatchScheduler:
                 return batch, queue
         return None, None
 
-    def _nearest_deadline(self, now: float) -> Optional[float]:
+    def _nearest_deadline(  # servelint: holds self._lock
+            self, now: float) -> Optional[float]:
         deadlines = [q.next_deadline() for q in self._queues]
         deadlines = [d for d in deadlines if d is not None]
         if not deadlines:

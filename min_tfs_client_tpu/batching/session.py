@@ -171,6 +171,11 @@ class BatchedSignatureRunner:
         # runner.run — _process must execute the real signature, not re-enter
         # the queue.
         self._inner_run = signature.run
+        # Outputs that can never split along dim 0: requests fetching one
+        # of them bypass the queue (run() routes them direct), so callers
+        # that filter them OUT still batch.
+        self._non_batch_major = frozenset(
+            declared_non_batch_major_outputs(signature))
         # Bucket the jit cache exactly on the allowed sizes.
         signature.batch_buckets = tuple(allowed)
         self._allowed = allowed
@@ -189,6 +194,16 @@ class BatchedSignatureRunner:
 
     def run(self, inputs, output_filter=()) -> dict[str, np.ndarray]:
         if not self.signature.batched:
+            return self._inner_run(inputs, output_filter)
+        if self._non_batch_major and (
+                not output_filter
+                or any(k in self._non_batch_major for k in output_filter)):
+            # The effective fetch set includes a declared non-batch-major
+            # output (scalar / fixed-leading-dim): a merged batch could
+            # never split it back per caller, so this request executes
+            # direct. Requests whose output_filter excludes those outputs
+            # keep the batched path — the filter union in _process_batch
+            # then never fetches them.
             return self._inner_run(inputs, output_filter)
         # Reject bad requests BEFORE they join a batch: a malformed request
         # must fail alone with INVALID_ARGUMENT, never its batch-mates.
@@ -364,6 +379,20 @@ class BatchedSignatureRunner:
         self._scheduler.remove_queue(self._queue)
 
 
+def declared_non_batch_major_outputs(signature: Signature) -> list[str]:
+    """Output aliases whose DECLARED spec can never split along dim 0:
+    rank-0, or a concrete (non-None) leading dim. Requests fetching one
+    of these execute direct rather than batched (ADVICE round-5:
+    auto-fallback instead of unservable-under-batching). Unknown-rank
+    specs (imported graphs whose shape inference failed) are NOT treated
+    as non-batch-major — their () shape means "don't know", and the
+    runtime split check still protects the batch."""
+    return sorted(
+        alias for alias, spec in signature.outputs.items()
+        if not getattr(spec, "unknown_rank", False)
+        and (not spec.shape or spec.shape[0] is not None))
+
+
 def maybe_wrap_servable(servable, params: BatchingParameters | dict | None,
                         scheduler: SharedBatchScheduler | None = None):
     """Wrap every batched device signature of a servable with a batching
@@ -380,6 +409,19 @@ def maybe_wrap_servable(servable, params: BatchingParameters | dict | None,
     # partitioned import additionally amortizes its interior dispatch.
     for key, signature in servable.signatures.items():
         if not signature.batched:
+            continue
+        non_batch_major = declared_non_batch_major_outputs(signature)
+        if non_batch_major and \
+                len(non_batch_major) == len(signature.outputs):
+            # EVERY declared output is non-batch-major (scalars, vocab
+            # tensors, fixed-row tables): no request could ever split
+            # from a merged batch, so skip the queue entirely — direct
+            # (unbatched) execution instead of unservable-under-batching.
+            # Mixed signatures ARE wrapped: the runner routes each
+            # request by its effective fetch set (see run()), so callers
+            # filtering the non-batch-major outputs away still batch.
+            # Undeclared violations still surface per-batch in
+            # _process_batch.
             continue
         runner = BatchedSignatureRunner(
             signature, scheduler,

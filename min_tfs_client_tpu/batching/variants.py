@@ -93,10 +93,10 @@ class StreamingBatchScheduler:
         self._process = process
         self._clock = clock
         self._lock = threading.Lock()
-        self._open: Optional[_OpenBatch] = None
-        self._in_flight = 0
+        self._open: Optional[_OpenBatch] = None    # guarded_by: self._lock
+        self._in_flight = 0                        # guarded_by: self._lock
         self._num_threads = num_threads
-        self._stopped = False
+        self._stopped = False                      # guarded_by: self._lock
 
     def schedule(self, task: BatchTask) -> None:
         if task.size > self._options.max_batch_size:
@@ -127,7 +127,7 @@ class StreamingBatchScheduler:
             if batch.size >= self._options.max_batch_size:
                 self._seal(batch)
 
-    def _seal(self, batch: _OpenBatch) -> None:
+    def _seal(self, batch: _OpenBatch) -> None:  # servelint: holds self._lock
         # caller holds self._lock
         if self._open is batch:
             self._open = None
@@ -190,15 +190,17 @@ class AdaptiveSharedBatchScheduler:
         self._max_batch_size = max_batch_size
         self._clock = clock
         self._cv = threading.Condition()
-        self._batches: collections.deque[list[BatchTask]] = collections.deque()
-        self._open_size = 0
-        self._in_flight = 0
+        self._batches: collections.deque[list[BatchTask]] = (
+            collections.deque())                     # guarded_by: self._cv
+        self._open_size = 0                          # guarded_by: self._cv
+        self._in_flight = 0                          # guarded_by: self._cv
         self._limit = max(1, min(options.initial_in_flight_limit,
-                                 options.num_threads))
-        self._direction = 1
-        self._window: list[float] = []
-        self._prev_window_mean: Optional[float] = None
-        self._stop = False
+                                 options.num_threads))  # guarded_by: self._cv
+        self._direction = 1                          # guarded_by: self._cv
+        self._window: list[float] = []               # guarded_by: self._cv
+        self._prev_window_mean: Optional[float] = (
+            None)                                    # guarded_by: self._cv
+        self._stop = False                           # guarded_by: self._cv
         self._threads = [
             threading.Thread(target=self._worker, daemon=True,
                              name=f"adaptive-batch-{i}")
@@ -208,7 +210,11 @@ class AdaptiveSharedBatchScheduler:
 
     @property
     def in_flight_limit(self) -> int:
-        return self._limit
+        # The hill-climbing worker mutates _limit concurrently; an
+        # unlocked read could publish a torn view of the walk to the
+        # monitoring endpoint (servelint LK001 caught this).
+        with self._cv:
+            return self._limit
 
     def schedule(self, task: BatchTask) -> None:
         with self._cv:
@@ -251,7 +257,7 @@ class AdaptiveSharedBatchScheduler:
                     self._feedback(elapsed)
                     self._cv.notify()
 
-    def _feedback(self, elapsed: float) -> None:
+    def _feedback(self, elapsed: float) -> None:  # servelint: holds self._cv
         # caller holds self._cv
         self._window.append(elapsed)
         if len(self._window) < self._options.batches_to_average_over:
@@ -315,9 +321,11 @@ class _SerialQueue:
         self._scheduler = scheduler
         self._options = options
         self.process = process
-        self._open: list[BatchTask] = []
-        self._open_size = 0
+        # Owned by the scheduler's lock: every entry point runs under it.
+        self._open: list[BatchTask] = []   # guarded_by: self._scheduler._cv
+        self._open_size = 0                # guarded_by: self._scheduler._cv
 
+    # servelint: holds self._scheduler._cv
     def schedule(self, task: BatchTask) -> None:
         """Called under the scheduler lock via scheduler.schedule()."""
         if task.size > self._options.max_batch_size:
@@ -338,13 +346,13 @@ class _SerialQueue:
         if self._open_size >= self._options.max_batch_size:
             self._close()
 
-    def _close(self) -> None:
+    def _close(self) -> None:  # servelint: holds self._scheduler._cv
         if self._open:
             full = self._open_size >= self._options.max_batch_size
             self._scheduler._add_batch(self, self._open, full)
             self._open, self._open_size = [], 0
 
-    def flush(self) -> None:
+    def flush(self) -> None:  # servelint: holds self._scheduler._cv
         self._close()
 
 
@@ -362,13 +370,15 @@ class SerialDeviceBatchScheduler:
         self._options = options
         self._cv = threading.Condition()
         # (effective_age_key, queue, tasks)
-        self._batches: list[tuple[float, _SerialQueue, list[BatchTask]]] = []
-        self._queues: list[_SerialQueue] = []
-        self._in_flight = 0
-        self._limit = max(1, min(options.initial_in_flight_batches_limit,
-                                 options.num_batch_threads))
-        self._pending_samples: list[int] = []
-        self._stop = False
+        self._batches: list[tuple[float, _SerialQueue, list[BatchTask]]] = (
+            [])                                      # guarded_by: self._cv
+        self._queues: list[_SerialQueue] = []        # guarded_by: self._cv
+        self._in_flight = 0                          # guarded_by: self._cv
+        self._limit = max(
+            1, min(options.initial_in_flight_batches_limit,
+                   options.num_batch_threads))       # guarded_by: self._cv
+        self._pending_samples: list[int] = []        # guarded_by: self._cv
+        self._stop = False                           # guarded_by: self._cv
         self._threads = [
             threading.Thread(target=self._worker, daemon=True,
                              name=f"serial-device-batch-{i}")
@@ -403,13 +413,16 @@ class SerialDeviceBatchScheduler:
             queue.flush()
             self._cv.notify()
 
+    # servelint: holds self._cv (reached from _SerialQueue.schedule,
+    # which the scheduler only enters under its own lock)
     def enqueued_batches(self, queue: Optional[_SerialQueue] = None) -> int:
         if queue is None:
             return len(self._batches)
         return sum(1 for _, q, _tasks in self._batches if q is queue)
 
-    def _add_batch(self, queue: _SerialQueue, tasks: list[BatchTask],
-                   full: bool) -> None:
+    def _add_batch(  # servelint: holds self._cv
+            self, queue: _SerialQueue, tasks: list[BatchTask],
+            full: bool) -> None:
         # caller holds self._cv
         oldest = min(t.enqueue_time for t in tasks)
         boost = self._options.full_batch_scheduling_boost_s if full else 0.0
@@ -439,7 +452,7 @@ class SerialDeviceBatchScheduler:
                     self._feedback()
                     self._cv.notify()
 
-    def _feedback(self) -> None:
+    def _feedback(self) -> None:  # servelint: holds self._cv
         # caller holds self._cv
         try:
             pending = int(self._options.get_pending_on_serial_device())

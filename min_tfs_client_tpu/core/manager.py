@@ -85,11 +85,13 @@ class AspiredVersionsManager:
         self._load_retry_interval_s = load_retry_interval_s
         self._lock = threading.RLock()
         # servable name -> version -> harness (current generation)
-        self._harnesses: dict[str, dict[int, LoaderHarness]] = {}
+        self._harnesses: dict[str, dict[int, LoaderHarness]] = (
+            {})                                     # guarded_by: self._lock
         # servable name -> version -> Loader, staged by set_aspired_versions
-        self._pending: dict[str, dict[int, Loader]] = {}
+        self._pending: dict[str, dict[int, Loader]] = (
+            {})                                     # guarded_by: self._lock
         # versions currently aspired per stream (None until first callback)
-        self._aspired: dict[str, set[int]] = {}
+        self._aspired: dict[str, set[int]] = {}     # guarded_by: self._lock
         self._load_pool = ThreadPoolExecutor(
             num_load_threads, thread_name_prefix="servable-load")
         self._unload_pool = ThreadPoolExecutor(
@@ -133,7 +135,7 @@ class AspiredVersionsManager:
             for name in names:
                 self._reconcile_stream(name)
 
-    def _absorb_pending(self) -> None:
+    def _absorb_pending(self) -> None:  # servelint: holds self._lock
         for name, versions in self._pending.items():
             self._aspired[name] = set(versions)
             streams = self._harnesses.setdefault(name, {})
@@ -154,7 +156,7 @@ class AspiredVersionsManager:
                 streams[version].request_load()
         self._pending.clear()
 
-    def _reconcile_stream(self, name: str) -> None:
+    def _reconcile_stream(self, name: str) -> None:  # servelint: holds self._lock
         streams = self._harnesses.get(name, {})
         aspired = self._aspired.get(name, set())
 
@@ -196,6 +198,7 @@ class AspiredVersionsManager:
             harness.approve_load()
             self._load_pool.submit(self._run_load, harness)
 
+    # servelint: holds self._lock
     def _reservation_fits_all(self, name: str, versions: set[int]) -> bool:
         streams = self._harnesses[name]
         # Keyed by sid so versions already holding a reservation

@@ -112,10 +112,12 @@ class CachingManager:
         self._max_load_retries = max_load_retries
         self._load_retry_interval_s = load_retry_interval_s
         self._lock = threading.Lock()
-        self._harnesses: dict[str, dict[int, LoaderHarness]] = {}
+        self._harnesses: dict[str, dict[int, LoaderHarness]] = (
+            {})                                     # guarded_by: self._lock
         # Coalesce concurrent first-requests per servable id
         # (caching_manager.h "merge parallel requests" contract).
-        self._inflight: dict[ServableId, threading.Event] = {}
+        self._inflight: dict[ServableId, threading.Event] = (
+            {})                                     # guarded_by: self._lock
 
     def list_available(self) -> list[ServableId]:
         with self._lock:

@@ -20,8 +20,10 @@ class ServableStateMonitor:
     def __init__(self, bus: EventBus, *, max_log_events: int = 1000):
         self._lock = threading.Condition()
         # name -> version -> (ServableState, wall time)
-        self._states: dict[str, dict[int, tuple[ServableState, float]]] = {}
-        self._log = collections.deque(maxlen=max_log_events)
+        self._states: dict[str, dict[int, tuple[ServableState, float]]] = (
+            {})                                     # guarded_by: self._lock
+        self._log = collections.deque(
+            maxlen=max_log_events)                  # guarded_by: self._lock
         self._sub = bus.subscribe(self._on_event, with_time=True)
 
     def _on_event(self, event: ServableState, when: float) -> None:

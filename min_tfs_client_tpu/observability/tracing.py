@@ -172,10 +172,15 @@ class RequestTrace:
         # is deferred to the drain thread — ~12us of registry bookkeeping
         # that should not ride the request's critical path. Readers get
         # read-your-writes through flush_metrics() (prometheus_text calls
-        # it before serializing).
-        _pending.append(self)
-        if _drain_thread is None or not _drain_thread.is_alive():
-            _ensure_drain_thread()
+        # it before serializing). The enqueue + liveness check share ONE
+        # uncontended lock acquisition (~100ns): servelint's
+        # lock-discipline rule flagged the old unlocked read of
+        # _drain_thread, whose double-checked start could race a
+        # just-died (post-fork) thread and drop the revival.
+        with _pending_lock:
+            _pending.append(self)
+            if _drain_thread is None or not _drain_thread.is_alive():
+                _start_drain_thread_locked()
 
 
 class _Fanout:
@@ -312,20 +317,34 @@ class span:
 # Sink 1: metrics registry (exported off the request path by a drain
 # thread; flush_metrics() gives synchronous readers read-your-writes)
 
-_pending: collections.deque = collections.deque()
-_drain_thread: threading.Thread | None = None
-_drain_start_lock = threading.Lock()
+_pending_lock = threading.Lock()
+_pending: collections.deque = collections.deque()  # guarded_by: _pending_lock
+_drain_thread: threading.Thread | None = None      # guarded_by: _pending_lock
 
 
-def _ensure_drain_thread() -> None:
+def _start_drain_thread_locked() -> None:
+    """Start (or revive, after a fork — daemon threads do not survive
+    into the child) the export thread. Caller holds _pending_lock."""
     global _drain_thread
-    with _drain_start_lock:
-        # Re-check under the lock; also revives the thread after a fork
-        # (daemon threads do not survive into the child).
-        if _drain_thread is None or not _drain_thread.is_alive():
-            _drain_thread = threading.Thread(
-                target=_drain_loop, name="trace-metrics-export", daemon=True)
-            _drain_thread.start()
+    _drain_thread = threading.Thread(
+        target=_drain_loop, name="trace-metrics-export", daemon=True)
+    _drain_thread.start()
+
+
+def _reset_after_fork() -> None:  # pragma: no cover - exercised via fork
+    """A fork can land while another thread holds _pending_lock (the
+    drain thread acquires it every 0.5s); the child would inherit a
+    locked mutex with no owner and hang on its first finish(). Re-init
+    the lock and let the next finish() restart the drain thread."""
+    global _pending_lock, _drain_thread
+    _pending_lock = threading.Lock()
+    # servelint: lock-ok the child is single-threaded here and the
+    # pre-fork lock may be held by a thread that no longer exists
+    _drain_thread = None
+
+
+if hasattr(os, "register_at_fork"):  # not on every platform
+    os.register_at_fork(after_in_child=_reset_after_fork)
 
 
 def _drain_loop() -> None:  # pragma: no cover - exercised via flush
@@ -341,12 +360,16 @@ def _drain_loop() -> None:  # pragma: no cover - exercised via flush
 def flush_metrics() -> None:
     """Drain every pending trace into the metrics registry. Called by the
     drain thread, and synchronously by the Prometheus exporter so a
-    scrape right after a request still sees that request's samples."""
+    scrape right after a request still sees that request's samples.
+    The registry export runs OUTSIDE the lock — holding _pending_lock
+    across _export_metrics would stall every finishing request behind a
+    scrape."""
     while True:
-        try:
-            trace = _pending.popleft()
-        except IndexError:
-            return
+        with _pending_lock:
+            try:
+                trace = _pending.popleft()
+            except IndexError:
+                return
         _export_metrics(trace)
 
 
@@ -386,7 +409,8 @@ def _export_metrics(trace: RequestTrace) -> None:
 class _Ring:
     def __init__(self, capacity: int):
         self._lock = threading.Lock()
-        self._traces: collections.deque = collections.deque(maxlen=capacity)
+        self._traces: collections.deque = collections.deque(
+            maxlen=capacity)                       # guarded_by: self._lock
 
     def record(self, trace: RequestTrace) -> None:
         with self._lock:

@@ -107,6 +107,8 @@ def probe_devices(
     for dev in devices if devices is not None else jax.devices():
         try:
             x = jax.device_put(np.ones((8,), np.float32), dev)
+            # servelint: jit-ok cold-path health probe — the throwaway
+            # compile + blocking sync IS the liveness test
             got = float(jax.jit(lambda a: a.sum())(x).block_until_ready())
             ok = abs(got - 8.0) < 1e-6
             out.append(DeviceHealth(str(dev), ok,

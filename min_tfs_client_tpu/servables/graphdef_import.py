@@ -1453,7 +1453,11 @@ def _spec_from_tensor_info(info: tf_graph_pb2.TensorInfo) -> TensorSpec:
     dims = tuple(
         None if d.size == -1 else int(d.size)
         for d in info.tensor_shape.dim)
-    return TensorSpec(DataType(int(info.dtype) or 1), dims)
+    # Preserve unknown_rank: a dim-less shape with the flag set means
+    # shape inference failed at export, NOT a scalar — batching's
+    # non-batch-major fallback must not key off it.
+    return TensorSpec(DataType(int(info.dtype) or 1), dims,
+                      unknown_rank=bool(info.tensor_shape.unknown_rank))
 
 
 def load_saved_model(

@@ -48,15 +48,23 @@ DEFAULT_BATCH_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256)
 
 @dataclass(frozen=True)
 class TensorSpec:
-    """Dtype + shape template; None dims are polymorphic (batch / sequence)."""
+    """Dtype + shape template; None dims are polymorphic (batch / sequence).
+
+    `unknown_rank` mirrors TensorShapeProto.unknown_rank: shape () then
+    means "rank unknown" (shape inference failed at export), NOT a
+    scalar — no shape checks apply, and batching must not assume the
+    tensor is non-batch-major."""
 
     dtype: object
     shape: tuple[Optional[int], ...] = ()
+    unknown_rank: bool = False
 
     def __post_init__(self):
         object.__setattr__(self, "dtype", DataType(self.dtype))
 
     def validate(self, arr: np.ndarray, alias: str) -> None:
+        if self.unknown_rank:
+            return
         if len(arr.shape) != len(self.shape):
             raise ServingError.invalid_argument(
                 f"input {alias!r}: expected rank {len(self.shape)}, "
@@ -388,6 +396,8 @@ class Signature:
                                if self.params is not None
                                else self.fn(arrays))
             self._check_produced(outputs, keys)
+            # servelint: sync-ok host-path outputs are already numpy (the
+            # name is shared with the device branch below)
             return {k: np.asarray(outputs[k]) for k in keys}
 
         true_seq = self._true_seq_len(arrays)
@@ -576,12 +586,16 @@ class Signature:
             info = sig.inputs[alias]
             info.name = f"{alias}:0"
             info.dtype = spec.dtype.enum
+            if spec.unknown_rank:
+                info.tensor_shape.unknown_rank = True
             for d in spec.shape:
                 info.tensor_shape.dim.add(size=-1 if d is None else d)
         for alias, spec in self.outputs.items():
             info = sig.outputs[alias]
             info.name = f"{alias}:0"
             info.dtype = spec.dtype.enum
+            if spec.unknown_rank:
+                info.tensor_shape.unknown_rank = True
             for d in spec.shape:
                 info.tensor_shape.dim.add(size=-1 if d is None else d)
         return sig
@@ -605,6 +619,9 @@ def fetch_outputs(outputs: Mapping[str, object],
                 pass
     result = {}
     for key, value in outputs.items():
+        # servelint: sync-ok THE sanctioned device->host materialization:
+        # every async copy above is already in flight, so this wall-clock
+        # cost is max(transfer), not a serialized sum
         arr = np.asarray(value)
         if batch is not None and arr.ndim:
             arr = arr[:batch]

@@ -16,23 +16,32 @@ import threading
 from typing import Sequence
 
 _registry_lock = threading.Lock()
-_registry: dict[str, "_Metric"] = {}
+_registry: dict[str, "_Metric"] = {}         # guarded_by: _registry_lock
 
 
 class _Metric:
     kind = "untyped"
 
-    def __init__(self, name: str, description: str, label_names: Sequence[str]):
+    def __init__(self, name: str, description: str, label_names: Sequence[str],
+                 extra: dict | None = None):
         self.name = name
         self.description = description
         self.label_names = tuple(label_names)
         self._lock = threading.Lock()
-        self._cells: dict[tuple, object] = {}
+        self._cells: dict[tuple, object] = {}    # guarded_by: self._lock
+        if extra:
+            # Subclass state (histogram buckets) must exist BEFORE the
+            # metric publishes to the registry: with the old post-super()
+            # assignment, a thread re-registering the same name could
+            # alias a half-built instance and observe() into missing
+            # buckets (servelint's lock audit surfaced this window).
+            self.__dict__.update(extra)
         with _registry_lock:
             existing = _registry.get(name)
             if existing is not None:
                 # Same-name re-creation returns the same metric (TF allows
-                # only one registration; we tolerate idempotent re-use).
+                # only one registration; we tolerate idempotent re-use —
+                # and keep the FIRST registration's state).
                 self.__dict__ = existing.__dict__
                 return
             _registry[name] = self
@@ -76,9 +85,10 @@ class Histogram(_Metric):
 
     def __init__(self, name, description, label_names=(),
                  buckets: Sequence[float] | None = None):
-        super().__init__(name, description, label_names)
-        if "buckets" not in self.__dict__:
-            self.buckets = list(buckets or exponential_buckets(10, 1.8, 33))
+        super().__init__(
+            name, description, label_names,
+            extra={"buckets":
+                   list(buckets or exponential_buckets(10, 1.8, 33))})
 
     def observe(self, value: float, *labels) -> None:
         with self._lock:

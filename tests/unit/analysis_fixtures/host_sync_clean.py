@@ -1,0 +1,30 @@
+"""servelint fixture: host-sync rule must NOT fire anywhere in here."""
+
+import numpy as np
+
+
+def fetch_outputs(outputs):
+    return {k: np.asarray(v) for k, v in outputs.items()}  # untainted param
+
+
+class Runner:
+    def host_data_is_fine(self, inputs):
+        # Plain host-side numpy work: no device seed anywhere.
+        arr = np.asarray(inputs["x"])
+        total = float(arr.sum())
+        return int(total), arr.tolist()
+
+    def sanctioned_fetch_clears_taint(self, arrays):
+        outputs = self._execute(arrays)
+        fetched = fetch_outputs(outputs)
+        return {k: np.asarray(v) for k, v in fetched.items()}
+
+    def annotated_sync_point(self, arrays):
+        outputs = self._execute(arrays)
+        # servelint: sync-ok fixture's one sanctioned materialization
+        return np.asarray(outputs)
+
+    def metadata_access_is_host_side(self, arrays):
+        outputs = self._execute(arrays)
+        batch = outputs["y"].shape[0]
+        return int(batch)

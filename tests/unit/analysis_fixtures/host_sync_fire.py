@@ -1,0 +1,35 @@
+"""servelint fixture: host-sync rule SHOULD fire on every marked line.
+
+Never imported/executed — parsed by tests/unit/test_static_analysis.py.
+"""
+
+import numpy as np
+
+
+class Runner:
+    def hs001_asarray_on_execute(self, arrays):
+        outputs = self._execute(arrays)
+        return np.asarray(outputs)              # HS001
+
+    def hs001_float_on_jitted(self, x):
+        y = self.jitted()(x)
+        return float(y)                         # HS001
+
+    def hs001_tolist_via_subscript(self, arrays):
+        outs = self._run_device(arrays)
+        first = outs["logits"]
+        return first.tolist()                   # HS001
+
+    def hs002_block(self, x):
+        y = self.jitted()(x)
+        return y.block_until_ready()            # HS002
+
+    def hs003_implicit_bool(self, arrays):
+        mask = self._execute(arrays)
+        if mask:                                # HS003
+            return 1
+        return 0
+
+    def hs004_fstring(self, arrays):
+        logits = self._execute(arrays)
+        return f"logits={logits}"               # HS004

@@ -1,0 +1,57 @@
+"""servelint fixture: locks rule must NOT fire anywhere in here."""
+
+import threading
+
+_pending_lock = threading.Lock()
+_pending = []                                # guarded_by: _pending_lock
+
+
+def enqueue(item):
+    with _pending_lock:
+        _pending.append(item)
+
+
+def drain():
+    out = []
+    while True:
+        with _pending_lock:
+            if not _pending:
+                return out
+            out.append(_pending.pop())
+
+
+class Scheduler:
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._queues = []                    # guarded_by: self._cv
+        self._stop = False                   # guarded_by: self._cv
+
+    def add(self, queue):
+        with self._cv:
+            self._queues.append(queue)
+            self._cv.notify()
+
+    def _drain_locked(self):
+        # `_locked` suffix: caller-holds-the-lock convention.
+        return list(self._queues)
+
+    def snapshot(self):  # servelint: holds self._cv
+        return list(self._queues), self._stop
+
+    def peek_depth(self):
+        # servelint: lock-ok approximate depth for a log line; GIL-atomic
+        return len(self._queues)
+
+    def stop(self):
+        with self._cv:
+            self._stop = True
+            self._cv.notify_all()
+
+    def spawn_worker(self):
+        # A closure is its own scope: it satisfies the contract by
+        # acquiring the lock itself (or via a holds annotation).
+        def worker():
+            with self._cv:
+                return list(self._queues)
+
+        return worker

@@ -1,0 +1,42 @@
+"""servelint fixture: locks rule SHOULD fire on every marked line."""
+
+import threading
+
+_registry_lock = threading.Lock()
+_registry = {}                               # guarded_by: _registry_lock
+_ghost = {}                      # guarded_by: _never_acquired  -> LK003
+
+
+class Queue:
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._batches = []                   # guarded_by: self._mu
+        self._depth = 0                      # guarded_by: self._mu
+
+    def unguarded_read(self):
+        return len(self._batches)            # LK001
+
+    def unguarded_write(self, task):
+        self._batches.append(task)           # LK001 (load of the list)
+        self._depth += 1                     # LK002 (augmented write)
+
+    def guarded_is_fine(self, task):
+        with self._mu:
+            self._batches.append(task)
+            self._depth += 1
+
+    def spawn_worker(self):
+        def worker():
+            while True:
+                self._batches.pop()          # LK001 (closure on a thread)
+
+        return worker
+
+
+def register_unguarded(name, metric):
+    _registry[name] = metric                 # LK001 (subscript store)
+
+
+def lookup_guarded(name):
+    with _registry_lock:
+        return _registry.get(name)

@@ -1,0 +1,39 @@
+"""servelint fixture: recompile rule must NOT fire anywhere in here."""
+
+import functools
+
+import jax
+
+
+class Cached:
+    def __init__(self, fn):
+        # Bound once; the compile cache lives for the servable's lifetime.
+        self._jitted = jax.jit(fn)
+        self._cache = {}
+
+    def run(self, x):
+        return self._jitted(x)
+
+    def per_key(self, keys, fn):
+        for key in keys:
+            # Cached under a key: one compile per specialization, bounded.
+            self._cache[key] = jax.jit(fn)
+        return self._cache
+
+    def probe(self, x):
+        # servelint: jit-ok deliberate throwaway compile in a fixture
+        return jax.jit(lambda a: a)(x)
+
+
+@functools.partial(jax.jit, static_argnames=("causal",))
+def static_branches_are_fine(x, *, causal=False):
+    if causal:          # static arg: branch resolved at trace time
+        return x
+    return -x
+
+
+@jax.jit
+def none_guards_are_host_side(x, lengths=None):
+    if lengths is None:  # identity test, not a tracer concretization
+        return x
+    return x * lengths

@@ -1,0 +1,55 @@
+"""servelint fixture: recompile rule SHOULD fire on every marked line."""
+
+import jax
+
+
+def rc001_jit_per_call(x):
+    return jax.jit(lambda a: a * 2)(x)          # RC001
+
+
+def rc002_jit_in_loop(fns, xs):
+    outs = []
+    for fn, x in zip(fns, xs):
+        jitted = jax.jit(fn)                    # RC002
+        outs.append(jitted(x))
+    return outs
+
+
+def rc003_rc004_static_hazards(request_sizes, x):
+    step = jax.jit(lambda a, sizes: a, static_argnums=(1,))
+    step(x, [1, 2, 3])                          # RC003 unhashable literal
+    step(x, request_sizes)                      # RC004 per-request varying
+    return x
+
+
+@jax.jit
+def rc005_tracer_branch(x, y):
+    if x > 0:                                   # RC005
+        return y
+    return -y
+
+
+@jax.jit
+def rc006_shape_branch(x):
+    if x.shape[0] > 8:                          # RC006
+        return x[:8]
+    return x
+
+
+@jax.jit
+def rc007_tracer_fstring(x):
+    label = f"value={x}"                        # RC007
+    return x, label
+
+
+def rc005_via_factory_binding(x):
+    return _by_name(x)
+
+
+def _by_name(x):
+    while x:                                    # RC005 (jitted by name below)
+        x = x - 1
+    return x
+
+
+_by_name_jit = jax.jit(_by_name)

@@ -1,0 +1,32 @@
+"""servelint fixture: spans rule must NOT fire anywhere in here."""
+
+from min_tfs_client_tpu.batching.scheduler import BatchTask
+from min_tfs_client_tpu.observability import tracing
+
+
+def with_span_is_fine(arrays, run):
+    with tracing.span("batching/merge", batch=len(arrays)):
+        return run(arrays)
+
+
+def with_request_trace_is_fine(api, handler, request):
+    with tracing.request_trace(api) as trace:
+        response = handler(request)
+        if trace is not None:
+            trace.annotate(status="0")
+        return response
+
+
+def sanctioned_batchtask_handoff(arrays, n, scheduler, queue):
+    # THE sanctioned thread crossing: the scheduler thread re-activates
+    # the trace via tracing.activate(tracing.fanout(...)).
+    trace = tracing.current_trace()
+    task = BatchTask(inputs=arrays, size=n, trace=trace)
+    scheduler.schedule(queue, task)
+    return task
+
+
+def reviewed_exception(worker, pool):
+    trace = tracing.current_trace()
+    # servelint: span-ok fixture-reviewed crossing for the test corpus
+    return pool.submit(worker, trace)

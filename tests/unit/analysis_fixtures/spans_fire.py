@@ -1,0 +1,27 @@
+"""servelint fixture: spans rule SHOULD fire on every marked line."""
+
+import threading
+
+from min_tfs_client_tpu.observability import tracing
+
+
+def sp001_span_assigned(name):
+    s = tracing.span(name)                   # SP001
+    s.__enter__()
+    return s
+
+
+def sp001_bare_request_trace(api):
+    tracing.request_trace(api)               # SP001
+
+
+def sp002_trace_to_thread(worker):
+    trace = tracing.current_trace()
+    t = threading.Thread(target=worker, args=(trace,))   # SP002
+    t.start()
+    return t
+
+
+def sp002_trace_to_executor(pool, worker):
+    trace = tracing.current_trace()
+    return pool.submit(worker, trace)        # SP002

@@ -616,3 +616,117 @@ class TestSparseTripleValidation:
             "f#shape": np.array([2, 2], np.int64),
         })
         assert set(out) == {"f#indices", "f#values", "f#shape"}
+
+
+class TestNonBatchMajorFallback:
+    """Requests fetching a DECLARED non-batch-major output auto-fall back
+    to direct (unbatched) execution under a batching config instead of
+    becoming unservable (the batched split would die with INTERNAL);
+    callers whose output_filter excludes those outputs keep batching."""
+
+    def _scalar_out_sig(self, executed):
+        # y is batch-major, vocab_size is a scalar diagnostic — the split
+        # step could never hand each co-batched caller a slice of it.
+        def fn(inputs):
+            return {"y": inputs["x"] * 2.0,
+                    "vocab_size": np.float32(7.0)}
+
+        sig = Signature(
+            fn=fn,
+            inputs={"x": TensorSpec(np.float32, (None,))},
+            outputs={"y": TensorSpec(np.float32, (None,)),
+                     "vocab_size": TensorSpec(np.float32, ())},
+            on_host=True,
+        )
+        original_run = sig.run
+
+        def counting_run(inputs, output_filter=()):
+            executed.append(np.asarray(inputs["x"]).shape[0])
+            return original_run(inputs, output_filter)
+
+        sig.run = counting_run
+        return sig
+
+    def test_mixed_signature_wraps_and_routes_per_request(self, scheduler):
+        from min_tfs_client_tpu.batching.session import (
+            declared_non_batch_major_outputs,
+        )
+
+        executed = []
+        sig = self._scalar_out_sig(executed)
+        assert declared_non_batch_major_outputs(sig) == ["vocab_size"]
+        servable = Servable("m", 1, {"serving_default": sig})
+        maybe_wrap_servable(
+            servable, {"max_batch_size": 8, "batch_timeout_s": 0.2},
+            scheduler)
+        # Mixed signature IS wrapped (batch-major callers benefit).
+        assert len(servable._batch_runners) == 1
+
+        # Callers filtering away the scalar still ride the queue and
+        # co-batch: two concurrent y-only requests -> ONE merged run.
+        results = {}
+
+        def call(i):
+            results[i] = sig.run({"x": np.array([float(i)], np.float32)},
+                                 output_filter=("y",))
+
+        threads = [threading.Thread(target=call, args=(i,))
+                   for i in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10)
+        assert sorted(results) == [0, 1]
+        for i in range(2):
+            assert set(results[i]) == {"y"}
+            np.testing.assert_array_equal(results[i]["y"], [2.0 * i])
+        assert executed == [2], "filtered callers must co-batch"
+
+    def test_scalar_fetch_routes_direct(self, scheduler):
+        executed = []
+        sig = self._scalar_out_sig(executed)
+        servable = Servable("m", 1, {"serving_default": sig})
+        maybe_wrap_servable(
+            servable, {"max_batch_size": 8, "batch_timeout_s": 0.05},
+            scheduler)
+        # Unfiltered requests fetch the scalar -> direct execution: one
+        # run per request, correct outputs, no INTERNAL from the split.
+        out = sig.run({"x": np.array([1.0, 2.0], np.float32)})
+        np.testing.assert_array_equal(out["y"], [2.0, 4.0])
+        assert float(out["vocab_size"]) == 7.0
+        out2 = sig.run({"x": np.array([3.0], np.float32)},
+                       output_filter=("vocab_size",))
+        assert float(out2["vocab_size"]) == 7.0
+        assert executed == [2, 1]
+
+    def test_unknown_rank_output_keeps_batching(self, scheduler):
+        from min_tfs_client_tpu.batching.session import (
+            declared_non_batch_major_outputs,
+        )
+        from min_tfs_client_tpu.servables.servable import TensorSpec as TS
+
+        # Imported graphs whose output shape inference failed declare
+        # unknown_rank; that must NOT demote the signature to unbatched.
+        sig = Signature(
+            fn=lambda inputs: {"y": inputs["x"] * 2.0},
+            inputs={"x": TS(np.float32, (None,))},
+            outputs={"y": TS(np.float32, (), unknown_rank=True)},
+            on_host=True,
+        )
+        assert declared_non_batch_major_outputs(sig) == []
+        servable = Servable("m", 1, {"serving_default": sig})
+        maybe_wrap_servable(servable, {"max_batch_size": 4}, scheduler)
+        assert len(servable._batch_runners) == 1
+        out = sig.run({"x": np.array([1.0, 2.0], np.float32)})
+        np.testing.assert_array_equal(out["y"], [2.0, 4.0])
+
+    def test_fixed_leading_dim_also_falls_back(self, scheduler):
+        sig = Signature(
+            fn=lambda inputs: {"table": np.zeros((3, 2), np.float32)},
+            inputs={"x": TensorSpec(np.float32, (None,))},
+            outputs={"table": TensorSpec(np.float32, (3, 2))},
+            on_host=True,
+        )
+        servable = Servable("m", 1, {"serving_default": sig})
+        maybe_wrap_servable(servable, {"max_batch_size": 4}, scheduler)
+        assert not getattr(servable, "_batch_runners", [])

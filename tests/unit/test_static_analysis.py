@@ -1,0 +1,225 @@
+"""servelint test suite: fixture corpus per rule family, baseline
+add/stale semantics, annotation load-bearing checks, and THE tier-1 gate
+(test_repo_gate_is_clean) that fails any PR introducing an unbaselined
+hot-path finding or a stale baseline entry."""
+
+import json
+import os
+import re
+import subprocess
+import sys
+
+import pytest
+
+from min_tfs_client_tpu.analysis import (
+    AnalysisConfig,
+    analyze_paths,
+    default_baseline_path,
+    default_package_root,
+    diff_baseline,
+    load_baseline,
+    run_analysis,
+    save_baseline,
+)
+from min_tfs_client_tpu.analysis import host_sync, locks, recompile, spans
+from min_tfs_client_tpu.analysis.core import parse_module
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "analysis_fixtures")
+# Every fixture module counts as hot-path so the host-sync rule applies
+# (single-file invocations relativize to the file's own directory).
+FIXTURE_CONFIG = AnalysisConfig(hot_paths=("",))
+REPO_ROOT = os.path.dirname(default_package_root())
+SUBPROC_ENV = {**os.environ, "JAX_PLATFORMS": "cpu",
+               "PYTHONPATH": REPO_ROOT + os.pathsep +
+               os.environ.get("PYTHONPATH", "")}
+
+_MARKER = re.compile(r"\b((?:HS|RC|LK|SP)\d{3})\b")
+
+
+def _expected_markers(fname: str, prefix: str) -> list[tuple[int, str]]:
+    """(line, code) for every `# <CODE>` marker of the rule family."""
+    expected = []
+    path = os.path.join(FIXTURES, fname)
+    with open(path, "r", encoding="utf-8") as f:
+        for lineno, line in enumerate(f, start=1):
+            comment = line.partition("#")[2]
+            for code in _MARKER.findall(comment):
+                if code.startswith(prefix):
+                    expected.append((lineno, code))
+    return expected
+
+
+def _findings(fname: str, rule) -> list:
+    report = analyze_paths([os.path.join(FIXTURES, fname)],
+                           config=FIXTURE_CONFIG, rules=[rule])
+    return report.findings
+
+
+RULESET = [
+    ("host_sync_fire.py", "host_sync_clean.py", host_sync, "HS"),
+    ("recompile_fire.py", "recompile_clean.py", recompile, "RC"),
+    ("locks_fire.py", "locks_clean.py", locks, "LK"),
+    ("spans_fire.py", "spans_clean.py", spans, "SP"),
+]
+
+
+class TestFixtureCorpus:
+    @pytest.mark.parametrize("fire,clean,rule,prefix", RULESET,
+                             ids=[r[2].RULE for r in RULESET])
+    def test_should_fire_exactly_on_markers(self, fire, clean, rule, prefix):
+        expected = _expected_markers(fire, prefix)
+        assert len(expected) >= 2, "fixture must carry >=2 positive cases"
+        actual = [(f.line, f.code) for f in _findings(fire, rule)]
+        assert sorted(actual) == sorted(expected), (
+            f"{fire}: findings {sorted(actual)} != markers "
+            f"{sorted(expected)}")
+
+    @pytest.mark.parametrize("fire,clean,rule,prefix", RULESET,
+                             ids=[r[2].RULE for r in RULESET])
+    def test_must_not_fire_on_clean_corpus(self, fire, clean, rule, prefix):
+        found = _findings(clean, rule)
+        assert found == [], (
+            f"{clean}: expected no findings, got "
+            f"{[f.render() for f in found]}")
+
+    def test_findings_carry_location_rule_and_hint(self):
+        f = _findings("host_sync_fire.py", host_sync)[0]
+        assert f.path.endswith("host_sync_fire.py")
+        assert f.line > 0 and f.code.startswith("HS") and f.hint
+        rendered = f.render()
+        assert f"{f.path}:{f.line}" in rendered and f.code in rendered
+
+
+class TestBaseline:
+    def _fire_findings(self):
+        return _findings("locks_fire.py", locks)
+
+    def test_baseline_add_roundtrip(self, tmp_path):
+        findings = self._fire_findings()
+        path = str(tmp_path / "baseline.json")
+        save_baseline(path, findings)
+        diff = diff_baseline(findings, load_baseline(path))
+        assert diff.clean and diff.matched == len(findings)
+
+    def test_new_finding_fails(self, tmp_path):
+        findings = self._fire_findings()
+        path = str(tmp_path / "baseline.json")
+        save_baseline(path, findings[:-1])  # one finding unbaselined
+        diff = diff_baseline(findings, load_baseline(path))
+        assert not diff.clean
+        assert [f.key() for f in diff.new] == [findings[-1].key()]
+
+    def test_stale_entry_fails(self, tmp_path):
+        findings = self._fire_findings()
+        path = str(tmp_path / "baseline.json")
+        save_baseline(path, findings)
+        baseline = load_baseline(path)
+        baseline.entries["analysis_fixtures/locks_fire.py::LK001::"
+                         "Gone.method::load:_gone"] = 1
+        diff = diff_baseline(findings, baseline)
+        assert not diff.clean and len(diff.stale) == 1
+
+    def test_missing_required_guard_fails(self, tmp_path):
+        path = str(tmp_path / "baseline.json")
+        with open(path, "w") as f:
+            json.dump({"version": 1, "entries": {}, "required_guards": [
+                "locks_clean.py::Scheduler._queues",
+                "locks_clean.py::Gone._vanished",
+            ]}, f)
+        report = run_analysis(
+            [os.path.join(FIXTURES, "locks_clean.py")],
+            baseline_path=path, config=FIXTURE_CONFIG, rules=[locks])
+        assert not report.clean
+        assert [f.code for f in report.diff.new] == ["LK004"]
+        assert "Gone._vanished" in report.diff.new[0].message
+
+
+class TestAnnotationsAreLoadBearing:
+    """Deleting a seeded annotation must make the run fail — the
+    acceptance property of the seeded corpus."""
+
+    def _strip_and_run(self, relpath, pattern, rule):
+        path = os.path.join(default_package_root(), *relpath.split("/")[1:])
+        with open(path, "r", encoding="utf-8") as f:
+            source = f.read()
+        assert re.search(pattern, source), f"seed annotation gone: {pattern}"
+        stripped = re.sub(pattern, "# stripped", source)
+        module = parse_module(path, relpath, source=stripped)
+        return [f for f in rule.check(module, AnalysisConfig())]
+
+    def test_sync_ok_removal_fires_host_sync(self):
+        found = self._strip_and_run(
+            "min_tfs_client_tpu/servables/servable.py",
+            r"# servelint: sync-ok THE sanctioned[^\n]*", host_sync)
+        assert any(f.code == "HS001" for f in found)
+
+    def test_holds_removal_fires_locks(self):
+        found = self._strip_and_run(
+            "min_tfs_client_tpu/batching/scheduler.py",
+            r"# servelint: holds self\._lock", locks)
+        assert any(f.code in ("LK001", "LK002") for f in found)
+
+    def test_guarded_by_removal_fails_via_required_guards(self):
+        baseline = load_baseline(default_baseline_path())
+        guard = ("min_tfs_client_tpu/core/monitor.py::"
+                 "ServableStateMonitor._states")
+        assert guard in baseline.required_guards
+        missing = locks.missing_guard_findings(
+            baseline.required_guards,
+            declared=set(baseline.required_guards) - {guard})
+        assert [f.code for f in missing] == ["LK004"]
+        assert guard.split("::")[1] in missing[0].message
+
+
+class TestTier1Gate:
+    """THE gate: the shipped tree must be clean against the shipped
+    baseline. Runs inside the normal tier-1 pytest invocation."""
+
+    def test_repo_gate_is_clean(self):
+        report = run_analysis([default_package_root()],
+                              baseline_path=default_baseline_path())
+        assert report.files_scanned > 50
+        assert report.clean, "\n" + report.render()
+
+    def test_injected_violation_fails_cli(self, tmp_path):
+        # CLI contract: non-zero exit + file:line + rule id on stdout.
+        bad = tmp_path / "servables"
+        bad.mkdir()
+        src = bad / "hot.py"
+        src.write_text(
+            "import numpy as np\n\n\n"
+            "class R:\n"
+            "    def f(self, arrays):\n"
+            "        outs = self._execute(arrays)\n"
+            "        return np.asarray(outs)\n")
+        proc = subprocess.run(
+            [sys.executable, "-m", "min_tfs_client_tpu.analysis",
+             "--baseline", "none", str(src)],
+            capture_output=True, text=True, check=False,
+            env=SUBPROC_ENV, cwd=str(tmp_path))
+        # A bare file outside the package tree is not hot-path; rerun
+        # against the real hot-path layout via the package for exit=1.
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+
+        pkg = tmp_path / "min_tfs_client_tpu" / "servables"
+        pkg.mkdir(parents=True)
+        (pkg.parent / "__init__.py").write_text("")
+        (pkg / "__init__.py").write_text("")
+        (pkg / "hot.py").write_text(src.read_text())
+        proc = subprocess.run(
+            [sys.executable, "-m", "min_tfs_client_tpu.analysis",
+             "--baseline", "none", str(tmp_path / "min_tfs_client_tpu")],
+            capture_output=True, text=True, check=False,
+            # NOT cwd=tmp_path: the stub package would shadow the real
+            # one on sys.path.
+            env=SUBPROC_ENV, cwd=REPO_ROOT)
+        assert proc.returncode == 1, proc.stdout + proc.stderr
+        assert "HS001" in proc.stdout
+        assert re.search(r"hot\.py:7", proc.stdout), proc.stdout
+
+    def test_cli_default_invocation_is_clean(self):
+        proc = subprocess.run(
+            [sys.executable, "-m", "min_tfs_client_tpu.analysis"],
+            capture_output=True, text=True, check=False,
+            env=SUBPROC_ENV, cwd=REPO_ROOT)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
