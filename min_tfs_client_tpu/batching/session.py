@@ -355,6 +355,14 @@ class BatchedSignatureRunner:
                              batch_tasks=len(batch),
                              padding_waste_fraction=round(
                                  (bucket - total) / max(1, bucket), 4))
+            # Flight-recorder ring: batch formations are exactly the
+            # "what was happening" context a post-mortem needs around an
+            # INTERNAL error. Scheduler thread, not the caller path.
+            from min_tfs_client_tpu.observability import flight_recorder
+
+            flight_recorder.record(
+                "batch", queue=self._queue.name, tasks=len(batch),
+                examples=total, bucket=bucket)
         except Exception:  # pragma: no cover - metrics must not break serving
             pass
 

@@ -32,6 +32,11 @@ class ServableStateMonitor:
                 event, when)
             self._log.append((event, when))
             self._lock.notify_all()
+        # Flight-recorder ring entry AFTER self._lock is released: the
+        # recorder takes its own lock and must never nest inside ours.
+        from min_tfs_client_tpu.observability import flight_recorder
+
+        flight_recorder.record_state_transition(event)
 
     # -- queries -------------------------------------------------------------
 

@@ -134,11 +134,30 @@ class ServerRequestLogger:
 
     def maybe_log(self, model_name: str, build_log: Callable[[], apis.PredictionLog],
                   model_spec: apis.ModelSpec) -> None:
+        logger = self._loggers.get(model_name)
+        if logger is None:
+            return
         try:
-            logger = self._loggers.get(model_name)
-            if logger is not None and logger.should_log():
+            if logger.should_log():
                 logger.log(build_log(), model_spec)
+                _count_outcome(model_name, "logged")
+            else:
+                _count_outcome(model_name, "sampled_out")
         except Exception:  # pragma: no cover - logging must never fail a
             import traceback  # healthy request (disk full, collector race)
 
+            _count_outcome(model_name, "dropped")
             traceback.print_exc()
+
+
+def _count_outcome(model_name: str, outcome: str) -> None:
+    """Sampling outcomes per model — request-log sampling was previously
+    invisible: a sampling_rate typo or a full disk produced no signal at
+    all. Now `request_log_count{model,outcome}` makes logged vs
+    sampled_out vs dropped scrapeable."""
+    try:
+        from min_tfs_client_tpu.server import metrics
+
+        metrics.request_log_count.increment(model_name, outcome)
+    except Exception:  # pragma: no cover - metrics must not break logging
+        pass

@@ -70,6 +70,13 @@ class ServerCore:
         # model name -> ModelConfig (current generation)
         self._model_configs: dict[str, ModelConfig] = {}
         self._source: FileSystemStoragePathSource | None = None
+        # HBM telemetry + readiness verdicts read this core (weakly);
+        # registered before the initial loads so /readyz answers "not
+        # ready" (rather than "no core") while models come up.
+        from min_tfs_client_tpu.observability import health, runtime
+
+        runtime.set_resource_tracker(self.manager.resources)
+        health.register_core(self)
         self._apply_config(config, initial=True)
 
     # -- config plumbing -----------------------------------------------------
@@ -278,7 +285,16 @@ class ServerCore:
         with self._lock:
             return name in self._model_configs
 
+    def configured_model_names(self) -> list[str]:
+        """The current config generation's model names — the readiness
+        verdict's 'all configured servables AVAILABLE' universe."""
+        with self._lock:
+            return sorted(self._model_configs)
+
     def stop(self) -> None:
+        from min_tfs_client_tpu.observability import health
+
+        health.unregister_core(self)
         if self._source is not None:
             self._source.stop()
         self.manager.stop()

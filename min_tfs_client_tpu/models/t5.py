@@ -1011,11 +1011,16 @@ def build_session_signatures(params: dict, config: T5Config, *, seq_len: int,
                                ttl_s=session_ttl_s, metric_label="t5")
     prefill_fn, read_sampling, extra_specs = _sampling_session_helpers(
         config, max_decode_len, sampling, sampling_top_p)
-    prefill_jit = jax.jit(prefill_fn)
-    step_jit = jax.jit(
-        lambda p, s: decode_step_state(maybe_dequantize(p), config, s,
-                                       top_k=sampling_top_k),
-        donate_argnums=(1,))
+    from min_tfs_client_tpu.observability import runtime as rt
+
+    prefill_jit = rt.instrument_jit(
+        "t5:decode:prefill", jax.jit(prefill_fn))
+    step_jit = rt.instrument_jit(
+        "t5:decode:step",
+        jax.jit(
+            lambda p, s: decode_step_state(maybe_dequantize(p), config, s,
+                                           top_k=sampling_top_k),
+            donate_argnums=(1,)))
 
     def _session_id(inputs) -> bytes:
         raw = np.asarray(inputs["session_id"]).reshape(-1)
@@ -1157,7 +1162,10 @@ def _build_pooled_session_signatures(params: dict, config: T5Config, *,
         max_sessions=max_slots, ttl_s=session_ttl_s,
         metric_label="t5-pooled",
         on_evict=lambda entry: pool.release_slot(entry[0]))
-    prefill_jit = jax.jit(prefill_fn)
+    from min_tfs_client_tpu.observability import runtime as rt
+
+    prefill_jit = rt.instrument_jit(
+        "t5:pooled:prefill", jax.jit(prefill_fn))
 
     def _session_id(inputs) -> bytes:
         raw = np.asarray(inputs["session_id"]).reshape(-1)

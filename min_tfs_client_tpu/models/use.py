@@ -131,10 +131,12 @@ def build_signatures(params: dict, config: USEConfig, *,
                      batch_buckets=(1, 2, 4, 8, 16, 32)) -> dict:
     from min_tfs_client_tpu.servables.servable import Signature, TensorSpec
 
+    from min_tfs_client_tpu.observability import runtime as rt
+
     # params ride as a jit argument (not a closure) so TP/DP placements on
     # the leaves survive partitioning — see servable.Signature.params.
-    device_fn = jax.jit(
-        lambda params, ids, lengths: encode(params, config, ids, lengths))
+    device_fn = rt.instrument_jit("use:encode", jax.jit(
+        lambda params, ids, lengths: encode(params, config, ids, lengths)))
 
     def host_fn(params, inputs):
         texts = np.asarray(inputs["text"], object).reshape(-1)

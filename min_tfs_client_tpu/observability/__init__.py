@@ -1,4 +1,4 @@
-"""Observability: the request-tracing spine + its export surfaces.
+"""Observability: the request-tracing spine + the serving health plane.
 
 `tracing` carries one RequestTrace per served request from the transport
 entry point (gRPC / REST / tpu:// in-process) through batching, device
@@ -7,6 +7,21 @@ sinks: the metrics registry (Prometheus), a bounded in-memory ring served
 as Chrome-trace JSON by `/monitoring/traces`, and (optionally) the JAX
 profiler's TraceAnnotation stream so XProf captures show the same stage
 names.
+
+On top of the spine, four cooperating health-plane subsystems
+(docs/OBSERVABILITY.md "Health plane"):
+
+ * `slo` — per-(model, signature, api) rolling latency quantiles,
+   error-rate windows, and burn rates against configurable objectives
+   (`/monitoring/slo`), fed off the hot path by the tracing drain;
+ * `runtime` — the compile-event ledger, per-device HBM accounting, and
+   transfer-bytes counters (`/monitoring/runtime`);
+ * `health` — liveness + readiness verdicts (`/monitoring/healthz`,
+   `/monitoring/readyz`, grpc.health.v1 on the serving port, and the
+   `:tpu/serving/ready` gauge);
+ * `flight_recorder` — a fixed-size ring of recent structured events,
+   dumped to JSON on the first INTERNAL error or SIGUSR2
+   (`/monitoring/flightrecorder`).
 """
 
 from min_tfs_client_tpu.observability import tracing  # noqa: F401

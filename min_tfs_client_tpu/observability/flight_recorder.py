@@ -1,0 +1,204 @@
+"""Black-box flight recorder: a fixed-size ring of recent structured
+events, dumped on the first INTERNAL error or on SIGUSR2.
+
+When a production replica throws INTERNAL, the question is never "what
+was the error" (the status message says) but "what was happening in the
+10 seconds before" — which versions transitioned, which batches formed,
+what compiled, which requests failed. This module keeps that context
+resident at near-zero cost:
+
+ * event sources append structured tuples: servable state transitions
+   (core/monitor.py), batch formations (batching/session.py), compile
+   events (observability/runtime.py), and request errors with digests
+   (server/handlers.py);
+ * the ring is lock-light: the event tuple is fully built before the
+   append, so the lock covers one deque.append (~100ns, uncontended —
+   every source is either a background thread or an error path);
+ * the FIRST INTERNAL error latches a dump: the ring is serialized to a
+   JSON file (TPU_SERVING_FLIGHT_DIR, default the system tempdir) and
+   logged, once — later INTERNALs still ring-record but don't re-dump
+   (a crash loop must not fill the disk). `SIGUSR2` dumps on demand;
+   `/monitoring/flightrecorder` serves the live ring as JSON.
+
+Event fields are coerced to JSON-able scalars at serialization time, so
+sources may pass whatever they have (enum states, numpy ints).
+"""
+
+from __future__ import annotations
+
+import collections
+import itertools
+import json
+import logging
+import os
+import signal
+import threading
+import time
+
+_log = logging.getLogger("min_tfs_client_tpu.flight_recorder")
+
+# Canonical-code value of INTERNAL (tf_error_pb2.Code.INTERNAL) — kept as
+# a literal so this module stays importable with zero proto deps.
+_INTERNAL = 13
+
+
+def _ring_capacity() -> int:
+    try:
+        return max(16, int(os.environ.get("TPU_SERVING_FLIGHT_RING", "2048")))
+    except ValueError:
+        return 2048
+
+
+def _jsonable(value):
+    if isinstance(value, (int, float, str, bool, type(None))):
+        return value
+    try:
+        return float(value)
+    except (TypeError, ValueError):
+        return str(value)
+
+
+class FlightRecorder:
+    def __init__(self, capacity: int | None = None):
+        self._lock = threading.Lock()
+        self._events: collections.deque = collections.deque(
+            maxlen=capacity or _ring_capacity())   # guarded_by: self._lock
+        self._seq = itertools.count(1)
+        self._dumped = False                       # guarded_by: self._lock
+        self._dump_dir: str | None = None          # guarded_by: self._lock
+
+    def configure(self, dump_dir: str | None = None) -> None:
+        with self._lock:
+            self._dump_dir = dump_dir or None
+
+    def record(self, kind: str, **fields) -> None:
+        event = (next(self._seq), time.time(), kind, fields)
+        with self._lock:
+            self._events.append(event)
+
+    def record_error(self, api: str, model: str, signature: str,
+                     code: int, message: str) -> None:
+        """An error leaving a handler. INTERNAL (the "this should never
+        happen" code) additionally triggers the one-shot dump.
+        `error_digest` is a stable id of the FAILURE MODE (target +
+        code + message with request-varying numbers masked), for
+        grouping/dedup across dumps and log correlation without logging
+        request payloads."""
+        import hashlib
+        import re
+
+        # Mask digits so per-request detail (shapes, ids, counts) in the
+        # exception text doesn't split one failure mode into N digests.
+        mode = re.sub(r"\d+", "#", str(message))[:160]
+        digest = hashlib.blake2s(
+            f"{api}/{model}/{signature}#{code}#{mode}".encode(),
+            digest_size=4).hexdigest()
+        self.record("error", api=api, model=model, signature=signature,
+                    code=int(code), error_digest=digest,
+                    message=str(message)[:300])
+        if int(code) == _INTERNAL:
+            with self._lock:
+                if self._dumped:
+                    return
+                self._dumped = True
+            self.dump(reason="first INTERNAL error")
+
+    def snapshot(self) -> list[tuple]:
+        with self._lock:
+            return list(self._events)
+
+    def to_json(self) -> dict:
+        events = [
+            {"seq": seq, "wall_time": round(ts, 6), "kind": kind,
+             **{k: _jsonable(v) for k, v in fields.items()}}
+            for seq, ts, kind, fields in self.snapshot()
+        ]
+        # servelint: lock-ok maxlen is set once at construction and
+        # never mutated; reading it is race-free
+        return {"capacity": self._events.maxlen, "events": events}
+
+    def dump(self, reason: str = "manual") -> str | None:
+        """Serialize the ring to a JSON file + the log. Never raises —
+        the recorder must not turn one failure into two."""
+        try:
+            with self._lock:
+                dump_dir = self._dump_dir
+            if dump_dir is None:
+                import tempfile
+
+                dump_dir = os.environ.get(
+                    "TPU_SERVING_FLIGHT_DIR", tempfile.gettempdir())
+            os.makedirs(dump_dir, exist_ok=True)
+            payload = self.to_json()
+            payload["reason"] = reason
+            payload["dumped_at"] = time.time()
+            path = os.path.join(
+                dump_dir,
+                f"flight_recorder_{os.getpid()}_{time.time_ns()}.json")
+            with open(path, "w", encoding="utf-8") as f:
+                json.dump(payload, f, indent=1)
+            _log.error(
+                "flight recorder dumped %d events to %s (reason: %s)",
+                len(payload["events"]), path, reason)
+            return path
+        except Exception:  # pragma: no cover - recorder must never raise
+            _log.exception("flight recorder dump failed")
+            return None
+
+    def reset(self) -> None:
+        """Test hook: empty the ring and re-arm the INTERNAL latch."""
+        with self._lock:
+            self._events.clear()
+            self._dumped = False
+
+
+recorder = FlightRecorder()
+
+record = recorder.record
+record_error = recorder.record_error
+snapshot = recorder.snapshot
+to_json = recorder.to_json
+dump = recorder.dump
+configure = recorder.configure
+reset = recorder.reset
+
+
+def record_state_transition(event) -> None:
+    """ServableState bus event -> ring entry (called by the state
+    monitor AFTER it released its own lock)."""
+    try:
+        recorder.record(
+            "state", servable=str(event.id),
+            state=event.manager_state.name,
+            error="" if event.error is None else str(event.error)[:200])
+    except Exception:  # pragma: no cover - sources must never fail callers
+        pass
+
+
+_handler_installed = False
+
+
+def _dump_async(reason: str) -> None:
+    """Dump from a fresh thread. Signal handlers run on the main thread
+    between bytecodes — if SIGUSR2 landed while the main thread held
+    the recorder's (non-reentrant) lock inside record(), an in-handler
+    dump would block on the very lock its own frame holds. The handler
+    therefore only spawns; the thread takes the lock normally."""
+    threading.Thread(target=recorder.dump, kwargs={"reason": reason},
+                     name="flight-recorder-dump", daemon=True).start()
+
+
+def install_signal_handler() -> bool:
+    """SIGUSR2 -> dump. Main-thread only (signal module rule); returns
+    False where that isn't possible (embedded/test threads)."""
+    global _handler_installed
+    if _handler_installed:
+        return True
+    try:
+        signal.signal(
+            signal.SIGUSR2,
+            lambda signum, frame: _dump_async("SIGUSR2"))
+        _handler_installed = True
+        return True
+    except (ValueError, AttributeError, OSError):
+        return False

@@ -1,0 +1,236 @@
+"""Liveness & readiness: one verdict for load balancers, schedulers,
+and humans — `/monitoring/healthz`, `/monitoring/readyz`, and the
+standard `grpc.health.v1.Health` service on the serving port.
+
+Liveness ("is this process worth keeping?") checks the threads that
+would take serving down silently if they died: the batch-scheduler
+worker pool and the manager's reconciliation ticker. Answering the
+probe at all already proves the transport event loop.
+
+Readiness ("should this replica receive traffic?") is the conjunction
+the north-star load balancer needs as ONE signal:
+
+ * every configured model has >= 1 AVAILABLE version per the
+   ServableStateMonitor (AVAILABLE implies warmup ran — warmup executes
+   inside load(), before READY is ever published);
+ * no configured model sits in a load/error limbo with nothing serving;
+ * the SLO burn rate is below the shedding threshold
+   (`--slo_shed_burn_rate`; 0 disables shedding) — a replica burning
+   10x its error budget stops advertising ready so the balancer drains
+   it BEFORE users notice.
+
+The verdict is also exported as the `:tpu/serving/ready` gauge so the
+adaptive scheduler and dashboards consume the same bit the probes see.
+
+The ServerCore registers itself here (weakly) at construction; bare
+cores in tests therefore get working readiness without a full Server.
+"""
+
+from __future__ import annotations
+
+import threading
+import weakref
+
+_lock = threading.Lock()
+_core_ref = None                                   # guarded_by: _lock
+
+
+def register_core(core) -> None:
+    """Called by ServerCore.__init__ (weak — health must not keep a
+    stopped core alive). Last registration wins."""
+    global _core_ref
+    with _lock:
+        _core_ref = weakref.ref(core)
+
+
+def unregister_core(core) -> None:
+    """Called by ServerCore.stop(); only unregisters if `core` is still
+    the current one (tests construct cores in sequence)."""
+    global _core_ref
+    with _lock:
+        if _core_ref is not None and _core_ref() is core:
+            _core_ref = None
+
+
+def _current_core():
+    with _lock:
+        return _core_ref() if _core_ref is not None else None
+
+
+# -- liveness ----------------------------------------------------------------
+
+
+def liveness() -> dict:
+    """{"ok": bool, "checks": {...}} — each check True/False/None
+    (None = subsystem not in use, which is healthy)."""
+    checks: dict[str, object] = {}
+
+    from min_tfs_client_tpu.batching import scheduler as sched_mod
+
+    pool = sched_mod._global_scheduler  # peek; never instantiate for a probe
+    if pool is None:
+        checks["batch_workers"] = None
+    else:
+        checks["batch_workers"] = any(t.is_alive() for t in pool._threads)
+
+    core = _current_core()
+    if core is None:
+        checks["manager_ticker"] = None
+    else:
+        ticker = getattr(core.manager, "_ticker", None)
+        checks["manager_ticker"] = (None if ticker is None
+                                    else ticker.is_alive())
+
+    ok = all(v is not False for v in checks.values())
+    return {"ok": ok, "checks": checks}
+
+
+# -- readiness ---------------------------------------------------------------
+
+
+def readiness(max_burn: float | None = None) -> dict:
+    """{"ready": bool, "models": {...}, "slo": {...}, "reasons": [...]}.
+    `max_burn` lets the Prometheus exporter pass the shed-eligible burn
+    it already computed (slo.export_gauges) instead of re-merging the
+    windows; None computes it fresh."""
+    from min_tfs_client_tpu.core.states import ManagerState
+    from min_tfs_client_tpu.observability import slo
+
+    reasons: list[str] = []
+    models: dict[str, dict] = {}
+    core = _current_core()
+    if core is None:
+        reasons.append("no server core registered")
+    else:
+        for name in core.configured_model_names():
+            versions = core.monitor.versions_of(name)
+            available = sorted(
+                v for v, s in versions.items()
+                if s.manager_state == ManagerState.AVAILABLE)
+            states = {v: s.manager_state.name
+                      for v, s in sorted(versions.items())}
+            models[name] = {"available_versions": available,
+                            "states": states}
+            if not available:
+                reasons.append(f"model {name!r} has no AVAILABLE version")
+
+    # Shed-eligible burn: keys below the shed_min_samples floor are
+    # excluded, so a single failed request at idle cannot drain a
+    # replica (let alone a fleet, one bad request per replica).
+    burn = slo.shed_eligible_burn_rate() if max_burn is None else max_burn
+    shed = slo.shed_burn_rate()
+    slo_detail = {"max_burn_rate": round(burn, 4),
+                  "shed_burn_rate": shed}
+    if shed > 0 and burn >= shed:
+        reasons.append(
+            f"SLO burn rate {burn:.2f} >= shedding threshold {shed:.2f}")
+
+    ready = not reasons
+    verdict = {"ready": ready, "models": models, "slo": slo_detail,
+               "reasons": reasons}
+    _export_ready_gauge(ready)
+    return verdict
+
+
+def _export_ready_gauge(ready: bool) -> None:
+    try:
+        from min_tfs_client_tpu.server import metrics
+
+        metrics.safe_set(metrics.server_ready, 1.0 if ready else 0.0)
+    except Exception:  # pragma: no cover - metrics must not break probes
+        pass
+
+
+def export_gauges(max_burn: float | None = None) -> None:
+    """Refresh the readiness gauge on scrape (prometheus_text hook);
+    `max_burn` reuses the SLO exporter's window merge."""
+    readiness(max_burn)
+
+
+# -- the standard gRPC health protocol, hand-rolled --------------------------
+#
+# grpc.health.v1 is two trivial messages; the checking package is not a
+# dependency of this repo, so the wire format is produced directly:
+#   HealthCheckRequest  { string service = 1; }
+#   HealthCheckResponse { enum ServingStatus status = 1; }  1=SERVING,
+#                                                           2=NOT_SERVING
+
+_SERVING = 1
+_NOT_SERVING = 2
+
+
+def _parse_service(request_bytes: bytes) -> str | None:
+    """Field 1 (length-delimited string) of HealthCheckRequest.
+    Returns "" for an absent field (= whole-server probe) and None for
+    a MALFORMED message (truncated varint, length past the buffer,
+    non-UTF-8) — garbage must not silently read as a healthy whole-
+    server probe."""
+    data = request_bytes or b""
+    if not data:
+        return ""
+    if data[0] != 0x0A:  # field 1, wire type 2
+        return None
+    # varint length (service names are short; 5 bytes bounds 32 bits)
+    length, shift, pos, done = 0, 0, 1, False
+    while pos < len(data) and shift <= 28:
+        byte = data[pos]
+        length |= (byte & 0x7F) << shift
+        pos += 1
+        if not byte & 0x80:
+            done = True
+            break
+        shift += 7
+    if not done or pos + length > len(data):
+        return None
+    try:
+        return data[pos:pos + length].decode("utf-8")
+    except UnicodeDecodeError:
+        return None
+
+
+def _encode_status(status: int) -> bytes:
+    return bytes((0x08, status))  # field 1 varint; status values are < 128
+
+
+def check_service(service: str) -> tuple[bool, int]:
+    """(known, status) for one health-check target. "" = whole server;
+    a configured model name = that model's readiness."""
+    verdict = readiness()
+    if not service:
+        return True, _SERVING if verdict["ready"] else _NOT_SERVING
+    model = verdict["models"].get(service)
+    if model is None:
+        core = _current_core()
+        if core is None or not core.model_exists(service):
+            return False, _NOT_SERVING
+        return True, _NOT_SERVING
+    return True, (_SERVING if model["available_versions"]
+                  else _NOT_SERVING)
+
+
+def grpc_health_handler():
+    """A generic handler implementing grpc.health.v1.Health/Check.
+    Registered on the main serving port (server.py) so standard k8s /
+    envoy / grpc-health-probe tooling works unmodified."""
+    import grpc
+
+    def check(request_bytes, context):
+        service = _parse_service(request_bytes)
+        if service is None:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                          "malformed HealthCheckRequest")
+        known, status = check_service(service)
+        if not known:
+            context.abort(grpc.StatusCode.NOT_FOUND,
+                          "unknown service for health check")
+        return _encode_status(status)
+
+    handlers = {
+        "Check": grpc.unary_unary_rpc_method_handler(
+            check,
+            request_deserializer=None,   # raw bytes in
+            response_serializer=None,    # raw bytes out
+        ),
+    }
+    return grpc.method_handlers_generic_handler(
+        "grpc.health.v1.Health", handlers)

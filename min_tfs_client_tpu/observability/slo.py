@@ -1,0 +1,388 @@
+"""Per-(model, signature, api) SLO tracking: rolling latency quantiles,
+error-rate windows, and burn-rate computation.
+
+The reference stack stops at raw counters/samplers; operating a fleet
+needs the derived layer — "which model is burning its latency budget?" —
+answered live. Three pieces:
+
+ * a fixed-bucket LOG histogram (`_LOG_BOUNDS`): recording a sample is
+   one integer bucket index from `math.log` (O(1), no allocation), and
+   any quantile is one cumulative walk over ~80 ints. Accuracy is
+   bounded by the bucket growth factor (1.35 ⇒ a quantile estimate is
+   within ±16% of the true value — the geometric midpoint of the
+   matched bucket is returned), which is the right trade for burn-rate
+   alerting: SLO decisions care about 2x/10x excursions, not 5%.
+ * a rolling window of K slices (default 6 x 10s): each slice holds one
+   histogram + error/over-objective counters; `record` touches only the
+   current slice, queries merge the live slices, and rotation is a
+   pointer bump + array zero — no per-sample timestamps retained.
+ * objectives (`SLOConfig`): a latency objective at a quantile plus an
+   error budget; burn rate = observed burn / allowed burn over the
+   window. burn 1.0 = exactly consuming budget; >1 = over. The max of
+   the latency and error burn feeds the readiness verdict
+   (observability/health.py) and the shedding threshold.
+
+Samples are recorded OFF the hot path: tracing.py's deferred-export
+drain thread calls `observe_trace` for every finished RequestTrace, so
+the request path pays nothing beyond the spans it already records.
+Synchronous readers (the `/monitoring/slo` endpoint, the Prometheus
+exporter) call `tracing.flush_metrics()` first for read-your-writes.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+from dataclasses import dataclass
+
+# Bucket i spans [_LOG_BASE * _LOG_GROWTH**i, next) microseconds. 80
+# buckets at 1.35 growth cover 1us .. ~2.9e10us (~8 hours) — every
+# latency a serving path can produce lands in a real bucket.
+_LOG_BASE = 1.0
+_LOG_GROWTH = 1.35
+_LOG_COUNT = 80
+_INV_LOG_GROWTH = 1.0 / math.log(_LOG_GROWTH)
+_LOG_BOUNDS = tuple(_LOG_BASE * _LOG_GROWTH ** i for i in range(_LOG_COUNT))
+
+
+def _bucket_index(value_us: float) -> int:
+    """Bucket i spans [G**i, G**(i+1)) microseconds."""
+    if value_us <= _LOG_BASE:
+        return 0
+    idx = int(math.log(value_us / _LOG_BASE) * _INV_LOG_GROWTH)
+    return idx if idx < _LOG_COUNT else _LOG_COUNT - 1
+
+
+def _bucket_value_us(idx: int) -> float:
+    """Representative latency for a bucket: the geometric midpoint (the
+    estimate's error is then symmetric in log space)."""
+    lo = _LOG_BOUNDS[idx]
+    return lo * math.sqrt(_LOG_GROWTH)
+
+
+@dataclass(frozen=True)
+class SLOConfig:
+    """One (model's) objective set. latency_objective_ms at
+    latency_quantile (e.g. p99 <= 200ms) plus an error budget (allowed
+    error fraction). shed_burn_rate: readiness drops when the max burn
+    rate crosses this (0 = never shed).
+
+    Known limits (deliberate, documented): the shed_* fields are read
+    from the DEFAULT config only — per-model overrides steer objectives
+    (latency/error budgets, windows) but not the shedding decision; and
+    the rolling window has a 0.5s slice floor, so window_s below 3s is
+    effectively stretched to ~3s while snapshot() reports the
+    configured value. Neither is reachable from the server flags (which
+    set only the default config, with a 60s window)."""
+
+    latency_objective_ms: float = 1000.0
+    latency_quantile: float = 0.99
+    error_budget: float = 0.01
+    window_s: float = 60.0
+    shed_burn_rate: float = 0.0
+    # A key must carry at least this many window samples before its burn
+    # can shed readiness: at near-idle traffic one failed request is
+    # burn = 1/total/budget = enormous, and shedding the replica (then
+    # the fleet, if a client sprays one bad request per replica) on a
+    # single sample is exactly the wrong move.
+    shed_min_samples: int = 20
+
+    def allowed_slow_fraction(self) -> float:
+        return max(1e-6, 1.0 - self.latency_quantile)
+
+
+class _Slice:
+    """One time slice of the rolling window."""
+
+    __slots__ = ("counts", "total", "errors", "over", "sum_us")
+
+    def __init__(self):
+        self.counts = [0] * _LOG_COUNT
+        self.total = 0
+        self.errors = 0
+        self.over = 0      # samples over the latency objective
+        self.sum_us = 0.0
+
+    def reset(self) -> None:
+        counts = self.counts
+        for i in range(_LOG_COUNT):
+            counts[i] = 0
+        self.total = 0
+        self.errors = 0
+        self.over = 0
+        self.sum_us = 0.0
+
+
+class _WindowedStats:
+    """Rolling-window latency/error stats for ONE (model, signature,
+    api) key. All methods are called with the tracker lock held."""
+
+    __slots__ = ("slices", "slice_s", "current", "current_start")
+
+    def __init__(self, window_s: float, num_slices: int = 6):
+        self.slices = [_Slice() for _ in range(num_slices)]
+        self.slice_s = max(0.5, window_s / num_slices)
+        self.current = 0
+        self.current_start = time.monotonic()
+
+    def _advance(self, now: float) -> None:
+        # Rotate forward as many slices as wall time demands; each
+        # rotation retires the oldest slice by zeroing it in place.
+        steps = int((now - self.current_start) / self.slice_s)
+        if steps <= 0:
+            return
+        for _ in range(min(steps, len(self.slices))):
+            self.current = (self.current + 1) % len(self.slices)
+            self.slices[self.current].reset()
+        self.current_start += steps * self.slice_s
+
+    def record(self, now: float, latency_us: float, ok: bool,
+               objective_us: float) -> None:
+        self._advance(now)
+        sl = self.slices[self.current]
+        sl.counts[_bucket_index(latency_us)] += 1
+        sl.total += 1
+        sl.sum_us += latency_us
+        if not ok:
+            sl.errors += 1
+        if latency_us > objective_us:
+            sl.over += 1
+
+    def merged(self, now: float) -> tuple[list[int], int, int, int, float]:
+        self._advance(now)
+        counts = [0] * _LOG_COUNT
+        total = errors = over = 0
+        sum_us = 0.0
+        for sl in self.slices:
+            sc = sl.counts
+            for i in range(_LOG_COUNT):
+                counts[i] += sc[i]
+            total += sl.total
+            errors += sl.errors
+            over += sl.over
+            sum_us += sl.sum_us
+        return counts, total, errors, over, sum_us
+
+
+def _quantile_us(counts: list[int], total: int, q: float) -> float:
+    if total <= 0:
+        return 0.0
+    target = max(1, math.ceil(q * total))
+    cum = 0
+    for i in range(_LOG_COUNT):
+        cum += counts[i]
+        if cum >= target:
+            return _bucket_value_us(i)
+    return _bucket_value_us(_LOG_COUNT - 1)
+
+
+# Hard cap on tracked (model, signature, api) keys. Model names arrive
+# straight from client requests (a NOT_FOUND trace still finishes), so
+# without a cap a client spraying random names grows tracker memory and
+# Prometheus label cardinality without bound. Real deployments track a
+# few dozen keys; beyond the cap, NEW keys are dropped (counted) while
+# established keys keep recording.
+_MAX_TRACKED_KEYS = 512
+
+
+class SLOTracker:
+    """The per-key registry. record() is called by the tracing drain
+    thread (already off the request path); snapshot()/export_gauges()
+    by monitoring readers — one uncontended lock covers both."""
+
+    def __init__(self, default: SLOConfig | None = None):
+        self._lock = threading.Lock()
+        self._default = default or SLOConfig()    # guarded_by: self._lock
+        self._per_model: dict[str, SLOConfig] = {}  # guarded_by: self._lock
+        # (model, signature, api) -> _WindowedStats
+        self._stats: dict[tuple, _WindowedStats] = {}  # guarded_by: self._lock
+        self._dropped_keys = 0                    # guarded_by: self._lock
+
+    def configure(self, default: SLOConfig | None = None,
+                  per_model: dict[str, SLOConfig] | None = None) -> None:
+        with self._lock:
+            if default is not None:
+                self._default = default
+            if per_model is not None:
+                self._per_model = dict(per_model)
+            # Objectives changed: restart the windows so the per-sample
+            # `over` counters all reflect ONE objective.
+            self._stats.clear()
+            self._dropped_keys = 0
+
+    def config_for(self, model: str) -> SLOConfig:
+        with self._lock:
+            return self._per_model.get(model, self._default)
+
+    def record(self, model: str, signature: str, api: str,
+               latency_s: float, ok: bool) -> None:
+        key = (model, signature, api)
+        latency_us = latency_s * 1e6
+        with self._lock:
+            cfg = self._per_model.get(model, self._default)
+            stats = self._stats.get(key)
+            if stats is None:
+                if len(self._stats) >= _MAX_TRACKED_KEYS:
+                    self._dropped_keys += 1
+                    return
+                stats = self._stats[key] = _WindowedStats(cfg.window_s)
+            stats.record(time.monotonic(), latency_us, ok,
+                         cfg.latency_objective_ms * 1e3)
+
+    def snapshot(self) -> dict:
+        """The `/monitoring/slo` payload: objectives + one entry per
+        (model, signature, api) with window quantiles and burn rates."""
+        now = time.monotonic()
+        entries = []
+        with self._lock:
+            default = self._default
+            per_model = dict(self._per_model)
+            dropped = self._dropped_keys
+            keyed = [(key, stats.merged(now))
+                     for key, stats in sorted(self._stats.items())]
+        for (model, signature, api), (counts, total, errors, over,
+                                      sum_us) in keyed:
+            cfg = per_model.get(model, default)
+            entry = {
+                "model": model, "signature": signature, "api": api,
+                "window_s": cfg.window_s, "count": total,
+                "error_count": errors,
+                "objective": {
+                    "latency_ms": cfg.latency_objective_ms,
+                    "quantile": cfg.latency_quantile,
+                    "error_budget": cfg.error_budget,
+                },
+            }
+            if total:
+                entry.update(
+                    error_ratio=round(errors / total, 6),
+                    mean_ms=round(sum_us / total / 1e3, 4),
+                    p50_ms=round(_quantile_us(counts, total, 0.5) / 1e3, 4),
+                    p90_ms=round(_quantile_us(counts, total, 0.9) / 1e3, 4),
+                    p99_ms=round(_quantile_us(counts, total, 0.99) / 1e3, 4),
+                    slow_fraction=round(over / total, 6),
+                )
+                error_burn = (errors / total) / max(1e-9, cfg.error_budget)
+                latency_burn = (over / total) / cfg.allowed_slow_fraction()
+                entry["burn_rate"] = {
+                    "error": round(error_burn, 4),
+                    "latency": round(latency_burn, 4),
+                    "max": round(max(error_burn, latency_burn), 4),
+                }
+            entries.append(entry)
+        return {
+            "default_objective": {
+                "latency_ms": default.latency_objective_ms,
+                "quantile": default.latency_quantile,
+                "error_budget": default.error_budget,
+                "window_s": default.window_s,
+                "shed_burn_rate": default.shed_burn_rate,
+            },
+            "dropped_keys": dropped,
+            "entries": entries,
+        }
+
+    def max_burn_rate(self, min_count: int = 0,
+                      entries=None) -> float:
+        """The worst burn rate across tracked keys. `min_count` filters
+        keys with too few window samples (the shedding eligibility
+        floor); `entries` reuses an already-built snapshot so a scrape
+        pays for ONE window merge. 0.0 when nothing qualifies."""
+        if entries is None:
+            entries = self.snapshot()["entries"]
+        worst = 0.0
+        for entry in entries:
+            burn = entry.get("burn_rate")
+            if burn and entry["count"] >= min_count \
+                    and burn["max"] > worst:
+                worst = burn["max"]
+        return worst
+
+    def export_gauges(self) -> float:
+        """Mirror the window stats into Prometheus gauges (called by the
+        exporter right before serialization, like flush_metrics).
+        Returns the shed-eligible max burn rate computed from the same
+        snapshot, so the readiness-gauge refresh that follows does not
+        re-merge the windows. Keys whose window emptied export ZEROS —
+        a burn gauge must clear when the burn clears, not freeze at its
+        last bad value until the next request."""
+        entries = self.snapshot()["entries"]
+        try:
+            from min_tfs_client_tpu.server import metrics
+
+            for entry in entries:
+                labels = (entry["model"], entry["signature"], entry["api"])
+                burn = entry.get("burn_rate",
+                                 {"error": 0.0, "latency": 0.0})
+                metrics.safe_set(metrics.slo_latency_ms,
+                                 entry.get("p50_ms", 0.0), *labels, "0.5")
+                metrics.safe_set(metrics.slo_latency_ms,
+                                 entry.get("p99_ms", 0.0), *labels, "0.99")
+                metrics.safe_set(metrics.slo_error_ratio,
+                                 entry.get("error_ratio", 0.0), *labels)
+                metrics.safe_set(metrics.slo_burn_rate,
+                                 burn["error"], *labels, "error")
+                metrics.safe_set(metrics.slo_burn_rate,
+                                 burn["latency"], *labels, "latency")
+        except Exception:  # pragma: no cover - metrics must not break serving
+            pass
+        with self._lock:
+            min_count = self._default.shed_min_samples
+        return self.max_burn_rate(min_count=min_count, entries=entries)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._stats.clear()
+            self._dropped_keys = 0
+
+
+tracker = SLOTracker()
+
+
+def configure(default: SLOConfig | None = None,
+              per_model: dict[str, SLOConfig] | None = None) -> None:
+    tracker.configure(default, per_model)
+
+
+# Status codes whose errors are the CLIENT's fault (malformed request,
+# unknown model): they spend no server error budget — a client spraying
+# bad requests must not be able to shed the fleet's readiness. They do
+# still count as latency samples.
+_CLIENT_FAULT_CODES = frozenset(("3", "5"))  # INVALID_ARGUMENT, NOT_FOUND
+
+
+def observe_trace(trace) -> None:
+    """Feed one finished RequestTrace into the tracker. Runs on the
+    tracing drain thread (observability/tracing.py _export_metrics) —
+    never on the request path."""
+    ok = trace.status == "0" or trace.status in _CLIENT_FAULT_CODES
+    tracker.record(trace.model or "unknown", trace.signature or "",
+                   trace.api, trace.duration_s(), ok)
+
+
+def snapshot() -> dict:
+    return tracker.snapshot()
+
+
+def max_burn_rate() -> float:
+    return tracker.max_burn_rate()
+
+
+def shed_eligible_burn_rate(entries=None) -> float:
+    """Max burn over keys with enough window samples to shed on."""
+    return tracker.max_burn_rate(
+        min_count=tracker.config_for("").shed_min_samples,
+        entries=entries)
+
+
+def shed_burn_rate() -> float:
+    return tracker.config_for("").shed_burn_rate
+
+
+def export_gauges() -> float:
+    return tracker.export_gauges()
+
+
+def reset() -> None:
+    tracker.reset()

@@ -277,8 +277,21 @@ class request_trace:
         if self._ann is not None:
             self._ann.__exit__(exc_type, exc, tb)
         _current.reset(self._token)
-        self._trace.finish(
-            status="0" if exc is None else str(getattr(exc, "code", 2)))
+        if exc is None:
+            status = "0"
+        else:
+            # The SAME mapping the transports apply to the wire
+            # (error_from_exception): a raw ValueError must record as
+            # INVALID_ARGUMENT here too, or the SLO tracker would bill a
+            # client-fault request to the server's error budget and a
+            # malformed-request spray could shed readiness. Error path
+            # only — the import never taxes a healthy request.
+            from min_tfs_client_tpu.utils.status import (
+                error_from_exception,
+            )
+
+            status = str(error_from_exception(exc).code)
+        self._trace.finish(status=status)
         return False
 
 
@@ -374,6 +387,14 @@ def flush_metrics() -> None:
 
 
 def _export_metrics(trace: RequestTrace) -> None:
+    try:
+        # SLO windows ingest every finished trace here, on the drain
+        # thread — the request path records spans and nothing else.
+        from min_tfs_client_tpu.observability import slo
+
+        slo.observe_trace(trace)
+    except Exception:  # pragma: no cover - SLO must not break serving
+        pass
     try:
         from min_tfs_client_tpu.server import metrics
 

@@ -39,4 +39,4 @@ def curry_signature(signature: Signature,
     # signature loses the shared-leading-batch-dim property.
     return dataclasses.replace(
         signature, fn=fn, inputs=remaining, batched=False, _jitted=None,
-        _resolved_fn=None)
+        _exec_wrapped=None, _resolved_fn=None)

@@ -117,6 +117,7 @@ def make_loader(
                            if alias in sb.pad_values})
                 sig.sequence_bucketing = dataclasses.replace(sb, **changes)
                 sig._jitted = None
+                sig._exec_wrapped = None
         # Warmup runs against the bare signatures, BEFORE the batching
         # wrapper: replaying through the batch queue would stall each record
         # up to batch_timeout (the reference replays directly against the

@@ -23,7 +23,7 @@ from typing import Callable, Mapping, Optional, Sequence
 
 import numpy as np
 
-from min_tfs_client_tpu.observability import tracing
+from min_tfs_client_tpu.observability import runtime, tracing
 from min_tfs_client_tpu.protos import tf_graph_pb2, tfs_apis_pb2
 from min_tfs_client_tpu.tensor.dtypes import DataType
 from min_tfs_client_tpu.tensor.example_codec import FeatureSpec
@@ -193,7 +193,15 @@ class Signature:
     mesh: Optional[object] = dc_field(default=None, repr=False,
                                       compare=False)
 
+    # "model:version:signature", stamped by Servable.__init__ — keys the
+    # compile-event ledger (observability/runtime.py).
+    telemetry_label: str = ""
+
     _jitted: Callable | None = dc_field(default=None, repr=False, compare=False)
+    # jitted() + the compile-ledger probe, wrapped ONCE (the hit path
+    # must not allocate thunks); cleared wherever _jitted is cleared.
+    _exec_wrapped: Callable | None = dc_field(default=None, repr=False,
+                                              compare=False)
     _resolved_fn: Callable | None = dc_field(default=None, repr=False,
                                              compare=False)
 
@@ -247,9 +255,20 @@ class Signature:
         return fn
 
     def _execute(self, arrays: dict) -> dict:
+        # Compile-event ledger: the instrument_jit wrapper (cached next
+        # to _jitted) detects cache misses via _cache_size()
+        # (~0.04us/read) and builds the shape-bucket string only when a
+        # compile actually happened; the hit path is one attribute read
+        # and a direct call — no per-request thunks.
+        fn = self._exec_wrapped
+        if fn is None:
+            fn = self._exec_wrapped = runtime.instrument_jit(
+                self.telemetry_label or "unlabeled", self.jitted(),
+                # the arrays dict is always the LAST positional arg
+                bucket_fn=lambda args: runtime.shape_bucket(args[-1]))
         if self.params is not None:
-            return self.jitted()(self.params, arrays)
-        return self.jitted()(arrays)
+            return fn(self.params, arrays)
+        return fn(arrays)
 
     def _data_axis_size(self) -> int:
         if self.mesh is None:
@@ -544,10 +563,11 @@ class Signature:
         # All-or-none on TOTAL bytes: the ~0.2 ms plumbing is per call,
         # and a placed/unplaced split would exclude arrays from the one
         # overlapped DMA while still paying the call.
-        if not dense or sum(v.nbytes for v in dense.values()) \
-                < cls._PLACE_MIN_BYTES:
+        total_bytes = sum(v.nbytes for v in dense.values())
+        if not dense or total_bytes < cls._PLACE_MIN_BYTES:
             return dict(arrays)
         placed = jax.device_put(dense)
+        runtime.count_transfer("host_to_device", total_bytes)
         return {k: placed.get(k, arrays[k]) for k in arrays}
 
     def _cast_transfers(self, arrays: dict[str, np.ndarray]) -> dict:
@@ -567,6 +587,8 @@ class Signature:
         load-time shardings, activations follow the data."""
         from min_tfs_client_tpu.parallel.mesh import shard_batch
 
+        runtime.count_transfer("host_to_device", sum(
+            getattr(v, "nbytes", 0) for v in arrays.values()))
         return shard_batch(self.mesh, arrays)
 
     def round_up_batch(self, batch: int) -> int:
@@ -618,14 +640,17 @@ def fetch_outputs(outputs: Mapping[str, object],
             except Exception:  # pragma: no cover - fall back to sync copy
                 pass
     result = {}
+    fetched_bytes = 0
     for key, value in outputs.items():
         # servelint: sync-ok THE sanctioned device->host materialization:
         # every async copy above is already in flight, so this wall-clock
         # cost is max(transfer), not a serialized sum
         arr = np.asarray(value)
+        fetched_bytes += arr.nbytes  # pre-slice: what crossed the link
         if batch is not None and arr.ndim:
             arr = arr[:batch]
         result[key] = arr
+    runtime.count_transfer("device_to_host", fetched_bytes)
     return result
 
 
@@ -646,6 +671,9 @@ class Servable:
         self.name = name
         self.version = version
         self.signatures = dict(signatures)
+        for key, sig in self.signatures.items():
+            if not sig.telemetry_label:
+                sig.telemetry_label = f"{name}:{version}:{key}"
         self.hbm_estimate_bytes = hbm_estimate_bytes
         self.warmup_records = list(warmup_records)
         # Compiled union executables for MultiInference, keyed by the
@@ -734,7 +762,9 @@ class Servable:
         else:
             arrays = Signature._place(arrays)
         params_map = {k: s.params for k, s in sigs.items()}
-        nested = fused(params_map, arrays)
+        nested = runtime.ledgered_call(
+            f"{self.name}:{self.version}:union[{'+'.join(keys)}]",
+            fused, lambda: fused(params_map, arrays), arrays)
         # Single overlapped fetch across every task's outputs.
         flat = {(k, alias): v for k, outs in nested.items()
                 for alias, v in outs.items()}
@@ -749,6 +779,7 @@ class Servable:
         self._union_jits.clear()
         for sig in self.signatures.values():
             sig._jitted = None
+            sig._exec_wrapped = None
 
 
 def attach_mesh(signatures, mesh, *, only_if_absent: bool = False):
@@ -777,4 +808,5 @@ def attach_mesh(signatures, mesh, *, only_if_absent: bool = False):
         if sig.mesh is not mesh:
             sig.mesh = mesh
             sig._jitted = None  # re-trace with the new placement
+            sig._exec_wrapped = None
     return signatures

@@ -66,3 +66,14 @@ class ModelServiceImpl(gs.ModelServiceServicer):
 
     def HandleReloadConfigRequest(self, request, context):
         return _guard(self._handlers.handle_reload_config, request, context)
+
+
+def health_service_handler():
+    """grpc.health.v1.Health on the serving port: the readiness verdict
+    (observability/health.py) behind the standard probe protocol, so
+    k8s / envoy / grpc-health-probe work against this server with zero
+    extra deps (the wire format is hand-rolled — two one-field
+    messages). Registered by server.py via add_generic_rpc_handlers."""
+    from min_tfs_client_tpu.observability import health
+
+    return health.grpc_health_handler()

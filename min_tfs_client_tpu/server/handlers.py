@@ -75,9 +75,26 @@ def _instrumented(api: str):
                                    if spec is not None else "")):
                     response = fn(self, request)
             except Exception as exc:
-                err = ServingError if isinstance(exc, ServingError) else None
-                code = exc.code if err else 2
+                # Same mapping the transports apply to the wire status
+                # (error_from_exception): an unexpected RuntimeError IS
+                # an INTERNAL to the client, so it must count — and
+                # trigger the flight-recorder dump — as one here too.
+                from min_tfs_client_tpu.utils.status import (
+                    error_from_exception,
+                )
+
+                code = error_from_exception(exc).code
                 metrics.request_count.increment(api, str(code))
+                # Black-box ring entry (and the one-shot dump when the
+                # code is INTERNAL): every transport funnels through
+                # here, so this is THE error tap.
+                from min_tfs_client_tpu.observability import flight_recorder
+
+                flight_recorder.record_error(
+                    api,
+                    spec.name if spec is not None else "",
+                    spec.signature_name if spec is not None else "",
+                    code, str(exc))
                 raise
             metrics.request_count.increment(api, "0")
             metrics.request_latency.observe(

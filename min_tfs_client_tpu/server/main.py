@@ -121,6 +121,23 @@ def build_parser() -> argparse.ArgumentParser:
                         "the reference's unconditional check; pass =false "
                         "to let any signature with Example feature specs "
                         "serve either API)")
+    p.add_argument("--slo_latency_objective_ms", type=float, default=1000.0,
+                   help="default per-model latency objective at "
+                        "--slo_latency_quantile (health plane; "
+                        "docs/OBSERVABILITY.md)")
+    p.add_argument("--slo_latency_quantile", type=float, default=0.99,
+                   help="quantile the latency objective applies to")
+    p.add_argument("--slo_error_budget", type=float, default=0.01,
+                   help="allowed error fraction over the SLO window")
+    p.add_argument("--slo_window_seconds", type=float, default=60.0,
+                   help="rolling window for SLO quantiles and burn rates")
+    p.add_argument("--slo_shed_burn_rate", type=float, default=0.0,
+                   help="readiness sheds when the max SLO burn rate "
+                        "reaches this (0 disables shedding)")
+    p.add_argument("--flight_recorder_dir", default="",
+                   help="directory for flight-recorder JSON dumps "
+                        "(first INTERNAL error / SIGUSR2); empty = "
+                        "TPU_SERVING_FLIGHT_DIR or the system tempdir")
     p.add_argument("--version", action="store_true",
                    help="print the server version and exit")
     return p
@@ -167,6 +184,12 @@ def options_from_args(args) -> ServerOptions:
         flush_filesystem_caches=args.flush_filesystem_caches,
         enable_signature_method_name_check=(
             args.enable_signature_method_name_check),
+        slo_latency_objective_ms=args.slo_latency_objective_ms,
+        slo_latency_quantile=args.slo_latency_quantile,
+        slo_error_budget=args.slo_error_budget,
+        slo_window_seconds=args.slo_window_seconds,
+        slo_shed_burn_rate=args.slo_shed_burn_rate,
+        flight_recorder_dir=args.flight_recorder_dir,
     )
 
 

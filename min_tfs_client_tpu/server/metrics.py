@@ -167,6 +167,41 @@ partition_calibration_failures = Counter(
     "Batch-1 calibration probes that failed; the dim-match heuristic "
     "stays in effect for the affected signature.", ("model",))
 
+# -- health-plane metrics (observability/slo.py, health.py, runtime.py) ------
+server_ready = Gauge(
+    ":tpu/serving/ready",
+    "Readiness verdict (1 = every configured model AVAILABLE and SLO "
+    "burn below the shedding threshold) — the one signal load "
+    "balancers and the adaptive scheduler consume.", ())
+slo_latency_ms = Gauge(
+    ":tpu/serving/slo_latency_ms",
+    "Rolling-window latency quantile estimate in milliseconds, by "
+    "model, signature, API, and quantile (log-histogram estimate, "
+    "docs/OBSERVABILITY.md).", ("model", "signature", "api", "quantile"))
+slo_error_ratio = Gauge(
+    ":tpu/serving/slo_error_ratio",
+    "Rolling-window server-fault error fraction, by model, signature, "
+    "and API.", ("model", "signature", "api"))
+slo_burn_rate = Gauge(
+    ":tpu/serving/slo_burn_rate",
+    "Observed burn over allowed burn for the window (1.0 = consuming "
+    "exactly the budget), by model, signature, API, and kind "
+    "(error|latency).", ("model", "signature", "api", "kind"))
+compile_wall_time = Histogram(
+    ":tpu/serving/compile_wall_time",
+    "Wall time of one XLA compilation (jit cache miss) in "
+    "microseconds, by model.", ("model",),
+    buckets=exponential_buckets(1000, 2.0, 24))
+transfer_bytes = Counter(
+    ":tpu/serving/transfer_bytes",
+    "Host<->device link traffic from the explicit transfer paths "
+    "(device_put placement, overlapped output fetch), by direction.",
+    ("direction",))
+request_log_count = Counter(
+    ":tensorflow/serving/request_log_count",
+    "Request-log sampling outcomes, by model and outcome "
+    "(logged | sampled_out | dropped).", ("model", "outcome"))
+
 
 def safe_set(gauge: Gauge, value: float, *labels) -> None:
     """Set a gauge without ever letting metrics break serving (the one
@@ -189,6 +224,16 @@ def prometheus_text() -> str:
         from min_tfs_client_tpu.observability.tracing import flush_metrics
 
         flush_metrics()
+    except Exception:  # pragma: no cover - exporter must always serialize
+        pass
+    try:
+        # Derived health-plane gauges refresh at scrape time: SLO window
+        # quantiles/burn and the readiness verdict. The SLO exporter
+        # returns the shed-eligible burn from ITS window merge so the
+        # readiness refresh doesn't repeat it.
+        from min_tfs_client_tpu.observability import health, slo
+
+        health.export_gauges(max_burn=slo.export_gauges())
     except Exception:  # pragma: no cover - exporter must always serialize
         pass
     lines: list[str] = []

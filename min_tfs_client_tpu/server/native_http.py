@@ -11,8 +11,11 @@ re-acquires it inside callbacks, so N native workers overlap wherever the
 handler blocks in native code (device waits, protobuf C++ parsing).
 
 Because the router is shared, the monitoring surfaces — the Prometheus
-text endpoint and the `/monitoring/traces` Chrome-trace debug endpoint
-(observability/tracing.py ring) — are served by BOTH backends identically.
+text endpoint, the `/monitoring/traces` Chrome-trace debug endpoint
+(observability/tracing.py ring), and the health plane
+(`/monitoring/healthz`, `/monitoring/readyz`, `/monitoring/slo`,
+`/monitoring/runtime`, `/monitoring/flightrecorder`;
+docs/OBSERVABILITY.md) — are served by BOTH backends identically.
 
 Falls back to the pure-Python `http.server` backend when the toolchain is
 unavailable (`start_best_rest_server`).

@@ -20,18 +20,22 @@ from __future__ import annotations
 
 import contextlib
 import functools
+import logging
 import threading
 from typing import Optional
 
 _lock = threading.Lock()
-_server = None
-_server_port: Optional[int] = None
+_server = None                                     # guarded_by: _lock
+_server_port: Optional[int] = None                 # guarded_by: _lock
+_last_error: Optional[str] = None                  # guarded_by: _lock
 
 
 def start_profiler_server(port: int) -> bool:
     """Start the in-process profiler gRPC server (idempotent). Returns True
-    when the server is (already) running on `port`."""
-    global _server, _server_port
+    when the server is (already) running on `port`. A failure logs a
+    structured warning (and is reported by `status()` /
+    `/monitoring/runtime`) — never a silent False."""
+    global _server, _server_port, _last_error
     with _lock:
         if _server is not None:
             return _server_port == port
@@ -40,16 +44,29 @@ def start_profiler_server(port: int) -> bool:
 
             _server = jax.profiler.start_server(port)
             _server_port = port
+            _last_error = None
             return True
-        except Exception:  # pragma: no cover - profiler lib unavailable
+        except Exception as exc:  # pragma: no cover - profiler unavailable
             _server = None
             _server_port = None
+            _last_error = f"{type(exc).__name__}: {exc}"
+            logging.getLogger(__name__).warning(
+                "profiler server failed to start on port %d: %s — "
+                "on-demand trace capture will be unavailable",
+                port, _last_error)
             return False
 
 
 def profiler_port() -> Optional[int]:
     with _lock:
         return _server_port
+
+
+def status() -> dict:
+    """Profiler-server state for the `/monitoring/runtime` payload."""
+    with _lock:
+        return {"running": _server is not None, "port": _server_port,
+                "last_error": _last_error}
 
 
 def trace(name: str, **kwargs):

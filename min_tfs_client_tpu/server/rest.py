@@ -40,6 +40,14 @@ PROMETHEUS_DEFAULT_PATH = "/monitoring/prometheus/metrics"
 # ?limit=N (most recent N traces), ?summary=1 (per-stage p50/p99 table
 # instead of the timeline).
 TRACES_DEFAULT_PATH = "/monitoring/traces"
+# Health-plane endpoints (observability/{health,slo,runtime,
+# flight_recorder}.py; docs/OBSERVABILITY.md "Health plane"). Served by
+# BOTH REST backends — the router below is shared with native_http.py.
+HEALTHZ_PATH = "/monitoring/healthz"
+READYZ_PATH = "/monitoring/readyz"
+SLO_PATH = "/monitoring/slo"
+RUNTIME_PATH = "/monitoring/runtime"
+FLIGHT_RECORDER_PATH = "/monitoring/flightrecorder"
 
 
 def _fill_spec(spec: apis.ModelSpec, m: re.Match) -> None:
@@ -188,6 +196,8 @@ def _route(
             bare, _, query = path.partition("?")
             if bare == TRACES_DEFAULT_PATH:
                 return _traces_reply(query)
+            if bare in _MONITORING_ROUTES:
+                return _MONITORING_ROUTES[bare](query)
             m = _METADATA_PATH.match(path)
             if m:
                 request = apis.GetModelMetadataRequest()
@@ -268,6 +278,62 @@ def _traces_reply(query: str) -> tuple[int, str, bytes]:
     else:
         payload = tracing.chrome_trace(traces)
     return _json_reply(200, payload)
+
+
+def _healthz_reply(query: str) -> tuple[int, str, bytes]:
+    """GET /monitoring/healthz — liveness. 200 while the process can
+    serve at all; 503 when a load-bearing thread pool died."""
+    from min_tfs_client_tpu.observability import health
+
+    verdict = health.liveness()
+    return _json_reply(200 if verdict["ok"] else 503, verdict)
+
+
+def _readyz_reply(query: str) -> tuple[int, str, bytes]:
+    """GET /monitoring/readyz — readiness: all configured models
+    AVAILABLE (warmup included) and SLO burn below the shedding
+    threshold. 503 + reasons while not ready."""
+    from min_tfs_client_tpu.observability import health
+
+    verdict = health.readiness()
+    return _json_reply(200 if verdict["ready"] else 503, verdict)
+
+
+def _slo_reply(query: str) -> tuple[int, str, bytes]:
+    """GET /monitoring/slo — per-(model, signature, api) window
+    quantiles, error ratios, and burn rates as JSON."""
+    from min_tfs_client_tpu.observability import slo, tracing
+
+    tracing.flush_metrics()  # read-your-writes for just-finished requests
+    return _json_reply(200, slo.snapshot())
+
+
+def _runtime_reply(query: str) -> tuple[int, str, bytes]:
+    """GET /monitoring/runtime[?live_arrays=1] — compile ledger, HBM
+    accounting, transfer counters, profiler status."""
+    from urllib.parse import parse_qs
+
+    from min_tfs_client_tpu.observability import runtime
+
+    params = parse_qs(query)
+    live = params.get("live_arrays", [""])[0] not in ("", "0")
+    return _json_reply(200, runtime.snapshot(include_live_arrays=live))
+
+
+def _flight_recorder_reply(query: str) -> tuple[int, str, bytes]:
+    """GET /monitoring/flightrecorder — the live event ring as JSON."""
+    from min_tfs_client_tpu.observability import flight_recorder
+
+    return _json_reply(200, flight_recorder.to_json())
+
+
+_MONITORING_ROUTES = {
+    HEALTHZ_PATH: _healthz_reply,
+    READYZ_PATH: _readyz_reply,
+    SLO_PATH: _slo_reply,
+    RUNTIME_PATH: _runtime_reply,
+    FLIGHT_RECORDER_PATH: _flight_recorder_reply,
+}
 
 
 def _parse_predict_fast(body_bytes: bytes):

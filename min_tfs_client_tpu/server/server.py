@@ -123,6 +123,20 @@ class ServerOptions:
     # the signature's method_name matches the API called; false relaxes
     # it so any signature with Example feature specs serves either API.
     enable_signature_method_name_check: bool = True
+    # -- health plane (observability/; docs/OBSERVABILITY.md) ------------
+    # Default SLO objective: latency_objective at latency_quantile (e.g.
+    # p99 <= 1000ms) and the allowed error fraction, computed over a
+    # rolling window. Burn rate 1.0 = consuming exactly the budget.
+    slo_latency_objective_ms: float = 1000.0
+    slo_latency_quantile: float = 0.99
+    slo_error_budget: float = 0.01
+    slo_window_seconds: float = 60.0
+    # Readiness sheds (readyz 503, grpc NOT_SERVING, ready gauge 0) when
+    # the max burn rate reaches this; 0 disables shedding.
+    slo_shed_burn_rate: float = 0.0
+    # Flight-recorder dump directory ("" = TPU_SERVING_FLIGHT_DIR env or
+    # the system tempdir).
+    flight_recorder_dir: str = ""
 
     def effective_inter_op_parallelism(self) -> int:
         """<= 0 = auto (leave grpc_max_threads alone; TF spells auto as
@@ -225,6 +239,22 @@ class Server:
                 # default parameters (server.cc:208-273).
                 batching = tfs_config_pb2.BatchingParameters()
 
+        # Health-plane configuration BEFORE the core builds: load events
+        # and any load-time compiles must already land in the recorder,
+        # and the SLO objectives must be set before the first request.
+        from min_tfs_client_tpu.observability import flight_recorder
+        from min_tfs_client_tpu.observability.slo import SLOConfig, configure
+
+        configure(default=SLOConfig(
+            latency_objective_ms=opts.slo_latency_objective_ms,
+            latency_quantile=opts.slo_latency_quantile,
+            error_budget=opts.slo_error_budget,
+            window_s=opts.slo_window_seconds,
+            shed_burn_rate=opts.slo_shed_burn_rate,
+        ))
+        flight_recorder.configure(opts.flight_recorder_dir or None)
+        flight_recorder.install_signal_handler()
+
         self.core = ServerCore(
             config,
             file_system_poll_wait_seconds=opts.file_system_poll_wait_seconds,
@@ -270,6 +300,14 @@ class Server:
 
         gs.add_ProfilerServiceServicer_to_server(
             ProfilerServiceImpl(), self._grpc_server)
+        # grpc.health.v1.Health on the MAIN port — readiness for standard
+        # probe tooling (observability/health.py).
+        from min_tfs_client_tpu.server.grpc_services import (
+            health_service_handler,
+        )
+
+        self._grpc_server.add_generic_rpc_handlers(
+            (health_service_handler(),))
         self.grpc_port = self._bind(self._grpc_server, opts.grpc_port)
         if opts.grpc_socket_path:
             if not self._grpc_server.add_insecure_port(

@@ -1,0 +1,328 @@
+"""Health-plane acceptance: /monitoring/{healthz,readyz,slo,runtime,
+flightrecorder} respond on BOTH REST backends; readiness flips across a
+scripted load/unload cycle (config reload + filesystem version drop);
+the flight recorder produces a parseable JSON dump on a forced INTERNAL
+error; the grpc.health.v1 service answers on the serving port; and the
+health plane stays cheap enough to leave on (<5% of toy p50, 60us
+floor — the tracing overhead test's convention)."""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from min_tfs_client_tpu.client import TensorServingClient
+from min_tfs_client_tpu.observability import flight_recorder
+from min_tfs_client_tpu.server.server import Server, ServerOptions
+from tests import fixtures
+
+BROKEN_SERVABLE_SRC = '''
+"""Signature that declares output "y" but produces "z" -> INTERNAL."""
+import numpy as np
+
+from min_tfs_client_tpu.servables.servable import (
+    Servable, Signature, TensorSpec)
+
+
+def build(path):
+    def bad_fn(inputs):
+        return {"z": inputs["x"]}
+
+    return {
+        "serving_default": Signature(
+            fn=bad_fn,
+            inputs={"x": TensorSpec(np.float32, (None,))},
+            outputs={"y": TensorSpec(np.float32, (None,))},
+            on_host=True,
+        ),
+    }
+'''
+
+
+@pytest.fixture(scope="module")
+def model_root(tmp_path_factory):
+    root = tmp_path_factory.mktemp("health_models")
+    fixtures.write_jax_servable(root / "native")
+    (root / "broken" / "1").mkdir(parents=True)
+    (root / "broken" / "1" / "servable.py").write_text(BROKEN_SERVABLE_SRC)
+    return root
+
+
+@pytest.fixture(scope="module")
+def config_file(model_root):
+    path = model_root / "models.config"
+    path.write_text(f"""
+model_config_list {{
+  config {{
+    name: "native"
+    base_path: "{model_root}/native"
+    model_platform: "jax"
+  }}
+  config {{
+    name: "broken"
+    base_path: "{model_root}/broken"
+    model_platform: "jax"
+  }}
+}}
+""")
+    return path
+
+
+@pytest.fixture(scope="module", params=["native", "python"])
+def rest_server(config_file, request, tmp_path_factory):
+    """The health plane, exercised against BOTH HTTP backends."""
+    if request.param == "native":
+        from min_tfs_client_tpu.server.native_http import (
+            native_http_available,
+        )
+
+        if not native_http_available():
+            pytest.skip("native HTTP library not buildable here")
+    mon = config_file.parent / "monitoring.config"
+    mon.write_text("prometheus_config { enable: true }\n")
+    srv = Server(ServerOptions(
+        grpc_port=0,
+        rest_api_port=0,
+        model_config_file=str(config_file),
+        file_system_poll_wait_seconds=0,
+        monitoring_config_file=str(mon),
+        rest_api_impl=request.param,
+        flight_recorder_dir=str(tmp_path_factory.mktemp("flight")),
+    ))
+    srv.build_and_start()
+    yield srv
+    srv.stop()
+
+
+def _get(port, path):
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=30) as r:
+            return r.status, r.read()
+    except urllib.error.HTTPError as err:
+        return err.code, err.read()
+
+
+def _get_json(port, path):
+    code, body = _get(port, path)
+    return code, json.loads(body)
+
+
+class TestEndpoints:
+    def test_healthz_live(self, rest_server):
+        code, payload = _get_json(rest_server.rest_port,
+                                  "/monitoring/healthz")
+        assert code == 200
+        assert payload["ok"] is True
+        assert payload["checks"]["manager_ticker"] is True
+
+    def test_readyz_ready_with_all_models_available(self, rest_server):
+        code, payload = _get_json(rest_server.rest_port,
+                                  "/monitoring/readyz")
+        assert code == 200, payload
+        assert payload["ready"] is True
+        assert payload["models"]["native"]["available_versions"] == [1]
+        assert payload["reasons"] == []
+
+    def test_slo_endpoint_tracks_served_requests(self, rest_server):
+        with TensorServingClient("127.0.0.1", rest_server.grpc_port) as c:
+            for _ in range(4):
+                c.predict_request(
+                    "native", {"x": np.arange(8, dtype=np.float32)})
+        code, payload = _get_json(rest_server.rest_port, "/monitoring/slo")
+        assert code == 200
+        assert payload["default_objective"]["quantile"] == 0.99
+        entry = next(e for e in payload["entries"]
+                     if e["model"] == "native" and e["api"] == "predict")
+        assert entry["count"] >= 4
+        assert entry["error_count"] == 0
+        assert entry["p50_ms"] > 0
+        assert entry["p99_ms"] >= entry["p50_ms"]
+        assert entry["burn_rate"]["max"] >= 0.0
+
+    def test_runtime_endpoint_compile_ledger_and_devices(self, rest_server):
+        with TensorServingClient("127.0.0.1", rest_server.grpc_port) as c:
+            # A fresh batch bucket forces a jit cache miss.
+            c.predict_request("native", {"x": np.arange(8, dtype=np.float32)})
+        code, payload = _get_json(rest_server.rest_port,
+                                  "/monitoring/runtime")
+        assert code == 200
+        compile_info = payload["compile"]
+        assert any(label.startswith("native:1:")
+                   for label in compile_info["executables"]), compile_info
+        event = next(e for e in compile_info["events"]
+                     if e["servable"].startswith("native:1:"))
+        assert "x:" in event["shape_bucket"]
+        assert event["wall_ms"] >= 0
+        assert payload["devices"], payload
+        assert {"running", "port"} <= set(payload["profiler"])
+        assert "device_to_host_bytes" in payload["transfer"]
+
+    def test_flightrecorder_endpoint_has_state_events(self, rest_server):
+        code, payload = _get_json(rest_server.rest_port,
+                                  "/monitoring/flightrecorder")
+        assert code == 200
+        kinds = {e["kind"] for e in payload["events"]}
+        assert "state" in kinds  # model load transitions ring-recorded
+
+    def test_prometheus_exports_ready_and_slo_gauges(self, rest_server):
+        with TensorServingClient("127.0.0.1", rest_server.grpc_port) as c:
+            c.predict_request("native", {"x": np.arange(4, dtype=np.float32)})
+        code, body = _get(rest_server.rest_port,
+                          "/monitoring/prometheus/metrics")
+        text = body.decode()
+        assert code == 200
+        assert "tpu_serving_ready 1" in text.replace(".0", "")
+        assert 'tpu_serving_slo_latency_ms{model="native"' in text
+        assert 'tpu_serving_slo_burn_rate{model="native"' in text
+        assert "tpu_serving_transfer_bytes" in text
+
+
+class TestGrpcHealthService:
+    def test_overall_and_per_model_check(self, rest_server):
+        import grpc
+
+        channel = grpc.insecure_channel(
+            f"127.0.0.1:{rest_server.grpc_port}")
+        check = channel.unary_unary("/grpc.health.v1.Health/Check")
+        assert check(b"") == b"\x08\x01"  # SERVING
+        assert check(b"\x0a\x06native") == b"\x08\x01"
+        with pytest.raises(grpc.RpcError) as err:
+            check(b"\x0a\x07unknown")
+        assert err.value.code() == grpc.StatusCode.NOT_FOUND
+        channel.close()
+
+
+class TestReadinessFlips:
+    def test_not_ready_to_ready_across_load_and_unload(
+            self, model_root):
+        """The scripted cycle: ready -> config adds a model with no
+        versions yet (not ready) -> the version lands on disk, the fs
+        poll loads it, readiness flips back on its own (the
+        not-ready->ready transition during model load) -> config
+        removes it again (ready; its per-model health check turns
+        NOT_FOUND)."""
+        import grpc
+
+        from min_tfs_client_tpu.protos import tfs_config_pb2
+
+        def server_config(names):
+            config = tfs_config_pb2.ModelServerConfig()
+            for name in names:
+                m = config.model_config_list.config.add()
+                m.name = name
+                m.base_path = str(model_root / name)
+                m.model_platform = "jax"
+            return config
+
+        base = model_root / "flip.config"
+        base.write_text(f"""
+model_config_list {{
+  config {{ name: "native" base_path: "{model_root}/native"
+            model_platform: "jax" }}
+}}
+""")
+        mon = model_root / "flip_monitoring.config"
+        mon.write_text("prometheus_config { enable: true }\n")
+        srv = Server(ServerOptions(
+            grpc_port=0, rest_api_port=0, rest_api_impl="python",
+            model_config_file=str(base),
+            monitoring_config_file=str(mon),
+            file_system_poll_wait_seconds=0.2,
+        ))
+        srv.build_and_start()
+        client = TensorServingClient("127.0.0.1", srv.grpc_port)
+        health_check = grpc.insecure_channel(
+            f"127.0.0.1:{srv.grpc_port}").unary_unary(
+            "/grpc.health.v1.Health/Check")
+        try:
+            code, payload = _get_json(srv.rest_port, "/monitoring/readyz")
+            assert code == 200 and payload["ready"] is True
+
+            # A configured model with no versions on disk: reload
+            # succeeds (nothing on disk to wait for) but readiness
+            # must drop with a reason naming the model.
+            (model_root / "late").mkdir(exist_ok=True)
+            client.reload_config_request(server_config(["native", "late"]))
+            code, payload = _get_json(srv.rest_port, "/monitoring/readyz")
+            assert code == 503, payload
+            assert any("late" in r for r in payload["reasons"]), payload
+            assert health_check(b"") == b"\x08\x02"  # NOT_SERVING
+            assert health_check(b"\x0a\x04late") == b"\x08\x02"
+
+            # The version lands on disk; the fs poll aspires and loads
+            # it; readiness must flip back with no further operator
+            # action.
+            fixtures.write_jax_servable(model_root / "late")
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                code, payload = _get_json(srv.rest_port,
+                                          "/monitoring/readyz")
+                if code == 200:
+                    break
+                time.sleep(0.2)
+            assert code == 200, payload
+            assert payload["models"]["late"]["available_versions"] == [1]
+            assert health_check(b"\x0a\x04late") == b"\x08\x01"  # SERVING
+
+            # Unload via config removal: ready again with the model gone
+            # from the configured universe.
+            client.reload_config_request(server_config(["native"]))
+            code, payload = _get_json(srv.rest_port, "/monitoring/readyz")
+            assert code == 200, payload
+            assert "late" not in payload["models"]
+            with pytest.raises(grpc.RpcError) as err:
+                health_check(b"\x0a\x04late")
+            assert err.value.code() == grpc.StatusCode.NOT_FOUND
+        finally:
+            client.close() if hasattr(client, "close") else None
+            srv.stop()
+
+
+class TestFlightRecorderDump:
+    def test_internal_error_produces_parseable_dump(self, rest_server,
+                                                    tmp_path):
+        flight_recorder.configure(str(tmp_path))
+        flight_recorder.reset()  # re-arm the first-INTERNAL latch
+        try:
+            body = json.dumps(
+                {"instances": [{"x": 1.0}, {"x": 2.0}]}).encode()
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{rest_server.rest_port}"
+                "/v1/models/broken:predict", data=body,
+                headers={"Content-Type": "application/json"})
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(req, timeout=30)
+            assert err.value.code == 500
+            assert "did not produce" in json.load(err.value)["error"]
+
+            dumps = sorted(tmp_path.glob("flight_recorder_*.json"))
+            assert dumps, "INTERNAL error did not dump the flight recorder"
+            payload = json.loads(dumps[-1].read_text())
+            assert payload["reason"] == "first INTERNAL error"
+            errors = [e for e in payload["events"] if e["kind"] == "error"]
+            assert errors and errors[-1]["code"] == 13
+            assert errors[-1]["model"] == "broken"
+            assert errors[-1]["error_digest"]
+
+            # The latch: a second INTERNAL must NOT write another dump.
+            with pytest.raises(urllib.error.HTTPError):
+                urllib.request.urlopen(
+                    urllib.request.Request(
+                        f"http://127.0.0.1:{rest_server.rest_port}"
+                        "/v1/models/broken:predict", data=body,
+                        headers={"Content-Type": "application/json"}),
+                    timeout=30)
+            assert sorted(tmp_path.glob("flight_recorder_*.json")) == dumps
+        finally:
+            flight_recorder.configure(None)
+
+
+# The health-plane overhead smoke lives in its own module
+# (test_health_plane_overhead.py) so this module's servers are torn
+# down before it measures.

@@ -1,0 +1,75 @@
+"""Health-plane overhead smoke, in its own module so the health-plane
+endpoint tests' servers (module-scoped fixtures in
+test_health_plane.py) are torn down before anything is timed."""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+import pytest
+
+from min_tfs_client_tpu.client import TensorServingClient
+from min_tfs_client_tpu.observability import slo, tracing
+from tests import fixtures
+
+
+@pytest.fixture(scope="module")
+def native_base(tmp_path_factory):
+    base = tmp_path_factory.mktemp("overhead_models") / "native"
+    fixtures.write_jax_servable(base)
+    return base
+
+
+class TestHealthPlaneOverheadSmoke:
+    def test_toy_overhead_within_budget(self, native_base):
+        """The health plane rides the tracing spine: with tracing ON the
+        drain thread feeds SLO windows and every execute pays the
+        cache-miss probe + transfer counters. Its overhead on the toy
+        model must stay under 5% of the solo p50 with the 60us floor
+        (the tracing overhead test's convention)."""
+        import gc
+
+        client = TensorServingClient(f"tpu://{native_base}")
+        x = np.arange(32, dtype=np.float32)
+
+        def call():
+            client.predict_request("native", {"x": x})
+
+        for _ in range(30):
+            call()  # warm jit + allocator
+
+        def chunk_p50(n=120):
+            ts = []
+            for _ in range(n):
+                t0 = time.perf_counter()
+                call()
+                ts.append(time.perf_counter() - t0)
+            ts.sort()
+            return ts[n // 2] * 1e6
+
+        on, off = [], []
+        # Drain the suite's accumulated trace backlog first — a drain
+        # burst landing mid-chunk would bill earlier tests' export work
+        # to whichever side is being measured.
+        tracing.flush_metrics()
+        gc.collect()
+        gc.disable()
+        try:
+            for _ in range(7):  # interleave so both see the same load
+                tracing.enable(True)
+                slo.reset()
+                on.append(chunk_p50())
+                tracing.enable(False)
+                off.append(chunk_p50())
+        finally:
+            gc.enable()
+            tracing.enable(True)
+        # min-of-chunks: each side's cleanest window — the statistic
+        # least polluted by ambient scheduler/allocator noise.
+        traced, untraced = min(on), min(off)
+        overhead = traced - untraced
+        budget = max(0.05 * untraced, 60.0)
+        assert overhead < budget, (
+            f"health-plane overhead {overhead:.1f}us exceeds budget "
+            f"{budget:.1f}us (on {traced:.1f}us, off {untraced:.1f}us)")

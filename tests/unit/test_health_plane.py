@@ -1,0 +1,583 @@
+"""Unit coverage for the health plane: SLO log-histogram quantiles,
+window rotation, burn rates; the compile-event ledger + jit
+instrumentation; the flight-recorder ring/latch/dump; readiness logic
+against a faked core; request-log sampling counters; profiler status."""
+
+from __future__ import annotations
+
+import json
+import time
+import types
+
+import numpy as np
+import pytest
+
+from min_tfs_client_tpu.observability import (
+    flight_recorder,
+    health,
+    runtime,
+    slo,
+)
+from min_tfs_client_tpu.observability.slo import (
+    SLOConfig,
+    SLOTracker,
+    _bucket_index,
+    _bucket_value_us,
+    _LOG_COUNT,
+    _LOG_GROWTH,
+    _WindowedStats,
+    _quantile_us,
+)
+
+
+class TestLogHistogram:
+    def test_bucket_index_monotonic_and_bounded(self):
+        prev = -1
+        for value in (0.5, 1, 2, 10, 1e3, 1e6, 1e9, 1e12):
+            idx = _bucket_index(value)
+            assert 0 <= idx < _LOG_COUNT
+            assert idx >= prev
+            prev = idx
+
+    def test_bucket_roundtrip_within_growth_factor(self):
+        # The representative value of a sample's bucket is within one
+        # growth factor of the sample — the estimator's accuracy bound.
+        for value in (1.7, 42.0, 9_999.0, 3.3e7):
+            est = _bucket_value_us(_bucket_index(value))
+            assert value / _LOG_GROWTH <= est <= value * _LOG_GROWTH
+
+    def test_quantile_estimation_bimodal(self):
+        counts = [0] * _LOG_COUNT
+        # 900 samples at ~2ms, 100 at ~500ms
+        counts[_bucket_index(2_000)] = 900
+        counts[_bucket_index(500_000)] = 100
+        p50 = _quantile_us(counts, 1000, 0.5)
+        p99 = _quantile_us(counts, 1000, 0.99)
+        assert 2_000 / _LOG_GROWTH <= p50 <= 2_000 * _LOG_GROWTH
+        assert 500_000 / _LOG_GROWTH <= p99 <= 500_000 * _LOG_GROWTH
+
+    def test_quantile_empty(self):
+        assert _quantile_us([0] * _LOG_COUNT, 0, 0.99) == 0.0
+
+
+class TestWindowRotation:
+    def test_samples_expire_after_window(self):
+        stats = _WindowedStats(window_s=6.0, num_slices=6)
+        now = time.monotonic()
+        stats.record(now, 1000.0, True, 1e9)
+        counts, total, errors, over, _ = stats.merged(now)
+        assert total == 1
+        # Advance past the whole window: everything rotated out.
+        counts, total, errors, over, _ = stats.merged(now + 7.0)
+        assert total == 0
+
+    def test_partial_rotation_keeps_recent(self):
+        stats = _WindowedStats(window_s=6.0, num_slices=6)
+        now = time.monotonic()
+        stats.record(now, 1000.0, True, 1e9)        # oldest slice
+        stats.record(now + 4.0, 2000.0, False, 1e9)  # newer slice
+        _, total, errors, _, _ = stats.merged(now + 5.0)
+        assert total == 2 and errors == 1
+        # Old sample out, recent one still in.
+        _, total, errors, _, _ = stats.merged(now + 8.0)
+        assert total == 1 and errors == 1
+
+
+class TestBurnRates:
+    def _tracker(self, **cfg) -> SLOTracker:
+        tracker = SLOTracker()
+        tracker.configure(default=SLOConfig(**cfg))
+        return tracker
+
+    def test_error_burn_rate(self):
+        tracker = self._tracker(error_budget=0.01, window_s=60.0)
+        for i in range(100):
+            tracker.record("m", "s", "predict", 0.001, ok=(i % 10 != 0))
+        entry = tracker.snapshot()["entries"][0]
+        assert entry["error_ratio"] == pytest.approx(0.1)
+        assert entry["burn_rate"]["error"] == pytest.approx(10.0)
+
+    def test_latency_burn_rate(self):
+        tracker = self._tracker(latency_objective_ms=1.0,
+                                latency_quantile=0.99, window_s=60.0)
+        # 10% of requests over the objective; allowed 1% -> burn 10.
+        for i in range(100):
+            latency = 0.0001 if i % 10 else 0.01
+            tracker.record("m", "s", "predict", latency, ok=True)
+        entry = tracker.snapshot()["entries"][0]
+        assert entry["slow_fraction"] == pytest.approx(0.1)
+        assert entry["burn_rate"]["latency"] == pytest.approx(10.0, rel=0.01)
+        assert tracker.max_burn_rate() == pytest.approx(10.0, rel=0.01)
+
+    def test_within_budget_burn_below_one(self):
+        tracker = self._tracker(error_budget=0.5, window_s=60.0)
+        for i in range(100):
+            tracker.record("m", "s", "predict", 0.001, ok=(i % 10 != 0))
+        entry = tracker.snapshot()["entries"][0]
+        assert entry["burn_rate"]["error"] == pytest.approx(0.2)
+
+    def test_per_model_override(self):
+        tracker = SLOTracker()
+        tracker.configure(default=SLOConfig(error_budget=0.01),
+                          per_model={"lenient": SLOConfig(error_budget=0.5)})
+        for _ in range(10):
+            tracker.record("lenient", "", "predict", 0.001, ok=False)
+            tracker.record("strict", "", "predict", 0.001, ok=False)
+        by_model = {e["model"]: e for e in tracker.snapshot()["entries"]}
+        assert by_model["lenient"]["burn_rate"]["error"] == pytest.approx(2.0)
+        assert by_model["strict"]["burn_rate"]["error"] == pytest.approx(100.0)
+
+    def test_shed_floor_excludes_thin_windows(self):
+        # One failed request at idle is burn 100 — but with fewer than
+        # shed_min_samples window samples it must not be shed-eligible.
+        tracker = self._tracker(error_budget=0.01, window_s=60.0)
+        for _ in range(5):
+            tracker.record("m", "", "predict", 0.001, ok=False)
+        assert tracker.max_burn_rate() == pytest.approx(100.0)
+        assert tracker.max_burn_rate(min_count=20) == 0.0
+        for _ in range(15):
+            tracker.record("m", "", "predict", 0.001, ok=False)
+        assert tracker.max_burn_rate(min_count=20) == pytest.approx(100.0)
+
+    def test_client_fault_statuses_spend_no_error_budget(self):
+        class _Trace:
+            model, signature, api = "m", "s", "predict"
+
+            def __init__(self, status):
+                self.status = status
+
+            def duration_s(self):
+                return 0.001
+
+        slo.reset()
+        try:
+            slo.observe_trace(_Trace("3"))   # INVALID_ARGUMENT: client
+            slo.observe_trace(_Trace("5"))   # NOT_FOUND: client
+            slo.observe_trace(_Trace("13"))  # INTERNAL: server fault
+            entry = slo.snapshot()["entries"][0]
+            assert entry["count"] == 3       # all count as latency samples
+            assert entry["error_count"] == 1  # only the INTERNAL
+        finally:
+            slo.reset()
+
+    def test_raw_client_fault_exception_maps_like_the_wire(self):
+        """A raw ValueError escaping a handler reaches the client as
+        INVALID_ARGUMENT — the trace (and so the SLO error budget) must
+        see the same code, not UNKNOWN(2)."""
+        from min_tfs_client_tpu.observability import tracing
+
+        tracing.ring_clear()
+        with pytest.raises(ValueError):
+            with tracing.request_trace("predict", model="m"):
+                raise ValueError("malformed tensor")
+        trace = tracing.ring_snapshot()[-1]
+        assert trace.status == "3"
+        assert trace.status in slo._CLIENT_FAULT_CODES
+
+    def test_export_gauges_zero_when_window_empties(self):
+        from min_tfs_client_tpu.server import metrics
+
+        tracker = self._tracker(error_budget=0.01, window_s=60.0)
+        tracker.record("gz", "sig", "predict", 0.001, ok=False)
+        tracker.export_gauges()
+        labels = ("gz", "sig", "predict")
+        assert metrics.slo_error_ratio.value(*labels) == 1.0
+        assert metrics.slo_burn_rate.value(*labels, "error") == 100.0
+        # The window empties (simulate full rotation): gauges must
+        # clear, not freeze at the last bad value.
+        for stats in tracker._stats.values():
+            for sl in stats.slices:
+                sl.reset()
+        tracker.export_gauges()
+        assert metrics.slo_error_ratio.value(*labels) == 0.0
+        assert metrics.slo_burn_rate.value(*labels, "error") == 0.0
+
+    def test_tracked_key_cap_bounds_client_cardinality(self):
+        """Model names come straight from client requests: beyond the
+        cap, NEW keys are dropped (and counted) instead of growing
+        tracker memory / Prometheus label cardinality without bound."""
+        from min_tfs_client_tpu.observability.slo import _MAX_TRACKED_KEYS
+
+        tracker = self._tracker()
+        for i in range(_MAX_TRACKED_KEYS + 50):
+            tracker.record(f"spray-{i}", "", "predict", 0.001, ok=True)
+        snap = tracker.snapshot()
+        assert len(snap["entries"]) == _MAX_TRACKED_KEYS
+        assert snap["dropped_keys"] == 50
+        # Established keys keep recording.
+        tracker.record("spray-0", "", "predict", 0.001, ok=True)
+        entry = next(e for e in tracker.snapshot()["entries"]
+                     if e["model"] == "spray-0")
+        assert entry["count"] == 2
+
+    def test_record_cost_stays_sub_slo_floor(self):
+        """The per-sample cost bound: recording must stay far under the
+        60us overhead floor even though it runs off the hot path."""
+        tracker = self._tracker()
+        t0 = time.perf_counter()
+        n = 5000
+        for _ in range(n):
+            tracker.record("m", "s", "predict", 0.001, ok=True)
+        per_sample_us = (time.perf_counter() - t0) / n * 1e6
+        assert per_sample_us < 60.0, per_sample_us
+
+
+class TestCompileLedger:
+    def setup_method(self):
+        runtime.reset_compile_ledger()
+
+    def test_record_and_snapshot(self):
+        runtime.record_compile("m:1:sig", "x:float32[8]", 0.25)
+        runtime.record_compile("m:1:sig", "x:float32[16]", 0.5)
+        ledger = runtime.compile_ledger()
+        assert ledger["executables"]["m:1:sig"] == 2
+        assert ledger["total_compiles"] == 2
+        assert [e["shape_bucket"] for e in ledger["events"]] == \
+            ["x:float32[8]", "x:float32[16]"]
+        assert ledger["events"][0]["wall_ms"] == pytest.approx(250.0)
+
+    def test_signature_execute_records_cache_misses(self):
+        from min_tfs_client_tpu.servables.servable import (
+            Servable,
+            Signature,
+            TensorSpec,
+        )
+
+        sig = Signature(
+            fn=lambda arrays: {"y": arrays["x"] * 2.0},
+            inputs={"x": TensorSpec(np.float32, (None,))},
+            outputs={"y": TensorSpec(np.float32, (None,))},
+            batch_buckets=(2, 4),
+        )
+        Servable("ledgered", 7, {"serving_default": sig})
+        sig.run({"x": np.ones(2, np.float32)})   # bucket 2: compile
+        sig.run({"x": np.ones(2, np.float32)})   # cache hit: no event
+        sig.run({"x": np.ones(3, np.float32)})   # bucket 4: compile
+        ledger = runtime.compile_ledger()
+        assert ledger["executables"]["ledgered:7:serving_default"] == 2
+        buckets = [e["shape_bucket"] for e in ledger["events"]]
+        assert any("[2]" in b for b in buckets)
+        assert any("[4]" in b for b in buckets)
+
+    def test_batched_runner_misses_reach_ledger(self):
+        """Acceptance: the ledger sees every jit cache miss exercised
+        through the batching front-end."""
+        from min_tfs_client_tpu.batching.scheduler import (
+            SharedBatchScheduler,
+        )
+        from min_tfs_client_tpu.batching.session import (
+            BatchedSignatureRunner,
+        )
+        from min_tfs_client_tpu.servables.servable import (
+            Servable,
+            Signature,
+            TensorSpec,
+        )
+
+        sig = Signature(
+            fn=lambda arrays: {"y": arrays["x"] + 1.0},
+            inputs={"x": TensorSpec(np.float32, (None,))},
+            outputs={"y": TensorSpec(np.float32, (None,))},
+        )
+        Servable("batched", 1, {"serving_default": sig})
+        scheduler = SharedBatchScheduler(num_threads=1)
+        runner = BatchedSignatureRunner(
+            sig, scheduler, name="batched:1:serving_default",
+            max_batch_size=8, allowed_batch_sizes=[2, 8])
+        try:
+            out = runner.run({"x": np.ones(1, np.float32)})
+            np.testing.assert_allclose(out["y"], [2.0])
+            ledger = runtime.compile_ledger()
+            assert ledger["executables"][
+                "batched:1:serving_default"] == 1
+            assert "[2]" in ledger["events"][0]["shape_bucket"]
+        finally:
+            runner.close()
+            scheduler.stop()
+
+    def test_instrument_jit_records_once_per_shape(self):
+        import jax
+
+        calls = jax.jit(lambda x: x + 1)
+        wrapped = runtime.instrument_jit("test:jit", calls)
+        wrapped(np.ones(3, np.float32))
+        wrapped(np.ones(3, np.float32))
+        wrapped(np.ones(5, np.float32))
+        ledger = runtime.compile_ledger()
+        assert ledger["executables"]["test:jit"] == 2
+        assert "float32[3]" in ledger["events"][0]["shape_bucket"]
+
+    def test_shape_bucket_string(self):
+        bucket = runtime.shape_bucket({
+            "b": np.zeros((2, 3), np.int32),
+            "a": np.zeros(4, np.float32),
+        })
+        assert bucket == "a:float32[4],b:int32[2x3]"
+
+
+class TestTransferCounters:
+    def test_count_transfer_feeds_metric(self):
+        from min_tfs_client_tpu.server import metrics
+
+        before = metrics.transfer_bytes.value("host_to_device")
+        runtime.count_transfer("host_to_device", 1024)
+        runtime.count_transfer("host_to_device", 0)   # ignored
+        runtime.count_transfer("host_to_device", -5)  # ignored
+        assert metrics.transfer_bytes.value("host_to_device") == before + 1024
+
+    def test_fetch_outputs_counts_device_to_host(self):
+        import jax.numpy as jnp
+
+        from min_tfs_client_tpu.server import metrics
+        from min_tfs_client_tpu.servables.servable import fetch_outputs
+
+        before = metrics.transfer_bytes.value("device_to_host")
+        fetch_outputs({"y": jnp.ones((4, 2), jnp.float32)}, batch=2)
+        assert metrics.transfer_bytes.value("device_to_host") \
+            == before + 4 * 2 * 4  # pre-slice bytes crossed the link
+
+
+class TestFlightRecorder:
+    def test_ring_is_bounded(self):
+        rec = flight_recorder.FlightRecorder(capacity=16)
+        for i in range(100):
+            rec.record("tick", i=i)
+        events = rec.snapshot()
+        assert len(events) == 16
+        assert events[-1][3]["i"] == 99
+
+    def test_to_json_coerces_non_scalars(self):
+        rec = flight_recorder.FlightRecorder(capacity=8)
+        rec.record("x", n=np.int64(3), f=np.float32(0.5), s="ok",
+                   obj=object())
+        payload = rec.to_json()
+        json.dumps(payload)  # fully serializable
+        event = payload["events"][0]
+        assert event["n"] == 3.0 and event["s"] == "ok"
+
+    def test_internal_error_dumps_once(self, tmp_path):
+        rec = flight_recorder.FlightRecorder(capacity=32)
+        rec.configure(str(tmp_path))
+        rec.record("state", servable="m:1", state="AVAILABLE")
+        rec.record_error("predict", "m", "sig", code=3, message="bad arg")
+        assert not list(tmp_path.glob("*.json"))  # INVALID_ARGUMENT: no dump
+        rec.record_error("predict", "m", "sig", code=13, message="boom")
+        dumps = list(tmp_path.glob("flight_recorder_*.json"))
+        assert len(dumps) == 1
+        payload = json.loads(dumps[0].read_text())
+        assert payload["reason"] == "first INTERNAL error"
+        kinds = [e["kind"] for e in payload["events"]]
+        assert kinds == ["state", "error", "error"]
+        # Latched: the next INTERNAL does not write a second file.
+        rec.record_error("predict", "m", "sig", code=13, message="boom2")
+        assert len(list(tmp_path.glob("flight_recorder_*.json"))) == 1
+        # reset() re-arms.
+        rec.reset()
+        rec.record_error("predict", "m", "sig", code=13, message="boom3")
+        assert len(list(tmp_path.glob("flight_recorder_*.json"))) == 2
+
+    def test_manual_dump_reason(self, tmp_path):
+        rec = flight_recorder.FlightRecorder(capacity=8)
+        rec.configure(str(tmp_path))
+        rec.record("tick")
+        path = rec.dump(reason="SIGUSR2")
+        assert path is not None
+        assert json.loads(open(path).read())["reason"] == "SIGUSR2"
+
+
+class _FakeState:
+    def __init__(self, manager_state):
+        self.manager_state = manager_state
+
+
+class _FakeCore:
+    """Just enough core surface for readiness()/check_service()."""
+
+    def __init__(self, states: dict[str, dict[int, object]]):
+        self._states = states
+        self.monitor = types.SimpleNamespace(
+            versions_of=lambda name: self._states.get(name, {}))
+        self.manager = types.SimpleNamespace(_ticker=None)
+
+    def configured_model_names(self):
+        return sorted(self._states)
+
+    def model_exists(self, name):
+        return name in self._states
+
+
+class TestReadiness:
+    def teardown_method(self):
+        health._core_ref = None
+        slo.tracker.configure(default=SLOConfig())
+        slo.reset()
+
+    def test_no_core_not_ready(self):
+        health._core_ref = None
+        verdict = health.readiness()
+        assert not verdict["ready"]
+        assert "no server core" in verdict["reasons"][0]
+
+    def test_all_available_ready(self):
+        from min_tfs_client_tpu.core.states import ManagerState
+
+        core = _FakeCore({"m": {1: _FakeState(ManagerState.AVAILABLE)}})
+        health.register_core(core)
+        verdict = health.readiness()
+        assert verdict["ready"]
+        assert verdict["models"]["m"]["available_versions"] == [1]
+
+    def test_loading_model_not_ready(self):
+        from min_tfs_client_tpu.core.states import ManagerState
+
+        core = _FakeCore({
+            "m": {1: _FakeState(ManagerState.AVAILABLE)},
+            "slow": {1: _FakeState(ManagerState.LOADING)},
+        })
+        health.register_core(core)
+        verdict = health.readiness()
+        assert not verdict["ready"]
+        assert any("slow" in r for r in verdict["reasons"])
+
+    def test_burn_rate_sheds_readiness(self):
+        from min_tfs_client_tpu.core.states import ManagerState
+
+        core = _FakeCore({"m": {1: _FakeState(ManagerState.AVAILABLE)}})
+        health.register_core(core)
+        slo.tracker.configure(default=SLOConfig(
+            error_budget=0.01, shed_burn_rate=5.0))
+        for _ in range(20):
+            slo.tracker.record("m", "", "predict", 0.001, ok=False)
+        verdict = health.readiness()
+        assert not verdict["ready"]
+        assert any("burn rate" in r for r in verdict["reasons"])
+        assert verdict["slo"]["max_burn_rate"] >= 5.0
+
+    def test_check_service_per_model(self):
+        from min_tfs_client_tpu.core.states import ManagerState
+
+        core = _FakeCore({
+            "up": {1: _FakeState(ManagerState.AVAILABLE)},
+            "down": {1: _FakeState(ManagerState.LOADING)},
+        })
+        health.register_core(core)
+        assert health.check_service("up") == (True, 1)      # SERVING
+        assert health.check_service("down") == (True, 2)    # NOT_SERVING
+        assert health.check_service("") == (True, 2)        # overall
+        assert health.check_service("nope")[0] is False     # unknown
+
+    def test_grpc_wire_helpers(self):
+        assert health._parse_service(b"") == ""
+        assert health._parse_service(b"\x0a\x06native") == "native"
+        assert health._encode_status(1) == b"\x08\x01"
+        assert health._encode_status(2) == b"\x08\x02"
+        # Malformed messages must be rejected (None), never silently
+        # read as a healthy whole-server probe.
+        assert health._parse_service(b"\x0a\x85") is None  # varint cut
+        assert health._parse_service(b"\x0a\x7fxy") is None  # len > buf
+        assert health._parse_service(b"\x12\x01a") is None  # wrong field
+        assert health._parse_service(b"\x0a\x02\xff\xfe") is None  # bad utf8
+
+    def test_unregister_only_current(self):
+        core_a, core_b = _FakeCore({}), _FakeCore({})
+        health.register_core(core_a)
+        health.register_core(core_b)
+        health.unregister_core(core_a)  # stale unregister: ignored
+        assert health._current_core() is core_b
+        health.unregister_core(core_b)
+        assert health._current_core() is None
+
+
+class TestRequestLogCounters:
+    def test_logged_and_sampled_out_counted(self):
+        from min_tfs_client_tpu.core.request_logger import (
+            ServerRequestLogger,
+        )
+        from min_tfs_client_tpu.protos import tfs_apis_pb2 as apis
+        from min_tfs_client_tpu.protos import tfs_config_pb2
+        from min_tfs_client_tpu.server import metrics
+
+        def config(rate):
+            cfg = tfs_config_pb2.LoggingConfig()
+            cfg.sampling_config.sampling_rate = rate
+            cfg.log_collector_config.type = "memory"
+            return cfg
+
+        logger = ServerRequestLogger()
+        logger.update({"always": config(1.0), "never": config(0.0)})
+        spec = apis.ModelSpec(name="always")
+        before_logged = metrics.request_log_count.value("always", "logged")
+        before_sampled = metrics.request_log_count.value(
+            "never", "sampled_out")
+        for _ in range(3):
+            logger.maybe_log("always", apis.PredictionLog, spec)
+            logger.maybe_log("never", apis.PredictionLog, spec)
+            logger.maybe_log("unconfigured", apis.PredictionLog, spec)
+        assert metrics.request_log_count.value("always", "logged") \
+            == before_logged + 3
+        assert metrics.request_log_count.value("never", "sampled_out") \
+            == before_sampled + 3
+        # Unconfigured models record nothing at all.
+        assert metrics.request_log_count.value(
+            "unconfigured", "logged") == 0
+
+    def test_collector_failure_counted_dropped(self, capsys):
+        from min_tfs_client_tpu.core.request_logger import (
+            RequestLogger,
+            ServerRequestLogger,
+        )
+        from min_tfs_client_tpu.protos import tfs_apis_pb2 as apis
+        from min_tfs_client_tpu.protos import tfs_config_pb2
+        from min_tfs_client_tpu.server import metrics
+
+        class Exploding:
+            def collect(self, log):
+                raise OSError("disk full")
+
+        cfg = tfs_config_pb2.LoggingConfig()
+        cfg.sampling_config.sampling_rate = 1.0
+        server_logger = ServerRequestLogger()
+        server_logger._loggers = {"m": RequestLogger(cfg, Exploding())}
+        before = metrics.request_log_count.value("m", "dropped")
+        server_logger.maybe_log("m", apis.PredictionLog,
+                                apis.ModelSpec(name="m"))
+        assert metrics.request_log_count.value("m", "dropped") == before + 1
+        capsys.readouterr()  # swallow the traceback print
+
+
+class TestErrorTapCodeMapping:
+    def test_unexpected_exception_taps_as_internal(self, tmp_path):
+        """A RuntimeError escaping a handler reaches the client as
+        INTERNAL (error_from_exception) — the flight-recorder tap must
+        record 13 and trip the dump latch, not UNKNOWN(2)."""
+        from min_tfs_client_tpu.protos import tfs_apis_pb2 as apis
+        from min_tfs_client_tpu.server import handlers as handlers_mod
+
+        class _Boom:
+            @handlers_mod._instrumented("predict")
+            def predict(self, request):
+                raise RuntimeError("kaboom")
+
+        flight_recorder.configure(str(tmp_path))
+        flight_recorder.reset()
+        try:
+            request = apis.PredictRequest()
+            request.model_spec.name = "m"
+            with pytest.raises(RuntimeError):
+                _Boom().predict(request)
+            events = [e for e in flight_recorder.to_json()["events"]
+                      if e["kind"] == "error"]
+            assert events and events[-1]["code"] == 13
+            assert list(tmp_path.glob("flight_recorder_*.json"))
+        finally:
+            flight_recorder.configure(None)
+            flight_recorder.reset()
+
+
+class TestProfilerStatus:
+    def test_status_shape(self):
+        from min_tfs_client_tpu.server import profiler
+
+        status = profiler.status()
+        assert set(status) == {"running", "port", "last_error"}
+        assert isinstance(status["running"], bool)
