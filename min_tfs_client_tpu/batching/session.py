@@ -49,7 +49,18 @@ def resolve_allowed_batch_sizes(
     """The allowed-sizes rule shared by the runner and pre-warmup bucket
     setup: explicit allowed_batch_sizes (last entry must equal
     max_batch_size, main.cc rule), else the signature's default buckets
-    clipped to max_batch_size."""
+    clipped to max_batch_size.
+
+    With a data-parallel mesh attached (native signatures' `mesh`, or a
+    partitioned import's interior mesh), padding buckets must split
+    evenly over the data axis — every shard keeps a static shape — so
+    indivisible entries are dropped (round_up_batch would skip them
+    anyway; keeping them would make warmup prime executables that can
+    never serve). When the survivors no longer cover max_batch_size
+    (e.g. [8, 12] on an 8-way axis), the next axis multiple at/above it
+    is appended — the scheduler still forms batches up to
+    max_batch_size, and THAT bucket is where they pad, so warmup must
+    prime it."""
     max_batch_size = params.get("max_batch_size", 32)
     allowed_batch_sizes = params.get("allowed_batch_sizes")
     if allowed_batch_sizes:
@@ -63,6 +74,11 @@ def resolve_allowed_batch_sizes(
                    if s <= max_batch_size] or [max_batch_size]
         if allowed[-1] != max_batch_size:
             allowed.append(max_batch_size)
+    ndata = signature._data_axis_size()
+    if ndata > 1:
+        allowed = [b for b in allowed if b % ndata == 0]
+        if not allowed or allowed[-1] < max_batch_size:
+            allowed.append(-(-max_batch_size // ndata) * ndata)
     return tuple(allowed)
 
 

@@ -52,6 +52,15 @@ def from_proto(config, devices=None) -> Mesh:
     return make_mesh(axes, devices=devices)
 
 
+def data_axis_size(mesh: Mesh | None) -> int:
+    """Size of the "data" axis (1 when no mesh / no such axis) — the one
+    divisibility rule shared by Signature.round_up_batch, the batching
+    front-end's bucket resolution, and the partition's interior padding."""
+    if mesh is None:
+        return 1
+    return int(dict(mesh.shape).get(DATA_AXIS, 1))
+
+
 def data_parallel_sharding(mesh: Mesh) -> NamedSharding:
     """Batch-dim sharding: dim 0 split across the data axis."""
     return NamedSharding(mesh, PartitionSpec(DATA_AXIS))

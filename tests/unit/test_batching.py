@@ -730,3 +730,67 @@ class TestNonBatchMajorFallback:
         servable = Servable("m", 1, {"serving_default": sig})
         maybe_wrap_servable(servable, {"max_batch_size": 4}, scheduler)
         assert not getattr(servable, "_batch_runners", [])
+
+
+class TestMeshDivisibleBuckets:
+    """Padding/compile buckets must split evenly over the data axis when
+    a DP mesh is attached (round-6 tentpole: partitioned imports serve
+    sharded, so their buckets ride the same rule as native signatures)."""
+
+    def _sig(self, mesh=None):
+        sig = Signature(
+            fn=lambda arrays: {"y": arrays["x"]},
+            inputs={"x": TensorSpec(np.float32, (None, 2))},
+            outputs={"y": TensorSpec(np.float32, (None, 2))},
+        )
+        sig.mesh = mesh
+        return sig
+
+    def test_indivisible_allowed_sizes_are_dropped(self):
+        from min_tfs_client_tpu.batching.session import (
+            resolve_allowed_batch_sizes,
+        )
+        from min_tfs_client_tpu.parallel.mesh import make_mesh
+
+        sig = self._sig(make_mesh({"data": 4}))
+        allowed = resolve_allowed_batch_sizes(
+            sig, {"max_batch_size": 16,
+                  "allowed_batch_sizes": [2, 4, 6, 8, 16]})
+        assert allowed == (4, 8, 16)  # 2 and 6 can never serve on DP=4
+
+    def test_all_indivisible_falls_back_to_axis_multiple(self):
+        from min_tfs_client_tpu.batching.session import (
+            resolve_allowed_batch_sizes,
+        )
+        from min_tfs_client_tpu.parallel.mesh import make_mesh
+
+        sig = self._sig(make_mesh({"data": 8}))
+        allowed = resolve_allowed_batch_sizes(
+            sig, {"max_batch_size": 6, "allowed_batch_sizes": [2, 6]})
+        assert allowed == (8,)  # next multiple of ndata >= max_batch_size
+
+    def test_no_mesh_keeps_the_configured_sizes(self):
+        from min_tfs_client_tpu.batching.session import (
+            resolve_allowed_batch_sizes,
+        )
+
+        allowed = resolve_allowed_batch_sizes(
+            self._sig(), {"max_batch_size": 6,
+                          "allowed_batch_sizes": [2, 6]})
+        assert allowed == (2, 6)
+
+    def test_filter_keeps_max_batch_coverage(self):
+        """Dropping indivisible sizes must not leave the largest merged
+        batches pointing at an unlisted (never-warmed) bucket: when the
+        survivors stop short of max_batch_size, the next axis multiple
+        is appended."""
+        from min_tfs_client_tpu.batching.session import (
+            resolve_allowed_batch_sizes,
+        )
+        from min_tfs_client_tpu.parallel.mesh import make_mesh
+
+        sig = self._sig(make_mesh({"data": 8}))
+        allowed = resolve_allowed_batch_sizes(
+            sig, {"max_batch_size": 12, "allowed_batch_sizes": [8, 12]})
+        assert allowed == (8, 16)  # 12 dropped; 16 covers batches 9..12
+        assert sig.round_up_batch(12) in allowed

@@ -59,10 +59,23 @@ def _build_random_graph(rng: np.random.Generator):
 
     floats = ["x"]  # names of [B, WIDTH] float tensors
     n_layers = int(rng.integers(3, 9))
-    for i in range(n_layers):
-        kind = rng.choice(["matmul", "relu", "softmax", "addc", "mulc",
-                           "add2", "host_roundtrip"])
-        src = floats[int(rng.integers(0, len(floats)))]
+    # Layer plan: random middle, but FORCE a leading matmul and (usually)
+    # a host_roundtrip -> matmul tail, so the corpus reliably contains
+    # FLOP-bearing segments on BOTH sides of a host island — the
+    # multi-segment executor's load-bearing shape (two-tower DAGs).
+    kinds = ["matmul"] + [
+        str(rng.choice(["matmul", "relu", "softmax", "addc", "mulc",
+                        "add2", "host_roundtrip"]))
+        for _ in range(n_layers)]
+    n_chained = 0
+    if rng.random() < 0.7:
+        # The tail CHAINS (consumes the previous layer's output) so the
+        # second tower really sits downstream of the island.
+        kinds += ["host_roundtrip", "matmul"]
+        n_chained = 2
+    for i, kind in enumerate(kinds):
+        src = (floats[-1] if i >= len(kinds) - n_chained
+               else floats[int(rng.integers(0, len(floats)))])
         name = f"n{i}"
         if kind == "matmul":
             w = const(f"w{i}", (rng.standard_normal((WIDTH, WIDTH)) * 0.4
@@ -154,6 +167,50 @@ def test_partitioned_matches_all_host_on_random_graphs(seed):
         want = host_fn([x], np)
         if part is None:
             continue  # host-only graphs stay host; nothing to compare
+        got = part.run([x], batch_buckets=(1, 4, 8))
+        assert len(got) == len(want)
+        for g, w in zip(got, want):
+            g, w = np.asarray(g), np.asarray(w)
+            if w.dtype.kind in "OSU":
+                np.testing.assert_array_equal(g.astype(object), w)
+            else:
+                np.testing.assert_allclose(g, w, rtol=2e-4, atol=2e-5)
+
+
+def test_fuzz_corpus_actually_covers_multi_segment():
+    """Guard on the generator, not the engine: the host_roundtrip islands
+    must produce graphs that partition into >= 2 jitted segments, or the
+    parametrized oracle check above silently stops covering the
+    multi-segment executor."""
+    multi = 0
+    for seed in range(12):
+        rng = np.random.default_rng(seed)
+        gd, tables, fetches = _build_random_graph(rng)
+        part = try_partition(gd, ["x:0"], fetches,
+                             funclib=_FuncLib(None), tables=tables)
+        if part is not None and part.stats["n_segments"] >= 2:
+            multi += 1
+    assert multi >= 2, f"only {multi}/12 seeds exercised multi-segment"
+
+
+@pytest.mark.parametrize("seed", range(0, 12, 3))
+def test_partitioned_matches_all_host_on_the_mesh(seed):
+    """Same oracle property with the 8-device CPU mesh attached: DP
+    sharding + divisible padding must never change a value, multi-
+    segment DAGs included."""
+    from min_tfs_client_tpu.parallel.mesh import make_mesh
+
+    rng = np.random.default_rng(seed)
+    gd, tables, fetches = _build_random_graph(rng)
+    host_fn = GraphFunction(gd, ["x:0"], fetches, tables=tables)
+    part = try_partition(gd, ["x:0"], fetches,
+                         funclib=_FuncLib(None), tables=tables)
+    if part is None:
+        pytest.skip("host-only graph for this seed")
+    part.attach_mesh(make_mesh({"data": 8}))
+    for batch in (1, 5):
+        x = rng.standard_normal((batch, WIDTH)).astype(np.float32)
+        want = host_fn([x], np)
         got = part.run([x], batch_buckets=(1, 4, 8))
         assert len(got) == len(want)
         for g, w in zip(got, want):
