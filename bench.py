@@ -50,11 +50,11 @@ BASELINE_FILE = REPO / "bench_baseline.json"
 LASTGOOD_FILE = REPO / "bench_lastgood.json"
 
 ACCEL_CONFIGS = ["bert", "resnet", "bert_int8", "matmul", "use", "t5",
-                 "imported"]
+                 "imported", "in_flight"]
 # CPU fallback: BERT-base is ~7.6 s/call on this host's CPU and never
 # finished inside the budget in any round; the stale accelerator record
 # carries the BERT story instead.
-CPU_CONFIGS = ["matmul", "use", "imported", "t5"]
+CPU_CONFIGS = ["matmul", "use", "imported", "t5", "in_flight"]
 
 BUDGET = float(os.environ.get("BENCH_BUDGET", 240))
 _START = time.monotonic()
@@ -1370,10 +1370,233 @@ def _imported_host_batching_ratio(base: str) -> dict:
                 unbatched_runs / max(batched_runs, 1), 2)}
 
 
+def _pipeline_overlap_evidence(sig, x) -> dict:
+    """One traced request through the microbatch pipeline, reduced to
+    the two numbers that prove host/device overlap on a timeline no one
+    has to eyeball: how much host-island wall ran while another chunk's
+    device segment (dispatch->materialize) was in flight, and how many
+    device dispatches were issued with at least one other chunk already
+    in flight (the interleaving the GPipe schedule exists to produce)."""
+    from min_tfs_client_tpu.observability import tracing
+
+    tr = tracing.RequestTrace("bench", "in_flight", "predict")
+    with tracing.activate(tr):
+        sig.run({"x": x})
+    spans = list(tr.spans)
+    flights = {}  # (chunk, segment) -> [dispatched_at, materialized_at]
+    for name, t0, t1, args in spans:
+        if name == "pipeline/dispatch":
+            flights.setdefault(
+                (args["chunk"], args["segment"]), [t1, None])[0] = t1
+        elif name == "pipeline/materialize":
+            entry = flights.setdefault(
+                (args["chunk"], args["segment"]), [None, t0])
+            entry[1] = t0
+    # Dispatch-only entries (a pipeline attempt that aborted before its
+    # materialize span and fell back to serial) carry m=None — drop them
+    # everywhere, not just from the window count.
+    flights = {k: (d, m) for k, (d, m) in flights.items()
+               if d is not None and m is not None}
+    windows = [(d, m) for d, m in flights.values() if m > d]
+    host_overlap = 0.0
+    host_total = 0.0
+    for name, t0, t1, args in spans:
+        if name != "pipeline/host":
+            continue
+        host_total += t1 - t0
+        for key, (d, m) in flights.items():
+            if key[0] == args["chunk"]:
+                continue  # own chunk: sequential by construction
+            lo, hi = max(t0, d), min(t1, m)
+            if hi > lo:
+                host_overlap += hi - lo
+                break  # count each host slice once
+    interleaved = sum(
+        1 for name, t0, t1, args in spans if name == "pipeline/dispatch"
+        and any(d < t1 and m > t1 for (c, s), (d, m) in flights.items()
+                if c != args["chunk"]))
+    return {"host_ms_total": round(host_total * 1e3, 3),
+            "host_ms_overlapped": round(host_overlap * 1e3, 3),
+            "interleaved_dispatches": interleaved,
+            "in_flight_windows": len(windows)}
+
+
+def bench_in_flight(max_iters: int) -> dict:
+    """In-flight execution window sweep (ISSUE 5): the same toy device
+    signature served through BatchedSignatureRunner at window 1/4/8, and
+    the imported two-tower fixture's multi-segment microbatch pipeline
+    at depth 1/4/8 — both against a simulated-latency device (5 ms of
+    wall-clock between a dispatch and its result being ready, the
+    tunneled-PJRT-link model from PERF.md's transport profile). CPU CI
+    has no high-latency link, so the wrapper is what makes the overlap
+    win measurable and deterministic here; on the real chip the same
+    sweep measures the link itself. Numerics must be bit-identical at
+    every window size — that equality is asserted, not assumed."""
+    import concurrent.futures as cf
+    import tempfile as _tf
+
+    import numpy as np
+
+    from min_tfs_client_tpu.batching.scheduler import SharedBatchScheduler
+    from min_tfs_client_tpu.batching.session import (
+        BatchedSignatureRunner,
+        pipeline_snapshot,
+    )
+    from min_tfs_client_tpu.servables.servable import Signature, TensorSpec
+    from tests import fixtures
+
+    # 10 ms per in-flight batch: above the 5 ms acceptance floor, still
+    # ~6x below the 65 ms RTT PERF.md measured on the real tunneled
+    # link, and large enough that CPU-CI scheduling noise can't drown
+    # the serial-vs-overlapped contrast.
+    latency_s = 0.010
+    # 16 callers each sending 7 rows against max_batch_size 8: two such
+    # requests never co-batch (7+7 > 8) and size >= max takes the
+    # oversized direct path, so exactly one request = one queued batch =
+    # one window slot — the window can hold 8 batches in flight while
+    # the GIL churn of very wide caller pools stays out of the
+    # measurement (cross-caller coalescing has its own leg; this one
+    # measures the window).
+    threads, per_thread, req_rows = 16, 4, 7
+
+    def make_sig():
+        import jax.numpy as jnp
+
+        sig = Signature(
+            fn=lambda inputs: {"y": jnp.tanh(inputs["x"]) * 2.0 + 1.0},
+            inputs={"x": TensorSpec(np.float32, (None, 8))},
+            outputs={"y": TensorSpec(np.float32, (None, 8))},
+        )
+        fixtures.simulate_device_latency(sig, latency_s)
+        return sig
+
+    def toy_point(window: int) -> dict:
+        sched = SharedBatchScheduler(num_threads=1)
+        sig = make_sig()
+        dispatches = [0]
+        inner = sig.dispatch
+
+        def counting(inputs, output_filter=()):
+            dispatches[0] += 1
+            return inner(inputs, output_filter)
+
+        sig.dispatch = counting
+        runner = BatchedSignatureRunner(
+            sig, sched, name=f"bench-inflight-w{window}",
+            max_batch_size=8, batch_timeout_s=0.002,
+            allowed_batch_sizes=[8], max_in_flight_batches=window)
+        try:
+            outs = {}
+
+            def call(i):
+                x = (np.arange(req_rows * 8, dtype=np.float32)
+                     .reshape(req_rows, 8) * 0.01 + float(i % 32))
+                # 7 rows: pads to the 8-bucket on dispatch, splits back
+                # to exactly these rows on materialize.
+                outs[i] = np.asarray(runner.run({"x": x})["y"])
+
+            with cf.ThreadPoolExecutor(threads) as pool:
+                list(pool.map(call, range(threads)))  # warm/compile
+                dispatches[0] = 0
+                # The window's counters are cumulative — snapshot after
+                # warmup so the reported ratio covers only the measured
+                # calls (warmup includes the ramp where in_flight is 0).
+                warm = pipeline_snapshot().get(
+                    f"bench-inflight-w{window}", {})
+                total = threads * per_thread
+                t0 = time.perf_counter()
+                list(pool.map(call, range(total)))
+                wall = time.perf_counter() - t0
+            stats = pipeline_snapshot().get(
+                f"bench-inflight-w{window}", {})
+            d = stats.get("dispatched", 0) - warm.get("dispatched", 0)
+            o = stats.get("overlapped", 0) - warm.get("overlapped", 0)
+            return {"window": window,
+                    "qps": round(total * req_rows / wall, 1),
+                    "per_call_ms": round(wall / total * 1e3, 3),
+                    "executions": dispatches[0],
+                    "overlap_ratio": round(o / d, 4) if d else 0.0,
+                    "outputs": {i: outs[i] for i in range(32)}}
+        finally:
+            runner.close()
+            sched.stop()
+
+    toy = [toy_point(w) for w in (1, 4, 8)]
+    # Bit-identical across windows — the compat guarantee, enforced.
+    for point in toy[1:]:
+        for i, want in toy[0]["outputs"].items():
+            assert np.array_equal(point["outputs"][i], want), (
+                f"window {point['window']} diverged on caller {i}")
+    for point in toy:
+        del point["outputs"]
+    speedup = round(toy[-1]["qps"] / max(toy[0]["qps"], 1e-6), 2)
+
+    imported = []
+    try:
+        from min_tfs_client_tpu.servables.graphdef_import import (
+            load_saved_model,
+        )
+
+        base = pathlib.Path(_tf.mkdtemp(prefix="tpu_bench_if_")) / "tt"
+        fixtures.write_imported_two_tower(base)
+        sv = load_saved_model(str(base / "1"), "tt", 1)
+        sig = sv.signature("")
+        part = sig.partition
+        if part is not None and len(part.segments) > 1:
+            fixtures.simulate_interior_latency(part, latency_s)
+            # Host islands get a per-row cost too: the pipeline's win is
+            # host work hidden under in-flight device segments, and the
+            # two-tower fixture's lookup island is near-free on CPU
+            # while production imports burn real host time on string
+            # ops/Example parsing at these row counts.
+            fixtures.simulate_host_latency(part, 0.0003)
+            rng = np.random.default_rng(0)
+            x = rng.standard_normal((32, 8)).astype(np.float32)
+            want = None
+            for depth in (1, 4, 8):
+                part.pipeline_depth = depth
+                sig.run({"x": x})  # warm/compile every chunk bucket
+                samples = []
+                for _ in range(5):
+                    t0 = time.perf_counter()
+                    got = sig.run({"x": x})
+                    samples.append((time.perf_counter() - t0) * 1e3)
+                if want is None:
+                    want = got
+                else:
+                    for k in want:
+                        assert np.array_equal(got[k], want[k]), (
+                            f"pipeline depth {depth} diverged on {k}")
+                samples.sort()
+                point = {"depth": depth, "segments": len(part.segments),
+                         "per_call_ms": round(samples[len(samples) // 2], 3)}
+                if depth > 1:
+                    point.update(_pipeline_overlap_evidence(sig, x))
+                imported.append(point)
+    except Exception:
+        traceback.print_exc(file=sys.stderr)
+
+    extra = {"injected_latency_ms": latency_s * 1e3,
+             "concurrent_callers": threads,
+             "toy": toy, "toy_speedup_w8_over_w1": speedup,
+             "imported_pipeline": imported}
+    if imported and len(imported) > 1:
+        # Best depth, not last: each chunk pays the injected RTT, so
+        # past the point where chunked latency outgrows the host work it
+        # hides, deeper pipelines REGRESS (depth 8 on this fixture) —
+        # report the sweet spot the way an operator would pick it.
+        best = min(imported[1:], key=lambda p: p["per_call_ms"])
+        extra["imported_speedup"] = round(
+            imported[0]["per_call_ms"] / max(best["per_call_ms"], 1e-6), 2)
+        extra["imported_best_depth"] = best["depth"]
+    return {"metric": "in_flight_toy_qps_w8", "value": toy[-1]["qps"],
+            "unit": "qps", "extra": extra}
+
+
 _CONFIG_FNS = {"bert": bench_bert, "bert_int8": bench_bert_int8,
                "matmul": bench_matmul, "use": bench_use,
                "t5": bench_t5, "resnet": bench_resnet,
-               "imported": bench_imported}
+               "imported": bench_imported, "in_flight": bench_in_flight}
 
 
 def child_main(out: pathlib.Path, configs: list[str]) -> None:

@@ -11,8 +11,10 @@ taint pass:
   seeds      results of `self._execute(...)`, `self._run_device(...)`,
              `self.jitted()(...)`, `self.interior_jitted(...)(...)`,
              `jax.jit(f)` callables (by name or `self.<attr>`, tracked
-             module-wide), `jax.device_put(...)`, and any `x` probed via
-             `getattr(x, "copy_to_host_async", ...)`;
+             module-wide), `jax.device_put(...)`, any `x` probed via
+             `getattr(x, "copy_to_host_async", ...)`, and any `x` passed
+             to `start_fetch(x)` (its contract: x holds device arrays
+             whose D2H copies are now in flight, nothing materialized);
   flows      assignments, subscripts, container displays, comprehensions,
              `.items()/.values()/.get()` accessors, arithmetic;
   sinks      the coercions above -> finding; `fetch_outputs(...)` is the
@@ -60,6 +62,10 @@ _PROPAGATING_BUILTINS = {"dict", "list", "tuple", "enumerate", "zip",
 # getattr probes that prove a value is a device array.
 _DEVICE_PROBE_ATTRS = {"copy_to_host_async", "block_until_ready",
                        "addressable_shards", "on_device_size_in_bytes"}
+# Functions whose ARGUMENT is thereby proven to hold device arrays (the
+# dispatch half of the overlapped fetch: copies issued, nothing
+# materialized — coercing the argument afterwards still blocks).
+_DEVICE_PROBE_FUNCS = {"start_fetch"}
 # Factory attrs whose RESULT is a device-executing callable (flagged only
 # when immediately invoked: self.jitted()(x)).
 _CALLABLE_FACTORY_ATTRS = {"jitted", "interior_jitted"}
@@ -208,7 +214,9 @@ class _Taint:
 
     def _absorb_probe(self, call: ast.Call) -> None:
         """getattr(x, "copy_to_host_async", ...) proves x is a device
-        array — the JAX-specific inference that catches fetch helpers."""
+        array — the JAX-specific inference that catches fetch helpers.
+        So does start_fetch(x): its contract is that x's values are
+        device arrays with D2H copies in flight, NOT materialized."""
         if isinstance(call.func, ast.Name) and call.func.id == "getattr" \
                 and len(call.args) >= 2 \
                 and isinstance(call.args[1], ast.Constant) \
@@ -216,6 +224,10 @@ class _Taint:
                 and isinstance(call.args[0], ast.Name):
             if call.args[0].id not in self.tainted:
                 self.tainted.add(call.args[0].id)
+        name = dotted(call.func) or ""
+        if name.rsplit(".", 1)[-1] in _DEVICE_PROBE_FUNCS and call.args \
+                and isinstance(call.args[0], ast.Name):
+            self.tainted.add(call.args[0].id)
 
     def _bind(self, target: ast.AST) -> None:
         for name in bound_names(target):

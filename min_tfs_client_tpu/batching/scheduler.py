@@ -48,6 +48,14 @@ class BatchTask:
     outputs: dict | None = None
     error: Exception | None = None
     done: threading.Event = field(default_factory=threading.Event)
+    # Set by a processor that hands completion to an in-flight window
+    # (batching/session.py): the worker then must NOT touch
+    # outputs/error/done — the window's completion thread owns them.
+    # Flipped on the worker thread BEFORE window.submit() (so the
+    # completion thread can never run while the worker's finally still
+    # owns the task) and reverted if submit raises, so a failed handoff
+    # cannot strand a detached task either.
+    detached: bool = False
 
 
 @dataclass(frozen=True)
@@ -199,10 +207,15 @@ class SharedBatchScheduler:
                 queue.process(batch)
             except Exception as exc:  # noqa: BLE001 - propagate to waiters
                 for task in batch:
-                    task.error = exc
+                    if not task.detached:
+                        task.error = exc
             finally:
+                # Tasks handed to an in-flight completion window are the
+                # window's to finish — completing them here would release
+                # callers before their batch materialized.
                 for task in batch:
-                    task.done.set()
+                    if not task.detached:
+                        task.done.set()
 
     def _find_mature(self, now: float):  # servelint: holds self._lock
         n = len(self._queues)

@@ -16,6 +16,8 @@ Parity with BatchingSession (batching/batching_session.{h,cc}):
 
 from __future__ import annotations
 
+import collections
+import threading
 import time
 
 import numpy as np
@@ -163,6 +165,172 @@ def pad_ragged(arrays: list[np.ndarray]) -> list[np.ndarray]:
     return out
 
 
+class _InFlightWindow:
+    """Bounded dispatch->materialize pipeline for one batching queue.
+
+    The transport profile (PERF.md) shows the tunneled PJRT link serves
+    ~25x more throughput with requests in flight than serialized; this
+    window converts that capacity server-side: the batch worker
+    acquire()s a slot, dispatches the batch (device work + D2H copies
+    launched, nothing materialized), and submit()s the completion; a
+    single completion thread materializes batches strictly in dispatch
+    order, so per-caller response ordering is preserved and each batch's
+    error stays its own. depth 1 is never constructed — window=1 keeps
+    the synchronous path bit-for-bit.
+    """
+
+    CLOSE_DRAIN_TIMEOUT_S = 30.0
+
+    def __init__(self, depth: int, name: str):
+        self.depth = int(depth)
+        self.name = name
+        self._cv = threading.Condition()
+        self._in_flight = 0          # guarded_by: self._cv
+        self._pending: collections.deque = (
+            collections.deque())     # guarded_by: self._cv
+        self._closed = False         # guarded_by: self._cv
+        self._thread: threading.Thread | None = None  # guarded_by: self._cv
+        self._dispatched = 0         # guarded_by: self._cv
+        self._overlapped = 0         # guarded_by: self._cv
+        with _windows_lock:
+            _windows[name] = self
+
+    # -- scheduler-thread side ----------------------------------------------
+
+    def acquire(self) -> bool:
+        """Take an in-flight slot, blocking while the window is full —
+        the backpressure that bounds device-queue depth and host memory
+        pinned by outstanding batches. Returns False when the window
+        closed instead: the worker already owns a popped batch at that
+        point, and erroring it would break the shutdown contract (the
+        pre-window code executed it synchronously — the caller must do
+        the same, not fail its riders)."""
+        with self._cv:
+            while self._in_flight >= self.depth and not self._closed:
+                self._cv.wait()
+            if self._closed:
+                return False
+            self._in_flight += 1
+            self._dispatched += 1
+            if self._in_flight > 1:
+                self._overlapped += 1
+            self._publish_locked()
+            return True
+
+    def release(self) -> None:
+        """Give a slot back without a completion (dispatch failed)."""
+        with self._cv:
+            self._in_flight -= 1
+            self._publish_locked()
+            self._cv.notify_all()
+
+    def submit(self, complete) -> None:
+        """Queue a completion callable; the completion thread runs them
+        FIFO (dispatch order) and releases the slot after each."""
+        with self._cv:
+            self._pending.append(complete)
+            try:
+                if self._thread is None or not self._thread.is_alive():
+                    self._thread = threading.Thread(
+                        target=self._drain, name=f"inflight-{self.name}",
+                        daemon=True)
+                    self._thread.start()
+            except BaseException:
+                # Thread.start() can fail under thread exhaustion. The
+                # completion MUST leave the queue before the caller's
+                # unwind re-attaches the tasks and releases the slot —
+                # a later drain popping it would double-complete the
+                # batch and double-release, driving _in_flight negative
+                # (close() would then spin forever). Still holding _cv,
+                # so no drain thread can have popped it in between.
+                self._pending.pop()
+                raise
+            self._cv.notify_all()
+
+    def depth_now(self) -> int:
+        with self._cv:
+            return self._in_flight
+
+    def stats(self) -> dict:
+        with self._cv:
+            return {
+                "window": self.depth,
+                "in_flight": self._in_flight,
+                "dispatched": self._dispatched,
+                "overlapped": self._overlapped,
+                "overlap_ratio": round(
+                    self._overlapped / self._dispatched, 4)
+                if self._dispatched else 0.0,
+            }
+
+    # -- completion thread ---------------------------------------------------
+
+    def _drain(self) -> None:
+        while True:
+            with self._cv:
+                while not self._pending and not self._closed:
+                    self._cv.wait()
+                if not self._pending:
+                    return  # closed and drained
+                complete = self._pending.popleft()
+            try:
+                complete()
+            except Exception:  # pragma: no cover - _complete_batch delivers
+                pass           # its own errors; the drain thread must live
+            finally:
+                self.release()
+
+    def close(self) -> None:
+        """Stop accepting dispatches and DRAIN: every batch already in
+        flight still materializes and its callers get real results —
+        shutdown must never turn dispatched work into errors. The wait
+        is BOUNDED (CLOSE_DRAIN_TIMEOUT_S): a wedged device must not
+        hold unload hostage (the pre-window code never blocked unload
+        on an executing batch). Past the deadline close() returns while
+        the daemon completion thread keeps draining, so late answers
+        still deliver to their callers."""
+        deadline = time.monotonic() + self.CLOSE_DRAIN_TIMEOUT_S
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+            thread = self._thread
+            while (self._pending or self._in_flight) \
+                    and time.monotonic() < deadline:
+                self._cv.wait(timeout=0.1)
+            drained = not self._pending and not self._in_flight
+        if drained and thread is not None:
+            # Joining a known-wedged thread would just re-pay the
+            # deadline; it is a daemon and keeps delivering on its own.
+            thread.join(timeout=5.0)
+        with _windows_lock:
+            if _windows.get(self.name) is self:
+                del _windows[self.name]
+
+    def _publish_locked(self) -> None:
+        """Gauges published under self._cv so depths cannot race out of
+        order and stick stale (the BatchQueue depth-gauge rule)."""
+        try:
+            from min_tfs_client_tpu.server import metrics
+
+            metrics.safe_set(metrics.in_flight_batches, self._in_flight,
+                             self.name)
+            metrics.safe_set(metrics.pipeline_overlap_occupancy,
+                             self._in_flight / self.depth, self.name)
+        except Exception:  # pragma: no cover - metrics must not break serving
+            pass
+
+
+_windows_lock = threading.Lock()
+_windows: dict[str, _InFlightWindow] = {}      # guarded_by: _windows_lock
+
+
+def pipeline_snapshot() -> dict:
+    """Per-queue in-flight window stats for /monitoring/runtime."""
+    with _windows_lock:
+        windows = list(_windows.values())
+    return {w.name: w.stats() for w in windows}
+
+
 class BatchedSignatureRunner:
     """Drop-in .run() for a Signature, coalescing concurrent callers."""
 
@@ -177,6 +345,7 @@ class BatchedSignatureRunner:
         max_enqueued_batches: int = 64,
         allowed_batch_sizes: list[int] | None = None,
         pad_variable_length_inputs: bool = False,
+        max_in_flight_batches: int = 1,
     ):
         allowed = list(resolve_allowed_batch_sizes(signature, {
             "max_batch_size": max_batch_size,
@@ -187,6 +356,16 @@ class BatchedSignatureRunner:
         # runner.run — _process must execute the real signature, not re-enter
         # the queue.
         self._inner_run = signature.run
+        # The async seam (dispatch is an instance attr when a test/bench
+        # wrapper shimmed it, the class method otherwise): the windowed
+        # path launches batch k+1 through this while batch k's D2H copies
+        # are still outstanding.
+        self._inner_dispatch = signature.dispatch
+        window = max(1, int(max_in_flight_batches or 1))
+        # window == 1 keeps the synchronous path — not a window of depth
+        # 1 but literally the pre-window code, the default-compat
+        # guarantee docs/MIGRATING.md documents.
+        self._window = _InFlightWindow(window, name) if window > 1 else None
         # Outputs that can never split along dim 0: requests fetching one
         # of them bypass the queue (run() routes them direct), so callers
         # that filter them OUT still batch.
@@ -351,9 +530,19 @@ class BatchedSignatureRunner:
             union: tuple = ()
         else:
             union = tuple(sorted({name for f in filters for name in f}))
+        if self._window is not None and self._dispatch_windowed(
+                batch, sizes, total, merged, union):
+            return
+        # No window, or the window closed between this batch's pop and
+        # its dispatch (unload racing the worker): execute synchronously
+        # — the popped batch's riders get real results either way.
         with tracing.span("batching/execute"):
             outputs = self._inner_run(merged, union)
 
+        self._record_batch_telemetry(total, len(batch))
+        self._split_outputs(batch, sizes, total, outputs)
+
+    def _record_batch_telemetry(self, total: int, n_tasks: int) -> None:
         try:
             from min_tfs_client_tpu.server import metrics
 
@@ -368,7 +557,7 @@ class BatchedSignatureRunner:
                 metrics.padding_wasted_examples.increment(
                     self._queue.name, by=bucket - total)
             tracing.annotate(batch_size=total, padding_bucket=bucket,
-                             batch_tasks=len(batch),
+                             batch_tasks=n_tasks,
                              padding_waste_fraction=round(
                                  (bucket - total) / max(1, bucket), 4))
             # Flight-recorder ring: batch formations are exactly the
@@ -377,11 +566,13 @@ class BatchedSignatureRunner:
             from min_tfs_client_tpu.observability import flight_recorder
 
             flight_recorder.record(
-                "batch", queue=self._queue.name, tasks=len(batch),
+                "batch", queue=self._queue.name, tasks=n_tasks,
                 examples=total, bucket=bucket)
         except Exception:  # pragma: no cover - metrics must not break serving
             pass
 
+    def _split_outputs(self, batch: list[BatchTask], sizes: list[int],
+                       total: int, outputs: dict) -> None:
         # Outputs must be batch-major to split back to callers — the
         # reference's batching_session errors on a mismatched 0th dim
         # rather than handing each caller an arbitrary slice (imported
@@ -399,8 +590,73 @@ class BatchedSignatureRunner:
                             for k, v in outputs.items()}
             offset += size
 
+    # -- in-flight window (window > 1) ---------------------------------------
+
+    def _dispatch_windowed(self, batch: list[BatchTask], sizes: list[int],
+                           total: int, merged: dict, union: tuple) -> bool:
+        """Scheduler-thread half of the pipelined path: take a window
+        slot, LAUNCH the merged batch (device dispatch + D2H copies in
+        flight), and hand materialization to the completion thread. The
+        worker is then free to merge and dispatch the next batch while
+        this one's transfers run. Returns False (batch untouched) when
+        the window closed under the worker — the caller executes the
+        batch synchronously instead of failing its riders."""
+        window = self._window
+        with tracing.span("batching/in_flight_wait"):
+            if not window.acquire():
+                return False
+        try:
+            with tracing.span("batching/dispatch"):
+                handle = self._inner_dispatch(merged, union)
+        except BaseException:
+            # Dispatch failed on THIS batch: give the slot back and let
+            # the worker's error path fail exactly these tasks.
+            window.release()
+            raise
+        self._record_batch_telemetry(total, len(batch))
+        tracing.annotate(in_flight_depth=window.depth_now(),
+                         in_flight_window=window.depth)
+        # Hand ownership to the completion thread. detached is flipped
+        # before submit so the worker's finally can never complete a task
+        # the window owns; until submit returns the window cannot have
+        # run the completion, so the unwind below cannot race it.
+        for task in batch:
+            task.detached = True
+        try:
+            window.submit(lambda: self._complete_batch(
+                batch, sizes, total, handle))
+        except BaseException:
+            for task in batch:
+                task.detached = False
+            window.release()
+            raise
+        return True
+
+    def _complete_batch(self, batch: list[BatchTask], sizes: list[int],
+                        total: int, handle) -> None:
+        """Completion-thread half: materialize one batch's outputs and
+        deliver them (or its error — isolated to THIS batch) to every
+        rider. The riders' traces cross the thread boundary through the
+        BatchTask mechanism, never ambient contextvars."""
+        traces = [t.trace for t in batch if t.trace is not None]
+        try:
+            with tracing.activate(tracing.fanout(traces)):
+                with tracing.span("batching/materialize"):
+                    outputs = handle.result()
+                self._split_outputs(batch, sizes, total, outputs)
+        except Exception as exc:  # noqa: BLE001 - delivered to the riders
+            for task in batch:
+                task.error = exc
+        finally:
+            for task in batch:
+                task.done.set()
+
     def close(self) -> None:
         self._scheduler.remove_queue(self._queue)
+        if self._window is not None:
+            # Drain AFTER the queue closed: no new dispatches can arrive,
+            # and every batch already in flight still delivers.
+            self._window.close()
 
 
 def declared_non_batch_major_outputs(signature: Signature) -> list[str]:
@@ -456,6 +712,7 @@ def maybe_wrap_servable(servable, params: BatchingParameters | dict | None,
             allowed_batch_sizes=params.get("allowed_batch_sizes"),
             pad_variable_length_inputs=params.get(
                 "pad_variable_length_inputs", False),
+            max_in_flight_batches=params.get("max_in_flight_batches", 1),
         )
         # Replace the signature's run with the batched path, keep a handle
         # for unload-time queue removal.

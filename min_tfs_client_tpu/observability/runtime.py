@@ -279,7 +279,20 @@ def snapshot(include_live_arrays: bool = False) -> dict:
         "devices": device_memory(),
         "transfer": transfer_totals(),
         "profiler": profiler.status(),
+        "pipeline": pipeline_stats(),
     }
     if include_live_arrays:
         payload["live_arrays"] = live_array_stats()
     return payload
+
+
+def pipeline_stats() -> dict:
+    """Per-queue in-flight execution window stats (depth, dispatched,
+    overlapped, overlap ratio) — the runtime view of the pipelined
+    batching path (batching/session.py _InFlightWindow)."""
+    try:
+        from min_tfs_client_tpu.batching.session import pipeline_snapshot
+
+        return pipeline_snapshot()
+    except Exception:  # pragma: no cover - stats must not break the payload
+        return {}

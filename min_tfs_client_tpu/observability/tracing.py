@@ -67,6 +67,12 @@ STAGES = (
     "batching/queue_wait",
     "batching/merge",
     "batching/execute",
+    # Pipelined in-flight execution (window > 1): slot wait, async launch
+    # (device dispatch + D2H copies issued), and the completion thread's
+    # materialization of one batch (docs/OBSERVABILITY.md).
+    "batching/in_flight_wait",
+    "batching/dispatch",
+    "batching/materialize",
     "serving/pad",
     "device/host_to_device",
     "device/execute",
@@ -74,6 +80,12 @@ STAGES = (
     "host/execute",
     "partition/pre",
     "partition/post",
+    # Microbatched partition pipeline (multi-segment imports): per-chunk
+    # host stage, device launch, and materialization — chunk j's host
+    # stage overlaps chunk j-1's device segment.
+    "pipeline/host",
+    "pipeline/dispatch",
+    "pipeline/materialize",
     "serving/serialize",
 )
 
@@ -113,10 +125,15 @@ class RequestTrace:
     """One request's timeline: spans + metadata, filled as it flows.
 
     Deliberately lock-free on the recording path: `spans.append` of a
-    pre-built tuple is atomic under the GIL, and the only cross-thread
-    writer (the batch scheduler) finishes before the caller's
-    `task.done.wait()` returns. Readers copy the list (`list(spans)`),
-    which is likewise GIL-safe against a concurrent append.
+    pre-built tuple is atomic under the GIL, and every cross-thread
+    writer finishes before the caller's `task.done.wait()` returns —
+    the batch scheduler stops writing before handing the task off, and
+    the in-flight window's completion thread closes its last span
+    before `done.set()` (batching/session.py `_complete_batch`). Any
+    new writer must keep that ordering: no span may be recorded after
+    the task's `done` event fires. Readers copy the list
+    (`list(spans)`), which is likewise GIL-safe against a concurrent
+    append.
     """
 
     __slots__ = ("id", "api", "model", "signature", "transport", "status",

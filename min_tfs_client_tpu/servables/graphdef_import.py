@@ -1648,6 +1648,21 @@ def load_saved_model(
 
             sig.fn = make_part_fn()
             sig.partition = partition
+            # Declared batch membership per feed, for the microbatch
+            # pipeline's chunking: only a polymorphic leading dim rides
+            # the batch; a fixed-shape feed (vocab table, config tensor)
+            # must never be sliced even when its row count coincides
+            # with the request batch. unknown_rank -> None (pipeline
+            # declines rather than guess), and so do sparse-triple
+            # pseudo-aliases (same `pseudo` rule as `batched` above):
+            # indices/values lead with nnz and carry global example ids,
+            # so neither row-slicing nor pass-whole yields a consistent
+            # per-chunk triple — sparse signatures serve serially.
+            partition.feed_batch_major = [
+                None if (in_specs[a].unknown_rank or a in pseudo)
+                else bool(in_specs[a].shape
+                          and in_specs[a].shape[0] is None)
+                for a in in_aliases]
 
     if not signatures:
         raise ServingError.failed_precondition(

@@ -43,7 +43,7 @@ import numpy as np
 
 from min_tfs_client_tpu.observability import tracing
 from min_tfs_client_tpu.protos import tf_tensor_pb2
-from min_tfs_client_tpu.servables.servable import fetch_outputs
+from min_tfs_client_tpu.servables.servable import fetch_outputs, start_fetch
 
 # Ops that must run on host regardless of their dtype attrs (string
 # processing, hash tables, Example parsing). Mirrors the kernel classes
@@ -225,6 +225,24 @@ class _Segment:
         self.param_args: list = []
 
 
+class _InteriorHandle:
+    """Completion handle for one launched jitted interior: the device
+    dispatch is in flight and every output's D2H copy is issued when the
+    handle is constructed (_dispatch_interior); result() blocks only for
+    materialization and returns the outputs as a list, in order."""
+
+    __slots__ = ("_outs",)
+
+    def __init__(self, outs):
+        self._outs = list(outs)
+
+    def result(self) -> list:
+        fetched = fetch_outputs(dict(enumerate(self._outs)))
+        outs = [fetched[i] for i in range(len(self._outs))]
+        self._outs = None  # free the device refs promptly
+        return outs
+
+
 class GraphPartition:
     """The execution stages of one partitioned signature.
 
@@ -248,6 +266,11 @@ class GraphPartition:
     # smaller consts stay closed over (GSPMD replicates them, which is
     # what DP wants and costs little HBM).
     TP_MIN_BYTES = 1 << 20
+    # Microbatch pipelining needs every chunk's leading dim >= 2 so a
+    # genuinely batch-major result can never be confused with a fixed
+    # (1, ...) output that batch-1 calibration harmlessly mis-marks
+    # (slicing tolerates the mix-up, concatenation would not).
+    PIPELINE_MIN_CHUNK = 2
 
     def __init__(self, *, segments, post, feed_names, post_extra_refs,
                  stats, build_refs):
@@ -282,6 +305,25 @@ class GraphPartition:
         # Latched on the first failed probe so a persistent failure is
         # recorded once, not per padded request.
         self._calibration_failed = False
+        # Same latch for pipelined-run failures (run() falls back to
+        # serial): warn once, not per request.
+        self._pipeline_fallback_logged = False
+        # Microbatch pipeline depth (m): >1 lets multi-segment runs split
+        # the merged batch into up to m chunks and software-pipeline host
+        # islands against jitted segments (chunk j's host stage overlaps
+        # chunk j-1's device work, GPipe over the host/device boundary).
+        # 1 = the serial path, exactly the pre-pipeline behavior. Set by
+        # the loader from --max_in_flight_batches (platforms.make_loader).
+        self.pipeline_depth = 1
+        # Per-feed batch-major declarations, aligned with feed_names:
+        # True = leading dim is the batch (safe to chunk), False = fixed
+        # shape (must pass whole — slicing a table-shaped feed whose row
+        # count happens to equal the batch would silently corrupt host
+        # stages), None per entry = unknown rank (pipeline declines).
+        # Set from the signature's input specs at import
+        # (graphdef_import); stays None for direct try_partition callers,
+        # which fall back to the dim-0-match heuristic.
+        self.feed_batch_major: "list[bool | None] | None" = None
 
     # -- single-segment aliases (the k == 1 common case; tests and the
     # -- introspection surface predate multi-segment) ------------------------
@@ -554,12 +596,51 @@ class GraphPartition:
             batch_buckets: Sequence[int]) -> list[object]:
         """feed_values aligned with feed_names; returns fetch values.
 
-        Segments execute in topo order: each host prelude sees the
-        signature feeds plus every earlier stage's cut/interior-output
-        values (GraphFunction feeds shield their upstream cones), each
-        interior pads to a bucket, runs jitted (mesh-sharded when
-        attached), and slices back before the next host stage."""
+        Multi-segment partitions with pipeline_depth > 1 microbatch the
+        batch and software-pipeline host islands against device segments
+        (_run_pipelined); single-segment graphs, small batches, and any
+        pipeline surprise take the serial path, whose own failure mode
+        (PartitionError) keeps the caller's all-host fallback — a
+        pipeline problem is never a failed request."""
         feed_values = [np.asarray(v) for v in feed_values]
+        if self.pipeline_depth > 1 and len(self.segments) > 1:
+            try:
+                results = self._run_pipelined(feed_values, batch_buckets)
+            except Exception:  # noqa: BLE001 - serial recomputes from the
+                results = None  # untouched feeds; in-flight work is dropped
+                if not self._pipeline_fallback_logged:
+                    # Once per partition (same latch rationale as
+                    # _record_calibration_failure): a PERSISTENT
+                    # pipeline failure means every depth>1 request does
+                    # the chunked work, discards it, and re-runs
+                    # serially — ~2x latency and device load that must
+                    # not stay invisible to operators.
+                    self._pipeline_fallback_logged = True
+                    import logging
+
+                    logging.getLogger(__name__).warning(
+                        "microbatch pipeline failed; serving this and "
+                        "(silently) any later failing requests via the "
+                        "serial path — persistent failures double "
+                        "per-request work", exc_info=True)
+            if results is not None:
+                return results
+        return self._run_serial(feed_values, batch_buckets)
+
+    def _run_serial(self, feed_values: list[np.ndarray],
+                    batch_buckets: Sequence[int]) -> list[object]:
+        """The original whole-batch path: segments execute in topo order;
+        each host prelude sees the signature feeds plus every earlier
+        stage's cut/interior-output values (GraphFunction feeds shield
+        their upstream cones), each interior pads to a bucket, runs
+        jitted (mesh-sharded when attached), and slices back before the
+        next host stage.
+
+        KEEP IN SYNC with _pipeline_chunk: it is this body minus the
+        static-args and calibration branches (the pipeline declines
+        those upstream), under pipeline/* span names, with a yield at
+        the dispatch point. The fuzz oracle (test_partition_fuzz
+        pipelined variant) asserts the two stay row-for-row identical."""
         from min_tfs_client_tpu.parallel.mesh import data_axis_size
 
         # One (mesh, epoch) snapshot per request: a concurrent
@@ -627,10 +708,9 @@ class GraphPartition:
                 # result.)
                 raise PartitionError("mesh changed mid-request")
             with tracing.span("device/execute"):
-                outs = fn(padded)
+                handle = self._dispatch_interior(fn, padded)
             with tracing.span("device/device_to_host"):
-                fetched = fetch_outputs(dict(enumerate(outs)))
-            outs = [fetched[i] for i in range(len(outs))]
+                outs = handle.result()
             if sliced:
                 outs = [o[:seg_batch]
                         if self._is_batch_major(seg.out_batch_major,
@@ -651,6 +731,198 @@ class GraphPartition:
             # matching each result against EVERY padded segment's
             # bucket, since segments over different leading dims (per-
             # example vs per-token rows) pad to different buckets.
+            out = []
+            for i, r in enumerate(results):
+                arr = np.asarray(r)
+                pair = next(
+                    ((b, k) for b, k in sliced_pairs
+                     if self._is_batch_major(self._result_batch_major,
+                                             i, arr, k)), None)
+                out.append(arr[:pair[0]] if pair is not None else r)
+            results = out
+        return results
+
+    # -- microbatch software pipeline (pipeline_depth > 1) -------------------
+
+    def _dispatch_interior(self, fn: Callable, padded: list) -> "_InteriorHandle":
+        """Launch one jitted interior and issue its outputs' D2H copies;
+        the handle's result() materializes. The ONE seam both the serial
+        and pipelined paths go through — bench's simulated-latency device
+        wrapper shims exactly this method."""
+        outs = fn(padded)
+        start_fetch(dict(enumerate(outs)))
+        return _InteriorHandle(outs)
+
+    def _run_pipelined(self, feed_values: list[np.ndarray],
+                       batch_buckets: Sequence[int]
+                       ) -> "list[object] | None":
+        """Microbatch the batch into m <= pipeline_depth chunks and
+        round-robin them through the segment stages: chunk j runs its
+        host island while chunk j-1's device segment and D2H copies are
+        still in flight (GPipe over the host/device boundary). Returns
+        None to decline — uncalibrated outputs, static shape operands,
+        ambiguous batch dim, or a batch too small to split — and the
+        caller serves serially. Chunk padding follows the same bucket
+        rule any request of that size takes, so results match the serial
+        path row for row (the batched-signature contract: rows are
+        independent — the same property padding already relies on)."""
+        import collections
+
+        from min_tfs_client_tpu.parallel.mesh import data_axis_size
+
+        with self._jit_lock:
+            mesh = self._mesh
+            epoch = self._mesh_epoch
+        ndata = data_axis_size(mesh)
+        if any(any(seg.static_flags) for seg in self.segments):
+            # Static shape operands specialize the jit on full-batch
+            # values host stages computed; per-chunk re-specialization is
+            # legal but churns the cache — serve serially instead.
+            return None
+        flags = self.feed_batch_major
+        if flags is not None and any(f is None for f in flags):
+            return None  # an unknown-rank feed: chunk membership is
+            # undecidable, serial path answers
+        if flags is not None:
+            # Declared batch membership: every batch-major feed must
+            # agree on the batch; fixed-shape feeds stay out of the set
+            # (and are never sliced below) even when their row count
+            # coincides with the batch.
+            dims = {v.shape[0] for i, v in enumerate(feed_values)
+                    if flags[i] and np.ndim(v)}
+        else:
+            # Heuristic for direct try_partition callers: the batch
+            # reference is the dynamic interior-consumed signature feeds
+            # (the same rule _calibrate uses; with no static flags, that
+            # is every used feed).
+            ref = [feed_values[i] for seg in self.segments
+                   for i in seg.used_feed_idx]
+            dims = {v.shape[0] for v in ref if np.ndim(v)}
+        if len(dims) != 1:
+            return None  # interiors fed only by cuts, or ambiguous
+        batch = dims.pop()
+        min_chunk = max(self.PIPELINE_MIN_CHUNK, ndata)
+        if batch < 2 * min_chunk:
+            return None  # too small to overlap anything
+        if any(seg.out_batch_major is None for seg in self.segments) \
+                or self._result_batch_major is None:
+            if self._calibration_failed:
+                return None
+            self._calibrate(feed_values)
+            if any(seg.out_batch_major is None for seg in self.segments) \
+                    or self._result_batch_major is None:
+                return None
+        if not all(self._result_batch_major):
+            # A non-batch-major RESULT's value may still depend on the
+            # whole batch (a count or aggregate, not just a constant
+            # table) — the merge below would take chunk 0's value,
+            # computed over chunk rows only, silently diverging from
+            # the serial path. Bit-identity outranks overlap: decline.
+            return None
+        chunk = -(-batch // self.pipeline_depth)
+        chunk = max(chunk, min_chunk)
+        if ndata > 1:
+            chunk = -(-chunk // ndata) * ndata
+        m = -(-batch // chunk)
+        if m < 2 or batch - (m - 1) * chunk < self.PIPELINE_MIN_CHUNK:
+            return None  # a runt tail chunk would re-open the (1, ...)
+            # vs batch-major ambiguity the gate exists to close
+        chunk_feeds, sizes = [], []
+        for j in range(m):
+            lo, hi = j * chunk, min(batch, (j + 1) * chunk)
+            sizes.append(hi - lo)
+            chunk_feeds.append([
+                v[lo:hi] if (np.ndim(v) and v.shape[0] == batch
+                             and (flags is None or flags[i]))
+                else v
+                for i, v in enumerate(feed_values)])
+        tracing.annotate(pipeline_chunks=m, pipeline_chunk_size=chunk)
+        gens = [self._pipeline_chunk(cf, batch_buckets, ndata, mesh,
+                                     epoch, j)
+                for j, cf in enumerate(chunk_feeds)]
+        results: list = [None] * m
+        live = collections.deque(enumerate(gens))
+        while live:
+            j, gen = live.popleft()
+            try:
+                next(gen)
+            except StopIteration as stop:
+                results[j] = stop.value
+            else:
+                live.append((j, gen))
+        merged: list = []
+        for i in range(len(results[0])):
+            # Every result is batch-major here — non-batch-major results
+            # declined the pipeline upstream (their value may encode a
+            # batch-wide count/aggregate no chunk can reproduce).
+            parts = [np.asarray(r[i]) for r in results]
+            if any(not p.ndim or p.shape[0] != s
+                   for p, s in zip(parts, sizes)):
+                raise PartitionError(
+                    f"pipelined result {i} does not follow the chunk "
+                    "batch; serial path must answer")
+            merged.append(np.concatenate(parts, axis=0))
+        return merged
+
+    def _pipeline_chunk(self, feeds: list[np.ndarray],
+                        batch_buckets: Sequence[int], ndata: int, mesh,
+                        epoch: int, chunk_idx: int):
+        """Generator running ONE chunk through every stage, yielding at
+        each device-dispatch point so the driver can overlap other
+        chunks' host work with this chunk's in-flight device segment.
+
+        KEEP IN SYNC with _run_serial (see its docstring): a semantics
+        fix there almost certainly belongs here too."""
+        computed: dict[str, np.ndarray] = {}
+        sliced_pairs: list[tuple[int, int]] = []
+        for idx, seg in enumerate(self.segments):
+            cut_values: list[np.ndarray] = []
+            if seg.cut_in_refs:
+                extra = [computed[r] for r in seg.extra_feed_refs]
+                with tracing.span("pipeline/host", chunk=chunk_idx,
+                                  segment=idx):
+                    cut_values = [
+                        np.asarray(v)
+                        for v in seg.host_fn(feeds + extra, np)]
+                for ref, v in zip(seg.cut_in_refs, cut_values):
+                    if v.dtype.kind in "OSU":
+                        raise PartitionError(
+                            f"cut tensor {ref} is string-typed at "
+                            "runtime; partition invalid")
+            dyn = [np.asarray(v)
+                   for v in [feeds[i] for i in seg.used_feed_idx]
+                   + cut_values]
+            padded, seg_batch, seg_bucket = _pad_interior(
+                dyn, batch_buckets, ndata)
+            sliced = seg_bucket is not None and seg_bucket != seg_batch
+            if sliced and (seg_batch, seg_bucket) not in sliced_pairs:
+                sliced_pairs.append((seg_batch, seg_bucket))
+            if mesh is not None:
+                with tracing.span("device/host_to_device"):
+                    padded = self._place_dyn(padded, mesh)
+            fn = self._jit_for(idx)([], ())
+            if self._mesh_epoch != epoch:
+                raise PartitionError("mesh changed mid-request")
+            with tracing.span("pipeline/dispatch", chunk=chunk_idx,
+                              segment=idx):
+                handle = self._dispatch_interior(fn, padded)
+            yield  # device segment + D2H in flight: let other chunks run
+            with tracing.span("pipeline/materialize", chunk=chunk_idx,
+                              segment=idx):
+                outs = handle.result()
+            if sliced:
+                outs = [o[:seg_batch]
+                        if self._is_batch_major(seg.out_batch_major,
+                                                i, o, seg_bucket) else o
+                        for i, o in enumerate(outs)]
+            for ref, v in zip(seg.cut_in_refs, cut_values):
+                computed.setdefault(ref, v)
+            for ref, o in zip(seg.out_refs, outs):
+                computed[ref] = np.asarray(o)
+        post_feeds = feeds + [computed[r] for r in self._post_extra_refs]
+        with tracing.span("pipeline/host", chunk=chunk_idx, segment=-1):
+            results = self.post(post_feeds, np)
+        if sliced_pairs:
             out = []
             for i, r in enumerate(results):
                 arr = np.asarray(r)

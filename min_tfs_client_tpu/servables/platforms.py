@@ -92,6 +92,17 @@ def make_loader(
             # Compile buckets must be final BEFORE warmup, or warmup primes
             # shapes that will never serve.
             batching = apply_batch_buckets(servable, batching)
+        window = max(1, int(config.get("max_in_flight_batches", 1) or 1))
+        if batching is not None:
+            batching.setdefault("max_in_flight_batches", window)
+        if window > 1:
+            # Multi-segment partitioned imports reuse the same knob as
+            # their microbatch pipeline depth: chunk j's host island
+            # overlaps chunk j-1's in-flight device segment.
+            for sig in servable.signatures.values():
+                part = getattr(sig, "partition", None)
+                if part is not None:
+                    part.pipeline_depth = window
         seq_buckets = config.get("seq_buckets")
         seq_pad_value = config.get("seq_pad_value")
         if seq_buckets or seq_pad_value is not None:

@@ -18,6 +18,8 @@ port:
 
 from __future__ import annotations
 
+import threading
+
 from dataclasses import dataclass, field as dc_field
 from typing import Callable, Mapping, Optional, Sequence
 
@@ -393,7 +395,35 @@ class Signature:
         inputs: Mapping[str, np.ndarray],
         output_filter: Sequence[str] = (),
     ) -> dict[str, np.ndarray]:
-        """Validate, pad, execute, slice, return alias-keyed outputs."""
+        """Validate, pad, execute, slice, return alias-keyed outputs.
+
+        Window-1 view of the async seam: dispatch + immediate result().
+        The batching layer's in-flight window calls the two halves from
+        different threads to overlap batch k+1's dispatch with batch k's
+        outstanding D2H copies."""
+        return self.dispatch(inputs, output_filter).result()
+
+    def dispatch(
+        self,
+        inputs: Mapping[str, np.ndarray],
+        output_filter: Sequence[str] = (),
+    ) -> "ExecutionHandle":
+        """Validate, pad, place, and LAUNCH the execution, returning a
+        completion handle instead of materialized outputs.
+
+        For device signatures the jit dispatch is async on real
+        accelerators and every requested output's device->host copy is
+        already issued (copy_to_host_async) when this returns — the
+        caller can dispatch more work while the transfers run; the
+        handle's result() blocks only for materialization. Host
+        signatures (string graphs, partitioned imports) have no async
+        device seam of their own, so they execute here and the handle is
+        already complete. Validation errors raise HERE, synchronously —
+        a malformed request must fail before any batch-mate could be
+        affected. result() is idempotent and may be called from another
+        thread; trace spans recorded during it land on whatever trace is
+        active on THAT thread (the batching completion thread activates
+        the riders' fanout before materializing)."""
         with tracing.span("serving/validate"):
             arrays = self.validate(inputs, output_filter)
         keys = list(output_filter) if output_filter else list(self.outputs)
@@ -415,20 +445,27 @@ class Signature:
             self._check_produced(outputs, keys)
             # servelint: sync-ok host-path outputs are already numpy (the
             # name is shared with the device branch below)
-            return {k: np.asarray(outputs[k]) for k in keys}
+            return CompletedExecution({k: np.asarray(outputs[k])
+                                       for k in keys})
 
         true_seq = self._true_seq_len(arrays)
         outputs, batch = self._run_device(arrays)
         self._check_produced(outputs, keys)
         # Fetch ONLY the requested outputs (the executable computes them
         # all, but unfetched ones never cross the device->host link), in a
-        # single overlapped round: async-copy every output, then read. N
-        # sequential DMAs collapse to one round trip — on remote/tunneled
-        # PJRT transports each synchronous fetch costs a full RTT, and even
-        # locally the DMAs overlap.
+        # single overlapped round: async-copy every output now, leave the
+        # materialization to result(). N sequential DMAs collapse to one
+        # round trip — on remote/tunneled PJRT transports each synchronous
+        # fetch costs a full RTT, and even locally the DMAs overlap.
+        pending = {k: outputs[k] for k in keys}
+        # Issuing the copies is the dispatch half of the D2H stage (the
+        # handle's result() records the blocking half under the same
+        # name; stage_durations sums them) — at MB-scale outputs the
+        # issue loop is real wall time and must stay inside a span or
+        # the trace-coverage acceptance (>=90%) loses it.
         with tracing.span("device/device_to_host"):
-            result = fetch_outputs({k: outputs[k] for k in keys}, batch)
-        return self._slice_seq_outputs(result, true_seq)
+            start_fetch(pending)
+        return _DeviceExecution(self, pending, batch, true_seq)
 
     def _true_seq_len(self, arrays: Mapping[str, np.ndarray]) -> Optional[int]:
         sb = self.sequence_bucketing
@@ -621,6 +658,89 @@ class Signature:
         return sig
 
 
+class ExecutionHandle:
+    """Completion handle for one dispatched execution.
+
+    result() returns the alias-keyed numpy outputs, raising the
+    execution's error instead when it failed; it is idempotent (the
+    first call materializes, later calls replay the outcome) and safe to
+    call from a different thread than dispatch()."""
+
+    __slots__ = ("_result", "_error", "_done", "_lock")
+
+    def __init__(self):
+        self._result: dict | None = None
+        self._error: Exception | None = None
+        self._done = False                   # guarded_by: self._lock
+        self._lock = threading.Lock()
+
+    def _materialize(self) -> dict:  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def result(self) -> dict:
+        # Locked: "safe to call from a different thread" must include
+        # two threads calling result() concurrently — an unlocked _done
+        # check would let both run _materialize, and _DeviceExecution's
+        # loser would fetch from the already-freed _pending.
+        with self._lock:
+            if not self._done:
+                try:
+                    self._result = self._materialize()
+                except Exception as exc:  # delivered to every result() call
+                    self._error = exc
+                self._done = True
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+
+class CompletedExecution(ExecutionHandle):
+    """A handle whose work finished at dispatch time (host signatures,
+    simulated executions in tests)."""
+
+    __slots__ = ()
+
+    def __init__(self, outputs: dict):
+        super().__init__()
+        self._result = outputs
+        self._done = True
+
+
+class _DeviceExecution(ExecutionHandle):
+    """Pending device outputs: dispatch launched the executable and
+    issued every D2H copy; materialization (np.asarray) happens in
+    result() on whichever thread drives completion."""
+
+    __slots__ = ("_signature", "_pending", "_batch", "_true_seq")
+
+    def __init__(self, signature: "Signature", pending: dict,
+                 batch: Optional[int], true_seq: Optional[int]):
+        super().__init__()
+        self._signature = signature
+        self._pending = pending
+        self._batch = batch
+        self._true_seq = true_seq
+
+    def _materialize(self) -> dict:
+        with tracing.span("device/device_to_host"):
+            result = fetch_outputs(self._pending, self._batch)
+        self._pending = None  # free the device refs promptly
+        return self._signature._slice_seq_outputs(result, self._true_seq)
+
+
+def start_fetch(outputs: Mapping[str, object]) -> None:
+    """Issue the device->host copy of every jax.Array output WITHOUT
+    materializing: the transfers run while the caller does other work
+    (the dispatch half of fetch_outputs' overlapped round)."""
+    for value in outputs.values():
+        start = getattr(value, "copy_to_host_async", None)
+        if start is not None:
+            try:
+                start()
+            except Exception:  # pragma: no cover - fall back to sync copy
+                pass
+
+
 def fetch_outputs(outputs: Mapping[str, object],
                   batch: Optional[int] = None) -> dict[str, np.ndarray]:
     """Device->host for a dict of outputs as ONE overlapped round.
@@ -630,13 +750,7 @@ def fetch_outputs(outputs: Mapping[str, object],
     one link round trip instead of a sequential sum. `batch` slices padded
     leading dims back to the true request size (host-side view, no copy).
     """
-    for value in outputs.values():
-        start = getattr(value, "copy_to_host_async", None)
-        if start is not None:
-            try:
-                start()
-            except Exception:  # pragma: no cover - fall back to sync copy
-                pass
+    start_fetch(outputs)
     result = {}
     fetched_bytes = 0
     for key, value in outputs.items():

@@ -40,6 +40,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--file_system_poll_wait_seconds", type=float, default=1.0)
     p.add_argument("--enable_batching", action="store_true")
     p.add_argument("--batching_parameters_file", default="")
+    p.add_argument("--max_in_flight_batches", type=int, default=1,
+                   help="batches a queue may have dispatched to the device "
+                        "with results not yet materialized; >1 overlaps "
+                        "batch k+1's dispatch with batch k's D2H copies "
+                        "and microbatch-pipelines multi-segment imports "
+                        "(1 = exact pre-window serial behavior)")
     p.add_argument("--monitoring_config_file", default="")
     p.add_argument("--ssl_config_file", default="")
     p.add_argument("--max_num_load_retries", type=int, default=5)
@@ -157,6 +163,7 @@ def options_from_args(args) -> ServerOptions:
         file_system_poll_wait_seconds=args.file_system_poll_wait_seconds,
         enable_batching=args.enable_batching,
         batching_parameters_file=args.batching_parameters_file,
+        max_in_flight_batches=args.max_in_flight_batches,
         monitoring_config_file=args.monitoring_config_file,
         ssl_config_file=args.ssl_config_file,
         max_num_load_retries=args.max_num_load_retries,

@@ -162,6 +162,16 @@ padding_wasted_examples = Counter(
     ":tpu/serving/padding_wasted_examples",
     "Example-slots executed as padding (bucket size minus real examples), "
     "by queue.", ("queue",))
+in_flight_batches = Gauge(
+    ":tpu/serving/in_flight_batches",
+    "Batches dispatched to the device whose outputs are not yet "
+    "materialized (the pipelined execution window's current depth), "
+    "by queue.", ("queue",))
+pipeline_overlap_occupancy = Gauge(
+    ":tpu/serving/pipeline_overlap_occupancy",
+    "In-flight depth over the configured --max_in_flight_batches window "
+    "at the most recent dispatch (1.0 = window fully used), by queue.",
+    ("queue",))
 partition_calibration_failures = Counter(
     ":tpu/serving/partition_calibration_failures",
     "Batch-1 calibration probes that failed; the dim-match heuristic "

@@ -56,6 +56,13 @@ class ServerOptions:
     file_system_poll_wait_seconds: float = 1.0
     enable_batching: bool = False
     batching_parameters_file: str = ""
+    # In-flight execution window per batching queue: how many batches may
+    # be dispatched (device work launched, D2H copies issued) with results
+    # not yet materialized. 1 = the exact pre-window serial path; >1
+    # overlaps batch k+1's dispatch with batch k's outstanding transfers
+    # and sets the microbatch pipeline depth of multi-segment partitioned
+    # imports (docs/MIGRATING.md "Pipelined in-flight execution").
+    max_in_flight_batches: int = 1
     monitoring_config_file: str = ""
     ssl_config_file: str = ""
     max_num_load_retries: int = 5
@@ -421,6 +428,8 @@ def _platform_configs(opts: ServerOptions, batching) -> dict:
         "warmup_iterations": opts.warmup_iterations,
         "synthesize_warmup": opts.synthesize_warmup,
     }
+    if opts.max_in_flight_batches > 1:
+        shared["max_in_flight_batches"] = opts.max_in_flight_batches
     if batching is not None:
         shared["batching_parameters"] = batching
     mesh_axes = _parse_mesh_axes(opts.mesh_axes)

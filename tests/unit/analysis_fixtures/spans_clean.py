@@ -30,3 +30,15 @@ def reviewed_exception(worker, pool):
     trace = tracing.current_trace()
     # servelint: span-ok fixture-reviewed crossing for the test corpus
     return pool.submit(worker, trace)
+
+
+def sanctioned_completion_thread_materialize(batch, handle, split):
+    # The in-flight window's completion thread (batching/session.py
+    # _complete_batch): the riders' traces crossed the queue ON their
+    # BatchTasks, so the materializing thread re-enters them through
+    # activate(fanout(...)) — no ambient contextvar ever crossed.
+    traces = [t.trace for t in batch if t.trace is not None]
+    with tracing.activate(tracing.fanout(traces)):
+        with tracing.span("batching/materialize"):
+            outputs = handle.result()
+    return split(outputs)

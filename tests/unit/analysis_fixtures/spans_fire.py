@@ -25,3 +25,11 @@ def sp002_trace_to_thread(worker):
 def sp002_trace_to_executor(pool, worker):
     trace = tracing.current_trace()
     return pool.submit(worker, trace)        # SP002
+
+
+def sp002_trace_to_completion_thread(window, materialize, handle):
+    # Handing the live trace to an in-flight completion window directly
+    # — it must ride the BatchTask instead (tasks carry .trace; the
+    # completion thread activates the fanout).
+    trace = tracing.current_trace()
+    window.submit(materialize, handle, trace)    # SP002

@@ -93,7 +93,17 @@ class TestPooledSessions:
         rng = np.random.default_rng(3)
         n = 6
         prompts = [_prompt(config, rng) for _ in range(n)]
-        wants = [_oracle(params, config, p)[0] for p in prompts]
+        # Reference = the SAME pooled program run one session at a time.
+        # The scan oracle is a different XLA executable (batch-1 scan vs
+        # the pool's vmapped batch-8 step); its float reassociation can
+        # flip greedy argmax at near-ties (prompt 0 here has a 0.002
+        # logit margin between tokens 0 and 54), which says nothing
+        # about the property under test — that concurrency and tick
+        # coalescing never change a session's tokens. Cross-program
+        # oracle exactness is covered on tie-free prompts by
+        # test_single_session_matches_oracle / test_interleaved above.
+        wants = [_run_session(sigs, np.asarray(f"ref-{i}".encode(), object),
+                              prompts[i]) for i in range(n)]
         results = [None] * n
         errors = []
 

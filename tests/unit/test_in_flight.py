@@ -1,0 +1,612 @@
+"""Pipelined in-flight execution (ISSUE 5).
+
+The bounded dispatch window through the batching layer, the Signature
+async execute/fetch seam, and the partition microbatch pipeline must be
+NUMERICS-INVISIBLE: window=1 is literally the pre-window code path, and
+every window/depth produces bit-identical outputs — overlap only moves
+wall-clock, never values. Errors stay with their own batch, shutdown
+drains instead of dropping, and trace context crosses the completion
+thread via the BatchTask mechanism (never ambient contextvars).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from min_tfs_client_tpu.batching.scheduler import SharedBatchScheduler
+from min_tfs_client_tpu.batching.session import (
+    BatchedSignatureRunner,
+    pipeline_snapshot,
+)
+from min_tfs_client_tpu.servables.servable import (
+    CompletedExecution,
+    ExecutionHandle,
+    Servable,
+    Signature,
+    TensorSpec,
+)
+from min_tfs_client_tpu.utils.status import ServingError
+from tests import fixtures
+
+
+@pytest.fixture()
+def scheduler():
+    s = SharedBatchScheduler(num_threads=2)
+    yield s
+    s.stop()
+
+
+def _toy_signature():
+    import jax.numpy as jnp
+
+    return Signature(
+        fn=lambda inputs: {"y": jnp.tanh(inputs["x"]) * 2.0 + 1.0},
+        inputs={"x": TensorSpec(np.float32, (None, 4))},
+        outputs={"y": TensorSpec(np.float32, (None, 4))},
+    )
+
+
+def _run_wave(runner, n=24, rows=1):
+    """n concurrent callers, each `rows` rows. rows stays BELOW the
+    runner's max_batch_size so requests ride the queue (size >= max
+    takes the oversized direct path and never sees the window)."""
+    results = [None] * n
+    errors = [None] * n
+
+    def call(i):
+        try:
+            x = (np.arange(rows * 4, dtype=np.float32).reshape(rows, 4)
+                 * 0.1 + i)
+            results[i] = np.asarray(runner.run({"x": x})["y"])
+        except Exception as exc:  # noqa: BLE001 - asserted by callers
+            errors[i] = exc
+
+    threads = [threading.Thread(target=call, args=(i,)) for i in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    return results, errors
+
+
+class TestDispatchSeam:
+    def test_run_equals_dispatch_result(self):
+        sig = _toy_signature()
+        x = np.arange(8, dtype=np.float32).reshape(2, 4)
+        want = sig.run({"x": x})
+        handle = sig.dispatch({"x": x})
+        assert isinstance(handle, ExecutionHandle)
+        got = handle.result()
+        np.testing.assert_array_equal(got["y"], want["y"])
+
+    def test_result_is_idempotent_and_cross_thread(self):
+        sig = _toy_signature()
+        x = np.ones((2, 4), np.float32)
+        handle = sig.dispatch({"x": x})
+        first = handle.result()
+        box = {}
+        t = threading.Thread(
+            target=lambda: box.setdefault("r", handle.result()))
+        t.start()
+        t.join(timeout=10)
+        np.testing.assert_array_equal(first["y"], box["r"]["y"])
+
+    def test_host_signature_dispatch_is_completed(self):
+        sig = Signature(
+            fn=lambda inputs: {"y": np.asarray(inputs["x"]) + 1.0},
+            inputs={"x": TensorSpec(np.float32, (None, 4))},
+            outputs={"y": TensorSpec(np.float32, (None, 4))},
+            on_host=True,
+        )
+        handle = sig.dispatch({"x": np.zeros((2, 4), np.float32)})
+        assert isinstance(handle, CompletedExecution)
+        np.testing.assert_array_equal(handle.result()["y"],
+                                      np.ones((2, 4), np.float32))
+
+    def test_validation_errors_raise_at_dispatch(self):
+        sig = _toy_signature()
+        with pytest.raises(ServingError):
+            sig.dispatch({"x": np.zeros((2, 5), np.float32)})
+
+    def test_handle_replays_error(self):
+        class Boom(ExecutionHandle):
+            def _materialize(self):
+                raise ValueError("boom")
+
+        handle = Boom()
+        with pytest.raises(ValueError):
+            handle.result()
+        with pytest.raises(ValueError):  # replayed, not recomputed
+            handle.result()
+
+
+class TestWindowedBatching:
+    def test_bit_identical_across_window_sizes(self, scheduler):
+        outs = {}
+        for window in (1, 2, 8):
+            sig = _toy_signature()
+            runner = BatchedSignatureRunner(
+                sig, scheduler, name=f"win{window}", max_batch_size=8,
+                batch_timeout_s=0.005, allowed_batch_sizes=[2, 4, 8],
+                max_in_flight_batches=window)
+            try:
+                results, errors = _run_wave(runner)
+            finally:
+                runner.close()
+            assert all(e is None for e in errors), errors
+            outs[window] = results
+        for window in (2, 8):
+            for a, b in zip(outs[1], outs[window]):
+                np.testing.assert_array_equal(a, b)
+
+    def test_window_overlaps_batches(self, scheduler):
+        """With a simulated 20 ms device and a window of 4, four batches
+        must actually be in flight together (the overlap counter), and
+        throughput must beat the serial window=1 run. Best-of-3: the
+        contrast is wall-clock, and a loaded CI box can stagger thread
+        starts enough to serialize one attempt's dispatches."""
+        last = None
+        for attempt in range(3):
+            walls, overlapped = {}, 0
+            for window in (1, 4):
+                sig = _toy_signature()
+                fixtures.simulate_device_latency(sig, 0.02)
+                name = f"olap{window}a{attempt}"
+                runner = BatchedSignatureRunner(
+                    sig, scheduler, name=name, max_batch_size=2,
+                    batch_timeout_s=0.001, allowed_batch_sizes=[2],
+                    max_in_flight_batches=window)
+                try:
+                    _run_wave(runner, n=8)  # warm the compile
+                    t0 = time.perf_counter()
+                    results, errors = _run_wave(runner, n=8)
+                    walls[window] = time.perf_counter() - t0
+                    assert all(e is None for e in errors), errors
+                    if window > 1:
+                        overlapped = pipeline_snapshot()[name]["overlapped"]
+                finally:
+                    runner.close()
+            last = (walls, overlapped)
+            if overlapped > 0 and walls[4] < walls[1]:
+                return
+        walls, overlapped = last
+        assert overlapped > 0
+        assert walls[4] < walls[1]
+
+    def test_error_in_batch_k_does_not_poison_k_plus_1(self, scheduler):
+        """A batch whose device run fails delivers its error to exactly
+        its own riders; batches already in the window and batches
+        dispatched after it still serve real results."""
+        sig = _toy_signature()
+        inner = sig.dispatch
+        fail_on = {2}  # the 3rd dispatched batch fails at materialize
+        count = [0]
+
+        class FailLate(ExecutionHandle):
+            def _materialize(self):
+                raise RuntimeError("injected device failure")
+
+        def flaky(inputs, output_filter=()):
+            k = count[0]
+            count[0] += 1
+            if k in fail_on:
+                return FailLate()
+            return inner(inputs, output_filter)
+
+        sig.dispatch = flaky
+        runner = BatchedSignatureRunner(
+            sig, scheduler, name="errwin", max_batch_size=2,
+            batch_timeout_s=0.001, allowed_batch_sizes=[2],
+            max_in_flight_batches=4)
+        try:
+            results, errors = _run_wave(runner, n=12)
+        finally:
+            runner.close()
+        failed = [i for i, e in enumerate(errors) if e is not None]
+        served = [i for i, e in enumerate(errors) if e is None]
+        # Exactly one batch of riders failed, everyone else got values.
+        assert 1 <= len(failed) <= 2
+        assert all(isinstance(errors[i], RuntimeError) for i in failed)
+        for i in served:
+            want = np.tanh(np.arange(4, dtype=np.float32).reshape(1, 4)
+                           * 0.1 + i) * 2.0 + 1.0
+            np.testing.assert_allclose(results[i], want, rtol=1e-6)
+
+    def test_dispatch_failure_fails_only_its_batch(self, scheduler):
+        sig = _toy_signature()
+        inner = sig.dispatch
+        count = [0]
+
+        def flaky(inputs, output_filter=()):
+            k = count[0]
+            count[0] += 1
+            if k == 1:
+                raise RuntimeError("injected dispatch failure")
+            return inner(inputs, output_filter)
+
+        sig.dispatch = flaky
+        runner = BatchedSignatureRunner(
+            sig, scheduler, name="dispfail", max_batch_size=2,
+            batch_timeout_s=0.001, allowed_batch_sizes=[2],
+            max_in_flight_batches=4)
+        try:
+            results, errors = _run_wave(runner, n=8)
+        finally:
+            runner.close()
+        # Exactly ONE batch failed (1 or 2 riders, timing-dependent with
+        # a 1 ms timeout); everyone outside it got a real value.
+        n_failed = sum(e is not None for e in errors)
+        assert 1 <= n_failed <= 2
+        assert all(isinstance(e, RuntimeError) for e in errors
+                   if e is not None)
+        for i, (r, e) in enumerate(zip(results, errors)):
+            if e is None:
+                want = (np.tanh(np.arange(4, dtype=np.float32)
+                                .reshape(1, 4) * 0.1 + i) * 2.0 + 1.0)
+                np.testing.assert_allclose(r, want, rtol=1e-6)
+
+    def test_close_drains_in_flight_batches(self, scheduler):
+        """Shutdown must materialize every dispatched batch — callers
+        blocked on a window batch get real results, never drops."""
+        sig = _toy_signature()
+        fixtures.simulate_device_latency(sig, 0.2)
+        # Generous timeout so slow thread starts still pair into 4 FULL
+        # batches — a straggler singleton would make a 5th batch that
+        # cannot enter the closed window.
+        runner = BatchedSignatureRunner(
+            sig, scheduler, name="drain", max_batch_size=2,
+            batch_timeout_s=0.05, allowed_batch_sizes=[2],
+            max_in_flight_batches=4)
+        results = {}
+
+        def call(i):
+            x = np.full((1, 4), float(i), np.float32)
+            results[i] = np.asarray(runner.run({"x": x})["y"])
+
+        threads = [threading.Thread(target=call, args=(i,))
+                   for i in range(8)]
+        for t in threads:
+            t.start()
+        # All 4 batches (8 callers / batch 2, window 4) must be IN the
+        # window before close — the drain guarantee covers dispatched
+        # work; tasks still queued get the pre-existing unavailable
+        # strand, which is not what this test measures.
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            stats = pipeline_snapshot().get("drain", {})
+            if stats.get("dispatched", 0) >= 4:
+                break
+            time.sleep(0.002)
+        assert pipeline_snapshot()["drain"]["dispatched"] >= 4
+        runner.close()    # drain: all dispatched work still delivers
+        for t in threads:
+            t.join(timeout=30)
+        assert len(results) == 8
+        for i, got in results.items():
+            want = np.tanh(np.full((1, 4), float(i), np.float32)) * 2 + 1
+            np.testing.assert_allclose(got, want, rtol=1e-6)
+        # The window deregistered from the snapshot registry.
+        assert "drain" not in pipeline_snapshot()
+
+    def test_worker_falls_back_to_sync_when_window_closes(self, scheduler):
+        """The close()/acquire() race: a batch the worker already popped
+        when unload closes the window must execute synchronously and
+        deliver real results (the pre-window behavior), never fail its
+        riders with 'window is closed'."""
+        from min_tfs_client_tpu.batching.session import _InFlightWindow
+
+        w = _InFlightWindow(2, "race-closed")
+        w.close()
+        assert w.acquire() is False  # closed: decline, don't raise
+
+        sig = _toy_signature()
+        runner = BatchedSignatureRunner(
+            sig, scheduler, name="race-fb", max_batch_size=2,
+            batch_timeout_s=0.001, allowed_batch_sizes=[2],
+            max_in_flight_batches=4)
+        try:
+            # Close ONLY the window (unload's first half); the queue is
+            # still accepting, so the worker pops batches and must take
+            # the synchronous fallback path.
+            runner._window.close()
+            results, errors = _run_wave(runner, n=4)
+            assert all(e is None for e in errors), errors
+            for i, got in enumerate(results):
+                want = np.tanh(
+                    np.arange(4, dtype=np.float32).reshape(1, 4)
+                    * 0.1 + i) * 2 + 1
+                np.testing.assert_allclose(got, want, rtol=1e-6)
+        finally:
+            runner.close()
+
+    def test_close_drain_wait_is_bounded(self):
+        """A wedged materialization must not hold close() (= unload)
+        hostage: past CLOSE_DRAIN_TIMEOUT_S close returns while the
+        daemon completion thread keeps waiting, and a late answer still
+        delivers."""
+        from min_tfs_client_tpu.batching.session import _InFlightWindow
+
+        w = _InFlightWindow(2, "wedged")
+        w.CLOSE_DRAIN_TIMEOUT_S = 0.3
+        release = threading.Event()
+        delivered = threading.Event()
+
+        def complete():
+            release.wait(timeout=30)
+            delivered.set()
+
+        assert w.acquire()
+        w.submit(complete)
+        t0 = time.perf_counter()
+        w.close()
+        took = time.perf_counter() - t0
+        assert took < 5, f"close() blocked {took:.1f}s on a wedged batch"
+        assert not delivered.is_set()  # still wedged at close return
+        release.set()                  # device finally answers
+        assert delivered.wait(timeout=10)
+
+    def test_window_1_builds_no_window(self, scheduler):
+        runner = BatchedSignatureRunner(
+            _toy_signature(), scheduler, name="nowin", max_batch_size=4,
+            max_in_flight_batches=1)
+        try:
+            assert runner._window is None
+            assert "nowin" not in pipeline_snapshot()
+        finally:
+            runner.close()
+
+    def test_trace_crosses_completion_thread_via_task(self, scheduler):
+        """The rider's RequestTrace records the materialize span even
+        though it runs on the completion thread — handed over through
+        BatchTask.trace + fanout, not ambient contextvars."""
+        from min_tfs_client_tpu.observability import tracing
+
+        sig = _toy_signature()
+        runner = BatchedSignatureRunner(
+            sig, scheduler, name="tracewin", max_batch_size=2,
+            batch_timeout_s=0.001, allowed_batch_sizes=[2],
+            max_in_flight_batches=2)
+        try:
+            tr = tracing.RequestTrace("m", "s", "predict")
+            with tracing.activate(tr):
+                runner.run({"x": np.ones((1, 4), np.float32)})
+            names = [s[0] for s in tr.spans]
+            assert "batching/dispatch" in names
+            assert "batching/materialize" in names
+        finally:
+            runner.close()
+
+
+def _wrap_servable(window, scheduler):
+    sig = _toy_signature()
+    sv = Servable("w", 1, {"predict": sig})
+    from min_tfs_client_tpu.batching.session import maybe_wrap_servable
+
+    maybe_wrap_servable(sv, {"max_batch_size": 8, "batch_timeout_s": 0.002,
+                             "max_in_flight_batches": window}, scheduler)
+    return sv
+
+
+def test_maybe_wrap_threads_window_through(scheduler):
+    sv = _wrap_servable(4, scheduler)
+    try:
+        runner = sv._batch_runners[0]
+        assert runner._window is not None
+        assert runner._window.depth == 4
+    finally:
+        for r in sv._batch_runners:
+            r.close()
+
+
+class TestPartitionPipeline:
+    @pytest.fixture(scope="class")
+    def two_tower(self, tmp_path_factory):
+        from min_tfs_client_tpu.servables.graphdef_import import (
+            load_saved_model,
+        )
+
+        base = tmp_path_factory.mktemp("tt") / "m"
+        fixtures.write_imported_two_tower(base)
+        sv = load_saved_model(str(base / "1"), "m", 1)
+        sig = next(iter(sv.signatures.values()))
+        assert len(sig.partition.segments) == 2
+        return sig
+
+    def test_pipelined_bit_identical_to_serial(self, two_tower):
+        part = two_tower.partition
+        rng = np.random.RandomState(7)
+        for batch in (8, 16, 23):
+            x = rng.randn(batch, 8).astype(np.float32)
+            part.pipeline_depth = 1
+            serial = two_tower.run({"x": x})
+            for depth in (2, 4, 8):
+                part.pipeline_depth = depth
+                try:
+                    got = two_tower.run({"x": x})
+                finally:
+                    part.pipeline_depth = 1
+                for k in serial:
+                    np.testing.assert_array_equal(got[k], serial[k])
+
+    def test_small_batches_take_serial_path(self, two_tower):
+        part = two_tower.partition
+        part.pipeline_depth = 4
+        try:
+            calls = []
+            inner = part._run_serial
+
+            def spy(feeds, buckets):
+                calls.append(True)
+                return inner(feeds, buckets)
+
+            part._run_serial = spy
+            two_tower.run({"x": np.ones((2, 8), np.float32)})
+            assert calls  # batch of 2 < 2*min_chunk: declined, serial
+        finally:
+            del part._run_serial
+            part.pipeline_depth = 1
+
+    def test_pipeline_surprise_falls_back_to_serial(self, two_tower):
+        """Any pipelined-path failure silently serves via the serial
+        path — a pipeline problem is never a failed request."""
+        part = two_tower.partition
+        part.pipeline_depth = 4
+        inner = part._dispatch_interior
+        try:
+            # Both paths share the dispatch seam, so explode only on the
+            # first (pipelined) attempt; the serial retry then succeeds.
+            calls = [0]
+
+            def once(fn, padded):
+                calls[0] += 1
+                if calls[0] <= 1:
+                    raise RuntimeError("pipeline-only failure")
+                return inner(fn, padded)
+
+            part._dispatch_interior = once
+            x = np.ones((16, 8), np.float32)
+            got = two_tower.run({"x": x})
+            part.pipeline_depth = 1
+            part.__dict__.pop("_dispatch_interior", None)
+            want = two_tower.run({"x": x})
+            for k in want:
+                np.testing.assert_array_equal(got[k], want[k])
+        finally:
+            part.__dict__.pop("_dispatch_interior", None)
+            part.pipeline_depth = 1
+
+    def test_pipeline_spans_show_interleaving(self, two_tower):
+        """The GPipe property, asserted on the trace timeline: at least
+        one chunk's device dispatch is issued while another chunk's
+        segment is still in flight (dispatch_j+1 before materialize_j)."""
+        from min_tfs_client_tpu.observability import tracing
+
+        part = two_tower.partition
+        fixtures.simulate_interior_latency(part, 0.003)
+        part.pipeline_depth = 4
+        try:
+            tr = tracing.RequestTrace("m", "s", "predict")
+            with tracing.activate(tr):
+                two_tower.run({"x": np.ones((16, 8), np.float32)})
+            seq = [(name, args) for name, _, _, args in tr.spans
+                   if name in ("pipeline/dispatch", "pipeline/materialize")]
+            assert seq, "pipeline spans missing"
+            in_flight: set = set()
+            interleaved = 0
+            for name, args in seq:
+                key = (args["chunk"], args["segment"])
+                if name == "pipeline/dispatch":
+                    if any(c != args["chunk"] for c, _ in in_flight):
+                        interleaved += 1
+                    in_flight.add(key)
+                else:
+                    in_flight.discard(key)
+            assert interleaved > 0
+        finally:
+            part.__dict__.pop("_dispatch_interior", None)
+            part.pipeline_depth = 1
+
+    def test_non_batch_major_result_declines_pipeline(self, two_tower):
+        """A calibrated non-batch-major RESULT may still be
+        batch-DEPENDENT in value (a count or aggregate, not only a
+        constant table) — the chunk merge would return chunk 0's value,
+        computed over chunk rows only. The pipeline must decline and
+        let the serial path answer."""
+        part = two_tower.partition
+        x = np.ones((16, 8), np.float32)
+        two_tower.run({"x": x})  # ensure calibrated
+        saved = part._result_batch_major
+        assert saved is not None and all(saved)
+        calls = []
+        inner = part._run_serial
+        part._run_serial = lambda f, b: (calls.append(True),
+                                         inner(f, b))[1]
+        part.pipeline_depth = 4
+        part._result_batch_major = [False] + list(saved[1:])
+        try:
+            two_tower.run({"x": x})
+            assert calls  # declined -> serial path answered
+        finally:
+            part._result_batch_major = saved
+            part.pipeline_depth = 1
+            del part._run_serial
+
+    def test_fixed_shape_feed_never_sliced(self, two_tower):
+        """Chunking follows the signature's DECLARED batch membership,
+        not a dim-0 coincidence: a feed declared fixed-shape (vocab
+        table, config tensor) whose row count happens to equal the
+        request batch must not be sliced — with no batch-major feed
+        left, the pipeline declines and the serial path answers.
+        unknown_rank likewise declines (membership undecidable)."""
+        part = two_tower.partition
+        # The import wired the declaration from the input specs.
+        assert part.feed_batch_major == [True]
+        calls = []
+        inner = part._run_serial
+        part._run_serial = lambda f, b: (calls.append(True),
+                                         inner(f, b))[1]
+        part.pipeline_depth = 4
+        x = np.ones((16, 8), np.float32)
+        try:
+            for declared in ([False], [None]):
+                part.feed_batch_major = declared
+                calls.clear()
+                two_tower.run({"x": x})
+                assert calls, declared  # declined -> serial path ran
+        finally:
+            part.feed_batch_major = [True]
+            part.pipeline_depth = 1
+            del part._run_serial
+
+    def test_single_segment_never_pipelines(self, tmp_path):
+        from min_tfs_client_tpu.servables.graphdef_import import (
+            load_saved_model,
+        )
+
+        base = tmp_path / "mm"
+        fixtures.write_matmul_model(base)
+        sv = load_saved_model(str(base / "1"), "mm", 1)
+        sig = next(iter(sv.signatures.values()))
+        part = sig.partition
+        if part is None:
+            pytest.skip("matmul model did not partition")
+        assert len(part.segments) == 1
+        part.pipeline_depth = 8
+        called = []
+        part._run_pipelined = lambda *a: called.append(True)
+        sig.run({"x": np.ones((16, 3), np.float32)})
+        assert not called
+
+
+class TestSchedulerDetached:
+    def test_detached_tasks_survive_worker_error_path(self, scheduler):
+        """A processor that detaches its tasks then raises must NOT have
+        the worker's finally complete them — the window owns delivery."""
+        from min_tfs_client_tpu.batching.scheduler import (
+            BatchTask,
+            QueueOptions,
+        )
+
+        delivered = []
+
+        def process(batch):
+            for t in batch:
+                t.detached = True
+            delivered.append(list(batch))
+            raise RuntimeError("post-handoff failure")
+
+        queue = scheduler.add_queue(
+            "det", QueueOptions(max_batch_size=2, batch_timeout_s=0),
+            process)
+        task = BatchTask(inputs={}, size=1)
+        scheduler.schedule(queue, task)
+        time.sleep(0.2)
+        assert delivered and not task.done.is_set()
+        assert task.error is None
+        # The owner (here: the test, playing the window) completes it.
+        task.outputs = {"y": 1}
+        task.done.set()

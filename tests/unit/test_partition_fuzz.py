@@ -193,6 +193,71 @@ def test_fuzz_corpus_actually_covers_multi_segment():
     assert multi >= 2, f"only {multi}/12 seeds exercised multi-segment"
 
 
+@pytest.mark.parametrize("seed", range(12))
+def test_pipelined_matches_serial_on_random_graphs(seed):
+    """ISSUE 5 oracle variant: the microbatch software pipeline must be
+    bit-identical to the serial partition path on every random DAG — for
+    multi-segment graphs it actually pipelines, for single-segment or
+    declined shapes it must fall through to serial untouched. The serial
+    results themselves are already oracle-checked against the all-host
+    interpreter above, so array_equal here closes the full chain."""
+    rng = np.random.default_rng(seed)
+    gd, tables, fetches = _build_random_graph(rng)
+    part = try_partition(gd, ["x:0"], fetches,
+                         funclib=_FuncLib(None), tables=tables)
+    if part is None:
+        pytest.skip("host-only graph for this seed")
+    for batch in (8, 16, 23):
+        x = rng.standard_normal((batch, WIDTH)).astype(np.float32)
+        part.pipeline_depth = 1
+        want = part.run([x], batch_buckets=(1, 4, 8, 16, 32))
+        for depth in (2, 4, 8):
+            part.pipeline_depth = depth
+            try:
+                got = part.run([x], batch_buckets=(1, 4, 8, 16, 32))
+            finally:
+                part.pipeline_depth = 1
+            assert len(got) == len(want)
+            for g, w in zip(got, want):
+                g, w = np.asarray(g), np.asarray(w)
+                if w.dtype.kind in "OSU":
+                    np.testing.assert_array_equal(g.astype(object),
+                                                  w.astype(object))
+                else:
+                    np.testing.assert_array_equal(g, w)
+
+
+def test_pipelined_fuzz_corpus_actually_pipelines():
+    """Coverage guard for the variant above: enough seeds must take the
+    pipelined path for real (multi-segment, batch large enough, not
+    declined), or the bit-identical check silently collapses into
+    serial-vs-serial."""
+    pipelined = 0
+    for seed in range(12):
+        rng = np.random.default_rng(seed)
+        gd, tables, fetches = _build_random_graph(rng)
+        part = try_partition(gd, ["x:0"], fetches,
+                             funclib=_FuncLib(None), tables=tables)
+        if part is None or part.stats["n_segments"] < 2:
+            continue
+        x = rng.standard_normal((16, WIDTH)).astype(np.float32)
+        serial_calls = []
+        inner = part._run_serial
+        part._run_serial = (
+            lambda f, b, _i=inner, _c=serial_calls: (_c.append(True),
+                                                     _i(f, b))[1])
+        part.pipeline_depth = 4
+        try:
+            part.run([x], batch_buckets=(1, 4, 8, 16, 32))
+        finally:
+            part.pipeline_depth = 1
+            del part._run_serial
+        if not serial_calls:
+            pipelined += 1
+    assert pipelined >= 2, (
+        f"only {pipelined}/12 seeds actually ran the microbatch pipeline")
+
+
 @pytest.mark.parametrize("seed", range(0, 12, 3))
 def test_partitioned_matches_all_host_on_the_mesh(seed):
     """Same oracle property with the 8-device CPU mesh attached: DP

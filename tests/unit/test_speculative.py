@@ -96,8 +96,12 @@ class TestSpeculativeDecode:
         np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
         np.testing.assert_array_equal(np.asarray(got_len),
                                       np.asarray(want_len))
-        # The scenario actually exercised early stop.
-        assert int(np.asarray(want_len).max()) < MAXDEC
+        # The scenario actually exercised early stop — on ROW 0, the row
+        # the eos token was probed from. Other rows' greedy streams need
+        # never emit that token (the tiny random model's streams are
+        # platform-dependent near argmax ties), so asserting the batch
+        # max would couple the fixture to unrelated rows' numerics.
+        assert int(np.asarray(want_len)[0]) < MAXDEC
 
     def test_finished_row_does_not_pin_acceptance(self, models):
         """A row that finishes early must not drag the batch-min
