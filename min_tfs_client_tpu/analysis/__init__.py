@@ -1,10 +1,16 @@
 """servelint: AST-based hot-path static analysis for the serving stack.
 
-Four rule families (docs/STATIC_ANALYSIS.md), a comment-annotation
-vocabulary (`# guarded_by:`, `# servelint: sync-ok|lock-ok|jit-ok|
-span-ok|holds`), and a checked-in baseline ratchet. Gated in tier-1 via
+Six rule families (docs/STATIC_ANALYSIS.md) — host-sync (HS), recompile
+(RC), lock-discipline (LK), span-discipline (SP), interprocedural
+lock-order (DL, a package-level pass), and thread-root inventory (TH) —
+plus a runtime schedule witness (witness.py) that verifies the
+annotations against live schedules in the concurrency test suites. The
+comment-annotation vocabulary (`# guarded_by:`, `# servelint:
+sync-ok|lock-ok|jit-ok|span-ok|holds|blocks|thread-ok`) and a checked-in
+baseline ratchet. Gated in tier-1 via
 tests/unit/test_static_analysis.py; CLI via `servelint` /
-`python -m min_tfs_client_tpu.analysis`.
+`python -m min_tfs_client_tpu.analysis` (`--jobs N` fans the file scan
+over processes).
 """
 
 from min_tfs_client_tpu.analysis.baseline import (

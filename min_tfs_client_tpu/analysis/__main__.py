@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 
 from min_tfs_client_tpu.analysis.baseline import save_baseline
@@ -27,8 +28,9 @@ def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(
         prog="servelint",
         description="AST-based hot-path analysis for the TPU serving "
-                    "stack: host-sync, recompile-hazard, lock-discipline "
-                    "and span-discipline rules (docs/STATIC_ANALYSIS.md).")
+                    "stack: host-sync, recompile-hazard, lock-discipline, "
+                    "span-discipline, interprocedural lock-order and "
+                    "thread-inventory rules (docs/STATIC_ANALYSIS.md).")
     parser.add_argument("paths", nargs="*",
                         help="files/directories to analyze "
                              "(default: the installed package)")
@@ -40,6 +42,9 @@ def main(argv: list[str] | None = None) -> int:
                              "and exit 0")
     parser.add_argument("--format", choices=("text", "json"),
                         default="text")
+    parser.add_argument("--jobs", type=int, default=1, metavar="N",
+                        help="parallel file-scan processes (0 = one per "
+                             "CPU); package passes still link globally")
     parser.add_argument("--list", action="store_true", dest="list_all",
                         help="print every finding (including baselined)")
     args = parser.parse_args(argv)
@@ -51,7 +56,10 @@ def main(argv: list[str] | None = None) -> int:
     elif baseline == "none":
         baseline = None
 
-    report = run_analysis(paths, baseline_path=baseline)
+    if args.jobs < 0:
+        parser.error(f"--jobs must be >= 0 (got {args.jobs})")
+    jobs = args.jobs if args.jobs > 0 else (os.cpu_count() or 1)
+    report = run_analysis(paths, baseline_path=baseline, jobs=jobs)
 
     if args.write_baseline:
         if baseline is None:

@@ -5,12 +5,14 @@ don't have in Python — `GUARDED_BY`/`EXCLUSIVE_LOCKS_REQUIRED` clang
 thread-safety annotations on batching/manager state, and static typing
 that makes an accidental device->host sync a visible type coercion. This
 package is the Python analogue: a self-contained `ast`-based analyzer
-(no new dependencies) with four rule families (docs/STATIC_ANALYSIS.md):
+(no new dependencies) with six rule families (docs/STATIC_ANALYSIS.md):
 
   host-sync   (HS*)  device->host coercions in hot-path modules
   recompile   (RC*)  jit recompile hazards (per-call jit, tracer branches)
   locks       (LK*)  `# guarded_by:` lock-discipline (GUARDED_BY analogue)
   spans       (SP*)  trace spans opened outside `with` / leaked to threads
+  lock-order  (DL*)  interprocedural lock-order cycles + untimed parks
+  threads     (TH*)  thread-root inventory / undeclared shared state
 
 Annotations are ordinary comments, so the runtime never pays for them:
 
@@ -21,6 +23,8 @@ Annotations are ordinary comments, so the runtime never pays for them:
   got = jax.jit(f)(x)       # servelint: jit-ok <reason>
   self._x += 1              # servelint: lock-ok <reason>
   s = tracing.span("x")     # servelint: span-ok <reason>
+  self._cv.wait()           # servelint: blocks <reason>
+  self.core = build()       # servelint: thread-ok <reason>
 """
 
 from __future__ import annotations

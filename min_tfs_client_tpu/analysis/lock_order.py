@@ -1,0 +1,872 @@
+"""DL: interprocedural lock-order analysis — deadlock-free by construction.
+
+The reference stack runs its batching/manager core under clang thread-
+safety analysis + TSan; a lock-order inversion there is a compile-time or
+sanitizer failure. This is the Python analogue for the threaded serving
+core: build an interprocedural lock-ACQUISITION graph and flag anything
+that could park a fleet node forever.
+
+Nodes are lock OBJECTS, resolved to stable ids (`path::Class.attr`,
+`path::<module>.name`) from
+
+  * creation sites  (`self._mu = threading.Lock()/RLock()/Condition()`),
+  * acquisition sites (`with self._mu:` and `x.acquire()`/`x.release()`),
+  * `# servelint: holds <lock>` caller-holds contracts.
+
+Edges are acquired-while-held relations, propagated across call edges
+within the package (self-method calls, module functions, package imports,
+constructor calls, and attribute/param-annotation-typed receivers —
+`self._scheduler._cv` resolves through `scheduler: "SerialDevice..."`).
+`threading.Condition(existing_lock)` aliases the condition to the lock it
+wraps (one mutex, one node).
+
+  DL001  cycle in the acquisition graph (>=3 locks, or re-acquiring a
+         non-reentrant lock through a call chain)
+  DL002  two locks acquired in both orders (the classic AB/BA inversion)
+  DL003  a blocking operation that can park a thread forever: untimed
+         Condition.wait()/Event.wait(), zero-arg Thread.join(), zero-arg
+         queue.get(), or a device sync (host_sync taint) while holding a
+         lock. Worker loops that are SUPPOSED to park annotate the line
+         `# servelint: blocks <why>`.
+
+The pass is package-level (`PACKAGE_PASS = True`): `summarize()` runs
+per module (parallelizable, picklable output), `check_package()` links
+the summaries, runs the fixpoint, and emits findings. `static_graph()`
+exposes the linked edge set — the runtime schedule witness asserts the
+OBSERVED acquisition order stays consistent with it.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+from min_tfs_client_tpu.analysis import host_sync
+from min_tfs_client_tpu.analysis.core import (
+    AnalysisConfig,
+    Finding,
+    ModuleInfo,
+    collect_jit_bindings,
+    dotted,
+    walk_scopes,
+)
+
+RULE = "lock-order"
+PACKAGE_PASS = True
+
+_LOCK_FACTORIES = {
+    "threading.Lock": "lock",
+    "threading.RLock": "rlock",
+    "threading.Condition": "condition",
+    "threading.Semaphore": "semaphore",
+    "threading.BoundedSemaphore": "semaphore",
+}
+# Reentrant kinds: a call chain re-entering the same lock is legal.
+_REENTRANT = {"rlock"}
+# Zero-arg blocking calls that park the calling thread with no deadline.
+# `get` only fires on receivers resolved to a known queue creation —
+# `ContextVar.get()` / `dict.get()` are non-blocking.
+_PARK_METHODS = {
+    "wait": "untimed wait() parks this thread until someone signals",
+    "join": "zero-arg join() waits forever for the thread to exit",
+    "get": "zero-arg get() parks until the queue produces",
+}
+_QUEUE_FACTORIES = {"queue.Queue", "Queue", "queue.SimpleQueue",
+                    "SimpleQueue", "queue.LifoQueue", "queue.PriorityQueue"}
+
+
+# -- picklable per-module summaries (computed per file, linked globally) -----
+
+
+@dataclass
+class FunctionSummary:
+    path: str
+    qualname: str
+    # (node, line, held_before) — `with`/acquire() events.
+    acquires: list = field(default_factory=list)
+    # (callee_spec, held, line) — callee_spec is a tuple tag resolved at
+    # link time: ("self", cls, meth) / ("fn", path, name) /
+    # ("method", path, cls, meth) / ("ctor", path, cls).
+    calls: list = field(default_factory=list)
+    # (kind, line, held, desc) — DL003 candidates (suppressed ones are
+    # dropped at summarize time).
+    parks: list = field(default_factory=list)
+    # (line, held, desc) — device-sync-while-locked candidates.
+    syncs: list = field(default_factory=list)
+
+    @property
+    def key(self):
+        return (self.path, self.qualname)
+
+
+@dataclass
+class ModuleSummary:
+    path: str
+    creations: dict = field(default_factory=dict)   # node -> kind
+    aliases: dict = field(default_factory=dict)     # node -> wrapped node
+    holds_nodes: set = field(default_factory=set)   # lockhood evidence
+    functions: list = field(default_factory=list)
+
+
+# -- module-local name/type resolution ---------------------------------------
+
+
+def _module_relpath(dotted_mod: str) -> str:
+    return dotted_mod.replace(".", "/") + ".py"
+
+
+class _Namespace:
+    """Imports, classes, and light attr/param typing for one module —
+    just enough to resolve `self._scheduler._cv` and `metrics.safe_set`
+    to stable cross-module ids. Unresolvable means NO edge (silence over
+    a false cycle)."""
+
+    def __init__(self, module: ModuleInfo):
+        self.module = module
+        self.path = module.path
+        self.classes: dict[str, ast.ClassDef] = {}
+        self.imports: dict[str, tuple] = {}   # name -> ("mod",path)|("sym",path,sym)
+        self.attr_types: dict[str, dict[str, tuple]] = {}  # cls -> attr -> ref
+        self.elem_types: dict[str, dict[str, tuple]] = {}  # cls -> attr -> ref
+        self._collect_imports()
+        self._collect_classes()
+
+    def _collect_imports(self) -> None:
+        pkg = self.path.rsplit("/", 1)[0].replace("/", ".") \
+            if "/" in self.path else ""
+        for node in ast.walk(self.module.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    local = alias.asname or alias.name.partition(".")[0]
+                    target = alias.name if alias.asname else \
+                        alias.name.partition(".")[0]
+                    self.imports[local] = ("mod", _module_relpath(target))
+            elif isinstance(node, ast.ImportFrom):
+                base = node.module or ""
+                if node.level:  # relative: anchor at this module's package
+                    parts = pkg.split(".") if pkg else []
+                    parts = parts[: len(parts) - (node.level - 1)]
+                    base = ".".join(parts + ([base] if base else []))
+                for alias in node.names:
+                    local = alias.asname or alias.name
+                    # `from pkg.mod import sym` — sym may itself be a
+                    # module; record both readings, module wins when the
+                    # symbol is used as an attribute base.
+                    self.imports[local] = (
+                        "sym", _module_relpath(base), alias.name)
+
+    def _collect_classes(self) -> None:
+        def visit(node, prefix):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.ClassDef):
+                    self.classes[f"{prefix}{child.name}"] = child
+                    visit(child, f"{prefix}{child.name}.")
+                elif not isinstance(child, (ast.FunctionDef,
+                                            ast.AsyncFunctionDef)):
+                    visit(child, prefix)
+        visit(self.module.tree, "")
+        for qual, classdef in self.classes.items():
+            self.attr_types[qual] = {}
+            self.elem_types[qual] = {}
+            self._collect_attr_types(qual, classdef)
+
+    # class references: ("cls", path, qualname) ------------------------------
+
+    def resolve_class(self, name: str) -> tuple | None:
+        if name in self.classes:
+            return ("cls", self.path, name)
+        imp = self.imports.get(name)
+        if imp and imp[0] == "sym":
+            return ("cls", imp[1], imp[2])
+        return None
+
+    def _annotation_class(self, ann) -> tuple | None:
+        """`X`, `"X"`, `Optional[X]` -> class ref; container[X] -> None
+        (see element type)."""
+        ref, _ = self._annotation_refs(ann)
+        return ref
+
+    def _annotation_refs(self, ann) -> tuple:
+        """(direct class ref or None, element class ref or None)."""
+        if ann is None:
+            return None, None
+        if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+            try:
+                ann = ast.parse(ann.value, mode="eval").body
+            except SyntaxError:
+                return None, None
+        if isinstance(ann, (ast.Name, ast.Attribute)):
+            name = (dotted(ann) or "").rsplit(".", 1)[-1]
+            return self.resolve_class(name), None
+        if isinstance(ann, ast.Subscript):
+            base = (dotted(ann.value) or "").rsplit(".", 1)[-1]
+            inner = ann.slice
+            if base == "Optional":
+                return self._annotation_refs(inner)
+            if base in ("list", "List", "deque", "Deque", "tuple", "Tuple",
+                        "Sequence", "Iterable", "dict", "Dict"):
+                if base in ("dict", "Dict") and isinstance(inner, ast.Tuple) \
+                        and len(inner.elts) == 2:
+                    inner = inner.elts[1]
+                ref, _ = self._annotation_refs(inner)
+                return None, ref
+        return None, None
+
+    def _collect_attr_types(self, qual: str, classdef: ast.ClassDef) -> None:
+        param_types: dict[str, tuple] = {}
+        for node in ast.walk(classdef):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for a in (node.args.posonlyargs + node.args.args +
+                          node.args.kwonlyargs):
+                    ref = self._annotation_class(a.annotation)
+                    if ref:
+                        param_types[a.arg] = ref
+        for node in ast.walk(classdef):
+            if isinstance(node, ast.AnnAssign) and \
+                    isinstance(node.target, ast.Attribute) and \
+                    isinstance(node.target.value, ast.Name) and \
+                    node.target.value.id == "self":
+                direct, elem = self._annotation_refs(node.annotation)
+                if direct:
+                    self.attr_types[qual][node.target.attr] = direct
+                if elem:
+                    self.elem_types[qual][node.target.attr] = elem
+            elif isinstance(node, ast.Assign):
+                for target in node.targets:
+                    if not (isinstance(target, ast.Attribute) and
+                            isinstance(target.value, ast.Name) and
+                            target.value.id == "self"):
+                        continue
+                    value = node.value
+                    if isinstance(value, ast.Call):
+                        name = (dotted(value.func) or "").rsplit(".", 1)[-1]
+                        ref = self.resolve_class(name)
+                        if ref:
+                            self.attr_types[qual].setdefault(
+                                target.attr, ref)
+                    elif isinstance(value, ast.Name) and \
+                            value.id in param_types:
+                        self.attr_types[qual].setdefault(
+                            target.attr, param_types[value.id])
+
+
+class _FnContext:
+    """Resolution context for one function: class scope + local types."""
+
+    def __init__(self, ns: _Namespace, class_qual: str | None, func):
+        self.ns = ns
+        self.class_qual = class_qual
+        self.local_types: dict[str, tuple] = {}
+        self.local_lock_alias: dict[str, str] = {}
+        for a in (func.args.posonlyargs + func.args.args +
+                  func.args.kwonlyargs) if hasattr(func, "args") else []:
+            ref = ns._annotation_class(a.annotation)
+            if ref:
+                self.local_types[a.arg] = ref
+
+    def note_assign(self, node: ast.Assign) -> None:
+        """`v = ClassName(...)` / `v = self._attr` / `v = self._list[i]`
+        type facts, plus `cv = self._cv` lock aliases."""
+        if len(node.targets) != 1 or \
+                not isinstance(node.targets[0], ast.Name):
+            return
+        name = node.targets[0].id
+        value = node.value
+        if isinstance(value, ast.Call):
+            cls = (dotted(value.func) or "").rsplit(".", 1)[-1]
+            ref = self.ns.resolve_class(cls)
+            if ref:
+                self.local_types[name] = ref
+            return
+        if isinstance(value, ast.Subscript):
+            base = value.value
+            if isinstance(base, ast.Attribute) and \
+                    isinstance(base.value, ast.Name) and \
+                    base.value.id == "self" and self.class_qual:
+                ref = self.ns.elem_types.get(self.class_qual, {}).get(
+                    base.attr)
+                if ref:
+                    self.local_types[name] = ref
+            return
+        expr = dotted(value)
+        if expr:
+            resolved = self.resolve_lock(expr)
+            if resolved:
+                self.local_lock_alias[name] = resolved
+            ref = self._resolve_type(expr)
+            if ref:
+                self.local_types[name] = ref
+
+    def _resolve_type(self, expr: str) -> tuple | None:
+        parts = expr.split(".")
+        if parts[0] == "self" and self.class_qual and len(parts) == 2:
+            return self.ns.attr_types.get(self.class_qual, {}).get(parts[1])
+        return None
+
+    def resolve_lock(self, expr: str) -> str | None:
+        """Dotted lock expression -> stable node id, or None."""
+        parts = expr.split(".")
+        if parts[0] == "self":
+            if not self.class_qual or len(parts) < 2:
+                return None
+            owner = ("cls", self.ns.path, self.class_qual)
+        elif parts[0] in self.local_lock_alias and len(parts) == 1:
+            return self.local_lock_alias[parts[0]]
+        elif parts[0] in self.local_types:
+            owner = self.local_types[parts[0]]
+        elif len(parts) == 1:
+            return f"{self.ns.path}::<module>.{parts[0]}"
+        else:
+            return None
+        # Walk intermediate attributes through attr types; the LAST part
+        # is the lock attribute on the final owner.
+        for attr in parts[1:-1]:
+            if owner[1] != self.ns.path:
+                return None  # cross-module attr walk: one hop only
+            owner = self.ns.attr_types.get(owner[2], {}).get(attr)
+            if owner is None:
+                return None
+        return f"{owner[1]}::{owner[2]}.{parts[-1]}"
+
+    def resolve_callee(self, call: ast.Call) -> tuple | None:
+        func = call.func
+        name = dotted(func)
+        if name is None:
+            return None
+        parts = name.split(".")
+        if len(parts) == 1:
+            ref = self.ns.resolve_class(parts[0])
+            if ref:
+                return ("ctor", ref[1], ref[2])
+            imp = self.ns.imports.get(parts[0])
+            if imp and imp[0] == "sym":
+                return ("fn", imp[1], imp[2])
+            return ("fn", self.ns.path, parts[0])
+        if parts[0] == "self" and self.class_qual:
+            if len(parts) == 2:
+                return ("self", self.class_qual, parts[1])
+            owner = self.ns.attr_types.get(self.class_qual, {}).get(parts[1])
+            if owner and len(parts) == 3:
+                return ("method", owner[1], owner[2], parts[2])
+            return None
+        if parts[0] in self.local_types and len(parts) == 2:
+            owner = self.local_types[parts[0]]
+            return ("method", owner[1], owner[2], parts[1])
+        imp = self.ns.imports.get(parts[0])
+        if imp and len(parts) == 2:
+            # module alias (`metrics.safe_set`) — either import form.
+            if imp[0] == "mod":
+                return ("fn", imp[1], parts[1])
+            return ("fn", _module_relpath(
+                imp[1][:-3].replace("/", ".") + "." + imp[2]), parts[1])
+        return None
+
+
+# -- per-module summarize ----------------------------------------------------
+
+
+def _creation_targets(module: ModuleInfo, factories) -> list:
+    """[(assign_node, enclosing_class, node_id, kind)] for every
+    `<target> = <factory>()` assignment — THE single resolution rule for
+    creation-site node ids, shared by summarize() (graph nodes) and
+    creation_sites() (the witness's frame-label map) so the two can
+    never diverge. `factories` maps dotted callables to kinds (a plain
+    set means kind == the callable name)."""
+    class_of: dict[int, str | None] = {}
+
+    def visit(n, cls):
+        # Each node maps to its ENCLOSING class (a ClassDef node itself
+        # belongs to the outer scope; its body to itself).
+        for child in ast.iter_child_nodes(n):
+            class_of[id(child)] = cls
+            if isinstance(child, ast.ClassDef):
+                visit(child, f"{cls}.{child.name}" if cls else child.name)
+            else:
+                visit(child, cls)
+
+    visit(module.tree, None)
+    out = []
+    for node in ast.walk(module.tree):
+        if not isinstance(node, ast.Assign) or \
+                not isinstance(node.value, ast.Call):
+            continue
+        name = dotted(node.value.func) or ""
+        if name not in factories:
+            continue
+        kind = factories[name] if isinstance(factories, dict) else name
+        cls = class_of.get(id(node))
+        for target in node.targets:
+            if isinstance(target, ast.Attribute) and \
+                    isinstance(target.value, ast.Name) and \
+                    target.value.id == "self" and cls:
+                out.append((node, cls,
+                            f"{module.path}::{cls}.{target.attr}", kind))
+            elif isinstance(target, ast.Name) and cls is None:
+                out.append((node, cls,
+                            f"{module.path}::<module>.{target.id}", kind))
+    return out
+
+
+def summarize(module: ModuleInfo, config: AnalysisConfig) -> ModuleSummary:
+    ns = _Namespace(module)
+    summary = ModuleSummary(path=module.path)
+    jit_names, jit_attrs = collect_jit_bindings(module.tree,
+                                                config.jit_factories)
+
+    # Lock creations + Condition(lock) aliases, anywhere in the module.
+    for node, cls, node_id, kind in _creation_targets(module,
+                                                      _LOCK_FACTORIES):
+        summary.creations[node_id] = kind
+        if kind == "condition" and node.value.args:
+            # Condition(wrapped_lock): same mutex, alias the node.
+            wrapped = dotted(node.value.args[0])
+            if wrapped and cls:
+                ctx = _FnContext(ns, cls,
+                                 ast.parse("def _x(): pass").body[0])
+                inner = ctx.resolve_lock(wrapped)
+                if inner:
+                    summary.aliases[node_id] = inner
+
+    # Queue creations (for the zero-arg .get() park check).
+    queue_nodes = {node_id for _, _, node_id, _ in
+                   _creation_targets(module, _QUEUE_FACTORIES)}
+
+    for qualname, func in walk_scopes(module.tree):
+        cls = _enclosing_class(qualname, ns)
+        ctx = _FnContext(ns, cls, func)
+        fs = FunctionSummary(path=module.path, qualname=qualname)
+        preheld = _preheld(module, func, ctx)
+        summary.holds_nodes |= set(preheld)
+        taint = host_sync._Taint(config, jit_names, jit_attrs)
+        taint.run(func)
+        _walk_body(module, ctx, fs, func.body, list(preheld), taint,
+                   queue_nodes)
+        if fs.acquires or fs.calls or fs.parks or fs.syncs:
+            summary.functions.append(fs)
+    return summary
+
+
+def _enclosing_class(qualname: str, ns: _Namespace) -> str | None:
+    """Longest class-qualname prefix of a walk_scopes qualname."""
+    parts = qualname.split(".")
+    for end in range(len(parts) - 1, 0, -1):
+        cand = ".".join(parts[:end])
+        if cand in ns.classes:
+            return cand
+    return None
+
+
+def _preheld(module: ModuleInfo, func, ctx: _FnContext) -> list[str]:
+    held: list[str] = []
+    start = min([d.lineno for d in func.decorator_list], default=func.lineno)
+    end = func.body[0].lineno if func.body else func.lineno
+    lines = list(range(start, end + 1))
+    line = start - 1
+    while line in module.comments:
+        lines.append(line)
+        line -= 1
+    for ln in lines:
+        for lock in module.holds_locks(ln):
+            resolved = ctx.resolve_lock(lock)
+            if resolved and resolved not in held:
+                held.append(resolved)
+    if func.name.endswith("_locked"):
+        # _locked-suffix convention: caller holds SOME lock; without a
+        # named one there is no node to seed — holds contracts name it.
+        pass
+    return held
+
+
+def _walk_body(module: ModuleInfo, ctx: _FnContext, fs: FunctionSummary,
+               body: list, held: list[str], taint,
+               queue_nodes: set[str]) -> None:
+    """Statement-ordered walk tracking the held set: `with` nests, and
+    bare acquire()/release() extend/retract within the current body."""
+    overlay: list[str] = []
+    for stmt in body:
+        _walk_stmt(module, ctx, fs, stmt, held + overlay, taint, overlay,
+                   queue_nodes)
+    del overlay[:]
+
+
+def _walk_stmt(module: ModuleInfo, ctx: _FnContext, fs: FunctionSummary,
+               stmt, held: list[str], taint, overlay: list[str],
+               queue_nodes: set[str]) -> None:
+    if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                         ast.ClassDef)):
+        return  # nested scopes summarized on their own
+    if isinstance(stmt, (ast.With, ast.AsyncWith)):
+        newly: list[str] = []
+        for item in stmt.items:
+            _scan_exprs(module, ctx, fs, item.context_expr, stmt, held, taint,
+                        queue_nodes)
+            expr = dotted(item.context_expr)
+            resolved = ctx.resolve_lock(expr) if expr else None
+            if resolved:
+                fs.acquires.append((resolved, stmt.lineno, tuple(held + newly)))
+                newly.append(resolved)
+        inner = held + newly
+        for child in stmt.body:
+            effective = inner + [o for o in overlay if o not in inner]
+            _walk_stmt(module, ctx, fs, child, effective, taint, overlay,
+                       queue_nodes)
+        return
+    if isinstance(stmt, ast.Assign):
+        ctx.note_assign(stmt)
+    # acquire()/release() as bare statements extend the held overlay.
+    if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call):
+        call = stmt.value
+        if isinstance(call.func, ast.Attribute):
+            recv = dotted(call.func.value)
+            resolved = ctx.resolve_lock(recv) if recv else None
+            if resolved and call.func.attr == "acquire":
+                fs.acquires.append((resolved, stmt.lineno, tuple(held)))
+                overlay.append(resolved)
+                return
+            if resolved and call.func.attr == "release":
+                if resolved in overlay:
+                    overlay.remove(resolved)
+                return
+    for child in ast.iter_child_nodes(stmt):
+        # Re-merge the acquire()/release() overlay per child: an
+        # acquire() inside this statement (if/try/while body) must be
+        # held for its later siblings too.
+        effective = held + [o for o in overlay if o not in held]
+        if isinstance(child, ast.stmt):
+            _walk_stmt(module, ctx, fs, child, effective, taint, overlay,
+                       queue_nodes)
+        else:
+            _scan_exprs(module, ctx, fs, child, stmt, effective, taint,
+                        queue_nodes)
+
+
+def _scan_exprs(module: ModuleInfo, ctx: _FnContext, fs: FunctionSummary,
+                node, stmt, held: list[str], taint,
+                queue_nodes: set[str]) -> None:
+    """Calls inside one expression tree: call edges, DL003 parks, and
+    device syncs while locked."""
+    for sub in ast.walk(node):
+        if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.ClassDef, ast.Lambda)):
+            continue
+        if not isinstance(sub, ast.Call):
+            continue
+        func = sub.func
+        if isinstance(func, ast.Attribute):
+            attr = func.attr
+            if attr in _PARK_METHODS and not sub.args and not sub.keywords:
+                recv_expr = dotted(func.value)
+                recv = recv_expr or "<expr>"
+                resolved = ctx.resolve_lock(recv_expr) if recv_expr else None
+                if attr == "get" and resolved not in queue_nodes:
+                    continue  # ContextVar/dict .get() is non-blocking
+                if not module.suppressed(sub, "blocks", stmt):
+                    fs.parks.append((attr, sub.lineno, tuple(held), recv))
+                continue
+            if attr == "block_until_ready" and held:
+                if not module.suppressed(sub, "blocks", stmt):
+                    fs.syncs.append((sub.lineno, tuple(held),
+                                     "block_until_ready()"))
+            if attr in host_sync._COERCION_METHODS and held and \
+                    taint.is_tainted(func.value):
+                if not module.suppressed(sub, "blocks", stmt):
+                    fs.syncs.append((sub.lineno, tuple(held),
+                                     f".{attr}() on a device value"))
+        name = dotted(func) or ""
+        if held and sub.args and (
+                name in host_sync._COERCION_FUNCS or
+                name in host_sync._COERCION_BUILTINS) and \
+                taint.is_tainted(sub.args[0]):
+            if not module.suppressed(sub, "blocks", stmt):
+                fs.syncs.append((sub.lineno, tuple(held),
+                                 f"{name}() on a device value"))
+        callee = ctx.resolve_callee(sub)
+        if callee is not None:
+            fs.calls.append((callee, tuple(held), sub.lineno))
+
+
+# -- link + findings ---------------------------------------------------------
+
+
+def check_package(summaries: list[ModuleSummary],
+                  config: AnalysisConfig) -> list[Finding]:
+    graph = _LinkedGraph(summaries)
+    findings: list[Finding] = []
+    findings.extend(graph.order_findings())
+    findings.extend(graph.park_findings())
+    return findings
+
+
+def static_graph(summaries: list[ModuleSummary]) -> set[tuple[str, str]]:
+    """The linked acquired-while-held edge set (canonical node ids) —
+    the reference the runtime witness checks observed order against."""
+    return set(_LinkedGraph(summaries).edges)
+
+
+def creation_sites(modules: list[ModuleInfo]) -> dict:
+    """{(path, lineno): (node_id, kind)} for every lock creation — the
+    witness labels runtime wrappers by matching their creation frame
+    against the assignment's line span. Same resolution rule as the
+    static graph's nodes (_creation_targets) by construction."""
+    out: dict = {}
+    for module in modules:
+        for node, _cls, node_id, kind in _creation_targets(
+                module, _LOCK_FACTORIES):
+            for ln in range(node.lineno,
+                            (node.end_lineno or node.lineno) + 1):
+                out[(module.path, ln)] = (node_id, kind)
+    return out
+
+
+class _LinkedGraph:
+    def __init__(self, summaries: list[ModuleSummary]):
+        self.aliases: dict[str, str] = {}
+        self.kinds: dict[str, str] = {}
+        known: set[str] = set()
+        self.functions: dict[tuple, FunctionSummary] = {}
+        self.fn_by_name: dict[tuple, tuple] = {}
+        for s in summaries:
+            self.aliases.update(s.aliases)
+            for node, kind in s.creations.items():
+                self.kinds[node] = kind
+                known.add(node)
+            known |= s.holds_nodes
+            for fs in s.functions:
+                self.functions[fs.key] = fs
+        self.known = {self._canon(n) for n in known}
+        for node, kind in list(self.kinds.items()):
+            canon = self._canon(node)
+            if canon != node and canon not in self.kinds:
+                self.kinds[canon] = self.kinds[node]
+        # edges: (a, b) -> example site string
+        self.edges: dict[tuple[str, str], str] = {}
+        self._link()
+
+    def _canon(self, node: str) -> str:
+        seen = set()
+        while node in self.aliases and node not in seen:
+            seen.add(node)
+            node = self.aliases[node]
+        return node
+
+    def _filter(self, nodes) -> tuple[str, ...]:
+        out = []
+        for n in nodes:
+            c = self._canon(n)
+            if c in self.known and c not in out:
+                out.append(c)
+        return tuple(out)
+
+    def _resolve_call(self, caller: FunctionSummary, spec) -> tuple | None:
+        tag = spec[0]
+        if tag == "self":
+            _, cls, meth = spec
+            key = (caller.path, f"{cls}.{meth}")
+            return key if key in self.functions else None
+        if tag == "fn":
+            _, path, name = spec
+            key = (path, name)
+            return key if key in self.functions else None
+        if tag == "method":
+            _, path, cls, meth = spec
+            key = (path, f"{cls}.{meth}")
+            return key if key in self.functions else None
+        if tag == "ctor":
+            _, path, cls = spec
+            key = (path, f"{cls}.__init__")
+            return key if key in self.functions else None
+        return None
+
+    def _link(self) -> None:
+        # Effective acquire sets: direct, then fixpoint over call edges.
+        eff: dict[tuple, set[str]] = {}
+        for key, fs in self.functions.items():
+            eff[key] = set(self._filter(n for n, _, _ in fs.acquires))
+        for _ in range(len(self.functions) + 1):
+            changed = False
+            for key, fs in self.functions.items():
+                for spec, _, _ in fs.calls:
+                    callee = self._resolve_call(fs, spec)
+                    if callee and not eff[callee] <= eff[key]:
+                        eff[key] |= eff[callee]
+                        changed = True
+            if not changed:
+                break
+        for key, fs in self.functions.items():
+            for node, line, held in fs.acquires:
+                node_c = self._canon(node)
+                if node_c not in self.known:
+                    continue
+                for h in self._filter(held):
+                    self._add_edge(h, node_c,
+                                   f"{fs.path}:{line} ({fs.qualname})")
+            for spec, held, line in fs.calls:
+                callee = self._resolve_call(fs, spec)
+                if callee is None:
+                    continue
+                held_f = self._filter(held)
+                if not held_f:
+                    continue
+                for a in eff[callee]:
+                    for h in held_f:
+                        self._add_edge(
+                            h, a, f"{fs.path}:{line} ({fs.qualname} -> "
+                                  f"{callee[1]})")
+        self.eff = eff
+
+    def _add_edge(self, a: str, b: str, site: str) -> None:
+        if a == b and self.kinds.get(a) in _REENTRANT:
+            return  # reentrant self-acquisition is legal
+        self.edges.setdefault((a, b), site)
+
+    # -- findings ------------------------------------------------------------
+
+    def order_findings(self) -> list[Finding]:
+        findings: list[Finding] = []
+        reported_pairs = set()
+        for (a, b), site in sorted(self.edges.items()):
+            if a == b:
+                path, line = _site_anchor(site)
+                findings.append(Finding(
+                    path=path, line=line, rule=RULE, code="DL001",
+                    message=f"non-reentrant lock {_pretty(a)} can be "
+                            f"re-acquired while already held (via {site})",
+                    hint="make the inner path a caller-holds helper "
+                         "(`# servelint: holds`) or switch to an RLock",
+                    scope="<package>", detail=f"selfcycle:{a}"))
+                continue
+            if (b, a) in self.edges and (b, a) not in reported_pairs:
+                reported_pairs.add((a, b))
+                path, line = _site_anchor(site)
+                findings.append(Finding(
+                    path=path, line=line, rule=RULE, code="DL002",
+                    message=f"inconsistent lock order: {_pretty(a)} -> "
+                            f"{_pretty(b)} (here) but also {_pretty(b)} -> "
+                            f"{_pretty(a)} (at {self.edges[(b, a)]})",
+                    hint="pick ONE acquisition order and restructure the "
+                         "other path (release before acquiring, or a "
+                         "caller-holds contract)",
+                    scope="<package>",
+                    detail="order:" + "<->".join(sorted((a, b)))))
+        findings.extend(self._cycle_findings(reported_pairs))
+        return findings
+
+    def _cycle_findings(self, reported_pairs) -> list[Finding]:
+        findings = []
+        for scc in _sccs(self.edges):
+            if len(scc) < 3:
+                continue  # 1 = fine/selfcycle; 2 = DL002 above
+            nodes = sorted(scc)
+            example = next(site for (a, b), site in sorted(self.edges.items())
+                           if a in scc and b in scc)
+            path, line = _site_anchor(example)
+            findings.append(Finding(
+                path=path, line=line, rule=RULE, code="DL001",
+                message="potential deadlock cycle through "
+                        + " -> ".join(_pretty(n) for n in nodes)
+                        + f" (example edge: {example})",
+                hint="break the cycle: order the locks globally and "
+                     "restructure the path acquiring against the order",
+                scope="<package>",
+                detail="cycle:" + "|".join(nodes)))
+        return findings
+
+    def park_findings(self) -> list[Finding]:
+        findings: list[Finding] = []
+        for key in sorted(self.functions):
+            fs = self.functions[key]
+            for kind, line, held, recv in fs.parks:
+                held_f = self._filter(held)
+                held_note = (" while holding "
+                             + ", ".join(_pretty(h) for h in held_f)
+                             ) if held_f else ""
+                findings.append(Finding(
+                    path=fs.path, line=line, rule=RULE, code="DL003",
+                    message=f"untimed {recv}.{kind}(){held_note} can park "
+                            f"this thread forever ("
+                            f"{_PARK_METHODS[kind]})",
+                    hint="add a timeout and loop on the predicate, or "
+                         "annotate `# servelint: blocks <why>` if parking "
+                         "forever is this loop's contract",
+                    scope=fs.qualname, detail=f"park:{recv}.{kind}"))
+            for line, held, desc in fs.syncs:
+                held_f = self._filter(held)
+                if not held_f:
+                    continue
+                findings.append(Finding(
+                    path=fs.path, line=line, rule=RULE, code="DL003",
+                    message=f"device sync ({desc}) while holding "
+                            + ", ".join(_pretty(h) for h in held_f)
+                            + " — every other thread on the lock waits out "
+                              "the device round-trip",
+                    hint="fetch outside the critical section, or annotate "
+                         "`# servelint: blocks <why>`",
+                    scope=fs.qualname, detail=f"sync:{desc}"))
+        return findings
+
+
+def _pretty(node: str) -> str:
+    path, _, scope = node.partition("::")
+    return f"{scope} ({path.rsplit('/', 1)[-1]})"
+
+
+def _site_anchor(site: str) -> tuple[str, int]:
+    loc = site.split(" ")[0]
+    path, _, line = loc.rpartition(":")
+    try:
+        return path, int(line)
+    except ValueError:
+        return loc, 1
+
+
+def _sccs(edges: dict) -> list[set]:
+    """Tarjan SCCs (iterative) over the edge dict's node universe."""
+    adj: dict[str, list[str]] = {}
+    for (a, b) in edges:
+        adj.setdefault(a, []).append(b)
+        adj.setdefault(b, [])
+    index: dict[str, int] = {}
+    low: dict[str, int] = {}
+    on_stack: set[str] = set()
+    stack: list[str] = []
+    out: list[set] = []
+    counter = [0]
+
+    for root in sorted(adj):
+        if root in index:
+            continue
+        work = [(root, iter(adj[root]))]
+        index[root] = low[root] = counter[0]
+        counter[0] += 1
+        stack.append(root)
+        on_stack.add(root)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for nxt in it:
+                if nxt not in index:
+                    index[nxt] = low[nxt] = counter[0]
+                    counter[0] += 1
+                    stack.append(nxt)
+                    on_stack.add(nxt)
+                    work.append((nxt, iter(adj[nxt])))
+                    advanced = True
+                    break
+                if nxt in on_stack:
+                    low[node] = min(low[node], index[nxt])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                scc = set()
+                while True:
+                    n = stack.pop()
+                    on_stack.discard(n)
+                    scc.add(n)
+                    if n == node:
+                        break
+                out.append(scc)
+    return out

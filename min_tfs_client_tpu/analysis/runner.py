@@ -1,12 +1,34 @@
-"""servelint runner: file discovery + rule orchestration + reporting."""
+"""servelint runner: file discovery + rule orchestration + reporting.
+
+Two rule shapes:
+
+  * per-file rules expose `check(module, config) -> [Finding]` and can
+    scan files independently — `--jobs N` fans them out over a process
+    pool (the repo gate is tier-1's slowest test; parsing dominates);
+  * package passes (`PACKAGE_PASS = True`, currently lock-order) expose
+    `summarize(module, config) -> summary` (picklable, computed per file
+    in the same fan-out) and `check_package(summaries, config)`, which
+    links summaries across the whole scanned set — the interprocedural
+    half cannot be file-local.
+"""
 
 from __future__ import annotations
 
 import functools
+import importlib
+import multiprocessing
 import os
+from concurrent.futures import ProcessPoolExecutor
 from dataclasses import dataclass, field
 
-from min_tfs_client_tpu.analysis import host_sync, locks, recompile, spans
+from min_tfs_client_tpu.analysis import (
+    host_sync,
+    lock_order,
+    locks,
+    recompile,
+    spans,
+    threads,
+)
 from min_tfs_client_tpu.analysis.baseline import (
     BaselineDiff,
     diff_baseline,
@@ -18,7 +40,7 @@ from min_tfs_client_tpu.analysis.core import (
     parse_module,
 )
 
-ALL_RULES = (host_sync, recompile, locks, spans)
+ALL_RULES = (host_sync, recompile, locks, spans, threads, lock_order)
 
 
 @dataclass
@@ -91,20 +113,76 @@ def iter_py_files(paths: list[str]):
                 yield full, rel(full)
 
 
+def _split_rules(rules):
+    per_file = [r for r in rules if not getattr(r, "PACKAGE_PASS", False)]
+    package = [r for r in rules if getattr(r, "PACKAGE_PASS", False)]
+    return per_file, package
+
+
+def _scan_file(abspath: str, relpath: str, config: AnalysisConfig,
+               per_file, package):
+    """One file's scan: (relpath, findings, declared_guards,
+    {package_rule_name: summary}) — everything picklable, so this is
+    also the --jobs worker body."""
+    module = parse_module(abspath, relpath)
+    if module is None:
+        return None
+    findings: list[Finding] = []
+    for rule in per_file:
+        findings.extend(rule.check(module, config))
+    guards = locks.collect_declared_guards(module)
+    summaries = {rule.__name__: rule.summarize(module, config)
+                 for rule in package}
+    return relpath, findings, guards, summaries
+
+
+def _scan_worker(abspath: str, relpath: str, config: AnalysisConfig,
+                 per_file_names: tuple, package_names: tuple):
+    per_file = [importlib.import_module(n) for n in per_file_names]
+    package = [importlib.import_module(n) for n in package_names]
+    return _scan_file(abspath, relpath, config, per_file, package)
+
+
 def analyze_paths(paths: list[str],
                   config: AnalysisConfig | None = None,
-                  rules=ALL_RULES) -> Report:
+                  rules=ALL_RULES,
+                  jobs: int = 1) -> Report:
     config = config or AnalysisConfig()
+    per_file, package = _split_rules(rules)
     report = Report()
-    for abspath, relpath in iter_py_files(paths):
-        module = parse_module(abspath, relpath)
-        if module is None:
+    files = list(iter_py_files(paths))
+    results = []
+    if jobs and jobs > 1 and len(files) > 1:
+        per_file_names = tuple(r.__name__ for r in per_file)
+        package_names = tuple(r.__name__ for r in package)
+        # Spawn, not fork: the in-process gate test runs with JAX (and
+        # its thread pools) loaded — forking a multithreaded process can
+        # deadlock the child. Workers only import the analysis package
+        # (pure stdlib), so spawn startup is cheap.
+        ctx = multiprocessing.get_context("spawn")
+        with ProcessPoolExecutor(max_workers=min(jobs, len(files)),
+                                 mp_context=ctx) as pool:
+            futures = [pool.submit(_scan_worker, ab, rel, config,
+                                   per_file_names, package_names)
+                       for ab, rel in files]
+            results = [f.result() for f in futures]
+    else:
+        results = [_scan_file(ab, rel, config, per_file, package)
+                   for ab, rel in files]
+    summaries_by_rule: dict[str, list] = {r.__name__: [] for r in package}
+    for res in results:
+        if res is None:
             continue
+        relpath, findings, guards, summaries = res
         report.files_scanned += 1
         report.scanned_paths.add(relpath)
-        for rule in rules:
-            report.findings.extend(rule.check(module, config))
-        report.declared_guards |= locks.collect_declared_guards(module)
+        report.findings.extend(findings)
+        report.declared_guards |= guards
+        for name, summary in summaries.items():
+            summaries_by_rule[name].append(summary)
+    for rule in package:
+        report.findings.extend(
+            rule.check_package(summaries_by_rule[rule.__name__], config))
     report.findings.sort(key=lambda f: (f.path, f.line, f.code))
     return report
 
@@ -112,11 +190,12 @@ def analyze_paths(paths: list[str],
 def run_analysis(paths: list[str],
                  baseline_path: str | None = None,
                  config: AnalysisConfig | None = None,
-                 rules=ALL_RULES) -> Report:
+                 rules=ALL_RULES,
+                 jobs: int = 1) -> Report:
     """Analyze `paths`, diff against the baseline, return the Report.
     `report.clean` is the gate predicate: no new findings, no stale
     baseline entries."""
-    report = analyze_paths(paths, config=config, rules=rules)
+    report = analyze_paths(paths, config=config, rules=rules, jobs=jobs)
     baseline = load_baseline(baseline_path)
     # A deleted guarded_by annotation silently disables its checks; the
     # baseline pins the expected declarations so deletion is a failure.

@@ -1,0 +1,434 @@
+"""TH: thread-root inventory — who spawns threads, what state they share.
+
+servelint's LK family enforces discipline on state that IS declared
+`# guarded_by:`; this family closes the other half: state that SHOULD be
+declared but isn't. It inventories thread roots — functions handed to
+`threading.Thread(target=...)` — and flags class/module state reachable
+from two or more concurrency domains (a root's call closure vs. the rest
+of the class, or two distinct roots) that is mutated with no guard
+declaration at all.
+
+  TH001  shared mutable state reachable from >=2 thread domains with no
+         `# guarded_by:` declaration
+  TH002  threading.Thread(...) spawned without explicit `name=` AND
+         `daemon=` — anonymous threads show up as "Thread-7" in the
+         flight recorder and trace spans, and an implicit daemon flag
+         inherits whatever the spawner happened to be
+
+Sanctions: `# servelint: thread-ok <why>` on the spawn (TH002) or the
+first mutation site (TH001 — e.g. state published once before the thread
+starts); synchronizer-typed attributes (Lock/RLock/Condition/Event/
+Semaphore/queue.Queue) are exempt by construction, as is state only ever
+assigned in `__init__` (single-threaded construction, the LK rule's
+exemption).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from min_tfs_client_tpu.analysis import locks
+from min_tfs_client_tpu.analysis.core import (
+    AnalysisConfig,
+    Finding,
+    ModuleInfo,
+    dotted,
+    walk_function_nodes,
+)
+
+RULE = "threads"
+
+_THREAD_CTORS = {"threading.Thread", "Thread"}
+_SYNCHRONIZER_FACTORIES = {
+    "threading.Lock", "threading.RLock", "threading.Condition",
+    "threading.Event", "threading.Semaphore", "threading.BoundedSemaphore",
+    "threading.Barrier", "queue.Queue", "queue.SimpleQueue",
+    "queue.LifoQueue", "queue.PriorityQueue",
+}
+_EXEMPT_METHODS = {"__init__", "__post_init__", "__del__", "__enter__"}
+# Mutating container methods: calling one on `self.x` counts as a write.
+_MUTATORS = {"append", "appendleft", "extend", "extendleft", "insert",
+             "pop", "popleft", "popitem", "remove", "discard", "clear",
+             "add", "update", "setdefault", "sort", "reverse", "rotate"}
+
+
+def check(module: ModuleInfo, config: AnalysisConfig) -> list[Finding]:
+    findings: list[Finding] = []
+    spawns = list(_thread_spawns(module))
+    findings.extend(_check_spawn_hygiene(module, spawns))
+    findings.extend(_check_class_sharing(module, spawns))
+    findings.extend(_check_module_sharing(module, spawns))
+    return findings
+
+
+# -- spawn discovery ---------------------------------------------------------
+
+
+class _Spawn:
+    def __init__(self, call: ast.Call, stmt: ast.stmt, scope: str,
+                 owner_class: str | None):
+        self.call = call
+        self.stmt = stmt
+        self.scope = scope                # enclosing def qualname
+        self.owner_class = owner_class    # class the spawn sits in, if any
+        self.target = None                # ("self", meth)|("fn", name)|
+        #                                   ("local", name)|None
+        kw = {k.arg: k.value for k in call.keywords if k.arg}
+        target = kw.get("target")
+        if target is None and len(call.args) >= 2:
+            target = call.args[1]  # Thread(group, target, ...)
+        if isinstance(target, ast.Attribute) and \
+                isinstance(target.value, ast.Name):
+            if target.value.id == "self":
+                self.target = ("self", target.attr)
+        elif isinstance(target, ast.Name):
+            self.target = ("name", target.id)
+        # Thread(group, target, name, ...): name may arrive positionally;
+        # daemon is keyword-only in the Thread signature.
+        self.has_name = "name" in kw or len(call.args) >= 3
+        self.has_daemon = "daemon" in kw
+
+
+def _thread_spawns(module: ModuleInfo):
+    """Every threading.Thread(...) call with its enclosing scope."""
+
+    def visit(node, scope, owner_class, stmt):
+        for child in ast.iter_child_nodes(node):
+            child_stmt = child if isinstance(child, ast.stmt) else stmt
+            if isinstance(child, ast.ClassDef):
+                yield from visit(child, scope, _q(owner_class, child.name),
+                                 child_stmt)
+                continue
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield from visit(child, _q(scope, child.name), owner_class,
+                                 child_stmt)
+                continue
+            if isinstance(child, ast.Call) and \
+                    (dotted(child.func) or "") in _THREAD_CTORS:
+                yield _Spawn(child, child_stmt, scope or "<module>",
+                             owner_class)
+            yield from visit(child, scope, owner_class, child_stmt)
+
+    yield from visit(module.tree, "", None, None)
+
+
+def _q(prefix, name):
+    return f"{prefix}.{name}" if prefix else name
+
+
+def _check_spawn_hygiene(module: ModuleInfo,
+                         spawns: list[_Spawn]) -> list[Finding]:
+    findings = []
+    for spawn in spawns:
+        missing = [k for k, present in (("name", spawn.has_name),
+                                        ("daemon", spawn.has_daemon))
+                   if not present]
+        if not missing:
+            continue
+        if module.suppressed(spawn.call, "thread-ok", spawn.stmt):
+            continue
+        target_desc = ".".join(spawn.target) if spawn.target else "<dynamic>"
+        findings.append(Finding(
+            path=module.path, line=spawn.call.lineno, rule=RULE,
+            code="TH002",
+            message=f"threading.Thread(target={target_desc}) spawned "
+                    f"without explicit {' and '.join(missing)} — anonymous "
+                    "threads defeat flight-recorder/trace attribution",
+            hint="pass name=\"<role>\" and daemon=<bool> explicitly "
+                 "(or `# servelint: thread-ok <why>`)",
+            scope=spawn.scope, detail=f"spawn:{target_desc}"))
+    return findings
+
+
+# -- class-level sharing -----------------------------------------------------
+
+
+def _check_class_sharing(module: ModuleInfo,
+                         spawns: list[_Spawn]) -> list[Finding]:
+    findings: list[Finding] = []
+    for classdef, prefix in locks._walk_classes(module.tree):
+        qual = f"{prefix}{classdef.name}"
+        methods = {name: fn for fn, name in locks._class_functions(classdef)}
+        # Roots: methods named as Thread targets from inside this class
+        # (self._worker), plus nested worker defs handed by bare name.
+        roots: set[str] = set()
+        for spawn in spawns:
+            if spawn.target is None:
+                continue
+            tag, name = spawn.target
+            if tag == "self" and spawn.owner_class == qual and \
+                    name in methods:
+                roots.add(name)
+            elif tag == "name":
+                # nested `def worker(): ...` passed by name from a method
+                # of this class: the nested def's path is scope-relative.
+                # Match on the spawning method's full segment ("tick."),
+                # not a bare prefix that would also claim "tickle.worker".
+                leaf = spawn.scope.split(".")[-1] if spawn.scope else ""
+                for meth_path in methods:
+                    if meth_path.endswith(f".{name}") and \
+                            spawn.owner_class == qual and leaf and \
+                            meth_path.startswith(f"{leaf}."):
+                        roots.add(meth_path)
+        if not roots:
+            continue
+        guards = locks._class_guards(module, classdef)
+        domains = _domains(methods, roots)
+        if len(domains) < 2:
+            continue
+        access: dict[str, dict[str, set]] = {}  # attr -> domain -> kinds
+        mutation_site: dict[str, tuple] = {}
+        sync_attrs = _synchronizer_attrs(classdef)
+        for dom_name, dom_methods in domains.items():
+            for meth in dom_methods:
+                fn = methods[meth]
+                leaf = meth.rsplit(".", 1)[-1]
+                is_init = leaf in _EXEMPT_METHODS
+                for node in walk_function_nodes(fn):
+                    attr, is_write, site = _self_access(node)
+                    if attr is None:
+                        continue
+                    access.setdefault(attr, {}).setdefault(
+                        dom_name, set()).add("w" if is_write else "r")
+                    if is_write and not is_init:
+                        prev = mutation_site.get(attr)
+                        if prev is None or site.lineno < prev[0].lineno:
+                            mutation_site[attr] = (site, _stmt_of(fn, site))
+        for attr in sorted(access):
+            if attr in guards or attr in sync_attrs:
+                continue
+            if attr not in mutation_site:
+                continue  # only ever written in __init__ (or never)
+            if len(access[attr]) < 2:
+                continue  # one domain only: not shared
+            site, stmt = mutation_site[attr]
+            if module.suppressed(site, "thread-ok", stmt):
+                continue
+            roots_desc = ", ".join(sorted(roots))
+            findings.append(Finding(
+                path=module.path, line=site.lineno, rule=RULE, code="TH001",
+                message=f"'self.{attr}' is mutated and reachable from "
+                        f">=2 thread domains of {classdef.name} (thread "
+                        f"roots: {roots_desc}) but carries no "
+                        "`# guarded_by:` declaration",
+                hint="declare `# guarded_by: <lock>` on the initialising "
+                     "assignment (the LK rules then enforce it), or "
+                     "`# servelint: thread-ok <why>` the mutation",
+                scope=f"{qual}", detail=f"shared:{attr}"))
+    return findings
+
+
+def _domains(methods: dict, roots: set[str]) -> dict[str, set]:
+    """Partition methods into per-root call closures + the rest."""
+    out: dict[str, set] = {}
+    claimed: set[str] = set()
+    for root in sorted(roots):
+        closure = _closure(methods, root)
+        out[f"root:{root}"] = closure
+        claimed |= closure
+    rest = {m for m in methods
+            if m not in claimed
+            and m.rsplit(".", 1)[-1] not in _EXEMPT_METHODS}
+    if rest:
+        out["rest"] = rest
+    return out
+
+
+def _closure(methods: dict, root: str) -> set[str]:
+    seen = {root}
+    frontier = [root]
+    while frontier:
+        meth = frontier.pop()
+        fn = methods.get(meth)
+        if fn is None:
+            continue
+        for node in walk_function_nodes(fn):
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    isinstance(node.func.value, ast.Name) and \
+                    node.func.value.id == "self":
+                callee = node.func.attr
+                if callee in methods and callee not in seen:
+                    seen.add(callee)
+                    frontier.append(callee)
+    return seen
+
+
+def _synchronizer_attrs(classdef: ast.ClassDef) -> set[str]:
+    out: set[str] = set()
+    for node in ast.walk(classdef):
+        if isinstance(node, ast.Assign) and \
+                isinstance(node.value, ast.Call) and \
+                (dotted(node.value.func) or "") in _SYNCHRONIZER_FACTORIES:
+            for target in node.targets:
+                if isinstance(target, ast.Attribute) and \
+                        isinstance(target.value, ast.Name) and \
+                        target.value.id == "self":
+                    out.add(target.attr)
+    return out
+
+
+def _self_access(node: ast.AST):
+    """(attr, is_write, anchor_node) for a `self.X` access, else
+    (None, ...). Subscript stores and mutator calls count as writes."""
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr, isinstance(node.ctx, (ast.Store, ast.Del)), node
+    if isinstance(node, ast.Subscript) and \
+            isinstance(node.ctx, (ast.Store, ast.Del)) and \
+            isinstance(node.value, ast.Attribute) and \
+            isinstance(node.value.value, ast.Name) and \
+            node.value.value.id == "self":
+        return node.value.attr, True, node
+    if isinstance(node, ast.Call) and \
+            isinstance(node.func, ast.Attribute) and \
+            node.func.attr in _MUTATORS and \
+            isinstance(node.func.value, ast.Attribute) and \
+            isinstance(node.func.value.value, ast.Name) and \
+            node.func.value.value.id == "self":
+        return node.func.value.attr, True, node
+    return None, False, None
+
+
+def _stmt_of(fn, node) -> ast.stmt | None:
+    """Deepest statement containing `node` (ast.walk is BFS, so the last
+    match is the innermost — the line a suppression comment anchors to)."""
+    found = None
+    for stmt in ast.walk(fn):
+        if isinstance(stmt, ast.stmt) and stmt is not fn:
+            if any(sub is node for sub in ast.walk(stmt)):
+                found = stmt
+    return found
+
+
+# -- module-level sharing ----------------------------------------------------
+
+
+def _check_module_sharing(module: ModuleInfo,
+                          spawns: list[_Spawn]) -> list[Finding]:
+    findings: list[Finding] = []
+    mod_fns = {n.name: n for n in module.tree.body
+               if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+    roots = set()
+    for spawn in spawns:
+        if spawn.target and spawn.target[0] == "name" and \
+                spawn.owner_class is None and spawn.target[1] in mod_fns:
+            roots.add(spawn.target[1])
+    if not roots:
+        return findings
+    guards = set(locks._module_guards(module))
+    sync_names = _module_synchronizers(module)
+    module_globals = {t.id for stmt in module.tree.body
+                      if isinstance(stmt, (ast.Assign, ast.AnnAssign))
+                      for t in (stmt.targets if isinstance(stmt, ast.Assign)
+                                else [stmt.target])
+                      if isinstance(t, ast.Name)}
+    # Per-root domains, mirroring the class-level check: a global shared
+    # between two spawned roots (writer thread / reader thread) must
+    # count as shared even when no non-root function ever touches it.
+    domains: dict[str, set] = {
+        f"root:{root}": _module_closure(mod_fns, root)
+        for root in sorted(roots)}
+    rest = set(mod_fns) - set().union(*domains.values())
+    if rest:
+        domains["rest"] = rest
+    for name, fn in mod_fns.items():
+        writes = _global_writes(fn, module_globals)
+        for g, site in writes.items():
+            if g in guards or g in sync_names:
+                continue
+            accessing_domains = {
+                dom for dom, members in domains.items()
+                if any(_references(mod_fns[m], g) for m in members)}
+            if len(accessing_domains) < 2:
+                continue
+            stmt = _stmt_of(fn, site)
+            if module.suppressed(site, "thread-ok", stmt):
+                continue
+            findings.append(Finding(
+                path=module.path, line=site.lineno, rule=RULE, code="TH001",
+                message=f"module global '{g}' is mutated and reachable "
+                        f"from >=2 thread domains (thread roots: "
+                        f"{', '.join(sorted(roots))}) but carries no "
+                        "`# guarded_by:` declaration",
+                hint="declare `# guarded_by: <module lock>` on the "
+                     "initialising assignment, or "
+                     "`# servelint: thread-ok <why>` the mutation",
+                scope=name, detail=f"shared:{g}"))
+    return findings
+
+
+def _module_synchronizers(module: ModuleInfo) -> set[str]:
+    out = set()
+    for stmt in module.tree.body:
+        if isinstance(stmt, ast.Assign) and \
+                isinstance(stmt.value, ast.Call) and \
+                (dotted(stmt.value.func) or "") in _SYNCHRONIZER_FACTORIES:
+            for target in stmt.targets:
+                if isinstance(target, ast.Name):
+                    out.add(target.id)
+    return out
+
+
+def _module_closure(mod_fns: dict, root: str) -> set[str]:
+    seen = {root}
+    frontier = [root]
+    while frontier:
+        fn = mod_fns.get(frontier.pop())
+        if fn is None:
+            continue
+        for node in walk_function_nodes(fn):
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Name) and \
+                    node.func.id in mod_fns and node.func.id not in seen:
+                seen.add(node.func.id)
+                frontier.append(node.func.id)
+    return seen
+
+
+def _global_writes(fn, module_globals: set[str]) -> dict[str, ast.AST]:
+    """Writes to module globals from one module-level function: `global`
+    rebinding, subscript stores (`d[k] = v`), and mutator-method calls
+    (`d.append(...)`) — the same write shapes the class-side check sees.
+    Names shadowed by params or plain local assignment don't count."""
+    declared_global: set[str] = set()
+    shadowed = {a.arg for a in (fn.args.posonlyargs + fn.args.args +
+                                fn.args.kwonlyargs)}
+    for node in walk_function_nodes(fn):
+        if isinstance(node, ast.Global):
+            declared_global.update(node.names)
+    for node in walk_function_nodes(fn):
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Store) \
+                and node.id not in declared_global:
+            shadowed.add(node.id)
+
+    def is_global(name: str) -> bool:
+        return name in declared_global or (
+            name in module_globals and name not in shadowed)
+
+    writes: dict[str, ast.AST] = {}
+    for node in walk_function_nodes(fn):
+        name = None
+        if isinstance(node, ast.Name) and \
+                isinstance(node.ctx, (ast.Store, ast.Del)) and \
+                node.id in declared_global:
+            name = node.id
+        elif isinstance(node, ast.Subscript) and \
+                isinstance(node.ctx, (ast.Store, ast.Del)) and \
+                isinstance(node.value, ast.Name) and \
+                is_global(node.value.id):
+            name = node.value.id
+        elif isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr in _MUTATORS and \
+                isinstance(node.func.value, ast.Name) and \
+                is_global(node.func.value.id):
+            name = node.func.value.id
+        if name is not None and name not in writes:
+            writes[name] = node
+    return writes
+
+
+def _references(fn, name: str) -> bool:
+    return any(isinstance(n, ast.Name) and n.id == name
+               for n in walk_function_nodes(fn))

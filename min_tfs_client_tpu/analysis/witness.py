@@ -1,0 +1,739 @@
+"""Runtime schedule witness: observed lock order + held-at-mutation proof.
+
+The static DL/LK families reason about the code; this module watches the
+code RUN. Installed (by the concurrency test suites — in-flight window,
+continuous batching, lifecycle, tracing) it monkeypatches
+`threading.Lock/RLock/Condition` into recording wrappers and patches
+`__setattr__` on every class carrying a `# guarded_by:` declaration, then
+asserts, at teardown:
+
+  1. the OBSERVED lock-acquisition-order graph is cycle-free and stays
+     consistent with the static graph (`lock_order.static_graph`) — no
+     schedule the suites exercised contradicts what the analyzer proved;
+  2. every recorded mutation of a `# guarded_by:`-declared attribute
+     happened with its declared lock actually HELD by the mutating
+     thread — the 60+ pinned annotations are load-bearing facts, not
+     trusted comments.
+
+Locks created while installed are labeled by their creation site and
+matched to static node ids (`path::Class.attr`); locks that predate the
+install (module-level registries) are checked with the primitives' own
+ownership probes. Mutations from `__init__`-family frames, from outside
+the package (tests poking internals), or on `# servelint: lock-ok`
+lines are exempt — the same exemptions the static LK rule applies.
+Container-typed guarded state (list/dict/set/deque) is wrapped in
+recording subclasses so `.append()`/`[k] = v` mutations are witnessed
+too, not just rebinding.
+
+Zero cost outside tests: nothing in this module runs unless a test
+fixture calls `ScheduleWitness.install()`.
+"""
+
+from __future__ import annotations
+
+import collections
+import functools
+import importlib
+import itertools
+import os
+import sys
+import threading
+import types
+import weakref
+import _thread
+
+from min_tfs_client_tpu.analysis import lock_order, locks
+from min_tfs_client_tpu.analysis.core import AnalysisConfig, parse_module
+
+_EXEMPT_FRAMES = {"__init__", "__post_init__", "__del__", "__enter__"}
+_CONTAINER_TYPES = (list, dict, set, collections.deque)
+
+# Originals captured at import, before any install can patch them.
+_REAL_LOCK = threading.Lock
+_REAL_RLOCK = threading.RLock
+_REAL_CONDITION = threading.Condition
+_THREADING_FILE = getattr(threading, "__file__", "")
+_THIS_FILE = __file__
+
+
+# -- static side: declarations, creation sites, static edges -----------------
+
+
+class StaticData:
+    def __init__(self, pkg_root: str):
+        self.pkg_root = pkg_root
+        self.pkg_parent = os.path.dirname(pkg_root)
+        self.class_guards: dict[tuple, dict[str, str]] = {}
+        #   (module_dotted, class_qual) -> {attr: lock_expr}
+        self.module_guards: dict[str, dict[str, str]] = {}
+        #   module_dotted -> {name: lock_expr}
+        self.lock_ok_lines: set[tuple] = set()      # (relpath, lineno)
+        self.creation_sites: dict = {}              # (relpath, ln) -> (node, kind)
+        self.static_edges: set = set()
+        self.declared_ids: set = set()
+
+    def relpath(self, filename: str) -> str | None:
+        """Package-relative path ('min_tfs_client_tpu/...') for frames
+        INSIDE the package; None for everything else — tests, bench
+        scripts and other repo files poking internals are exempt from
+        held-at-mutation checks, exactly like the static LK rule."""
+        ab = os.path.abspath(filename)
+        if not ab.startswith(self.pkg_root + os.sep):
+            return None
+        return os.path.relpath(ab, self.pkg_parent).replace(os.sep, "/")
+
+
+@functools.lru_cache(maxsize=1)
+def package_static() -> StaticData:
+    from min_tfs_client_tpu.analysis.runner import (
+        default_package_root,
+        iter_py_files,
+    )
+
+    pkg_root = default_package_root()
+    data = StaticData(pkg_root)
+    config = AnalysisConfig()
+    modules = []
+    for abspath, relpath in iter_py_files([pkg_root]):
+        module = parse_module(abspath, relpath)
+        if module is not None:
+            modules.append(module)
+    summaries = [lock_order.summarize(m, config) for m in modules]
+    data.static_edges = lock_order.static_graph(summaries)
+    data.creation_sites = lock_order.creation_sites(modules)
+    for module in modules:
+        dotted_mod = module.path[:-3].replace("/", ".")
+        mod_guards = {name: lock for name, (lock, _)
+                      in locks._module_guards(module).items()}
+        if mod_guards:
+            data.module_guards[dotted_mod] = mod_guards
+            for name in mod_guards:
+                data.declared_ids.add(f"{module.path}::<module>.{name}")
+        for classdef, prefix in locks._walk_classes(module.tree):
+            qual = f"{prefix}{classdef.name}"
+            guards = {attr: lock for attr, (lock, _)
+                      in locks._class_guards(module, classdef).items()}
+            if guards:
+                data.class_guards[(dotted_mod, qual)] = guards
+                for attr in guards:
+                    data.declared_ids.add(f"{module.path}::{qual}.{attr}")
+        for line, comment in module.comments.items():
+            if "lock-ok" in module.servelint_marks(line):
+                data.lock_ok_lines.add((module.path, line))
+    return data
+
+
+# -- recording lock wrappers -------------------------------------------------
+
+
+class _RecLockBase:
+    """Shared bookkeeping: creation label, static node id, owner probe."""
+
+    def _init_rec(self, witness: "ScheduleWitness", label: str,
+                  static_node: str | None):
+        self._witness = witness
+        self._label = label
+        self._static = static_node
+        self._serial = next(witness._serials)
+        self._owner = None
+
+    @property
+    def key(self):
+        return (self._label, self._serial)
+
+    def held_by_current(self) -> bool:
+        return self._owner == threading.get_ident()
+
+    def _is_owned(self) -> bool:
+        # threading.Condition probes this to decide notify legality;
+        # exactness here is what makes held-at-mutation checks exact.
+        return self.held_by_current()
+
+    def _at_fork_reinit(self) -> None:
+        # stdlib (concurrent.futures.thread, logging) registers this as
+        # an at-fork hook on module-level locks.
+        self._real = _thread.allocate_lock()
+        self._owner = None
+        if hasattr(self, "_count"):
+            self._count = 0
+
+
+class RecordingLock(_RecLockBase):
+    """threading.Lock() stand-in that reports acquisitions to the
+    witness. Non-reentrant, context-manageable, timeout-capable."""
+
+    def __init__(self, witness, label, static_node):
+        self._real = _thread.allocate_lock()
+        self._init_rec(witness, label, static_node)
+
+    def acquire(self, blocking=True, timeout=-1):
+        got = self._real.acquire(blocking, timeout)
+        if got:
+            self._owner = threading.get_ident()
+            self._witness._record_acquire(self)
+        return got
+
+    def release(self):
+        self._witness._record_release(self)
+        self._owner = None
+        self._real.release()
+
+    def locked(self):
+        return self._real.locked()
+
+    __enter__ = acquire
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+
+class RecordingRLock(_RecLockBase):
+    """threading.RLock() stand-in. Also serves as the mutex under every
+    Condition the patched factory builds (Condition's _release_save /
+    _acquire_restore land here, so wait() shows up as release+reacquire
+    in the held stack — exactly the mutex's real behavior)."""
+
+    def __init__(self, witness, label, static_node):
+        self._real = _thread.allocate_lock()
+        self._count = 0
+        self._init_rec(witness, label, static_node)
+
+    def acquire(self, blocking=True, timeout=-1):
+        me = threading.get_ident()
+        if self._owner == me:
+            self._count += 1
+            return True
+        got = self._real.acquire(blocking, timeout)
+        if got:
+            self._owner = me
+            self._count = 1
+            self._witness._record_acquire(self)
+        return got
+
+    def release(self):
+        if self._owner != threading.get_ident():
+            raise RuntimeError("cannot release un-acquired lock")
+        self._count -= 1
+        if self._count == 0:
+            self._witness._record_release(self)
+            self._owner = None
+            self._real.release()
+
+    def locked(self):
+        return self._real.locked()
+
+    __enter__ = acquire
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    # Condition integration (threading.Condition duck-probes these).
+    def _release_save(self):
+        count = self._count
+        self._count = 0
+        self._witness._record_release(self)
+        self._owner = None
+        self._real.release()
+        return count
+
+    def _acquire_restore(self, count):
+        self._real.acquire()
+        self._owner = threading.get_ident()
+        self._count = count
+        self._witness._record_acquire(self)
+
+
+# -- container proxies for guarded mutable state -----------------------------
+
+
+def _mutating(name):
+    def method(self, *args, **kwargs):
+        witness = self._rec_witness
+        if witness is not None:
+            witness._on_container_mutation(self)
+        return getattr(self._rec_base, name)(self, *args, **kwargs)
+    method.__name__ = name
+    return method
+
+
+def _make_proxy_class(base):
+    ns = {"_rec_base": base, "_rec_witness": None, "_rec_decl": None,
+          "_rec_guard": None, "_rec_owner": None}
+    mutators = {
+        list: ("append", "extend", "insert", "pop", "remove", "clear",
+               "sort", "reverse", "__setitem__", "__delitem__", "__iadd__"),
+        dict: ("__setitem__", "__delitem__", "pop", "popitem", "clear",
+               "update", "setdefault"),
+        set: ("add", "discard", "remove", "pop", "clear", "update",
+              "difference_update", "intersection_update",
+              "symmetric_difference_update"),
+        collections.deque: ("append", "appendleft", "extend", "extendleft",
+                            "pop", "popleft", "remove", "clear",
+                            "__setitem__", "__delitem__", "__iadd__"),
+    }[base]
+    for name in mutators:
+        ns[name] = _mutating(name)
+    return type(f"Recording{base.__name__.capitalize()}", (base,), ns)
+
+
+RecordingList = _make_proxy_class(list)
+RecordingDict = _make_proxy_class(dict)
+RecordingSet = _make_proxy_class(set)
+RecordingDeque = _make_proxy_class(collections.deque)
+_PROXY_FOR = {list: RecordingList, dict: RecordingDict, set: RecordingSet,
+              collections.deque: RecordingDeque}
+
+
+def _unwrap(proxy, base):
+    """Plain base-type copy of a recording proxy (same contents)."""
+    if base is collections.deque:
+        return collections.deque(proxy, proxy.maxlen)
+    return base(proxy)
+
+
+class _maybe_locked:
+    """Hold the declared guard (when it exists and is lockable) around a
+    container identity swap: a writer between the copy and the setattr
+    would otherwise mutate the discarded object and lose the write."""
+
+    def __init__(self, lock):
+        self._lock = lock if hasattr(lock, "__enter__") else None
+
+    def __enter__(self):
+        if self._lock is not None:
+            self._lock.__enter__()
+
+    def __exit__(self, *exc):
+        if self._lock is not None:
+            self._lock.__exit__(*exc)
+        return False
+
+
+# -- the witness -------------------------------------------------------------
+
+
+def _mutating_frame():
+    """The real mutating frame: the first one outside this module.
+    A fixed depth would land on a patched __setattr__ closure (defined
+    HERE) whenever instrumented classes chain base<-derived patches."""
+    frame = sys._getframe(2)
+    while frame is not None and frame.f_code.co_filename == _THIS_FILE:
+        frame = frame.f_back
+    return frame
+
+
+class ScheduleWitness:
+    """One install/uninstall cycle of runtime schedule recording."""
+
+    def __init__(self, static: StaticData | None = None):
+        self.static = static
+        self._serials = itertools.count(1)
+        self._ilock = _thread.allocate_lock()   # witness-internal, never wrapped
+        self._tls = threading.local()
+        self._active = False
+        self._installed = False
+        # results ------------------------------------------------------------
+        self.edges: dict[tuple, str] = {}       # (keyA, keyB) -> example site
+        self.verified: dict[str, int] = {}      # decl id -> held mutations
+        self.unverifiable: dict[str, int] = {}  # decl id -> probe-less mutations
+        self.violations: list[str] = []
+        # restore state ------------------------------------------------------
+        self._patched_classes: list[tuple] = []
+        self._patched_globals: list[tuple] = []
+        self._wrapped_instances: list[tuple] = []
+
+    @classmethod
+    def for_package(cls) -> "ScheduleWitness":
+        return cls(static=package_static())
+
+    # -- install / uninstall -------------------------------------------------
+
+    def install(self) -> "ScheduleWitness":
+        if self._installed:
+            return self
+        self._installed = True
+        self._active = True
+        threading.Lock = self._make_lock           # type: ignore[assignment]
+        threading.RLock = self._make_rlock         # type: ignore[assignment]
+        threading.Condition = self._make_condition  # type: ignore[assignment]
+        if self.static is not None:
+            self._instrument_package()
+        return self
+
+    def uninstall(self) -> None:
+        if not self._installed:
+            return
+        self._active = False
+        self._installed = False
+        threading.Lock = _REAL_LOCK
+        threading.RLock = _REAL_RLOCK
+        threading.Condition = _REAL_CONDITION
+        for cls, had_own, orig in reversed(self._patched_classes):
+            if had_own:
+                cls.__setattr__ = orig
+            else:
+                try:
+                    del cls.__setattr__
+                except AttributeError:
+                    pass
+        self._patched_classes.clear()
+        for mod, name, base in reversed(self._patched_globals):
+            proxy = getattr(mod, name, None)
+            if isinstance(proxy, _PROXY_FOR.get(base, ())):
+                with _maybe_locked(self._eval_lock(mod, proxy._rec_guard)):
+                    setattr(mod, name, _unwrap(proxy, base))
+        self._patched_globals.clear()
+        # Instance containers too: a proxy left on an object that
+        # outlives this witness (module-scoped fixtures, the metrics
+        # registry) would silently record to a dead witness for the rest
+        # of the session.
+        for ref, attr, base, proxy in self._wrapped_instances:
+            owner = ref()
+            if owner is not None and getattr(owner, attr, None) is proxy:
+                try:
+                    with _maybe_locked(
+                            self._eval_lock(owner, proxy._rec_guard)):
+                        object.__setattr__(owner, attr,
+                                           _unwrap(proxy, base))
+                except Exception:
+                    pass
+        self._wrapped_instances.clear()
+
+    # -- factory stand-ins ---------------------------------------------------
+
+    def _creation_label(self):
+        """(label, static_node): the first frame outside threading/this
+        module names the creation site; matching a known lock-creation
+        assignment span maps it to the static node id."""
+        frame = sys._getframe(2)
+        while frame is not None and frame.f_code.co_filename in (
+                _THREADING_FILE, _THIS_FILE):
+            frame = frame.f_back
+        if frame is None:
+            return "<unknown>", None
+        filename, lineno = frame.f_code.co_filename, frame.f_lineno
+        static_node = None
+        if self.static is not None:
+            rel = self.static.relpath(filename)
+            if rel is not None:
+                hit = self.static.creation_sites.get((rel, lineno))
+                if hit is not None:
+                    static_node = hit[0]
+        label = static_node or f"{os.path.basename(filename)}:{lineno}"
+        return label, static_node
+
+    def _make_lock(self):
+        label, node = self._creation_label()
+        return RecordingLock(self, label, node)
+
+    def _make_rlock(self):
+        label, node = self._creation_label()
+        return RecordingRLock(self, label, node)
+
+    def _make_condition(self, lock=None):
+        if lock is None:
+            label, node = self._creation_label()
+            lock = RecordingRLock(self, label, node)
+        return _REAL_CONDITION(lock)
+
+    # -- acquisition recording -----------------------------------------------
+
+    def _stack(self):
+        try:
+            return self._tls.stack
+        except AttributeError:
+            self._tls.stack = []
+            return self._tls.stack
+
+    def _record_acquire(self, lock) -> None:
+        stack = self._stack()
+        me = threading.get_ident()
+        # Prune stale entries first: threading.Lock may legally be
+        # released by a DIFFERENT thread (signaling idiom), which cannot
+        # pop it from the acquirer's stack — its cleared/reassigned
+        # _owner marks it dead here, and a stale entry would otherwise
+        # mint phantom acquired-while-held edges forever.
+        if any(h._owner != me for h in stack):
+            stack[:] = [h for h in stack if h._owner == me]
+        if self._active and stack:
+            with self._ilock:
+                for held in stack:
+                    if held is lock:
+                        continue
+                    edge = (held.key, lock.key)
+                    if edge not in self.edges:
+                        self.edges[edge] = self._call_site()
+        stack.append(lock)
+
+    def _record_release(self, lock) -> None:
+        stack = self._stack()
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i] is lock:
+                del stack[i]
+                break
+
+    def _call_site(self) -> str:
+        frame = sys._getframe(3)
+        steps = 0
+        while frame is not None and steps < 8 and \
+                frame.f_code.co_filename in (_THREADING_FILE, _THIS_FILE):
+            frame = frame.f_back
+            steps += 1
+        if frame is None:
+            return "<unknown>"
+        return (f"{os.path.basename(frame.f_code.co_filename)}:"
+                f"{frame.f_lineno} ({frame.f_code.co_name})")
+
+    # -- guarded-state instrumentation ---------------------------------------
+
+    def _instrument_package(self) -> None:
+        targets = []
+        for (dotted_mod, qual), guards in sorted(
+                self.static.class_guards.items()):
+            try:
+                mod = importlib.import_module(dotted_mod)
+            except Exception:
+                continue
+            obj = mod
+            for part in qual.split("."):
+                obj = getattr(obj, part, None)
+                if obj is None:
+                    break
+            if isinstance(obj, type):
+                relpath = dotted_mod.replace(".", "/") + ".py"
+                targets.append((obj, guards, f"{relpath}::{qual}"))
+        # Bases before derived (MRO depth): a derived class patched first
+        # would capture the base's UNpatched __setattr__ as its chain
+        # target and permanently bypass the base's guard checks.
+        targets.sort(key=lambda t: len(t[0].__mro__))
+        for obj, guards, prefix in targets:
+            self.instrument_class(obj, guards, decl_prefix=prefix)
+        for dotted_mod, guards in sorted(self.static.module_guards.items()):
+            try:
+                mod = importlib.import_module(dotted_mod)
+            except Exception:
+                continue
+            relpath = dotted_mod.replace(".", "/") + ".py"
+            for name, lock_expr in guards.items():
+                value = getattr(mod, name, None)
+                if type(value) in _PROXY_FOR:
+                    # Swap under the declared guard: a concurrent writer
+                    # (the session-persistent tracing drain thread, a
+                    # lingering server) between copy and setattr would
+                    # append to the discarded original.
+                    with _maybe_locked(self._eval_lock(mod, lock_expr)):
+                        value = getattr(mod, name)
+                        proxy = self._wrap_container(
+                            value, f"{relpath}::<module>.{name}", mod,
+                            lock_expr)
+                        setattr(mod, name, proxy)
+                    self._patched_globals.append((mod, name, type(value)))
+
+    def instrument_class(self, cls: type, guards: dict[str, str],
+                         decl_prefix: str | None = None) -> None:
+        """Patch cls.__setattr__ so every store to a guarded attribute is
+        witnessed. Public so tests can plant synthetic guarded classes."""
+        prefix = decl_prefix or f"<test>::{cls.__name__}"
+        had_own = "__setattr__" in cls.__dict__
+        # MRO lookup, not object.__setattr__: a guarded class inheriting
+        # a custom (or already-instrumented base) __setattr__ must chain
+        # through it, or base-declared attrs go unwitnessed.
+        orig = cls.__dict__.get("__setattr__") or cls.__setattr__
+        witness = self
+
+        def __setattr__(self_obj, name, value,
+                        _orig=orig, _guards=guards, _prefix=prefix):
+            lock_expr = _guards.get(name)
+            if lock_expr is not None:
+                value = witness._on_mutation(
+                    self_obj, f"{_prefix}.{name}", lock_expr, value)
+            _orig(self_obj, name, value)
+
+        cls.__setattr__ = __setattr__
+        self._patched_classes.append((cls, had_own, orig))
+
+    def _wrap_container(self, value, decl_id: str, owner, lock_expr: str):
+        proxy_cls = _PROXY_FOR[type(value)]
+        if type(value) is collections.deque:
+            proxy = proxy_cls(value, value.maxlen)
+        else:
+            proxy = proxy_cls(value)
+        object.__setattr__(proxy, "_rec_witness", self)
+        object.__setattr__(proxy, "_rec_decl", decl_id)
+        object.__setattr__(proxy, "_rec_guard", lock_expr)
+        object.__setattr__(proxy, "_rec_owner", owner)
+        if not isinstance(owner, types.ModuleType):
+            attr = decl_id.rsplit(".", 1)[-1]
+            try:
+                ref = weakref.ref(owner)
+            except TypeError:
+                def ref(_o=owner):
+                    return _o
+            self._wrapped_instances.append((ref, attr, type(value), proxy))
+        return proxy
+
+    # -- mutation recording --------------------------------------------------
+
+    def _on_mutation(self, instance, decl_id: str, lock_expr: str, value):
+        if self._active and type(value) in _PROXY_FOR:
+            value = self._wrap_container(value, decl_id, instance, lock_expr)
+        if not self._active:
+            return value
+        self._check_frame(_mutating_frame(), instance, decl_id, lock_expr)
+        return value
+
+    def _on_container_mutation(self, proxy) -> None:
+        witness = proxy._rec_witness
+        if witness is not self or not self._active:
+            return
+        self._check_frame(_mutating_frame(), proxy._rec_owner,
+                          proxy._rec_decl, proxy._rec_guard)
+
+    def _check_frame(self, frame, owner, decl_id: str,
+                     lock_expr: str) -> None:
+        if frame is None or frame.f_code.co_name in _EXEMPT_FRAMES:
+            return
+        rel = None
+        if self.static is not None:
+            rel = self.static.relpath(frame.f_code.co_filename)
+            if rel is None:
+                return  # outside the package: tests poking internals
+            if (rel, frame.f_lineno) in self.static.lock_ok_lines:
+                return
+        lock = self._eval_lock(owner, lock_expr)
+        held = self._is_held(lock)
+        site = f"{rel or frame.f_code.co_filename}:{frame.f_lineno}"
+        with self._ilock:
+            if held is None:
+                self.unverifiable[decl_id] = \
+                    self.unverifiable.get(decl_id, 0) + 1
+            elif held:
+                self.verified[decl_id] = self.verified.get(decl_id, 0) + 1
+            else:
+                self.violations.append(
+                    f"{decl_id} mutated at {site} on thread "
+                    f"{threading.current_thread().name!r} WITHOUT holding "
+                    f"its declared guard `{lock_expr}`")
+
+    @staticmethod
+    def _eval_lock(owner, lock_expr: str):
+        parts = lock_expr.split(".")
+        obj = owner
+        attrs = parts[1:] if parts[0] == "self" else parts
+        for attr in attrs:
+            obj = getattr(obj, attr, None)
+            if obj is None:
+                return None
+        return obj
+
+    @staticmethod
+    def _is_held(lock):
+        """True/False when ownership is provable, None when it isn't.
+        Wrapped locks answer exactly; pre-install primitives fall back
+        to their own probes (`_is_owned`, else `locked`)."""
+        if lock is None:
+            return None
+        if isinstance(lock, _RecLockBase):
+            return lock.held_by_current()
+        inner = getattr(lock, "_lock", None)   # Condition -> its mutex
+        if isinstance(inner, _RecLockBase):
+            return inner.held_by_current()
+        probe = getattr(lock, "_is_owned", None)
+        if probe is not None:
+            try:
+                return bool(probe())
+            except Exception:
+                return None
+        probe = getattr(lock, "locked", None)
+        if probe is not None:
+            # A plain pre-install mutex cannot name its owner. locked()
+            # False is a DEFINITE violation (nobody holds it); True only
+            # proves SOMEONE holds it, which must not count as verified
+            # — report unverifiable rather than an unsound pass.
+            try:
+                return None if probe() else False
+            except Exception:
+                return None
+        return None
+
+    # -- verdicts ------------------------------------------------------------
+
+    def observed_cycle(self) -> list | None:
+        return _find_cycle(self.edges.keys())
+
+    def static_inconsistency(self) -> list | None:
+        """A cycle in (static edges) U (observed edges mapped to static
+        node ids) — an observed schedule contradicting the proven order.
+        Instance self-edges (two instances of one class-level lock) are
+        orderable by instance and skipped."""
+        if self.static is None:
+            return None
+        union = set(self.static.static_edges)
+        for (a, b) in self.edges:
+            a_static = a[0] if "::" in a[0] else None
+            b_static = b[0] if "::" in b[0] else None
+            if a_static and b_static and a_static != b_static:
+                union.add((a_static, b_static))
+        return _find_cycle(union)
+
+    def assert_clean(self, require_static_consistency: bool = True) -> None:
+        problems = []
+        if self.violations:
+            listed = "\n  ".join(self.violations[:20])
+            problems.append(
+                f"{len(self.violations)} guarded_by violation(s) observed "
+                f"at runtime:\n  {listed}")
+        cycle = self.observed_cycle()
+        if cycle:
+            problems.append(
+                "observed lock-acquisition order contains a cycle: "
+                + " -> ".join(str(k) for k in cycle))
+        if require_static_consistency:
+            cycle = self.static_inconsistency()
+            if cycle:
+                problems.append(
+                    "observed order is INCONSISTENT with the static "
+                    "lock-order graph; union cycle: "
+                    + " -> ".join(str(k) for k in cycle))
+        if problems:
+            raise AssertionError(
+                "schedule witness found problems:\n" + "\n".join(problems))
+
+
+def _find_cycle(edges) -> list | None:
+    adj: dict = {}
+    for a, b in edges:
+        adj.setdefault(a, []).append(b)
+        adj.setdefault(b, [])
+    WHITE, GRAY, BLACK = 0, 1, 2
+    color = {n: WHITE for n in adj}
+    parent: dict = {}
+    for root in adj:
+        if color[root] != WHITE:
+            continue
+        stack = [(root, iter(adj[root]))]
+        color[root] = GRAY
+        while stack:
+            node, it = stack[-1]
+            advanced = False
+            for nxt in it:
+                if color[nxt] == GRAY:
+                    cycle = [nxt, node]
+                    cur = node
+                    while cur != nxt and cur in parent:
+                        cur = parent[cur]
+                        cycle.append(cur)
+                    cycle.reverse()
+                    return cycle
+                if color[nxt] == WHITE:
+                    color[nxt] = GRAY
+                    parent[nxt] = node
+                    stack.append((nxt, iter(adj[nxt])))
+                    advanced = True
+                    break
+            if not advanced:
+                color[node] = BLACK
+                stack.pop()
+    return None

@@ -207,7 +207,10 @@ class _InFlightWindow:
         the same, not fail its riders)."""
         with self._cv:
             while self._in_flight >= self.depth and not self._closed:
-                self._cv.wait()
+                # Timed + loop-on-predicate (servelint DL003): a
+                # completion thread that died un-notified must not park
+                # the batch worker forever with a popped batch in hand.
+                self._cv.wait(timeout=0.1)
             if self._closed:
                 return False
             self._in_flight += 1
@@ -269,6 +272,9 @@ class _InFlightWindow:
         while True:
             with self._cv:
                 while not self._pending and not self._closed:
+                    # servelint: blocks completion worker loop — parking
+                    # on an empty window is its contract; close() wakes
+                    # it with notify_all and it exits on the drained check
                     self._cv.wait()
                 if not self._pending:
                     return  # closed and drained
@@ -428,6 +434,10 @@ class BatchedSignatureRunner:
         task = BatchTask(inputs=arrays, size=n,
                          output_filter=tuple(output_filter), trace=trace)
         self._scheduler.schedule(self._queue, task)
+        # servelint: blocks delivery is the scheduler's hard contract —
+        # the worker's finally and the window's bounded close() drain
+        # both set done for every scheduled task, errors included; a
+        # timeout here would have nothing sound to do on expiry
         task.done.wait()
         if task.error is not None:
             raise task.error

@@ -121,6 +121,7 @@ class StreamingBatchScheduler:
                 self._open = batch
                 self._in_flight += 1
                 threading.Thread(target=self._drive, args=(batch,),
+                                 name="stream-batch-drive",
                                  daemon=True).start()
             batch.tasks.append(task)
             batch.size += task.size

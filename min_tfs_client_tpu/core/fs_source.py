@@ -94,10 +94,11 @@ class FileSystemStoragePathSource:
         servable_versions_always_present: bool = False,
     ):
         self._lock = threading.RLock()
-        self._servables = list(servables)
+        self._servables = list(servables)         # guarded_by: self._lock
         self._poll_wait_seconds = poll_wait_seconds
         self._always_present = servable_versions_always_present
-        self._callback: Optional[AspiredCallback] = None
+        self._callback: Optional[AspiredCallback] = (
+            None)                                 # guarded_by: self._lock
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 

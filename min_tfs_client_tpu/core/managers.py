@@ -152,7 +152,12 @@ class CachingManager:
                 if waiter is None:
                     self._inflight[sid] = threading.Event()
                     break
-            waiter.wait()
+            # Timed (servelint DL003): the outer `while True` re-checks
+            # the harness table on every 1s beat. If the loading thread
+            # dies without its finally (stale _inflight entry), followers
+            # keep polling — interruptible and visible in stacks, unlike
+            # the old single untimed park.
+            waiter.wait(timeout=1.0)
         try:
             resolved, loader = self._factory(name, version)
             harness = LoaderHarness(

@@ -261,7 +261,10 @@ class TickBatcher:
         entry = _TickEntry()
         with self._cv:
             while slot in self._pending or slot in self._inflight:
-                self._cv.wait()
+                # Timed + loop-on-predicate (servelint DL003): a leader
+                # lost to an interpreter-level failure must not park
+                # same-slot followers forever.
+                self._cv.wait(timeout=0.1)
             self._pending[slot] = entry
             if self._leader:
                 # A leader is running; wait for delivery — or take over
@@ -270,7 +273,10 @@ class TickBatcher:
                     if not self._leader:
                         self._leader = True
                         break
-                    self._cv.wait()
+                    # Timed (servelint DL003): wake to re-check the
+                    # leadership-lapse predicate above even if the
+                    # leader died between notify rounds.
+                    self._cv.wait(timeout=0.1)
                 if entry.done:
                     if entry.error is not None:
                         raise entry.error

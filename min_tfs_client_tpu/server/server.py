@@ -262,6 +262,8 @@ class Server:
         flight_recorder.configure(opts.flight_recorder_dir or None)
         flight_recorder.install_signal_handler()
 
+        # servelint: thread-ok published exactly once, BEFORE the
+        # config-poll thread spawns below; the poll loop only reads it
         self.core = ServerCore(
             config,
             file_system_poll_wait_seconds=opts.file_system_poll_wait_seconds,
@@ -399,7 +401,12 @@ class Server:
     def stop(self, grace: float = 5.0) -> None:
         self._config_poll_stop.set()
         if self._grpc_server is not None:
-            self._grpc_server.stop(grace).wait()
+            # Bounded (servelint DL003): grpc's stop() event fires when
+            # in-flight RPCs finish, but a handler wedged on a sick
+            # device would otherwise hold process shutdown hostage
+            # forever. Past grace + slack the server teardown proceeds;
+            # the daemonized handler threads die with the process.
+            self._grpc_server.stop(grace).wait(timeout=grace + 5.0)
         if self._rest_server is not None:
             self._rest_server.shutdown()
         if self.core is not None:

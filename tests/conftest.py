@@ -22,3 +22,27 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 import jax  # noqa: E402  (must follow the env setup above)
 
 jax.config.update("jax_platforms", "cpu")
+
+
+# ---------------------------------------------------------------------------
+# Runtime schedule witness (docs/STATIC_ANALYSIS.md "Runtime witness"):
+# concurrency suites opt in with an autouse fixture that requests
+# `schedule_witness`; every test then runs with threading.Lock/RLock/
+# Condition recording acquisition order and every `# guarded_by:`-declared
+# mutation checked held-at-mutation, asserted clean at teardown.
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def schedule_witness():
+    from min_tfs_client_tpu.analysis import witness as witness_mod
+
+    wit = witness_mod.ScheduleWitness.for_package()
+    wit.install()
+    try:
+        yield wit
+    finally:
+        wit.uninstall()
+    # After uninstall, so an assertion failure can't leak the patches.
+    wit.assert_clean()

@@ -1,0 +1,91 @@
+"""servelint fixture: lock-order rule must NOT fire anywhere here."""
+
+import threading
+
+
+class Ordered:
+    """One global order (outer before inner), on both paths — including
+    the interprocedural one through a caller-holds contract."""
+
+    def __init__(self):
+        self._outer = threading.Lock()
+        self._inner = threading.Lock()
+
+    def one(self):
+        with self._outer:
+            with self._inner:
+                pass
+
+    def two(self):
+        with self._outer:
+            self._locked_step()
+
+    def _locked_step(self):  # servelint: holds self._outer
+        with self._inner:
+            pass
+
+    def manual(self):
+        self._outer.acquire()
+        try:
+            with self._inner:
+                pass
+        finally:
+            self._outer.release()
+
+
+class TimedParker:
+    """Timed waits + a sanctioned forever-parking worker loop."""
+
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._thread = threading.Thread(target=self.worker, name="w",
+                                        daemon=True)
+        self._items = []
+
+    def take(self):
+        with self._cv:
+            while not self._items:
+                self._cv.wait(timeout=0.1)
+            return self._items.pop()
+
+    def worker(self):
+        with self._cv:
+            while True:
+                # servelint: blocks worker loop — parking forever on an
+                # empty queue is this thread's contract
+                self._cv.wait()
+
+    def stop(self):
+        self._thread.join(timeout=5.0)
+
+
+class AliasedCondition:
+    """threading.Condition(existing_lock) is the SAME mutex: reentrant
+    re-entry through the alias must not read as a second lock."""
+
+    def __init__(self):
+        self._mu = threading.RLock()
+        self._drained = threading.Condition(self._mu)
+
+    def drain(self):
+        with self._mu:
+            self.signal()
+
+    def signal(self):
+        with self._drained:
+            self._drained.notify_all()
+
+
+class Fetcher:
+    def __init__(self):
+        self._mu = threading.Lock()
+
+    def fetch(self, arrays):
+        outs = self._execute(arrays)
+        with self._mu:
+            pending = dict(outs)
+        return fetch_outputs(pending)  # sanctioned fetch, outside the lock
+
+
+def fetch_outputs(outputs):
+    return outputs

@@ -1,0 +1,87 @@
+"""servelint fixture: lock-order rule SHOULD fire on every marked line."""
+
+import threading
+
+
+class Inverted:
+    """Classic AB/BA inversion across two methods."""
+
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def ab(self):
+        with self._a:
+            with self._b:                         # DL002 (b->a in ba())
+                pass
+
+    def ba(self):
+        with self._b:
+            with self._a:
+                pass
+
+
+class SelfDeadlock:
+    """Re-acquiring a non-reentrant lock through a call chain."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+
+    def outer(self):
+        with self._mu:
+            self.helper()                         # DL001 (self-cycle)
+
+    def helper(self):
+        with self._mu:
+            pass
+
+
+class Ring:
+    """Three locks closed into a cycle across three methods."""
+
+    def __init__(self):
+        self._x = threading.Lock()
+        self._y = threading.Lock()
+        self._z = threading.Lock()
+
+    def xy(self):
+        with self._x:
+            with self._y:                         # DL001 (x->y->z->x ring)
+                pass
+
+    def yz(self):
+        with self._y:
+            with self._z:
+                pass
+
+    def zx(self):
+        with self._z:
+            with self._x:
+                pass
+
+
+class Parker:
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._thread = threading.Thread(target=self.take, name="t",
+                                        daemon=True)
+        self._items = []
+
+    def take(self):
+        with self._cv:
+            while not self._items:
+                self._cv.wait()                   # DL003 (untimed park)
+            return self._items.pop()
+
+    def stop(self):
+        self._thread.join()                       # DL003 (zero-arg join)
+
+
+class Syncer:
+    def __init__(self):
+        self._mu = threading.Lock()
+
+    def fetch(self, arrays):
+        outs = self._execute(arrays)
+        with self._mu:
+            return float(outs)                    # DL003 (sync while locked)

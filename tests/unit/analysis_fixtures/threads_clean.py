@@ -1,0 +1,73 @@
+"""servelint fixture: threads rule must NOT fire anywhere here."""
+
+import threading
+
+
+class Worker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._shared = []                         # guarded_by: self._lock
+        self._done = False                        # guarded_by: self._lock
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._loop, name="worker-loop", daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        while True:
+            with self._lock:
+                if self._done:
+                    return
+                self._shared.append(1)
+
+    def drain(self):
+        with self._lock:
+            return list(self._shared)
+
+    def stop(self):
+        with self._lock:
+            self._done = True
+        self._thread.join(timeout=5.0)
+
+
+class PublishedOnce:
+    """State written once before the thread spawns is the sanctioned
+    pattern — annotated, because the analyzer cannot prove ordering."""
+
+    def __init__(self):
+        self._config = None
+        self._thread = None
+
+    def start(self, config):
+        # servelint: thread-ok published exactly once before the spawn;
+        # the loop only reads it
+        self._config = config
+        self._thread = threading.Thread(
+            target=self._loop, name="published-once", daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        while self._config is not None:
+            break
+
+
+_jobs = []                                        # guarded_by: _jobs_lock
+_jobs_lock = threading.Lock()
+
+
+def _drain_loop():
+    global _jobs
+    with _jobs_lock:
+        while _jobs:
+            _jobs = _jobs[1:]
+
+
+def spawn():
+    threading.Thread(target=_drain_loop, name="drain", daemon=True).start()
+
+
+def submit(item):
+    with _jobs_lock:
+        _jobs.append(item)

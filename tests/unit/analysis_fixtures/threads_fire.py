@@ -1,0 +1,42 @@
+"""servelint fixture: threads rule SHOULD fire on every marked line."""
+
+import threading
+
+
+class Worker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._shared = []
+        self._done = False
+
+    def start(self):
+        self._thread = threading.Thread(target=self._loop)   # TH002
+        self._thread.start()
+
+    def _loop(self):
+        while not self._done:
+            self._shared.append(1)                # TH001 (undeclared shared)
+
+    def drain(self):
+        with self._lock:
+            return list(self._shared)
+
+    def stop(self):
+        self._done = True                         # TH001 (undeclared flag)
+
+
+_jobs = []
+
+
+def _drain_loop():
+    global _jobs
+    while _jobs:
+        _jobs = _jobs[1:]                         # TH001 (module global)
+
+
+def spawn():
+    threading.Thread(target=_drain_loop, name="drain", daemon=True).start()
+
+
+def submit(item):
+    _jobs.append(item)                            # TH001 (mutator call)

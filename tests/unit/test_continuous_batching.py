@@ -21,6 +21,13 @@ from min_tfs_client_tpu.utils.status import ServingError
 SEQ, MAXDEC = 12, 8
 
 
+@pytest.fixture(autouse=True)
+def _schedule_witness(schedule_witness):
+    """Runtime schedule witness (docs/STATIC_ANALYSIS.md): the shared-tick
+    machinery's lock order and guarded mutations are verified live."""
+    yield
+
+
 @pytest.fixture(scope="module")
 def pooled():
     config = t5.T5Config.tiny()

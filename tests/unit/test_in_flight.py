@@ -33,6 +33,15 @@ from min_tfs_client_tpu.utils.status import ServingError
 from tests import fixtures
 
 
+@pytest.fixture(autouse=True)
+def _schedule_witness(schedule_witness):
+    """Every in-flight test runs under the runtime schedule witness
+    (docs/STATIC_ANALYSIS.md "Runtime witness"): observed lock order must
+    stay acyclic/consistent with the static DL graph and every
+    guarded_by-declared mutation must hold its lock."""
+    yield
+
+
 @pytest.fixture()
 def scheduler():
     s = SharedBatchScheduler(num_threads=2)

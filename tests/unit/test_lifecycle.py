@@ -25,6 +25,13 @@ from min_tfs_client_tpu.utils.event_bus import EventBus
 from min_tfs_client_tpu.utils.status import ServingError
 
 
+@pytest.fixture(autouse=True)
+def _schedule_witness(schedule_witness):
+    """Runtime schedule witness (docs/STATIC_ANALYSIS.md): manager/monitor/
+    source lock order and guarded mutations are verified live."""
+    yield
+
+
 class FakeLoader(Loader):
     """core/test_util/fake_loader.{h,cc} equivalent."""
 

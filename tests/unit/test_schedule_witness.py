@@ -1,0 +1,342 @@
+"""Runtime schedule witness (ISSUE 8, docs/STATIC_ANALYSIS.md).
+
+Two halves:
+
+* planted-bug tests — the witness must CATCH an unguarded mutation of a
+  declared attribute and an observed lock-order inversion (otherwise the
+  green runs over the real suites prove nothing);
+* coverage tests — scenario drivers touch the batching window, the
+  scheduler variants, the lifecycle managers, tracing/SLO/metrics and
+  the flight recorder under the package witness, and the aggregate must
+  verify >= 40 distinct `# guarded_by:` declarations held-at-mutation
+  with an acyclic observed order graph consistent with the static DL
+  graph (the ISSUE 8 acceptance bar).
+
+Scenario tests run in definition order (pytest collects within a module
+top-down); `test_aggregate_coverage_threshold` last asserts the bar over
+everything the module observed.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from min_tfs_client_tpu.analysis import witness as witness_mod
+
+# Aggregated across this module's scenario tests.
+VERIFIED: dict[str, int] = {}
+EDGES: dict = {}
+
+
+@pytest.fixture
+def package_witness(schedule_witness):
+    """The conftest witness, with results harvested into the module
+    aggregate before teardown asserts it clean."""
+    yield schedule_witness
+    VERIFIED.update(schedule_witness.verified)
+    EDGES.update(schedule_witness.edges)
+
+
+# -- planted bugs: the witness must actually catch things --------------------
+
+
+class TestPlantedBugs:
+    def test_witness_catches_unguarded_mutation(self):
+        wit = witness_mod.ScheduleWitness()  # no static: no frame filter
+
+        class Planted:
+            def __init__(self):
+                self._mu = threading.Lock()
+                self._state = 0          # guarded_by: self._mu
+                self._items = []         # guarded_by: self._mu
+
+        wit.instrument_class(
+            Planted, {"_state": "self._mu", "_items": "self._mu"})
+        wit.install()
+        try:
+            p = Planted()
+
+            def racer():
+                p._state = 1             # planted: no lock held
+
+            t = threading.Thread(target=racer, name="planted-racer",
+                                 daemon=True)
+            t.start()
+            t.join(timeout=5.0)
+            with p._mu:
+                p._state = 2             # guarded: must NOT be flagged
+                p._items.append(1)       # container proxy, guarded
+            p._items.append(2)           # planted: container, no lock
+        finally:
+            wit.uninstall()
+        assert len(wit.violations) == 2, wit.violations
+        assert any("_state" in v and "planted-racer" in v
+                   for v in wit.violations)
+        assert any("_items" in v for v in wit.violations)
+        assert wit.verified.get("<test>::Planted._state") == 1
+        assert wit.verified.get("<test>::Planted._items") == 1
+        with pytest.raises(AssertionError, match="guarded_by violation"):
+            wit.assert_clean(require_static_consistency=False)
+
+    def test_witness_catches_order_inversion(self):
+        wit = witness_mod.ScheduleWitness()
+        wit.install()
+        try:
+            a = threading.Lock()
+            b = threading.Lock()
+            with a:
+                with b:
+                    pass
+            done = threading.Event()
+
+            def inverter():
+                with b:
+                    with a:              # planted: opposite order
+                        pass
+                done.set()
+
+            t = threading.Thread(target=inverter, name="planted-inverter",
+                                 daemon=True)
+            t.start()
+            assert done.wait(timeout=5.0)
+        finally:
+            wit.uninstall()
+        assert wit.observed_cycle() is not None
+        with pytest.raises(AssertionError, match="cycle"):
+            wit.assert_clean(require_static_consistency=False)
+
+    def test_clean_schedule_passes(self):
+        wit = witness_mod.ScheduleWitness()
+        wit.install()
+        try:
+            a = threading.Lock()
+            b = threading.Lock()
+            for _ in range(3):
+                with a:
+                    with b:
+                        pass
+        finally:
+            wit.uninstall()
+        assert wit.observed_cycle() is None
+        wit.assert_clean(require_static_consistency=False)
+
+
+# -- coverage scenarios ------------------------------------------------------
+
+
+def _drive_windowed_batching():
+    import jax.numpy as jnp
+
+    from min_tfs_client_tpu.batching.scheduler import SharedBatchScheduler
+    from min_tfs_client_tpu.batching.session import BatchedSignatureRunner
+    from min_tfs_client_tpu.servables.servable import Signature, TensorSpec
+
+    sig = Signature(
+        fn=lambda inputs: {"y": jnp.tanh(inputs["x"]) * 2.0 + 1.0},
+        inputs={"x": TensorSpec(np.float32, (None, 4))},
+        outputs={"y": TensorSpec(np.float32, (None, 4))},
+    )
+    sched = SharedBatchScheduler(num_threads=2)
+    runner = BatchedSignatureRunner(
+        sig, sched, name="witness-window", max_batch_size=8,
+        batch_timeout_s=0.002, max_in_flight_batches=4)
+    threads = [
+        threading.Thread(
+            target=lambda i=i: runner.run(
+                {"x": np.full((1, 4), i, np.float32)}),
+            name=f"witness-caller-{i}")
+        for i in range(12)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30.0)
+    sched.stop()
+
+
+class TestCoverageScenarios:
+    def test_windowed_batching_scenario(self, package_witness):
+        _drive_windowed_batching()
+        assert any("_InFlightWindow" in k for k in package_witness.verified)
+        assert any("ExecutionHandle._done" in k
+                   for k in package_witness.verified)
+
+    def test_scheduler_variants_scenario(self, package_witness):
+        from min_tfs_client_tpu.batching.scheduler import (
+            BatchTask,
+            QueueOptions,
+        )
+        from min_tfs_client_tpu.batching.variants import (
+            AdaptiveOptions,
+            AdaptiveSharedBatchScheduler,
+            SerialDeviceOptions,
+            SerialDeviceBatchScheduler,
+            SerialQueueOptions,
+            StreamingBatchScheduler,
+        )
+
+        done: list = []
+
+        def process(batch):
+            done.append(len(batch))
+
+        adaptive = AdaptiveSharedBatchScheduler(
+            AdaptiveOptions(num_threads=2, batches_to_average_over=2),
+            process, max_batch_size=4)
+        tasks = [BatchTask(inputs={}, size=1) for _ in range(10)]
+        for task in tasks:
+            adaptive.schedule(task)
+        for task in tasks:
+            assert task.done.wait(timeout=10.0)
+        adaptive.stop()
+
+        serial = SerialDeviceBatchScheduler(SerialDeviceOptions(
+            num_batch_threads=2, batches_to_average_over=2))
+        queue = serial.add_queue(SerialQueueOptions(max_batch_size=4),
+                                 process)
+        tasks = [BatchTask(inputs={}, size=1) for _ in range(6)]
+        for task in tasks:
+            serial.schedule(queue, task)
+        serial.flush(queue)
+        for task in tasks:
+            assert task.done.wait(timeout=10.0)
+        serial.stop()
+
+        streaming = StreamingBatchScheduler(
+            QueueOptions(max_batch_size=2, batch_timeout_s=0.005),
+            process, num_threads=2)
+        tasks = [BatchTask(inputs={}, size=1) for _ in range(6)]
+        for task in tasks:
+            # Queue-full UNAVAILABLE is the scheduler's documented
+            # backpressure; callers ride BatchSchedulerRetrier semantics.
+            deadline = time.monotonic() + 10.0
+            while True:
+                try:
+                    streaming.schedule(task)
+                    break
+                except Exception:
+                    assert time.monotonic() < deadline
+                    time.sleep(0.002)
+        for task in tasks:
+            assert task.done.wait(timeout=10.0)
+        streaming.stop()
+
+        assert any("AdaptiveSharedBatchScheduler" in k
+                   for k in package_witness.verified)
+        assert any("SerialDeviceBatchScheduler" in k
+                   for k in package_witness.verified)
+        assert any("StreamingBatchScheduler" in k
+                   for k in package_witness.verified)
+
+    def test_lifecycle_scenario(self, package_witness):
+        from min_tfs_client_tpu.core.loader import Loader, SimpleLoader
+        from min_tfs_client_tpu.core.manager import AspiredVersionsManager
+        from min_tfs_client_tpu.core.managers import CachingManager
+        from min_tfs_client_tpu.core.monitor import ServableStateMonitor
+        from min_tfs_client_tpu.core.fs_source import (
+            FileSystemStoragePathSource,
+        )
+        from min_tfs_client_tpu.utils.event_bus import EventBus
+
+        bus = EventBus()
+        monitor = ServableStateMonitor(bus)
+        manager = AspiredVersionsManager(
+            event_bus=bus, start_thread=False, max_load_retries=0,
+            load_retry_interval_s=0.0)
+        manager.set_aspired_versions(
+            "witmodel", [(1, SimpleLoader(lambda: object()))])
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            manager.tick()
+            if manager.list_available():
+                break
+            time.sleep(0.01)
+        assert manager.list_available()
+        manager.set_aspired_versions("witmodel", [])
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            manager.tick()
+            if not manager.list_available():
+                break
+            time.sleep(0.01)
+        manager.stop()
+
+        caching = CachingManager(
+            lambda name, version: (version or 1,
+                                   SimpleLoader(lambda: name)))
+        handle = caching.get_servable_handle("witcached", 1)
+        assert handle is not None
+
+        src = FileSystemStoragePathSource([], poll_wait_seconds=-1)
+        src.set_aspired_versions_callback(lambda name, versions: None)
+        src.update_config([])
+
+        assert any("AspiredVersionsManager" in k
+                   for k in package_witness.verified)
+        assert any("CachingManager" in k for k in package_witness.verified)
+
+    def test_observability_scenario(self, package_witness):
+        from min_tfs_client_tpu.observability import tracing
+        from min_tfs_client_tpu.observability.flight_recorder import (
+            FlightRecorder,
+        )
+        from min_tfs_client_tpu.observability.slo import (
+            SLOConfig,
+            SLOTracker,
+        )
+        from min_tfs_client_tpu.server import metrics
+
+        with tracing.request_trace("witness", model="m", signature="s"):
+            with tracing.span("witness/stage"):
+                pass
+        tracing.flush_metrics()
+
+        slo = SLOTracker(SLOConfig())
+        for i in range(5):
+            slo.record("m", "s", "classify", 0.001 * (i + 1), ok=True)
+        slo.configure(default=SLOConfig())
+        slo.record("m", "s", "classify", 0.002, ok=False)
+        assert slo.snapshot() is not None
+
+        recorder = FlightRecorder(capacity=64)
+        recorder.configure(dump_dir=None)
+        recorder.record("witness", detail=1)
+        recorder.reset()
+
+        counter = metrics.Counter(
+            ":test/witness/coverage_counter", "witness scenario counter",
+            ("leg",))
+        counter.increment("a")
+        counter.increment("b")
+        assert counter.value("a") == 1.0
+
+        assert any("SLOTracker" in k for k in package_witness.verified)
+        assert any("FlightRecorder" in k for k in package_witness.verified)
+        assert any("_Metric._cells" in k for k in package_witness.verified)
+
+    def test_aggregate_coverage_threshold(self):
+        """THE acceptance bar: >= 40 distinct guarded_by declarations
+        verified held-at-mutation across the scenarios, every one a
+        declaration the static pass knows, and the union of all observed
+        edges with the static graph acyclic."""
+        if not VERIFIED:
+            pytest.skip("scenario tests did not run in this process "
+                        "(isolated -k selection / distributed worker); "
+                        "the bar is asserted by the full module run")
+        static = witness_mod.package_static()
+        known = {k for k in VERIFIED if k in static.declared_ids}
+        assert len(known) >= 40, (
+            f"only {len(known)} declarations verified held-at-mutation:\n"
+            + "\n".join(sorted(known)))
+        union = set(static.static_edges)
+        for (a, b) in EDGES:
+            a_static = a[0] if "::" in a[0] else None
+            b_static = b[0] if "::" in b[0] else None
+            if a_static and b_static and a_static != b_static:
+                union.add((a_static, b_static))
+        assert witness_mod._find_cycle(union) is None
+        assert witness_mod._find_cycle(EDGES.keys()) is None

@@ -23,6 +23,13 @@ def scheduler():
 
 
 @pytest.fixture(autouse=True)
+def _schedule_witness(schedule_witness):
+    """Runtime schedule witness (docs/STATIC_ANALYSIS.md): the tracing
+    spine's deferred-export locking is verified live."""
+    yield
+
+
+@pytest.fixture(autouse=True)
 def _clean_ring():
     tracing.ring_clear()
     yield
