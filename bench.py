@@ -50,11 +50,12 @@ BASELINE_FILE = REPO / "bench_baseline.json"
 LASTGOOD_FILE = REPO / "bench_lastgood.json"
 
 ACCEL_CONFIGS = ["bert", "resnet", "bert_int8", "matmul", "use", "t5",
-                 "imported", "in_flight"]
+                 "imported", "in_flight", "decode_paged"]
 # CPU fallback: BERT-base is ~7.6 s/call on this host's CPU and never
 # finished inside the budget in any round; the stale accelerator record
 # carries the BERT story instead.
-CPU_CONFIGS = ["matmul", "use", "imported", "t5", "in_flight"]
+CPU_CONFIGS = ["matmul", "use", "imported", "t5", "in_flight",
+               "decode_paged"]
 
 BUDGET = float(os.environ.get("BENCH_BUDGET", 240))
 _START = time.monotonic()
@@ -1042,63 +1043,182 @@ def bench_t5(max_iters: int) -> dict:
             "extra": extra}
 
 
-def _t5_pooled_tokens_per_s(config, params, seq: int,
-                            decode_len: int) -> dict:
-    """Continuous batching: N concurrent single-sequence decode sessions
-    share one vmapped device tick per token (SlotPool/TickBatcher) vs N
-    independent per-session dispatches."""
+def _t5_pooled_run(config, params, seq: int, decode_len: int, *,
+                   n_sessions: int = 8, prompts=None,
+                   warm_full: bool = False, **session_kwargs) -> dict:
+    """THE concurrent pooled-decode harness (shared by the t5 and
+    decode_paged legs): init N single-sequence sessions, decode them
+    concurrently through the shared tick, return
+    {tokens_per_s, streams, pool_stats}. warm_full runs one throwaway
+    full-length generation first — the paged pool recompiles per
+    block-table width bucket, and steady state pays those once per
+    deployment, not per session."""
     import threading
 
     import numpy as np
 
     from min_tfs_client_tpu.models import t5
 
-    try:
-        n_sessions = 8
-        sigs = t5.build_session_signatures(
-            params, config, seq_len=seq, max_decode_len=decode_len,
-            max_sessions=n_sessions, continuous_batching=True)
+    sigs = t5.build_session_signatures(
+        params, config, seq_len=seq, max_decode_len=decode_len,
+        max_sessions=n_sessions, continuous_batching=True,
+        **session_kwargs)
+    if prompts is None:
         rng = np.random.default_rng(1)
         prompts = [rng.integers(2, config.vocab_size, (1, seq)).astype(
             np.int32) for _ in range(n_sessions)]
-        for i, ids in enumerate(prompts):
-            sigs["decode_init"].run({
-                "session_id": np.asarray(f"b{i}".encode(), object),
-                "input_ids": ids})
-        # Warm the tick executable before timing.
-        sigs["decode_step"].run(
-            {"session_id": np.asarray(b"b0", object)})
+    if warm_full:
+        warm = np.asarray(b"warm", object)
+        sigs["decode_init"].run({"session_id": warm,
+                                 "input_ids": prompts[0]})
+        for _ in range(decode_len - 1):
+            sigs["decode_step"].run({"session_id": warm})
+        sigs["decode_close"].run({"session_id": warm})
+    for i, ids in enumerate(prompts):
+        sigs["decode_init"].run({
+            "session_id": np.asarray(f"b{i}".encode(), object),
+            "input_ids": ids})
+    streams = [[] for _ in range(n_sessions)]
+    # Warm the tick executable before timing (session 0 steps once).
+    out = sigs["decode_step"].run({"session_id": np.asarray(b"b0", object)})
+    streams[0].append(int(out["token"][0]))
 
-        steps = decode_len - 2
-        barrier = threading.Barrier(n_sessions)
+    steps = decode_len - 2
+    barrier = threading.Barrier(n_sessions)
 
-        def worker(i):
-            sid = np.asarray(f"b{i}".encode(), object)
-            barrier.wait()
-            start = 0 if i else 1  # session 0 already stepped once
-            for _ in range(start, steps):
-                sigs["decode_step"].run({"session_id": sid})
+    def worker(i):
+        sid = np.asarray(f"b{i}".encode(), object)
+        barrier.wait()
+        start = 0 if i else 1  # session 0 already stepped once
+        for _ in range(start, steps):
+            row = sigs["decode_step"].run({"session_id": sid})
+            streams[i].append(int(row["token"][0]))
 
-        threads = [threading.Thread(target=worker, args=(i,))
-                   for i in range(n_sessions)]
-        t0 = time.perf_counter()
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join()
-        wall = time.perf_counter() - t0
-        for i in range(n_sessions):
-            sigs["decode_close"].run(
-                {"session_id": np.asarray(f"b{i}".encode(), object)})
-        total_tokens = steps * (n_sessions - 1) + (steps - 1)
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(n_sessions)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    pool = getattr(sigs["decode_init"], "_kv_pool", None)
+    pool_stats = pool.stats() if pool is not None else None
+    for i in range(n_sessions):
+        sigs["decode_close"].run(
+            {"session_id": np.asarray(f"b{i}".encode(), object)})
+    total_tokens = steps * (n_sessions - 1) + (steps - 1)
+    return {"tokens_per_s": round(total_tokens / wall, 1),
+            "streams": streams, "pool_stats": pool_stats,
+            "n_sessions": n_sessions}
+
+
+def _t5_pooled_tokens_per_s(config, params, seq: int,
+                            decode_len: int) -> dict:
+    """Continuous batching: N concurrent single-sequence decode sessions
+    share one vmapped device tick per token (SlotPool/TickBatcher) vs N
+    independent per-session dispatches."""
+    try:
+        run = _t5_pooled_run(config, params, seq, decode_len)
         return {
-            "tokens_per_s_continuous_batching":
-                round(total_tokens / wall, 1),
-            "continuous_batching_sessions": n_sessions,
+            "tokens_per_s_continuous_batching": run["tokens_per_s"],
+            "continuous_batching_sessions": run["n_sessions"],
         }
     except Exception:
         traceback.print_exc(file=sys.stderr)
         return {}
+
+
+def bench_decode_paged(max_iters: int) -> dict:
+    """Paged KV-cache decode (ROADMAP item 1): continuous-batching
+    tokens/s with the block-table-paged pool vs the dense slot pool
+    (same prompts, token identity recorded), plus the capacity
+    demonstration — sessions admitted under ONE fixed KV byte budget for
+    a short-prompt mix (paged admits pages-per-used-token, dense admits
+    max-length slots)."""
+    import jax
+    import numpy as np
+
+    from min_tfs_client_tpu.models import t5
+    from min_tfs_client_tpu.utils.status import ServingError
+
+    config = t5.T5Config.small()
+    params = t5.init_params(jax.random.PRNGKey(0), config)
+    seq, decode_len, n_sessions = 64, 32, 8
+    rng = np.random.default_rng(1)
+    prompts = [rng.integers(2, config.vocab_size, (1, seq)).astype(np.int32)
+               for _ in range(n_sessions)]
+
+    # The shared pooled-decode harness drives both pools over the SAME
+    # prompts. warm_full primes every tick executable before timing: the
+    # paged pool recompiles per block-table width bucket (W = 1, 2, 4
+    # over a 32-token generation) — steady-state serving pays those once
+    # per deployment, not per session.
+    dense = _t5_pooled_run(config, params, seq, decode_len,
+                           n_sessions=n_sessions, prompts=prompts,
+                           warm_full=True)
+    paged = _t5_pooled_run(config, params, seq, decode_len,
+                           n_sessions=n_sessions, prompts=prompts,
+                           warm_full=True, kv_block_size=8)
+    dense_tps, dense_streams = dense["tokens_per_s"], dense["streams"]
+    paged_tps, paged_streams = paged["tokens_per_s"], paged["streams"]
+    paged_stats = paged["pool_stats"]
+    extra = {
+        "model": "t5-small", "sessions": n_sessions,
+        "decode_len": decode_len, "kv_block_size": 8,
+        "dense_tokens_per_s": dense_tps,
+        "paged_over_dense": round(paged_tps / max(dense_tps, 1e-9), 3),
+        # Cross-program argmax ties can flip a token between the dense
+        # and paged executables (PERF.md round-5 note); record identity
+        # rather than asserting it. The unit suite asserts exactness on
+        # tie-free fixtures at every block size.
+        "paged_token_exact": paged_streams == dense_streams,
+        "paged_table_width": (paged_stats or {}).get("table_width"),
+        "paged_arena_bytes": (paged_stats or {}).get("arena_bytes"),
+        "paged_dense_equivalent_bytes":
+            (paged_stats or {}).get("dense_equivalent_bytes"),
+    }
+
+    if _child_time_left() > 30:
+        # Capacity under a fixed budget (structural, so the tiny config's
+        # fast compiles suffice): budget = 2 dense sessions' KV state;
+        # short sessions write 4 of 32 tokens = 1 page at block_size 8.
+        tiny = t5.T5Config.tiny()
+        tparams = t5.init_params(jax.random.PRNGKey(0), tiny)
+        trng = np.random.default_rng(2)
+
+        def admit(**kw):
+            sigs = t5.build_session_signatures(
+                tparams, tiny, seq_len=12, max_decode_len=32, **kw)
+            admitted = 0
+            try:
+                for i in range(64):
+                    ids = trng.integers(2, tiny.vocab_size,
+                                        (1, 12)).astype(np.int32)
+                    sid = np.asarray(f"c{i}".encode(), object)
+                    sigs["decode_init"].run({"session_id": sid,
+                                             "input_ids": ids})
+                    for _ in range(4):  # short mix: 4 used tokens
+                        sigs["decode_step"].run({"session_id": sid})
+                    admitted += 1
+            except ServingError:
+                pass
+            return admitted
+
+        cap_dense = admit(max_sessions=2, continuous_batching=True)
+        cap_paged = admit(max_sessions=64, continuous_batching=True,
+                          kv_block_size=8, kv_num_blocks=8,
+                          kv_evict_policy="refuse")
+        extra.update({
+            "capacity_budget_blocks": 8,
+            "capacity_sessions_dense": cap_dense,
+            "capacity_sessions_paged": cap_paged,
+            "capacity_ratio": round(cap_paged / max(cap_dense, 1), 2),
+        })
+
+    return {"metric": f"decode_paged_tokens_per_s_s{n_sessions}",
+            "value": paged_tps, "unit": "tokens/s",
+            "higher_is_better": True, "extra": extra}
 
 
 def bench_resnet(max_iters: int) -> dict:
@@ -1596,7 +1716,8 @@ def bench_in_flight(max_iters: int) -> dict:
 _CONFIG_FNS = {"bert": bench_bert, "bert_int8": bench_bert_int8,
                "matmul": bench_matmul, "use": bench_use,
                "t5": bench_t5, "resnet": bench_resnet,
-               "imported": bench_imported, "in_flight": bench_in_flight}
+               "imported": bench_imported, "in_flight": bench_in_flight,
+               "decode_paged": bench_decode_paged}
 
 
 def child_main(out: pathlib.Path, configs: list[str]) -> None:

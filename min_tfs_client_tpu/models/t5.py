@@ -683,7 +683,10 @@ def build_signatures(params: dict, config: T5Config, *, seq_len: int,
                      beam_size: int = 0,
                      beam_length_penalty: float = 1.0,
                      pipeline_mesh=None,
-                     pipeline_n_micro: int | None = None) -> dict:
+                     pipeline_n_micro: int | None = None,
+                     kv_block_size: int | None = None,
+                     kv_num_blocks: int | None = None,
+                     kv_evict_policy: str | None = None) -> dict:
     from min_tfs_client_tpu.servables.servable import Signature, TensorSpec
 
     # With `pipeline_mesh` (a Mesh carrying a "stage" axis) the ENCODER
@@ -843,7 +846,9 @@ def build_signatures(params: dict, config: T5Config, *, seq_len: int,
         max_sessions=max_sessions, session_ttl_s=session_ttl_s,
         continuous_batching=continuous_batching,
         sampling=session_sampling, sampling_top_k=sampling_top_k,
-        sampling_top_p=sampling_top_p))
+        sampling_top_p=sampling_top_p,
+        kv_block_size=kv_block_size, kv_num_blocks=kv_num_blocks,
+        kv_evict_policy=kv_evict_policy))
     return signatures
 
 
@@ -975,7 +980,10 @@ def build_session_signatures(params: dict, config: T5Config, *, seq_len: int,
                              continuous_batching: bool = False,
                              sampling: bool = False,
                              sampling_top_k: int = 0,
-                             sampling_top_p: bool = False) -> dict:
+                             sampling_top_p: bool = False,
+                             kv_block_size: int | None = None,
+                             kv_num_blocks: int | None = None,
+                             kv_evict_policy: str | None = None) -> dict:
     """The repeated-Predict decode surface (BASELINE config 5):
 
       decode_init:  session_id + input_ids -> prefill; KV cache parked in
@@ -992,13 +1000,21 @@ def build_session_signatures(params: dict, config: T5Config, *, seq_len: int,
     device tick (decode_sessions.SlotPool/TickBatcher) — K active
     sessions cost one dispatch per token instead of K. Sessions are then
     single-sequence (batch 1); the wire surface is identical.
+
+    kv_block_size > 0 additionally pages the pooled KV store
+    (decode_sessions.PagedSlotPool): session capacity scales with USED
+    tokens instead of max_decode_len slots. None defers to the server
+    flags (--kv_block_size etc., decode_sessions.default_paging); 0
+    forces the old dense slot pool byte-for-byte.
     """
     if continuous_batching:
         return _build_pooled_session_signatures(
             params, config, seq_len=seq_len, max_decode_len=max_decode_len,
             max_slots=max_sessions, session_ttl_s=session_ttl_s,
             sampling=sampling, sampling_top_k=sampling_top_k,
-            sampling_top_p=sampling_top_p)
+            sampling_top_p=sampling_top_p,
+            kv_block_size=kv_block_size, kv_num_blocks=kv_num_blocks,
+            kv_evict_policy=kv_evict_policy)
     from min_tfs_client_tpu.servables.decode_sessions import (
         DecodeSessionStore,
     )
@@ -1126,14 +1142,21 @@ def _build_pooled_session_signatures(params: dict, config: T5Config, *,
                                      session_ttl_s: float,
                                      sampling: bool = False,
                                      sampling_top_k: int = 0,
-                                     sampling_top_p: bool = False) -> dict:
+                                     sampling_top_p: bool = False,
+                                     kv_block_size: int | None = None,
+                                     kv_num_blocks: int | None = None,
+                                     kv_evict_policy: str | None = None
+                                     ) -> dict:
     """Continuous-batching variant: same wire surface, slot-pool device
     state, one vmapped tick per token across all concurrently-stepping
-    sessions. See decode_sessions.SlotPool."""
+    sessions. See decode_sessions.SlotPool; with kv_block_size > 0 the KV
+    caches live in the block-table-paged PagedSlotPool instead."""
     from min_tfs_client_tpu.servables.decode_sessions import (
         DecodeSessionStore,
+        PagedSlotPool,
         SlotPool,
         TickBatcher,
+        default_paging,
     )
     from min_tfs_client_tpu.servables.servable import Signature, TensorSpec
     from min_tfs_client_tpu.utils.status import ServingError
@@ -1156,7 +1179,32 @@ def _build_pooled_session_signatures(params: dict, config: T5Config, *,
         return new_state, {"token": token,
                            "finished": new_state["finished"]}
 
-    pool = SlotPool(template, one_step, max_slots=max_slots, params=params)
+    defaults = default_paging()
+    if kv_block_size is None:
+        kv_block_size = defaults["block_size"]
+    if kv_num_blocks is None:
+        kv_num_blocks = defaults["num_blocks"]
+    if kv_evict_policy is None:
+        kv_evict_policy = defaults["evict_policy"]
+
+    paged = bool(kv_block_size)
+    if paged:
+        # Page the decoder self-attention caches: leaves under "caches"
+        # named k/v, seq axis 2 of their (1, H, max_decode_len, d_kv)
+        # layout. Everything else (encoded prompt, token, PRNG keys, ...)
+        # stays dense — it is fully used from the first step.
+        def paged_axis_fn(path):
+            return 2 if ("caches" in path and path[-1] in ("k", "v")) \
+                else None
+
+        pool = PagedSlotPool(
+            template, one_step, max_slots=max_slots, params=params,
+            block_size=kv_block_size, num_blocks=kv_num_blocks or None,
+            paged_axis_fn=paged_axis_fn, evict_policy=kv_evict_policy,
+            metric_label="t5-paged")
+    else:
+        pool = SlotPool(template, one_step, max_slots=max_slots,
+                        params=params)
     batcher = TickBatcher(pool.tick)
     store = DecodeSessionStore(
         max_sessions=max_slots, ttl_s=session_ttl_s,
@@ -1206,6 +1254,16 @@ def _build_pooled_session_signatures(params: dict, config: T5Config, *,
             # rather than hand it to a future session mid-generation.
             pool.release_slot(slot)
             raise
+        if isinstance(row, Exception):
+            # Per-slot failure from the paged pool's tick (typed capacity
+            # errors, eviction under kv_evict_policy=close). slot_fatal
+            # distinguishes a dead session from a capacity REFUSAL whose
+            # state is intact and may retry after others close.
+            if getattr(row, "slot_fatal", True):
+                pool.release_slot(slot)
+            else:
+                store.put(sid, (slot, host_step))
+            raise row
         host_step += 1
         if host_step < max_decode_len:
             store.put(sid, (slot, host_step))
@@ -1250,5 +1308,7 @@ def _build_pooled_session_signatures(params: dict, config: T5Config, *,
         use_top_p=sampling_top_p)
     for sig in (init_sig, step_sig, close_sig):
         sig._decode_store = store
+        if paged:
+            sig._kv_pool = pool  # loader re-labels gauges with model:version
     return {"decode_init": init_sig, "decode_step": step_sig,
             "decode_close": close_sig}

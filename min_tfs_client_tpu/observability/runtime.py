@@ -237,6 +237,39 @@ def live_array_stats() -> dict:
         return {"count": None, "bytes": None}
 
 
+# -- paged KV pool accounting ------------------------------------------------
+
+_kv_pools_lock = threading.Lock()
+_kv_pools: list = []  # weakrefs to live PagedSlotPools  # guarded_by: _kv_pools_lock
+
+
+def register_kv_pool(pool) -> None:
+    """Weakly register a PagedSlotPool for the /monitoring/runtime
+    `kv_pool` payload (telemetry must not extend a pool's lifetime)."""
+    with _kv_pools_lock:
+        _kv_pools[:] = [r for r in _kv_pools if r() is not None]
+        _kv_pools.append(weakref.ref(pool))
+
+
+def kv_pool_stats() -> list[dict]:
+    """Per-pool occupancy/pressure snapshot, read at scrape time (the
+    pools update their gauges on allocation events; this walks the pool
+    state off the hot path per the deferred-export discipline)."""
+    with _kv_pools_lock:
+        pools = [r() for r in _kv_pools]
+    out = []
+    for pool in pools:
+        if pool is None:
+            continue
+        try:
+            entry = {"model": pool.metric_label}
+            entry.update(pool.stats())
+            out.append(entry)
+        except Exception:  # pragma: no cover - telemetry is best-effort
+            pass
+    return out
+
+
 # -- transfer accounting -----------------------------------------------------
 
 
@@ -280,6 +313,7 @@ def snapshot(include_live_arrays: bool = False) -> dict:
         "transfer": transfer_totals(),
         "profiler": profiler.status(),
         "pipeline": pipeline_stats(),
+        "kv_pool": kv_pool_stats(),
     }
     if include_live_arrays:
         payload["live_arrays"] = live_array_stats()

@@ -217,6 +217,208 @@ def flash_attention(
     return out.reshape(b, h, sq_p, d)[:, :, :sq, :]
 
 
+# -- ragged paged attention (block-table KV) ---------------------------------
+#
+# The decode KV store (servables/decode_sessions.PagedSlotPool) keeps each
+# session's cache as block_size-token pages scattered through a shared
+# (num_pages, H, block_size, D) HBM arena, addressed by a per-session block
+# table. Attention then has two equivalent forms:
+#
+#  * `paged_attention_reference` — the jnp semantics oracle: gather the
+#    table's pages back into a contiguous (B, H, P*bs, D) view sized by the
+#    table width (true used tokens, NOT max length) and run masked dense
+#    attention. This is the CPU path and the token-exactness yardstick.
+#  * `paged_flash_attention` — Pallas kernel: the block table rides as a
+#    scalar-prefetch operand so the BlockSpec index_map DMAs exactly the
+#    pages each (batch, head) needs, one page per grid step, online-softmax
+#    accumulated in VMEM scratch. Pages never materialize contiguously.
+#
+# `paged_attention()` dispatches between them behind the same `_on_tpu()`
+# gate as the dense kernel (arXiv:2604.15464's ragged paged attention,
+# collapsed to the single-arena/one-table layout the pool uses).
+
+
+def gather_kv_pages(pages: jax.Array, block_tables: jax.Array) -> jax.Array:
+    """(num_pages, H, bs, D) arena + (B, P) int32 tables -> (B, H, P*bs, D).
+
+    Entries past a sequence's allocated pages may name ANY in-range page
+    (the pool points them at its trash page); callers mask by length."""
+    g = pages[block_tables]  # (B, P, H, bs, D)
+    b, p = block_tables.shape
+    _, h, bs, d = pages.shape
+    return g.transpose(0, 2, 1, 3, 4).reshape(b, h, p * bs, d)
+
+
+def paged_attention_reference(
+    q: jax.Array,
+    k_pages: jax.Array,
+    v_pages: jax.Array,
+    block_tables: jax.Array,
+    lengths: jax.Array,
+    *,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Oracle: gather pages per true sequence length, then masked dense
+    attention. q (B, H, Sq, D) holds the NEWEST Sq positions (right-
+    aligned, the KV-cache decode convention); lengths (B,) counts valid
+    keys INCLUDING the query rows' own (already-written) K/V. Query row r
+    attends keys < lengths - (Sq-1-r), so Sq=1 reduces to pure lengths
+    masking and Sq>1 is causal within the block. Returns (B, H, Sq, D)."""
+    b, h, sq, d = q.shape
+    if scale is None:
+        scale = 1.0 / float(np.sqrt(d))
+    k = gather_kv_pages(k_pages, block_tables)
+    v = gather_kv_pages(v_pages, block_tables)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    ki = jnp.arange(k.shape[-2])[None, None, None, :]
+    row_limit = (lengths[:, None, None, None]
+                 - (sq - 1 - jnp.arange(sq))[None, None, :, None])
+    s = jnp.where(ki < row_limit, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    all_masked = jnp.max(s, axis=-1, keepdims=True) <= NEG_INF * 0.5
+    p = jnp.where(all_masked, 0.0, p)
+    return jnp.einsum(
+        "bhqk,bhkd->bhqd", p.astype(v.dtype), v,
+        preferred_element_type=jnp.float32).astype(q.dtype)
+
+
+def _paged_kernel(tbl_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
+                  m_ref, l_ref, acc_ref, *,
+                  scale: float, block_size: int, num_heads: int, sq: int):
+    """One (batch*head, page) grid cell. The index_map already routed this
+    cell's K/V refs at the table's page; here we accumulate online softmax
+    across the page grid dim in VMEM scratch and emit on the last page."""
+    bh = pl.program_id(0)
+    page = pl.program_id(1)
+    sq_p, d = q_ref.shape
+
+    @pl.when(page == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    valid_len = len_ref[bh // num_heads]
+    q = q_ref[...].astype(jnp.float32) * scale
+    k = k_ref[...].astype(jnp.float32)  # (block_size, D)
+    v = v_ref[...].astype(jnp.float32)
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    ki = (page * block_size
+          + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1))
+    # Query row r is the (sq-1-r)-th newest position; padded rows
+    # (r >= sq) mask everything and emit zeros.
+    qi = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+    row_limit = valid_len - (sq - 1 - qi)
+    row_limit = jnp.where(qi < sq, row_limit, 0)
+    s = jnp.where(ki < row_limit, s, NEG_INF)
+
+    m_prev, l_prev, acc = m_ref[...], l_ref[...], acc_ref[...]
+    m_cur = jnp.max(s, axis=1, keepdims=True)
+    m_new = jnp.maximum(m_prev, m_cur)
+    p = jnp.exp(s - m_new)
+    correction = jnp.exp(m_prev - m_new)
+    l_new = correction * l_prev + jnp.sum(p, axis=1, keepdims=True)
+    acc_new = acc * correction + jax.lax.dot_general(
+        p, v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    m_ref[...] = m_new
+    l_ref[...] = l_new
+    acc_ref[...] = acc_new
+
+    @pl.when(page == pl.num_programs(1) - 1)
+    def _emit():
+        row_valid = m_new > NEG_INF * 0.5
+        denom = jnp.where(l_new == 0.0, 1.0, l_new)
+        o_ref[...] = jnp.where(row_valid, acc_new / denom,
+                               0.0).astype(o_ref.dtype)
+
+
+def paged_flash_attention(
+    q: jax.Array,
+    k_pages: jax.Array,
+    v_pages: jax.Array,
+    block_tables: jax.Array,
+    lengths: jax.Array,
+    *,
+    scale: Optional[float] = None,
+    interpret: bool = False,
+) -> jax.Array:
+    """Pallas ragged paged attention. Same contract as
+    paged_attention_reference; the block table and lengths ride as
+    scalar-prefetch operands so each grid step's BlockSpec index_map picks
+    the right arena page — gathered pages never materialize in HBM."""
+    b, h, sq, d = q.shape
+    num_pages, _, block_size, _ = k_pages.shape
+    _, max_pages = block_tables.shape
+    if scale is None:
+        scale = 1.0 / float(np.sqrt(d))
+
+    sq_p = max(8, 1 << (sq - 1).bit_length())  # MXU-friendly query rows
+    q_p = _pad_to(q, 2, sq_p)
+    q_f = q_p.reshape(b * h, sq_p, d)
+    tbl = jnp.repeat(block_tables.astype(jnp.int32), h, axis=0)  # (b*h, P)
+    num_heads_outer = h  # closed over by the index maps below
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,  # block tables, lengths
+        grid=(b * h, max_pages),
+        in_specs=[
+            pl.BlockSpec((None, sq_p, d), lambda bh, p, tbl, lens: (bh, 0, 0)),
+            pl.BlockSpec((None, None, block_size, d),
+                         lambda bh, p, tbl, lens: (tbl[bh, p],
+                                                   bh % num_heads_outer, 0, 0)),
+            pl.BlockSpec((None, None, block_size, d),
+                         lambda bh, p, tbl, lens: (tbl[bh, p],
+                                                   bh % num_heads_outer, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, sq_p, d),
+                               lambda bh, p, tbl, lens: (bh, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((sq_p, 1), jnp.float32),
+            pltpu.VMEM((sq_p, 1), jnp.float32),
+            pltpu.VMEM((sq_p, d), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(
+        _paged_kernel, scale=scale, block_size=block_size,
+        num_heads=h, sq=sq)
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b * h, sq_p, d), q.dtype),
+        interpret=interpret,
+    )(tbl, lengths.astype(jnp.int32), q_f, k_pages, v_pages)
+    return out.reshape(b, h, sq_p, d)[:, :, :sq, :]
+
+
+def paged_attention(
+    q: jax.Array,
+    k_pages: jax.Array,
+    v_pages: jax.Array,
+    block_tables: jax.Array,
+    lengths: jax.Array,
+    *,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Dispatch: Pallas ragged kernel on TPU when it applies (MXU-friendly
+    head dim, lane-aligned pages), gather-based jnp reference otherwise.
+    Semantics identical; the paged-decode suites assert token-exactness of
+    both against the dense path."""
+    use_pallas = (
+        _HAVE_PALLAS
+        and _on_tpu()
+        and q.shape[-1] % 8 == 0
+        and k_pages.shape[-2] % 8 == 0  # page rows land on sublanes
+    )
+    if use_pallas:
+        return paged_flash_attention(q, k_pages, v_pages, block_tables,
+                                     lengths, scale=scale)
+    return paged_attention_reference(q, k_pages, v_pages, block_tables,
+                                     lengths, scale=scale)
+
+
 def _on_tpu() -> bool:
     """True when the default device is a TPU. Checks the device's own
     platform, not just the backend name: a PJRT plugin can register under

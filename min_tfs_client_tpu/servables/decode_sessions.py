@@ -20,11 +20,82 @@ silent eviction of a live session mid-generation.
 
 from __future__ import annotations
 
+import contextlib
 import threading
 import time
 from typing import Callable, Optional
 
 from min_tfs_client_tpu.utils.status import ServingError
+
+# -- server-level paging defaults --------------------------------------------
+#
+# The builders that construct decode-session pools (models/t5.py) run inside
+# an exported servable.py whose saved signature_kwargs predate the paging
+# knobs; the server flags (--kv_block_size / --kv_num_blocks /
+# --kv_evict_policy) therefore flow here as module defaults, installed by
+# platforms.make_loader around the factory call and consulted by the
+# builders when no explicit kwarg was given. block_size 0 = paging off (the
+# old max-length slot pool, byte-for-byte).
+
+_paging_defaults_lock = threading.Lock()
+_paging_defaults = {"block_size": 0, "num_blocks": 0,
+                    "evict_policy": "swap"}  # guarded_by: _paging_defaults_lock
+
+EVICT_POLICIES = ("swap", "close", "refuse")
+
+
+def set_default_paging(block_size: int = 0, num_blocks: int = 0,
+                       evict_policy: str = "swap") -> dict:
+    """Install process defaults for new decode pools; returns the previous
+    defaults so a loader can scope them to one factory call."""
+    if evict_policy not in EVICT_POLICIES:
+        raise ServingError.invalid_argument(
+            f"kv_evict_policy must be one of {EVICT_POLICIES}, "
+            f"got {evict_policy!r}")
+    global _paging_defaults
+    with _paging_defaults_lock:
+        previous = dict(_paging_defaults)
+        _paging_defaults = {"block_size": int(block_size),
+                            "num_blocks": int(num_blocks),
+                            "evict_policy": evict_policy}
+    return previous
+
+
+def default_paging() -> dict:
+    """The paging knobs a builder should apply when given no explicit
+    kwargs: this thread's paging_scope override if one is active (the
+    loader path), else the process defaults (set_default_paging)."""
+    override = getattr(_paging_tls, "override", None)
+    if override is not None:
+        return dict(override)
+    with _paging_defaults_lock:
+        return dict(_paging_defaults)
+
+
+_paging_tls = threading.local()
+
+
+@contextlib.contextmanager
+def paging_scope(block_size: int = 0, num_blocks: int = 0,
+                 evict_policy: str = "swap"):
+    """Scope paging knobs to ONE loader factory call via a THREAD-LOCAL
+    override (the factory and the builders it invokes run synchronously on
+    this thread). A process-global set/restore pair — even a locked one —
+    either races concurrent loads into the wrong pool flavor (a dense-
+    configured load observing a paged scope, or vice versa) or serializes
+    every load on one lock; thread-locality removes both failure modes."""
+    if evict_policy not in EVICT_POLICIES:
+        raise ServingError.invalid_argument(
+            f"kv_evict_policy must be one of {EVICT_POLICIES}, "
+            f"got {evict_policy!r}")
+    previous = getattr(_paging_tls, "override", None)
+    _paging_tls.override = {"block_size": int(block_size),
+                            "num_blocks": int(num_blocks),
+                            "evict_policy": evict_policy}
+    try:
+        yield
+    finally:
+        _paging_tls.override = previous
 
 
 class DecodeSessionStore:
@@ -226,6 +297,661 @@ class SlotPool:
         fetched = fetch_outputs(outputs)
         return {s: {k: np.asarray(v)[s] for k, v in fetched.items()}
                 for s in slots}
+
+
+class PageAllocator:
+    """Free-list allocator over the shared KV page arena.
+
+    Pages are plain int indices into the (num_blocks + 1)-page arenas the
+    PagedSlotPool owns (the extra page is the pool's trash page and is
+    never allocated). Exhaustion is a TYPED capacity error —
+    RESOURCE_EXHAUSTED at the handlers, never a bare RuntimeError that
+    would serve as INTERNAL and trip the flight-recorder latch."""
+
+    def __init__(self, num_blocks: int, *, metric_label: str = "default"):
+        self.num_blocks = int(num_blocks)
+        self._lock = threading.Lock()
+        self._free = list(range(num_blocks))  # guarded_by: self._lock
+        self._label = metric_label            # guarded_by: self._lock
+
+    def set_metric_label(self, label: str) -> None:
+        with self._lock:
+            self._label = label
+            self._report_locked()
+
+    def _report_locked(self) -> None:
+        """Gauge export rides page-allocation events only (a page turns
+        over once per block_size tokens), never the per-token tick."""
+        try:
+            from min_tfs_client_tpu.server import metrics
+        except Exception:  # pragma: no cover
+            return
+        metrics.safe_set(metrics.kv_blocks_used,
+                         self.num_blocks - len(self._free), self._label)
+        metrics.safe_set(metrics.kv_blocks_total, self.num_blocks,
+                         self._label)
+
+    def try_alloc(self, n: int = 1) -> Optional[list[int]]:
+        """n pages or None — callers with an eviction policy retry."""
+        with self._lock:
+            if len(self._free) < n:
+                return None
+            pages = [self._free.pop() for _ in range(n)]
+            self._report_locked()
+            return pages
+
+    def alloc(self, n: int = 1) -> list[int]:
+        pages = self.try_alloc(n)
+        if pages is None:
+            raise ServingError.resource_exhausted(
+                f"decode KV page pool exhausted ({self.used()} of "
+                f"{self.num_blocks} blocks in use, {n} requested); close "
+                "idle sessions, raise --kv_num_blocks, or enable eviction "
+                "(--kv_evict_policy=swap)")
+        return pages
+
+    def free(self, pages: list[int]) -> None:
+        with self._lock:
+            self._free.extend(pages)
+            self._report_locked()
+
+    def used(self) -> int:
+        with self._lock:
+            return self.num_blocks - len(self._free)
+
+
+def _plain_path(path) -> tuple:
+    """jax KeyPath -> plain (str | int, ...) tuple for paged-leaf match."""
+    out = []
+    for k in path:
+        if hasattr(k, "key"):
+            out.append(k.key)
+        elif hasattr(k, "idx"):
+            out.append(k.idx)
+        elif hasattr(k, "name"):
+            out.append(k.name)
+        else:  # pragma: no cover - future key kinds
+            out.append(str(k))
+    return tuple(out)
+
+
+class _SwappedSession:
+    """Host-side copy of an evicted session's pages (bit-identical bf16/f32
+    round trip; restored by scatter on the session's next tick)."""
+
+    __slots__ = ("pages_host", "tokens", "n_pages")
+
+    def __init__(self, pages_host: list, tokens: int, n_pages: int):
+        self.pages_host = pages_host
+        self.tokens = tokens
+        self.n_pages = n_pages
+
+
+class PagedSlotPool:
+    """Block-table-paged continuous batching (ROADMAP open item 1).
+
+    Same tick surface as SlotPool — S single-sequence sessions advanced by
+    ONE vmapped jitted call per token — but KV-cache leaves live in shared
+    page arenas instead of per-slot max-length blocks:
+
+      * per cache leaf, ONE HBM arena `(num_blocks + 1, ..., block_size,
+        ...)` (the paged axis split into block_size-token pages; the last
+        page is the trash page absorbing masked writes);
+      * per session, a block table of int32 page indices grown ON DEMAND —
+        a session holds ceil(used_tokens / block_size) pages, so
+        concurrent-session capacity scales with tokens actually written,
+        not max_decode_len × max_slots;
+      * a free-list PageAllocator guarded by its own declared lock.
+
+    The tick gathers each session's pages back to a contiguous view sized
+    by the CURRENT table width (the same gather as the ragged paged
+    attention oracle, ops/attention.paged_attention_reference — on every
+    backend: the generic step_fn runs its own dense attention internally,
+    so the Pallas ragged kernel (ops/attention.paged_flash_attention),
+    while token-exact and TPU-gated via paged_attention(), is NOT yet
+    driven by this tick; wiring it in needs a paging-aware step
+    contract), runs the unmodified per-session step_fn under vmap, and
+    scatters back each session's NEWEST page only — the step contract for paged leaves is append-only
+    along the paged axis (one new row per step at the step index, earlier
+    rows pass through), which is what makes them KV caches at all.
+    Recycled pages are NOT zeroed: rows at or beyond a session's written
+    length are masked inside the model (exp(NEG_INF) underflows to exactly
+    0.0), so garbage never reaches an output — the paged-decode suite
+    asserts token-exactness against the dense pool.
+
+    Phase separation: `write()` only QUEUES a prefilled state (prefill
+    phase); the next tick integrates pending prefills through a separate
+    jitted write program — bounded per round, ticking slots first — before
+    running the decode program, so a burst of long prefills cannot stall
+    in-flight decodes.
+
+    Eviction under pressure (`evict_policy`): when the free list runs dry,
+      swap    gather the oldest-idle session's pages to host memory and
+              free them; the session restores transparently (bit-identical)
+              on its next tick;
+      close   drop the oldest-idle session; its next step raises the typed
+              capacity error (RESOURCE_EXHAUSTED);
+      refuse  no eviction — the REQUESTING session's step fails with the
+              typed capacity error and stays live for retry.
+    """
+
+    def __init__(self, template_state, step_fn, *, max_slots: int,
+                 params=None, block_size: int = 16,
+                 num_blocks: Optional[int] = None,
+                 paged_axis_fn: Callable[[tuple], Optional[int]] = None,
+                 evict_policy: str = "swap",
+                 max_prefills_per_tick: int = 8,
+                 metric_label: str = "default"):
+        import jax
+        import jax.numpy as jnp
+
+        if evict_policy not in EVICT_POLICIES:
+            raise ServingError.invalid_argument(
+                f"evict_policy must be one of {EVICT_POLICIES}, "
+                f"got {evict_policy!r}")
+        if paged_axis_fn is None:
+            raise ValueError("paged_axis_fn is required: it names the "
+                             "KV-cache leaves and their paged (seq) axis")
+        self._jax = jax
+        self._jnp = jnp
+        self.max_slots = int(max_slots)
+        self.block_size = int(block_size)
+        self._params = params
+        self._policy = evict_policy
+        self._max_prefills = int(max_prefills_per_tick)
+        self.metric_label = metric_label
+
+        shapes = jax.eval_shape(lambda: template_state)
+        flat, treedef = jax.tree_util.tree_flatten_with_path(shapes)
+        self._treedef = treedef
+        self._leaves = [leaf for _, leaf in flat]
+        paged_axes: dict[int, int] = {}
+        seq_len = None
+        for i, (path, leaf) in enumerate(flat):
+            axis = paged_axis_fn(_plain_path(path))
+            if axis is None:
+                continue
+            if leaf.shape[0] != 1:
+                raise ValueError(
+                    "paged sessions are single-sequence: leaf "
+                    f"{_plain_path(path)} has batch dim {leaf.shape[0]}")
+            if seq_len is None:
+                seq_len = int(leaf.shape[axis])
+            elif int(leaf.shape[axis]) != seq_len:
+                raise ValueError(
+                    "paged leaves must share one seq length (pages "
+                    f"allocate in lockstep); got {leaf.shape[axis]} vs "
+                    f"{seq_len} at {_plain_path(path)}")
+            paged_axes[i] = int(axis)
+        if not paged_axes:
+            raise ValueError("paged_axis_fn matched no leaves")
+        self._paged_axes = paged_axes
+        self.max_len = seq_len
+        self.pages_per_session = -(-seq_len // self.block_size)
+        if not num_blocks:
+            # Default: the same KV byte budget as the dense slot pool —
+            # identical worst case, strictly better short-sequence packing.
+            num_blocks = self.max_slots * self.pages_per_session
+        self.num_blocks = int(num_blocks)
+        self._trash = self.num_blocks  # extra arena page absorbing masked writes
+        self.allocator = PageAllocator(self.num_blocks,
+                                       metric_label=metric_label)
+
+        # Page-unit shape per paged leaf: drop the singleton session batch
+        # dim, paged axis -> block_size.  (1, H, S, D) axis 2 => (H, bs, D).
+        self._units: dict[int, tuple] = {}
+        arena_bytes = 0
+        dense_equiv = 0
+        for i, axis in paged_axes.items():
+            shape = self._leaves[i].shape
+            unit = tuple(shape[1:axis]) + (self.block_size,) \
+                + tuple(shape[axis + 1:])
+            self._units[i] = unit
+            itemsize = jnp.dtype(self._leaves[i].dtype).itemsize
+            per_page = itemsize
+            for d in unit:
+                per_page *= int(d)
+            arena_bytes += (self.num_blocks + 1) * per_page
+            per_leaf = itemsize
+            for d in shape:
+                per_leaf *= int(d)
+            dense_equiv += self.max_slots * per_leaf
+        self.arena_bytes = arena_bytes
+        self.dense_equivalent_bytes = dense_equiv
+
+        self._lock = threading.Lock()
+        # Tuples, not lists: the pools are identity-swapped wholesale under
+        # the lock (jit donation invalidates the old buffers), never
+        # mutated in place.
+        self._arenas = tuple(
+            jnp.zeros((self.num_blocks + 1,) + self._units[i],
+                      self._leaves[i].dtype)
+            for i in sorted(paged_axes))          # guarded_by: self._lock
+        self._arena_pos = {i: k for k, i in enumerate(sorted(paged_axes))}
+        self._dense_pool = tuple(
+            None if i in paged_axes
+            else jnp.zeros((self.max_slots,) + leaf.shape, leaf.dtype)
+            for i, leaf in enumerate(self._leaves))  # guarded_by: self._lock
+        self._free_slots = list(range(max_slots))  # guarded_by: self._lock
+        self._pages: dict[int, list[int]] = {}     # guarded_by: self._lock
+        self._tokens: dict[int, int] = {}          # guarded_by: self._lock
+        self._last_tick: dict[int, float] = {}     # guarded_by: self._lock
+        self._swapped: dict[int, _SwappedSession] = {}  # guarded_by: self._lock
+        self._dead: dict[int, ServingError] = {}   # guarded_by: self._lock
+        self._pending: dict[int, object] = {}      # guarded_by: self._lock
+        self._width = 1                            # guarded_by: self._lock
+        self._counters = {"prefill_flushed": 0, "decode_ticks": 0,
+                          "evicted_swap": 0, "evicted_close": 0,
+                          "restored": 0}           # guarded_by: self._lock
+        self._stats_lock = threading.Lock()
+        self._stats_cache: dict = {}               # guarded_by: self._stats_lock
+
+        dense_idx = [i for i in range(len(self._leaves))
+                     if i not in paged_axes]
+
+        def write_fn(dense_list, state_leaves, slot):
+            """Prefill-phase program: scatter ONE session's dense leaves
+            into the dense pool. Paged leaves are ignored — sessions start
+            with zero used tokens and recycled-page garbage is masked."""
+            out = list(dense_list)
+            for i in dense_idx:
+                s = state_leaves[i]
+                out[i] = jax.lax.dynamic_update_slice(
+                    dense_list[i], s[None].astype(dense_list[i].dtype),
+                    (slot,) + (0,) * s.ndim)
+            return out
+
+        def tick_fn(params, dense_list, arenas, tables, active, cur_pages):
+            """Decode-phase program: gather pages -> vmapped step ->
+            masked merge (dense) + newest-page scatter (paged). Table
+            width W is a trace-time shape: a MONOTONE high-water bucket
+            (1, 2, 4, ... capped at pages_per_session) that grows when a
+            live session needs more pages and deliberately never shrinks
+            — at most log2(pages_per_session)+1 compiles over the pool's
+            lifetime, vs a recompile every time the longest session
+            closes.
+
+            Paged leaves are APPEND-ONLY per step (KV-cache semantics:
+            the step writes exactly one new row at its step index and
+            passes every earlier row through), so only each session's
+            CURRENT page — cur_pages[slot] = tokens // block_size, the
+            page holding the newly written row — is scattered back;
+            earlier pages in the arena are already ground truth."""
+            width = tables.shape[1]
+            full = []
+            for i, leaf in enumerate(self._leaves):
+                axis = paged_axes.get(i)
+                if axis is None:
+                    full.append(dense_list[i])
+                    continue
+                arena = arenas[self._arena_pos[i]]
+                ua = axis - 1  # paged axis inside the page unit
+                g = arena[tables]                  # (slots, W, *unit)
+                g = jnp.moveaxis(g, 1, ua + 1)     # W beside the page rows
+                unit = self._units[i]
+                merged = (self.max_slots,) + unit[:ua] \
+                    + (width * self.block_size,) + unit[ua + 1:]
+                full.append(g.reshape(merged)[:, None])
+            tree = jax.tree_util.tree_unflatten(treedef, full)
+            if params is None:
+                new_tree, outputs = jax.vmap(step_fn)(tree)
+            else:
+                new_tree, outputs = jax.vmap(
+                    lambda s: step_fn(params, s))(tree)
+            new_leaves = jax.tree_util.tree_leaves(new_tree)
+
+            cur_ids = jnp.take_along_axis(tables, cur_pages[:, None],
+                                          axis=1)[:, 0]
+            scatter_idx = jnp.where(active, cur_ids, self._trash)
+            out_dense = list(dense_list)
+            out_arenas = list(arenas)
+            for i, leaf in enumerate(self._leaves):
+                axis = paged_axes.get(i)
+                if axis is None:
+                    mask = active.reshape(
+                        (-1,) + (1,) * (new_leaves[i].ndim - 1))
+                    out_dense[i] = jnp.where(mask, new_leaves[i],
+                                             dense_list[i])
+                    continue
+                ua = axis - 1
+                unit = self._units[i]
+                n = new_leaves[i][:, 0]            # (slots, ..., W*bs, ...)
+                split = (self.max_slots,) + unit[:ua] \
+                    + (width, self.block_size) + unit[ua + 1:]
+                n = n.reshape(split)
+                n = jnp.moveaxis(n, ua + 1, 1)     # (slots, W, *unit)
+                page = jnp.take_along_axis(
+                    n, cur_pages.reshape((-1,) + (1,) * (n.ndim - 1)),
+                    axis=1)[:, 0]                  # (slots, *unit)
+                out_arenas[self._arena_pos[i]] = \
+                    arenas[self._arena_pos[i]].at[scatter_idx].set(
+                        page.astype(arenas[self._arena_pos[i]].dtype))
+            return out_dense, out_arenas, outputs
+
+        def gather_fn(arenas, table_row):
+            """Swap-out program: one session's pages, trash-padded up to a
+            pow2 width bucket (_swap_width) — transfer and host RAM scale
+            with what the victim actually holds, and eviction compiles are
+            bounded at log2(pages_per_session)+1 buckets."""
+            return [arena[table_row] for arena in arenas]
+
+        def restore_fn(arenas, pages_list, table_row):
+            out = []
+            for arena, pages in zip(arenas, pages_list):
+                out.append(arena.at[table_row].set(pages.astype(arena.dtype)))
+            return out
+
+        from min_tfs_client_tpu.observability import runtime as rt
+
+        self._write_jit = rt.instrument_jit(
+            f"paged:{metric_label}:prefill_write",
+            jax.jit(write_fn, donate_argnums=(0,)))
+        self._tick_jit = rt.instrument_jit(
+            f"paged:{metric_label}:tick",
+            jax.jit(tick_fn, donate_argnums=(1, 2)))
+        self._gather_jit = jax.jit(gather_fn)
+        self._restore_jit = jax.jit(restore_fn, donate_argnums=(0,))
+        with self._lock:
+            self._publish_stats_locked()
+        rt.register_kv_pool(self)
+
+    # -- labels / telemetry ---------------------------------------------------
+
+    def set_metric_label(self, label: str) -> None:
+        self.metric_label = label
+        self.allocator.set_metric_label(label)
+
+    def stats(self) -> dict:
+        """Last published snapshot. Reads ONLY the stats lock — the pool
+        lock is held across whole device ticks and swap-out D2H, so a
+        monitoring scrape must never queue behind it (the off-the-hot-path
+        discipline the /monitoring/runtime payload promises). Mutators
+        publish via _publish_stats_locked."""
+        with self._stats_lock:
+            return dict(self._stats_cache)
+
+    def _publish_stats_locked(self) -> None:
+        """Called under self._lock at the end of every state-changing
+        public operation; the snapshot swap itself takes only the cheap
+        stats lock (pool lock -> stats lock, never reversed)."""
+        snap = {
+            "block_size": self.block_size,
+            "num_blocks": self.num_blocks,
+            "blocks_used": self.allocator.used(),
+            "max_slots": self.max_slots,
+            "pages_per_session": self.pages_per_session,
+            "sessions": len(self._pages) + len(self._pending)
+            + len(self._swapped),
+            "swapped_sessions": len(self._swapped),
+            "swapped_host_bytes": int(sum(
+                h.nbytes for s in self._swapped.values()
+                for h in s.pages_host)),
+            "pending_prefills": len(self._pending),
+            "table_width": self._width,
+            "evict_policy": self._policy,
+            "arena_bytes": self.arena_bytes,
+            "dense_equivalent_bytes": self.dense_equivalent_bytes,
+            **dict(self._counters),
+        }
+        with self._stats_lock:
+            self._stats_cache = snap
+
+    # -- slots ----------------------------------------------------------------
+
+    def acquire_slot(self) -> int:
+        with self._lock:
+            if not self._free_slots:
+                raise ServingError.resource_exhausted(
+                    f"decode slot pool ({self.max_slots}) exhausted; close "
+                    "idle sessions or raise max_slots")
+            return self._free_slots.pop()
+
+    def release_slot(self, slot: int) -> None:
+        with self._lock:
+            self._release_locked(slot)
+            self._publish_stats_locked()
+
+    def _release_locked(self, slot: int) -> None:
+        self._pending.pop(slot, None)
+        self._dead.pop(slot, None)
+        self._swapped.pop(slot, None)
+        self._tokens.pop(slot, None)
+        self._last_tick.pop(slot, None)
+        pages = self._pages.pop(slot, None)
+        if pages:
+            self.allocator.free(pages)
+        if slot not in self._free_slots:
+            self._free_slots.append(slot)
+
+    # -- prefill phase --------------------------------------------------------
+
+    def write(self, state, slot: int) -> None:
+        """Queue a freshly-prefilled session (PREFILL phase). The state is
+        integrated by the next tick's write program, so a long prefill
+        burst never blocks in-flight decode rounds on the pool lock."""
+        with self._lock:
+            self._pending[slot] = state
+            self._last_tick[slot] = time.monotonic()
+            self._publish_stats_locked()
+
+    def flush_prefills(self, limit: Optional[int] = None) -> int:
+        with self._lock:
+            flushed = self._flush_prefills_locked(limit=limit)
+            self._publish_stats_locked()
+            return flushed
+
+    def _flush_prefills_locked(self, limit: Optional[int] = None,
+                               urgent: tuple = ()) -> int:
+        """Integrate pending prefills: slots about to tick FIRST (their
+        step must see the state), then up to `limit` others — the
+        phase-aware admission bound keeping decode latency flat under an
+        init flood."""
+        order = [s for s in urgent if s in self._pending]
+        order += [s for s in list(self._pending) if s not in set(order)]
+        flushed = 0
+        for slot in order:
+            if (limit is not None and flushed >= limit
+                    and slot not in urgent):
+                break
+            state = self._pending.pop(slot)
+            leaves = self._jax.tree_util.tree_leaves(state)
+            self._dense_pool = tuple(self._write_jit(
+                self._dense_pool, leaves, self._jnp.int32(slot)))
+            self._pages[slot] = []
+            self._tokens[slot] = 0
+            flushed += 1
+        self._counters["prefill_flushed"] += flushed
+        return flushed
+
+    # -- page management ------------------------------------------------------
+
+    def _alloc_page_locked(self, busy: tuple) -> int:
+        if self._policy == "refuse":
+            return self.allocator.alloc(1)[0]
+        while True:
+            pages = self.allocator.try_alloc(1)
+            if pages is not None:
+                return pages[0]
+            victim = self._pick_victim_locked(busy)
+            if victim is None:
+                raise ServingError.resource_exhausted(
+                    f"decode KV page pool exhausted ({self.num_blocks} "
+                    "blocks) and no evictable session (every page holder "
+                    "is in the current tick); close sessions or raise "
+                    "--kv_num_blocks")
+            self._evict_locked(victim)
+
+    def _swap_width(self, n_pages: int) -> int:
+        """Pow2 row width for the swap gather/restore programs: scales
+        transfer + parked host bytes with the victim's real page count
+        while keeping the compile count bounded (same bucket discipline
+        as the tick's table width)."""
+        return min(self.pages_per_session,
+                   1 << max(0, n_pages - 1).bit_length())
+
+    def _pick_victim_locked(self, busy: tuple) -> Optional[int]:
+        """Oldest-idle session holding pages, excluding the current tick's
+        slots (evicting a session mid-round would corrupt its gather)."""
+        best, best_t = None, None
+        for slot, pages in self._pages.items():
+            if slot in busy or not pages:
+                continue
+            t = self._last_tick.get(slot, 0.0)
+            if best_t is None or t < best_t:
+                best, best_t = slot, t
+        return best
+
+    def _evict_locked(self, victim: int) -> None:
+        from min_tfs_client_tpu.servables.servable import fetch_outputs
+
+        pages = self._pages.pop(victim)
+        tokens = self._tokens.pop(victim, 0)
+        self._last_tick.pop(victim, None)
+        if self._policy == "swap":
+            import numpy as np
+
+            row = np.full((self._swap_width(len(pages)),), self._trash,
+                          np.int32)
+            row[:len(pages)] = pages
+            gathered = self._gather_jit(self._arenas, self._jnp.asarray(row))
+            # servelint: blocks swap-out must complete before the freed
+            # pages can be reallocated under this same lock
+            host = fetch_outputs(
+                {str(k): g for k, g in enumerate(gathered)})
+            self._swapped[victim] = _SwappedSession(
+                [host[str(k)] for k in range(len(gathered))],
+                tokens, len(pages))
+            self._counters["evicted_swap"] += 1
+            self._report_eviction("swap")
+        else:
+            self._dead[victim] = ServingError.resource_exhausted(
+                "decode session preempted: KV page pool exhausted and "
+                "kv_evict_policy=close dropped this oldest-idle session; "
+                "re-run decode_init to start over")
+            self._counters["evicted_close"] += 1
+            self._report_eviction("close")
+        self.allocator.free(pages)
+
+    def _restore_locked(self, slot: int, busy: tuple) -> None:
+        from min_tfs_client_tpu.observability import runtime
+
+        swap = self._swapped.pop(slot)
+        pages: list[int] = []
+        try:
+            for _ in range(swap.n_pages):
+                pages.append(self._alloc_page_locked(busy))
+        except ServingError:
+            if pages:
+                self.allocator.free(pages)
+            self._swapped[slot] = swap  # still restorable later
+            raise
+        import numpy as np
+
+        row = np.full((self._swap_width(swap.n_pages),), self._trash,
+                      np.int32)
+        row[:swap.n_pages] = pages
+        dev = [self._jax.device_put(h) for h in swap.pages_host]
+        runtime.count_transfer(
+            "host_to_device",
+            int(sum(h.nbytes for h in swap.pages_host)))
+        self._arenas = tuple(self._restore_jit(self._arenas, dev,
+                                               self._jnp.asarray(row)))
+        self._pages[slot] = pages
+        self._tokens[slot] = swap.tokens
+        self._counters["restored"] += 1
+        self._report_eviction("restore")
+
+    def _report_eviction(self, kind: str) -> None:
+        try:
+            from min_tfs_client_tpu.server import metrics
+
+            metrics.kv_evictions.increment(self.metric_label, kind)
+        except Exception:  # pragma: no cover - metrics must not break serving
+            pass
+
+    # -- decode phase ---------------------------------------------------------
+
+    def tick(self, slots: list[int]) -> dict[int, object]:
+        """Advance the given slots in ONE device call. Returns per-slot
+        host outputs; slots that could not run carry their TYPED error as
+        the value (per-slot failure isolation — a capacity refusal for one
+        session must not poison its tick-mates)."""
+        import numpy as np
+
+        from min_tfs_client_tpu.servables.servable import fetch_outputs
+
+        slots = list(slots)
+        results: dict[int, object] = {}
+        live: list[int] = []
+        outputs = None
+        with self._lock:
+            self._flush_prefills_locked(limit=self._max_prefills,
+                                        urgent=tuple(slots))
+            for s in slots:
+                err = self._dead.get(s)
+                if err is not None:
+                    err.slot_fatal = True
+                    results[s] = err
+                    continue
+                try:
+                    self._prepare_slot_locked(s, busy=tuple(slots))
+                except ServingError as exc:
+                    if not hasattr(exc, "slot_fatal"):
+                        # Capacity refusal: the session's pages/state are
+                        # intact; the caller may retry after closing others.
+                        exc.slot_fatal = False
+                    results[s] = exc
+                    continue
+                live.append(s)
+            if live:
+                width = self._width
+                tables = np.full((self.max_slots, width), self._trash,
+                                 np.int32)
+                for s, pages in self._pages.items():
+                    tables[s, :len(pages)] = pages
+                active = np.zeros((self.max_slots,), bool)
+                active[live] = True
+                cur_pages = np.zeros((self.max_slots,), np.int32)
+                for s in live:
+                    cur_pages[s] = self._tokens[s] // self.block_size
+                dense, arenas, outputs = self._tick_jit(
+                    self._params, self._dense_pool, self._arenas,
+                    self._jnp.asarray(tables), self._jnp.asarray(active),
+                    self._jnp.asarray(cur_pages))
+                self._dense_pool = tuple(dense)
+                self._arenas = tuple(arenas)
+                now = time.monotonic()
+                for s in live:
+                    self._tokens[s] += 1
+                    self._last_tick[s] = now
+                self._counters["decode_ticks"] += 1
+            self._publish_stats_locked()
+        if live:
+            fetched = fetch_outputs(outputs)
+            for s in live:
+                results[s] = {k: np.asarray(v)[s] for k, v in fetched.items()}
+        return results
+
+    def _prepare_slot_locked(self, slot: int, busy: tuple) -> None:
+        if slot in self._swapped:
+            self._restore_locked(slot, busy)
+        if slot not in self._pages:
+            exc = ServingError.failed_precondition(
+                f"slot {slot} holds no parked session state (released or "
+                "never written)")
+            exc.slot_fatal = True
+            raise exc
+        needed = -(-(self._tokens[slot] + 1) // self.block_size)
+        if needed > self.pages_per_session:
+            exc = ServingError.failed_precondition(
+                f"slot {slot} stepped past max_len {self.max_len}")
+            exc.slot_fatal = True
+            raise exc
+        while len(self._pages[slot]) < needed:
+            self._pages[slot].append(self._alloc_page_locked(busy))
+        if needed > self._width:
+            grown = 1 << (needed - 1).bit_length()
+            self._width = min(self.pages_per_session, grown)
 
 
 class _TickEntry:

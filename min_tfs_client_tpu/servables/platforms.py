@@ -57,19 +57,36 @@ def make_loader(
         estimate = estimate_for_mesh(int(estimate), mesh_axes)
 
     def create() -> Servable:
-        servable = factory(name, version, path, platform_config or {})
+        config = platform_config or {}
+        kv_block_size = int(config.get("kv_block_size", 0) or 0)
+        if kv_block_size:
+            # Server-level paging knobs reach the decode-pool builders
+            # (which run inside the export's servable.py, predating these
+            # kwargs) as a THREAD-LOCAL paging_scope override: concurrent
+            # loads (num_load_threads > 1) — configured or not — can
+            # never observe another load's knobs or a mid-flight restore.
+            from min_tfs_client_tpu.servables import decode_sessions
+
+            with decode_sessions.paging_scope(
+                    block_size=kv_block_size,
+                    num_blocks=int(config.get("kv_num_blocks", 0) or 0),
+                    evict_policy=config.get("kv_evict_policy", "swap")):
+                servable = factory(name, version, path, config)
+        else:
+            servable = factory(name, version, path, config)
         servable.name = name
         servable.version = version
-        config = platform_config or {}
-        # Decode-session stores report a per-model gauge; the family
-        # builder only knew its family name — re-label with the real
-        # model:version so two loaded models never share a gauge cell.
+        # Decode-session stores (and paged KV pools) report per-model
+        # gauges; the family builder only knew its family name — re-label
+        # with the real model:version so two loaded models never share a
+        # gauge cell.
         relabeled = set()
         for sig in servable.signatures.values():
-            store = getattr(sig, "_decode_store", None)
-            if store is not None and id(store) not in relabeled:
-                relabeled.add(id(store))
-                store.set_metric_label(f"{name}:{version}")
+            for attr in ("_decode_store", "_kv_pool"):
+                store = getattr(sig, attr, None)
+                if store is not None and id(store) not in relabeled:
+                    relabeled.add(id(store))
+                    store.set_metric_label(f"{name}:{version}")
         # Server-level mesh ("mesh_axes": {"data": -1, ...}): every batched
         # device signature serves data-parallel over it. Exports with their
         # own TP sharding config already attached a mesh at build; the

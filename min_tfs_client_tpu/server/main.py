@@ -46,6 +46,24 @@ def build_parser() -> argparse.ArgumentParser:
                         "batch k+1's dispatch with batch k's D2H copies "
                         "and microbatch-pipelines multi-segment imports "
                         "(1 = exact pre-window serial behavior)")
+    p.add_argument("--kv_block_size", type=int, default=0,
+                   help="page the decode KV cache into blocks of this many "
+                        "tokens (decode_sessions.PagedSlotPool): session "
+                        "capacity then scales with used tokens, not "
+                        "max-length slots. 0 = the old dense slot pool, "
+                        "byte-for-byte (docs/MIGRATING.md 'Paged KV cache')")
+    p.add_argument("--kv_num_blocks", type=int, default=0,
+                   help="KV page-pool capacity (the declared HBM budget); "
+                        "0 sizes it to the dense pool's worst case "
+                        "(max_sessions x ceil(max_decode_len/block_size))")
+    p.add_argument("--kv_evict_policy", default="swap",
+                   choices=["swap", "close", "refuse"],
+                   help="when the KV page pool runs dry: swap the "
+                        "oldest-idle session's pages to host memory "
+                        "(restored bit-identical on its next step), close "
+                        "it (typed RESOURCE_EXHAUSTED on its next step), "
+                        "or refuse the requesting step (session stays "
+                        "live for retry)")
     p.add_argument("--monitoring_config_file", default="")
     p.add_argument("--ssl_config_file", default="")
     p.add_argument("--max_num_load_retries", type=int, default=5)
@@ -164,6 +182,9 @@ def options_from_args(args) -> ServerOptions:
         enable_batching=args.enable_batching,
         batching_parameters_file=args.batching_parameters_file,
         max_in_flight_batches=args.max_in_flight_batches,
+        kv_block_size=args.kv_block_size,
+        kv_num_blocks=args.kv_num_blocks,
+        kv_evict_policy=args.kv_evict_policy,
         monitoring_config_file=args.monitoring_config_file,
         ssl_config_file=args.ssl_config_file,
         max_num_load_retries=args.max_num_load_retries,

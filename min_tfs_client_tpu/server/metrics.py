@@ -146,6 +146,20 @@ batch_queue_depth = Gauge(
 decode_session_count = Gauge(
     ":tpu/serving/decode_session_count",
     "Live incremental-decode sessions pinning HBM state.", ("model",))
+kv_blocks_used = Gauge(
+    ":tpu/serving/kv_blocks_used",
+    "KV-cache pages allocated out of the paged decode pool, by model. "
+    "Updated on page-allocation events (once per block_size tokens per "
+    "session), never on the per-token tick.", ("model",))
+kv_blocks_total = Gauge(
+    ":tpu/serving/kv_blocks_total",
+    "KV-cache page capacity of the paged decode pool, by model.",
+    ("model",))
+kv_evictions = Counter(
+    ":tpu/serving/kv_evictions",
+    "Paged-KV pressure events, by model and kind (swap = pages copied to "
+    "host and freed; close = session dropped with RESOURCE_EXHAUSTED; "
+    "restore = swapped session scattered back).", ("model", "kind"))
 
 # -- request-tracing spine metrics (observability/tracing.py sinks) ---------
 stage_latency = Histogram(

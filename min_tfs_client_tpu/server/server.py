@@ -63,6 +63,11 @@ class ServerOptions:
     # and sets the microbatch pipeline depth of multi-segment partitioned
     # imports (docs/MIGRATING.md "Pipelined in-flight execution").
     max_in_flight_batches: int = 1
+    # Paged decode KV cache (docs/MIGRATING.md "Paged KV cache"):
+    # block_size 0 = the pre-paging dense slot pool, byte-for-byte.
+    kv_block_size: int = 0
+    kv_num_blocks: int = 0
+    kv_evict_policy: str = "swap"
     monitoring_config_file: str = ""
     ssl_config_file: str = ""
     max_num_load_retries: int = 5
@@ -437,6 +442,15 @@ def _platform_configs(opts: ServerOptions, batching) -> dict:
     }
     if opts.max_in_flight_batches > 1:
         shared["max_in_flight_batches"] = opts.max_in_flight_batches
+    if opts.kv_block_size > 0:
+        shared["kv_block_size"] = opts.kv_block_size
+        shared["kv_num_blocks"] = opts.kv_num_blocks
+        shared["kv_evict_policy"] = opts.kv_evict_policy
+    elif opts.kv_num_blocks or opts.kv_evict_policy != "swap":
+        logging.getLogger(__name__).warning(
+            "--kv_num_blocks/--kv_evict_policy have no effect without "
+            "--kv_block_size > 0; the decode stack keeps the dense "
+            "max-length slot pool (docs/MIGRATING.md 'Paged KV cache')")
     if batching is not None:
         shared["batching_parameters"] = batching
     mesh_axes = _parse_mesh_axes(opts.mesh_axes)

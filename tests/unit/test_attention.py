@@ -1,4 +1,5 @@
-"""Flash attention kernel vs jnp reference (interpret mode on CPU mesh)."""
+"""Flash attention kernel vs jnp reference (interpret mode on CPU mesh),
+plus the ragged paged variants (block-table KV) vs the dense path."""
 
 import jax
 import jax.numpy as jnp
@@ -9,6 +10,9 @@ from min_tfs_client_tpu.ops.attention import (
     attention,
     attention_reference,
     flash_attention,
+    gather_kv_pages,
+    paged_attention_reference,
+    paged_flash_attention,
 )
 
 
@@ -67,6 +71,128 @@ def test_attention_dispatch_with_bias_uses_reference():
     out = attention(q, k, v, bias=bias)
     want = attention_reference(q, k, v, bias=bias)
     np.testing.assert_allclose(np.asarray(out), np.asarray(want), atol=1e-6)
+
+
+def _paged_case(seed, *, b, h, d, block_size, max_len, sq=1):
+    """Random ragged case: contiguous K/V, the same values scattered into
+    a shuffled page arena + block tables, and per-example lengths."""
+    rng = np.random.default_rng(seed)
+    pages_per_seq = -(-max_len // block_size)
+    padded = pages_per_seq * block_size
+    k = rng.standard_normal((b, h, padded, d)).astype(np.float32)
+    v = rng.standard_normal((b, h, padded, d)).astype(np.float32)
+    lengths = rng.integers(sq, max_len + 1, (b,)).astype(np.int32)
+    n_pages = b * pages_per_seq
+    perm = rng.permutation(n_pages)
+    k_pages = np.empty((n_pages, h, block_size, d), np.float32)
+    v_pages = np.empty((n_pages, h, block_size, d), np.float32)
+    tables = np.empty((b, pages_per_seq), np.int32)
+    for i in range(b):
+        for p in range(pages_per_seq):
+            page = int(perm[i * pages_per_seq + p])
+            tables[i, p] = page
+            sl = slice(p * block_size, (p + 1) * block_size)
+            k_pages[page] = k[i, :, sl]
+            v_pages[page] = v[i, :, sl]
+    q = rng.standard_normal((b, h, sq, d)).astype(np.float32)
+    return (jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+            jnp.asarray(k_pages), jnp.asarray(v_pages),
+            jnp.asarray(tables), jnp.asarray(lengths))
+
+
+class TestPagedAttention:
+    def test_gather_reconstructs_layout(self):
+        q, k, v, k_pages, v_pages, tables, _ = _paged_case(
+            0, b=2, h=2, d=8, block_size=4, max_len=16)
+        np.testing.assert_array_equal(
+            np.asarray(gather_kv_pages(k_pages, tables)), np.asarray(k))
+        np.testing.assert_array_equal(
+            np.asarray(gather_kv_pages(v_pages, tables)), np.asarray(v))
+
+    @pytest.mark.parametrize("block_size", [1, 8, 64])
+    def test_oracle_token_exact_vs_dense(self, block_size):
+        """Divisible page sizes: the gathered view IS the dense layout, so
+        the oracle must be BITWISE equal to the dense reference."""
+        for seed in range(4):
+            q, k, v, k_pages, v_pages, tables, lengths = _paged_case(
+                seed, b=3, h=2, d=16, block_size=block_size, max_len=64)
+            want = attention_reference(q, k, v, lengths=lengths)
+            got = paged_attention_reference(q, k_pages, v_pages, tables,
+                                            lengths)
+            np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    @pytest.mark.parametrize("block_size,max_len", [(3, 13), (8, 20),
+                                                    (64, 70)])
+    def test_oracle_with_non_divisible_tail(self, block_size, max_len):
+        """Non-divisible tails pad the gathered view past max_len; the
+        padded keys are masked, so outputs match the dense reference over
+        the same padded length."""
+        for seed in range(4):
+            q, k, v, k_pages, v_pages, tables, lengths = _paged_case(
+                seed, b=2, h=2, d=16, block_size=block_size,
+                max_len=max_len)
+            want = attention_reference(q, k, v, lengths=lengths)
+            got = paged_attention_reference(q, k_pages, v_pages, tables,
+                                            lengths)
+            np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    @pytest.mark.parametrize("block_size", [4, 8])
+    def test_pallas_kernel_matches_oracle(self, block_size):
+        for seed in range(3):
+            q, k, v, k_pages, v_pages, tables, lengths = _paged_case(
+                seed, b=2, h=3, d=16, block_size=block_size, max_len=32)
+            want = paged_attention_reference(q, k_pages, v_pages, tables,
+                                            lengths)
+            got = paged_flash_attention(q, k_pages, v_pages, tables,
+                                        lengths, interpret=True)
+            np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                       atol=2e-5, rtol=2e-5)
+
+    def test_pallas_kernel_multi_query_block(self):
+        """Sq>1 (a speculative verify block): row r attends keys
+        < lengths - (Sq-1-r); the kernel must agree with the oracle."""
+        q, k, v, k_pages, v_pages, tables, lengths = _paged_case(
+            5, b=2, h=2, d=16, block_size=4, max_len=24, sq=3)
+        want = paged_attention_reference(q, k_pages, v_pages, tables,
+                                         lengths)
+        got = paged_flash_attention(q, k_pages, v_pages, tables, lengths,
+                                    interpret=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_fuzz_ragged_mixes(self):
+        """Random (batch, heads, block size, ragged lengths) mixes: the
+        oracle stays exact vs dense and the kernel stays within kernel
+        tolerance of the oracle."""
+        rng = np.random.default_rng(1234)
+        for _ in range(8):
+            b = int(rng.integers(1, 4))
+            h = int(rng.integers(1, 4))
+            block_size = int(rng.choice([1, 2, 4, 8]))
+            max_len = int(rng.integers(block_size, 40))
+            q, k, v, k_pages, v_pages, tables, lengths = _paged_case(
+                int(rng.integers(1 << 30)), b=b, h=h, d=8,
+                block_size=block_size, max_len=max_len)
+            want = attention_reference(q, k, v, lengths=lengths)
+            got = paged_attention_reference(q, k_pages, v_pages, tables,
+                                            lengths)
+            np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+            kern = paged_flash_attention(q, k_pages, v_pages, tables,
+                                         lengths, interpret=True)
+            np.testing.assert_allclose(np.asarray(kern), np.asarray(got),
+                                       atol=2e-5, rtol=2e-5)
+
+    def test_zero_length_rows_are_zero(self):
+        q, k, v, k_pages, v_pages, tables, lengths = _paged_case(
+            7, b=2, h=2, d=8, block_size=4, max_len=16)
+        lengths = jnp.asarray([0, 9], jnp.int32)
+        ref = np.asarray(paged_attention_reference(
+            q, k_pages, v_pages, tables, lengths))
+        kern = np.asarray(paged_flash_attention(
+            q, k_pages, v_pages, tables, lengths, interpret=True))
+        assert np.isfinite(ref).all() and np.isfinite(kern).all()
+        np.testing.assert_array_equal(ref[0], 0.0)
+        np.testing.assert_array_equal(kern[0], 0.0)
 
 
 def test_fully_masked_rows_are_zero_in_both_paths():
