@@ -50,12 +50,12 @@ BASELINE_FILE = REPO / "bench_baseline.json"
 LASTGOOD_FILE = REPO / "bench_lastgood.json"
 
 ACCEL_CONFIGS = ["bert", "resnet", "bert_int8", "matmul", "use", "t5",
-                 "imported", "in_flight", "decode_paged"]
+                 "imported", "in_flight", "decode_paged", "routed"]
 # CPU fallback: BERT-base is ~7.6 s/call on this host's CPU and never
 # finished inside the budget in any round; the stale accelerator record
 # carries the BERT story instead.
 CPU_CONFIGS = ["matmul", "use", "imported", "t5", "in_flight",
-               "decode_paged"]
+               "decode_paged", "routed"]
 
 BUDGET = float(os.environ.get("BENCH_BUDGET", 240))
 _START = time.monotonic()
@@ -1713,11 +1713,150 @@ def bench_in_flight(max_iters: int) -> dict:
             "unit": "qps", "extra": extra}
 
 
+def bench_routed(max_iters: int) -> dict:
+    """Routed leg (ROADMAP item 5): 3 real server subprocesses behind
+    the in-process router, driven with the UNMODIFIED client SDK. The
+    router hop is a host-side byte proxy, so the servers are pinned to
+    JAX_PLATFORMS=cpu (three processes must not fight over one chip; the
+    quantity under test is the extra hop, which is platform-invariant).
+    Bit-identity of routed vs direct responses is ASSERTED in-bench —
+    an overhead number for a proxy that rewrites bytes would be
+    meaningless. Also exercises the sessioned path: sticky decode
+    streams through the router, with per-step overhead measured the
+    same way."""
+    import numpy as np
+
+    from min_tfs_client_tpu.client import TensorServingClient
+    from min_tfs_client_tpu.router.main import RouterOptions, RouterServer
+    from min_tfs_client_tpu.tensor.codec import tensor_proto_to_ndarray
+    from tests import fixtures
+
+    tmp = pathlib.Path(tempfile.mkdtemp(prefix="tpu_bench_routed_"))
+    model_root = tmp / "model"
+    fixtures.write_session_jax_servable(model_root)
+    monitoring = tmp / "monitoring.config"
+    monitoring.write_text("prometheus_config { enable: true }\n")
+
+    servers = []
+    router = None
+    try:
+        # Boot/parse/teardown choreography is the SHARED harness
+        # (tests/fixtures.ModelServerProcess) — same code the router
+        # integration suite runs, so a server-banner change breaks one
+        # place, loudly.
+        servers = [fixtures.ModelServerProcess(model_root, monitoring)
+                   for _ in range(3)]
+        backends = [s.wait_ready().backend_spec() for s in servers]
+
+        router = RouterServer(RouterOptions(
+            grpc_port=0, rest_api_port=0, backends=",".join(backends),
+            health_poll_interval_s=0.5)).build_and_start()
+        t0 = time.monotonic()
+        while len(router.core.membership.live_ids()) < 3:
+            if time.monotonic() - t0 > 30:
+                raise RuntimeError("router never saw 3 LIVE backends")
+            time.sleep(0.05)
+
+        routed = TensorServingClient("127.0.0.1", router.grpc_port)
+        direct = TensorServingClient(
+            "127.0.0.1", int(backends[0].split(":")[1]))
+
+        # -- bit identity (the proxy contract, asserted not assumed)
+        for i in range(5):
+            x = np.asarray([1.0 * i, -2.0 * i, 0.5], np.float32)
+            via_router = routed.predict_request("sess", {"x": x})
+            via_direct = direct.predict_request("sess", {"x": x})
+            assert via_router.SerializeToString(deterministic=True) == \
+                via_direct.SerializeToString(deterministic=True)
+
+        # -- stateless p50: direct vs routed (the router-hop overhead)
+        x = np.zeros((32,), np.float32)
+
+        def p50(client, n):
+            ts = []
+            for _ in range(n):
+                start = time.perf_counter()
+                client.predict_request("sess", {"x": x})
+                ts.append((time.perf_counter() - start) * 1e3)
+            ts.sort()
+            return ts[len(ts) // 2]
+
+        iters = max(10, min(max_iters, 50))
+        p50(direct, 5), p50(routed, 5)  # warm both paths
+        direct_ms = p50(direct, iters)
+        routed_ms = p50(routed, iters)
+
+        # -- concurrent throughput through the full stack (8 in-flight)
+        def qps(client, total=64, threads=8):
+            import concurrent.futures as cf
+
+            def one(_):
+                client.predict_request("sess", {"x": x})
+
+            start = time.perf_counter()
+            with cf.ThreadPoolExecutor(threads) as pool:
+                list(pool.map(one, range(total)))
+            return total / (time.perf_counter() - start)
+
+        qps_direct = qps(direct)
+        qps_routed = qps(routed)
+
+        # -- sessioned path: sticky stream steps through the router
+        sid = np.asarray(b"bench-routed-session", object)
+        routed.predict_request(
+            "sess", {"session_id": sid, "base": np.asarray(0, np.int32)},
+            signature_name="decode_init")
+        pids = set()
+        step_ts = []
+        for step in range(1, 21):
+            start = time.perf_counter()
+            resp = routed.predict_request(
+                "sess", {"session_id": sid}, signature_name="decode_step")
+            step_ts.append((time.perf_counter() - start) * 1e3)
+            token = int(tensor_proto_to_ndarray(resp.outputs["token"])[0])
+            assert token == step, "sticky stream broke"
+            pids.add(int(tensor_proto_to_ndarray(resp.outputs["pid"])[0]))
+        assert len(pids) == 1, "session hopped backends"
+        routed.predict_request("sess", {"session_id": sid},
+                               signature_name="decode_close")
+        step_ts.sort()
+
+        routed.close()
+        direct.close()
+        return {
+            "metric": "routed_predict_p50_ms", "value": routed_ms,
+            "unit": "ms",
+            "extra": {
+                "direct_p50_ms": round(direct_ms, 3),
+                "router_hop_overhead_ms": round(routed_ms - direct_ms, 3),
+                "router_hop_overhead_ratio": round(
+                    routed_ms / max(direct_ms, 1e-9), 3),
+                "qps_direct_8_callers": round(qps_direct, 1),
+                "qps_routed_8_callers": round(qps_routed, 1),
+                "qps_ratio": round(qps_routed / max(qps_direct, 1e-9), 3),
+                "session_step_p50_ms": round(
+                    step_ts[len(step_ts) // 2], 3),
+                "backends": 3,
+                "bit_identical": True,
+                "sticky_session_verified": True,
+            },
+        }
+    finally:
+        if router is not None:
+            try:
+                router.stop()
+            except Exception:
+                traceback.print_exc(file=sys.stderr)
+        for server in servers:
+            server.kill()
+
+
 _CONFIG_FNS = {"bert": bench_bert, "bert_int8": bench_bert_int8,
                "matmul": bench_matmul, "use": bench_use,
                "t5": bench_t5, "resnet": bench_resnet,
                "imported": bench_imported, "in_flight": bench_in_flight,
-               "decode_paged": bench_decode_paged}
+               "decode_paged": bench_decode_paged,
+               "routed": bench_routed}
 
 
 def child_main(out: pathlib.Path, configs: list[str]) -> None:

@@ -73,7 +73,23 @@ class TensorServingClient:
         host: str,
         port: Optional[int] = None,
         credentials: Optional[grpc.ChannelCredentials] = None,
+        *,
+        retry_unavailable: bool = False,
+        max_retries: int = 3,
+        retry_backoff_s: float = 0.05,
+        retry_backoff_max_s: float = 2.0,
     ) -> None:
+        """`retry_unavailable=True` opts into bounded retry with
+        exponential backoff + full jitter on UNAVAILABLE, for IDEMPOTENT
+        Predict only — a routed fleet ejecting a dead backend then
+        becomes invisible to callers (docs/ROUTING.md). Off by default:
+        retrying is a policy decision, and non-idempotent calls
+        (decode_* sessioned signatures, config reloads) are never
+        retried regardless."""
+        self._retry_unavailable = retry_unavailable
+        self._max_retries = max(0, max_retries)
+        self._retry_backoff_s = retry_backoff_s
+        self._retry_backoff_max_s = retry_backoff_max_s
         if host.startswith(TPU_SCHEME):
             from min_tfs_client_tpu.client.inprocess import InProcessChannel
 
@@ -109,6 +125,42 @@ class TensorServingClient:
 
     # -- helpers ------------------------------------------------------------
 
+    def _call_idempotent(self, call, request, timeout):
+        """Run `call(request, timeout)`, retrying UNAVAILABLE with
+        exponential backoff + full jitter when the client opted in.
+        ONLY safe for idempotent requests — the caller vouches. Total
+        attempts = 1 + max_retries; any other status code, and the last
+        UNAVAILABLE, propagate unchanged."""
+        if not self._retry_unavailable:
+            return call(request, timeout)
+        import random
+        import time
+
+        for attempt in range(self._max_retries + 1):
+            try:
+                return call(request, timeout)
+            except grpc.RpcError as err:
+                if (attempt >= self._max_retries
+                        or err.code() != grpc.StatusCode.UNAVAILABLE):
+                    raise
+                # Full jitter (not capped-equal steps): concurrent
+                # callers hitting the same eject must not re-converge
+                # on the recovering fleet in lockstep.
+                cap = min(self._retry_backoff_max_s,
+                          self._retry_backoff_s * (2 ** attempt))
+                time.sleep(random.uniform(0, cap))
+
+    @staticmethod
+    def _predict_is_idempotent(signature_name: Optional[str],
+                               input_dict) -> bool:
+        """Sessioned decode traffic mutates server-side KV state
+        (models/t5.py decode_step advances the stream), so it is never
+        retried; everything else on the Predict surface is a pure
+        function of the request."""
+        if signature_name and signature_name.startswith("decode_"):
+            return False
+        return "session_id" not in input_dict
+
     def _fill_spec(self, request, model_name, model_version,
                    signature_name=None, version_label=None) -> None:
         request.model_spec.name = model_name
@@ -138,7 +190,10 @@ class TensorServingClient:
             request.inputs[k].CopyFrom(ndarray_to_tensor_proto(np.asarray(v)))
         if output_filter:
             request.output_filter.extend(output_filter)
-        return PredictionServiceStub(self._channel).Predict(request, timeout)
+        call = PredictionServiceStub(self._channel).Predict
+        if self._predict_is_idempotent(signature_name, input_dict):
+            return self._call_idempotent(call, request, timeout)
+        return call(request, timeout)
 
     def classification_request(
         self,

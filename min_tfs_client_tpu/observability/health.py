@@ -33,6 +33,11 @@ import weakref
 
 _lock = threading.Lock()
 _core_ref = None                                   # guarded_by: _lock
+# Cores whose Server has begun a graceful drain (Server.stop / SIGTERM):
+# readiness flips NOT_SERVING for them IMMEDIATELY, before any in-flight
+# work is waited out, so routers stop sending new traffic during the
+# grace window. Weak — a drained core that gets collected must not pin.
+_draining = weakref.WeakSet()                      # guarded_by: _lock
 
 
 def register_core(core) -> None:
@@ -50,6 +55,29 @@ def unregister_core(core) -> None:
     with _lock:
         if _core_ref is not None and _core_ref() is core:
             _core_ref = None
+        _draining.discard(core)
+
+
+def mark_draining(core) -> None:
+    """Flip this core's readiness to NOT_SERVING (both `/monitoring/
+    readyz` and `grpc.health.v1`) without touching model state. Called
+    by Server.stop() BEFORE it waits out in-flight work — the drain
+    contract routers rely on (docs/ROUTING.md)."""
+    with _lock:
+        _draining.add(core)
+
+
+def clear_draining(core) -> None:
+    """Undo mark_draining (a cancelled shutdown)."""
+    with _lock:
+        _draining.discard(core)
+
+
+def is_draining() -> bool:
+    """True when the CURRENT registered core has begun a graceful drain."""
+    with _lock:
+        core = _core_ref() if _core_ref is not None else None
+        return core is not None and core in _draining
 
 
 def _current_core():
@@ -98,6 +126,12 @@ def readiness(max_burn: float | None = None) -> dict:
 
     reasons: list[str] = []
     models: dict[str, dict] = {}
+    draining = is_draining()
+    if draining:
+        # Listed FIRST: drain wins over every other verdict — a draining
+        # replica must read NOT_SERVING even while its models stay
+        # AVAILABLE and keep answering in-flight sessioned traffic.
+        reasons.append("draining: graceful shutdown in progress")
     core = _current_core()
     if core is None:
         reasons.append("no server core registered")
@@ -126,8 +160,8 @@ def readiness(max_burn: float | None = None) -> dict:
             f"SLO burn rate {burn:.2f} >= shedding threshold {shed:.2f}")
 
     ready = not reasons
-    verdict = {"ready": ready, "models": models, "slo": slo_detail,
-               "reasons": reasons}
+    verdict = {"ready": ready, "draining": draining, "models": models,
+               "slo": slo_detail, "reasons": reasons}
     _export_ready_gauge(ready)
     return verdict
 
@@ -199,6 +233,11 @@ def check_service(service: str) -> tuple[bool, int]:
     if not service:
         return True, _SERVING if verdict["ready"] else _NOT_SERVING
     model = verdict["models"].get(service)
+    if model is not None and verdict.get("draining"):
+        # Per-model probes flip with the whole server during drain: a
+        # router watching one model's health must also stop sending it
+        # new sessions.
+        return True, _NOT_SERVING
     if model is None:
         core = _current_core()
         if core is None or not core.model_exists(service):

@@ -1,0 +1,23 @@
+"""Sessioned routing tier: the drain-aware front door that turns "a
+server" into "a service" (ROADMAP item 5; docs/ROUTING.md).
+
+A standalone process (`tpu-serving-router`) fronting N model-server
+processes speaking the SAME frozen wire protocol — the client SDK works
+against the router with zero changes:
+
+ * `ring.py`        deterministic consistent hashing (rendezvous/HRW over
+                    FarmHash64) keyed on (model, session-id | request-hash)
+                    with provably bounded rebalance on membership change;
+ * `membership.py`  health-plane-fed membership: polls each backend's
+                    `grpc.health.v1.Health/Check` and `/monitoring/readyz`,
+                    ejects NOT_SERVING (drain) and unreachable (dead)
+                    backends from the new-work rotation;
+ * `sessions.py`    the stickiness table — a decode session's KV cache
+                    lives in ONE process, so its requests must keep
+                    landing there even while that backend drains;
+ * `core.py`        the routing decision tying the three together;
+ * `proxy.py`       the pure proxy data plane: gRPC requests forwarded as
+                    raw bytes (never re-serialized), REST forwarded as-is,
+                    plus the router's own `/monitoring/router` payload;
+ * `main.py`        CLI entry point.
+"""

@@ -1,0 +1,229 @@
+"""RouterCore: the routing decision — membership x ring x stickiness.
+
+One rule set, applied per request:
+
+ * sessioned (the request carries a scalar DT_STRING `session_id`
+   input): a pinned session goes to ITS backend while that backend is
+   LIVE **or DRAINING** (drain stops new sessions, never in-flight
+   ones); if its backend is DEAD the pin is dropped and the request
+   fails UNAVAILABLE — the KV state died with the process. An unpinned
+   session id is a NEW session: assigned via the ring over LIVE
+   backends only, then pinned.
+ * stateless: the ring over LIVE backends, keyed on (model,
+   request-fingerprint) so identical requests revisit warm caches.
+
+The data plane reports outcomes back through note_result(): errors feed
+the per-backend error counters, and connectivity failures pulse the
+membership poll so ejection happens within one poll interval.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+from min_tfs_client_tpu.router import ring as ring_mod
+from min_tfs_client_tpu.router.membership import (
+    DEAD,
+    DRAINING,
+    LIVE,
+    Backend,
+    MembershipTable,
+)
+from min_tfs_client_tpu.router.sessions import SessionTable
+from min_tfs_client_tpu.utils.status import ServingError
+
+
+class ChannelPool:
+    """One persistent gRPC channel per backend, shared by the data plane
+    and the health poller. Unlimited message sizes, like the server and
+    client (serving tensors routinely exceed the 4 MB default)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._channels: dict[str, object] = {}   # guarded_by: self._lock
+
+    def get(self, backend: Backend):
+        import grpc
+
+        with self._lock:
+            channel = self._channels.get(backend.backend_id)
+            if channel is None:
+                channel = grpc.insecure_channel(
+                    backend.grpc_target,
+                    options=[("grpc.max_send_message_length", -1),
+                             ("grpc.max_receive_message_length", -1)])
+                self._channels[backend.backend_id] = channel
+            return channel
+
+    def close(self) -> None:
+        with self._lock:
+            channels, self._channels = list(self._channels.values()), {}
+        for channel in channels:
+            channel.close()
+
+
+@dataclass(frozen=True)
+class RouteResult:
+    """One routing decision: the backend, and whether THIS request
+    created the session pin (so a failed first forward can undo it)."""
+
+    backend: Backend
+    fresh_pin: bool
+
+
+class RouterCore:
+    def __init__(
+        self,
+        backends: Sequence[Backend],
+        poll_interval_s: float = 1.0,
+        probe_timeout_s: float = 1.0,
+        eject_after_failures: int = 1,
+        session_idle_timeout_s: float = 3600.0,
+        poller=None,
+    ):
+        self.channels = ChannelPool()
+        self.sessions = SessionTable(idle_timeout_s=session_idle_timeout_s)
+        self.membership = MembershipTable(
+            backends,
+            self.channels,
+            poll_interval_s=poll_interval_s,
+            probe_timeout_s=probe_timeout_s,
+            eject_after_failures=eject_after_failures,
+            poller=poller,
+            on_dead=self._backend_died,
+            on_tick=self._tick,
+        )
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "RouterCore":
+        self.membership.start()
+        return self
+
+    def stop(self) -> None:
+        self.membership.stop()
+        self.channels.close()
+
+    # -- membership callbacks ------------------------------------------------
+
+    def _backend_died(self, backend_id: str) -> None:
+        lost = self.sessions.drop_backend(backend_id)
+        if lost:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "dropped %d session pin(s) to dead backend %s",
+                lost, backend_id)
+
+    def _tick(self) -> None:
+        from min_tfs_client_tpu.server import metrics
+
+        self.sessions.evict_idle()
+        counts = self.sessions.count_by_backend()
+        for backend in self.membership.backends():
+            metrics.safe_set(metrics.router_sticky_sessions,
+                             float(counts.get(backend.backend_id, 0)),
+                             backend.backend_id)
+
+    # -- routing -------------------------------------------------------------
+
+    def route(self, model: str, session_id: Optional[bytes],
+              request_bytes: bytes) -> "RouteResult":
+        """The decision for one request — `.backend` plus whether this
+        request CREATED its session pin (`.fresh_pin`, so the data plane
+        can roll the pin back if the first forward never reaches the
+        backend). Raises typed UNAVAILABLE when no backend can take it
+        (lost session / empty rotation)."""
+        if session_id is not None:
+            return self._route_sessioned(model, session_id)
+        routing_id = ring_mod.request_fingerprint(request_bytes)
+        return RouteResult(self._assign_new(model, routing_id), False)
+
+    def _route_sessioned(self, model: str,
+                         session_id: bytes) -> "RouteResult":
+        # Two passes cover the lost-race re-read; pin churn beyond that
+        # would need release() racing pin_if_absent in a tight loop.
+        for _ in range(2):
+            pinned = self.sessions.lookup(model, session_id)
+            if pinned is not None:
+                state = self.membership.state_of(pinned)
+                if state in (LIVE, DRAINING):
+                    backend = self.membership.backend(pinned)
+                    if backend is not None:
+                        return RouteResult(backend, False)
+                # DEAD (or removed): the KV state is gone; fail the
+                # stream honestly instead of manufacturing NOT_FOUNDs
+                # elsewhere.
+                self.sessions.release(model, session_id)
+                raise ServingError.unavailable(
+                    f"session {session_id!r} was pinned to backend "
+                    f"{pinned} which is {state}; the session's state is "
+                    "lost — start a new session")
+            candidate = self._assign_new(model, session_id)
+            winner_id, we_pinned = self.sessions.pin_if_absent(
+                model, session_id, candidate.backend_id)
+            if we_pinned:
+                return RouteResult(candidate, True)
+            # a concurrent first-request won the pin: follow the winner
+            # through the normal pinned path (state checks included)
+        raise ServingError.unavailable(  # pragma: no cover - needs a
+            f"session {session_id!r} pin is churning; retry")  # tight race
+
+    def _assign_new(self, model: str, routing_id: bytes) -> Backend:
+        live = self.membership.live_ids()
+        if not live:
+            raise ServingError.unavailable(
+                "no live backends: every backend is draining, dead, or "
+                "not yet polled")
+        backend_id = ring_mod.assign(ring_mod.ring_key(model, routing_id),
+                                     live)
+        backend = self.membership.backend(backend_id)
+        if backend is None:  # pragma: no cover - ids come from membership
+            raise ServingError.unavailable(
+                f"backend {backend_id} vanished from the membership table")
+        return backend
+
+    # -- data-plane feedback -------------------------------------------------
+
+    def note_result(self, backend: Backend, method: str,
+                    error_code: Optional[str] = None,
+                    unreachable: bool = False) -> None:
+        from min_tfs_client_tpu.server import metrics
+
+        metrics.router_backend_requests.increment(
+            backend.backend_id, method)
+        if error_code is not None:
+            metrics.router_backend_errors.increment(
+                backend.backend_id, error_code)
+        if unreachable:
+            self.membership.note_error(backend.backend_id)
+
+    def session_closed(self, model: str, session_id: bytes) -> None:
+        """decode_close round-tripped: forget the pin."""
+        self.sessions.release(model, session_id)
+
+    # -- observability -------------------------------------------------------
+
+    def ready(self) -> bool:
+        return bool(self.membership.live_ids())
+
+    def snapshot(self) -> dict:
+        payload = self.membership.snapshot()
+        live = self.membership.live_ids()
+        # Shares come from the membership table's cache (recomputed only
+        # on live-set change): a 20 Hz monitoring poll or Prometheus
+        # scrape must not pay 1024 pure-Python fingerprints per read.
+        payload["ring"] = {
+            "live_backends": live,
+            "occupancy": {b: round(s, 4) for b, s in
+                          self.membership.occupancy_shares().items()},
+        }
+        payload["sessions"] = {
+            "total": self.sessions.size(),
+            "by_backend": self.sessions.count_by_backend(),
+            "idle_timeout_s": self.sessions.idle_timeout_s,
+        }
+        payload["ready"] = bool(live)
+        return payload

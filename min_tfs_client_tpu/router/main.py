@@ -1,0 +1,187 @@
+"""`tpu-serving-router` — the routing tier's process assembly + CLI.
+
+    tpu-serving-router --port=8600 --rest_api_port=8601 \
+        --backends=10.0.0.1:8500:8501,10.0.0.2:8500:8501
+
+The router is a pure front door: no jax, no model state — it boots in
+milliseconds and can run N replicas side by side (the ring is a pure
+function of (key, membership), so identical routers make identical
+choices; only the stickiness table is per-router, and sessions stay
+correct because a session id is pinned before its first forward).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import threading
+from dataclasses import dataclass
+from typing import Optional
+
+from min_tfs_client_tpu.router.core import RouterCore
+from min_tfs_client_tpu.router.membership import parse_backends
+
+
+@dataclass
+class RouterOptions:
+    grpc_port: int = 8600
+    rest_api_port: int = 0
+    backends: str = ""
+    health_poll_interval_s: float = 1.0
+    probe_timeout_s: float = 1.0
+    eject_after_failures: int = 1
+    session_idle_timeout_s: float = 3600.0
+    forward_timeout_s: float = 60.0
+    grpc_max_threads: int = 16
+
+
+class RouterServer:
+    def __init__(self, options: RouterOptions, poller=None):
+        self.options = options
+        self.core: Optional[RouterCore] = None
+        self._grpc_server = None
+        self._rest_server = None
+        self._poller = poller
+
+    def build_and_start(self) -> "RouterServer":
+        import grpc
+        from concurrent import futures
+
+        from min_tfs_client_tpu.router.proxy import GrpcProxy
+
+        opts = self.options
+        self.core = RouterCore(
+            parse_backends(opts.backends),
+            poll_interval_s=opts.health_poll_interval_s,
+            probe_timeout_s=opts.probe_timeout_s,
+            eject_after_failures=opts.eject_after_failures,
+            session_idle_timeout_s=opts.session_idle_timeout_s,
+            poller=self._poller,
+        )
+        self.core.start()
+        proxy = GrpcProxy(self.core,
+                          default_timeout_s=opts.forward_timeout_s)
+        self._grpc_server = grpc.server(
+            futures.ThreadPoolExecutor(
+                max_workers=opts.grpc_max_threads,
+                thread_name_prefix="router-grpc"),
+            options=[("grpc.max_send_message_length", -1),
+                     ("grpc.max_receive_message_length", -1)])
+        self._grpc_server.add_generic_rpc_handlers(
+            tuple(proxy.generic_handlers()))
+        self.grpc_port = self._grpc_server.add_insecure_port(
+            f"0.0.0.0:{opts.grpc_port}")
+        self._grpc_server.start()
+        self._rest_server, self.rest_port = _start_rest(
+            self.core, opts.rest_api_port)
+        return self
+
+    def wait_for_termination(self) -> None:
+        self._grpc_server.wait_for_termination()
+
+    def stop(self, grace: float = 2.0) -> None:
+        if self._grpc_server is not None:
+            # Bounded teardown (servelint DL003): past grace + slack the
+            # daemonized handler threads die with the process.
+            self._grpc_server.stop(grace).wait(timeout=grace + 5.0)
+        if self._rest_server is not None:
+            self._rest_server.shutdown()
+        if self.core is not None:
+            self.core.stop()
+
+
+def _start_rest(core: RouterCore, port: int):
+    """The router's REST surface: /monitoring/router + healthz/readyz/
+    prometheus, and a verbatim /v1 proxy. http.server is plenty — the
+    REST path is the ops/debug surface; the data plane is gRPC."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    from min_tfs_client_tpu.router.proxy import rest_route_request
+
+    class _RouterRestHandler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, *args):  # quiet
+            pass
+
+        def _send(self, code: int, content_type: str, body: bytes) -> None:
+            self.send_response(code)
+            self.send_header("Content-Type", content_type)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):  # noqa: N802 - http.server API
+            self._send(*rest_route_request(
+                core, "GET", self.path, b"", self.headers))
+
+        def do_POST(self):  # noqa: N802 - http.server API
+            length = int(self.headers.get("Content-Length", "0"))
+            raw = self.rfile.read(length)
+            self._send(*rest_route_request(
+                core, "POST", self.path, raw, self.headers))
+
+    server = ThreadingHTTPServer(("0.0.0.0", port), _RouterRestHandler)
+    thread = threading.Thread(target=server.serve_forever,
+                              name="router-rest-server", daemon=True)
+    thread.start()
+    return server, server.server_address[1]
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser("tpu-serving-router")
+    p.add_argument("--port", type=int, default=8600,
+                   help="gRPC port the router listens on")
+    p.add_argument("--rest_api_port", type=int, default=0,
+                   help="REST/monitoring port (/monitoring/router, "
+                        "readyz, prometheus, /v1 proxy); 0 = ephemeral")
+    p.add_argument("--backends", required=True,
+                   help="comma-separated host:grpc_port[:rest_port] "
+                        "backend list")
+    p.add_argument("--health_poll_interval_s", type=float, default=1.0,
+                   help="seconds between health-plane sweeps; a dead "
+                        "backend is ejected within one interval")
+    p.add_argument("--probe_timeout_s", type=float, default=1.0,
+                   help="per-probe timeout for grpc health / readyz")
+    p.add_argument("--eject_after_failures", type=int, default=1,
+                   help="consecutive unreachable polls before a backend "
+                        "is marked DEAD (1 = eject on first)")
+    p.add_argument("--session_idle_timeout_s", type=float, default=3600.0,
+                   help="drop a session pin after this much idle time "
+                        "(the backend expires its HBM side on its own)")
+    p.add_argument("--forward_timeout_s", type=float, default=60.0,
+                   help="forward deadline when the client sent none")
+    p.add_argument("--grpc_max_threads", type=int, default=16)
+    return p
+
+
+def options_from_args(args) -> RouterOptions:
+    return RouterOptions(
+        grpc_port=args.port,
+        rest_api_port=args.rest_api_port,
+        backends=args.backends,
+        health_poll_interval_s=args.health_poll_interval_s,
+        probe_timeout_s=args.probe_timeout_s,
+        eject_after_failures=args.eject_after_failures,
+        session_idle_timeout_s=args.session_idle_timeout_s,
+        forward_timeout_s=args.forward_timeout_s,
+        grpc_max_threads=args.grpc_max_threads,
+    )
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    router = RouterServer(options_from_args(args)).build_and_start()
+    backends = ",".join(
+        b.backend_id for b in router.core.membership.backends())
+    print(f"[tpu-serving-router] routing: gRPC on {router.grpc_port}, "
+          f"REST on {router.rest_port}; backends: {backends}", flush=True)
+    try:
+        router.wait_for_termination()
+    except KeyboardInterrupt:
+        router.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,431 @@
+"""Health-plane-fed membership: which backends may receive new work.
+
+The router never guesses liveness from data-plane failures alone — the
+backends already publish a considered verdict on two planes
+(observability/health.py): the standard `grpc.health.v1.Health/Check` on
+the serving port and `/monitoring/readyz` on the REST port. The
+membership table polls both and folds them into one state per backend:
+
+  LIVE      health answered SERVING on every polled plane — in the
+            new-work rotation (the hash ring routes over exactly these);
+  DRAINING  health ANSWERED, and said NOT_SERVING — the backend is
+            alive but asked for no new traffic (graceful shutdown,
+            config reload, SLO shedding). Out of the rotation, but
+            sticky sessions keep flowing to it: their KV state lives in
+            that process and cannot move;
+  DEAD      the health plane is unreachable (connection refused, RPC
+            deadline) for `eject_after_failures` consecutive polls —
+            fully ejected; sessions pinned there are lost and dropped;
+  UNKNOWN   not successfully polled yet (startup) — not routable, not
+            counted as an ejection.
+
+The data plane can `note_error()` a backend after a forwarding failure;
+that wakes the poll loop immediately so a crashed backend is ejected
+within one poll interval of the first failed request, not one interval
+plus the residual sleep.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+import urllib.error
+import urllib.request
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass, field
+from typing import Callable, Optional, Sequence
+
+from min_tfs_client_tpu.utils.status import ServingError
+
+log = logging.getLogger(__name__)
+
+LIVE = "LIVE"
+DRAINING = "DRAINING"
+DEAD = "DEAD"
+UNKNOWN = "UNKNOWN"
+
+# Poll verdicts (what one probe of one backend concluded).
+SERVING = "serving"
+NOT_SERVING = "not_serving"
+UNREACHABLE = "unreachable"
+
+
+@dataclass(frozen=True)
+class Backend:
+    """One server process. `rest_port` None = gRPC-only backend (REST
+    proxying and readyz polling then skip it)."""
+
+    host: str
+    grpc_port: int
+    rest_port: Optional[int] = None
+
+    @property
+    def backend_id(self) -> str:
+        return f"{self.host}:{self.grpc_port}"
+
+    @property
+    def grpc_target(self) -> str:
+        return f"{self.host}:{self.grpc_port}"
+
+
+def parse_backend(spec: str) -> Backend:
+    """"host:grpc_port[:rest_port]" -> Backend."""
+    parts = spec.strip().rsplit(":", 2)
+    if len(parts) == 3 and parts[0] and parts[1].isdigit() \
+            and parts[2].isdigit():
+        return Backend(parts[0], int(parts[1]), int(parts[2]))
+    host, sep, port = spec.strip().rpartition(":")
+    if not sep or not host or not port.isdigit():
+        raise ServingError.invalid_argument(
+            f"malformed backend spec {spec!r} "
+            "(want host:grpc_port[:rest_port])")
+    return Backend(host, int(port))
+
+
+def parse_backends(spec: str) -> list[Backend]:
+    backends = [parse_backend(p) for p in spec.split(",") if p.strip()]
+    if not backends:
+        raise ServingError.invalid_argument(
+            "--backends is empty: the router needs at least one "
+            "host:grpc_port[:rest_port] entry")
+    ids = [b.backend_id for b in backends]
+    if len(set(ids)) != len(ids):
+        raise ServingError.invalid_argument(
+            f"duplicate backend ids in --backends: {ids}")
+    return backends
+
+
+# -- the two probe planes ----------------------------------------------------
+
+
+def grpc_health_verdict(channel, timeout_s: float) -> str:
+    """One grpc.health.v1.Health/Check round-trip -> poll verdict. The
+    wire format is the same two one-field messages observability/
+    health.py hand-rolls; an empty request probes the whole server."""
+    import grpc
+
+    call = channel.unary_unary("/grpc.health.v1.Health/Check")
+    try:
+        reply = call(b"", timeout=timeout_s)
+    except grpc.RpcError as err:
+        code = err.code()
+        if code in (grpc.StatusCode.UNAVAILABLE,
+                    grpc.StatusCode.DEADLINE_EXCEEDED):
+            return UNREACHABLE
+        # The port answered but refused the probe (UNIMPLEMENTED on a
+        # foreign server, INTERNAL, ...): alive, not serving.
+        return NOT_SERVING
+    # HealthCheckResponse: field 1 varint, 1 = SERVING.
+    if len(reply) >= 2 and reply[0] == 0x08 and reply[1] == 1:
+        return SERVING
+    return NOT_SERVING
+
+
+def readyz_verdict(backend: Backend,
+                   timeout_s: float) -> tuple[str, Optional[dict]]:
+    """(verdict, readyz payload) from GET /monitoring/readyz. The
+    payload's per-model availability feeds the router's own per-model
+    health answers."""
+    url = (f"http://{backend.host}:{backend.rest_port}"
+           "/monitoring/readyz")
+    try:
+        with urllib.request.urlopen(url, timeout=timeout_s) as resp:
+            return SERVING, json.loads(resp.read())
+    except urllib.error.HTTPError as err:
+        # 503 IS the readiness protocol answering "not ready" — the
+        # body still carries the verdict detail.
+        try:
+            payload = json.loads(err.read())
+        except Exception:  # noqa: BLE001 - body is best-effort detail
+            payload = None
+        return NOT_SERVING, payload
+    except Exception:  # noqa: BLE001 - refused/timeout/reset alike
+        return UNREACHABLE, None
+
+
+@dataclass
+class _Entry:
+    backend: Backend
+    state: str = UNKNOWN                 # guarded_by: MembershipTable._lock
+    consecutive_failures: int = 0        # guarded_by: MembershipTable._lock
+    polls: int = 0                       # guarded_by: MembershipTable._lock
+    last_poll_s: float = 0.0             # guarded_by: MembershipTable._lock
+    last_verdict: str = ""               # guarded_by: MembershipTable._lock
+    last_readyz: Optional[dict] = field(
+        default=None)                    # guarded_by: MembershipTable._lock
+
+
+class MembershipTable:
+    """The fleet's state machine + its poll thread.
+
+    `poller` is injectable for planted-failure tests: a callable
+    `(Backend) -> (verdict, readyz_payload|None)`. The default probes
+    grpc health (via `channels.get`) and, when the backend has a REST
+    port, readyz — the stricter plane wins (any NOT_SERVING answer
+    drains; gRPC unreachable is dead even if REST still answers, since
+    the data plane is gRPC)."""
+
+    def __init__(
+        self,
+        backends: Sequence[Backend],
+        channels,
+        poll_interval_s: float = 1.0,
+        probe_timeout_s: float = 1.0,
+        eject_after_failures: int = 1,
+        poller: Optional[Callable] = None,
+        on_dead: Optional[Callable[[str], None]] = None,
+        on_tick: Optional[Callable[[], None]] = None,
+    ):
+        self._channels = channels
+        self.poll_interval_s = poll_interval_s
+        self.probe_timeout_s = probe_timeout_s
+        self.eject_after_failures = max(1, eject_after_failures)
+        self._poller = poller or self._default_poll
+        self._on_dead = on_dead
+        self._on_tick = on_tick
+        self._lock = threading.Lock()
+        self._entries: dict[str, _Entry] = {
+            b.backend_id: _Entry(b) for b in backends
+        }                                          # guarded_by: self._lock
+        self._stop = threading.Event()
+        # Data-plane failure reports pulse this so the next poll runs
+        # NOW instead of after the residual interval sleep.
+        self._poke = threading.Event()
+        # Occupancy is 1024 pure-Python fingerprints per live backend
+        # (~17 ms for 3) — recomputed only when the live set changes,
+        # not every poll, and REUSED by /monitoring/router snapshots.
+        # Written by the poll thread only; readers take the atomic dict
+        # reference (never mutated in place).
+        self._gauged_live: Optional[tuple] = None
+        self._occupancy: dict[str, float] = {}
+        # Probes run CONCURRENTLY: a wedged backend costs one sweep
+        # max(probe_timeout), not sum — sequential probing would let one
+        # sick process stretch everyone else's ejection latency to
+        # interval + N*timeout.
+        self._probe_pool = ThreadPoolExecutor(
+            max_workers=min(8, max(1, len(backends))),
+            thread_name_prefix="router-probe")
+        # servelint: thread-ok published once here, before start() can spawn
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> None:
+        self.poll_once()  # synchronous first pass: route correctly at boot
+        self._thread = threading.Thread(
+            target=self._poll_loop, name="router-membership-poll",
+            daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._poke.set()
+        if self._thread is not None:
+            self._thread.join(timeout=self.poll_interval_s
+                              + self.probe_timeout_s + 5.0)
+        self._probe_pool.shutdown(wait=False)
+
+    def _poll_loop(self) -> None:
+        while not self._stop.is_set():
+            # Interruptible sleep: a data-plane note_error() pulse cuts
+            # it short. Bounded either way (servelint DL003).
+            self._poke.wait(timeout=self.poll_interval_s)
+            self._poke.clear()
+            if self._stop.is_set():
+                return
+            try:
+                self.poll_once()
+            except Exception:  # pragma: no cover - poll must survive
+                log.exception("membership poll pass failed")
+
+    # -- polling -------------------------------------------------------------
+
+    def _default_poll(self, backend: Backend):
+        verdict = grpc_health_verdict(
+            self._channels.get(backend), self.probe_timeout_s)
+        payload = None
+        if backend.rest_port:
+            rest_verdict, payload = readyz_verdict(
+                backend, self.probe_timeout_s)
+            # gRPC unreachable = dead regardless of REST (the data plane
+            # is gRPC); otherwise any definite NOT_SERVING answer wins.
+            if verdict == SERVING and rest_verdict != SERVING:
+                verdict = (NOT_SERVING if rest_verdict == NOT_SERVING
+                           else verdict)
+        return verdict, payload
+
+    def poll_once(self) -> dict[str, str]:
+        """Probe every backend once and apply transitions; returns
+        {backend_id: state}. Probes run OUTSIDE the lock (a wedged
+        backend must not block routing decisions)."""
+        with self._lock:
+            backends = [e.backend for e in self._entries.values()]
+
+        def probe(backend):
+            try:
+                return self._poller(backend)
+            except Exception:  # noqa: BLE001 - a poller bug reads as dead
+                log.exception("health poll of %s raised",
+                              backend.backend_id)
+                return UNREACHABLE, None
+
+        futures = {b.backend_id: self._probe_pool.submit(probe, b)
+                   for b in backends}
+        verdicts = {bid: f.result() for bid, f in futures.items()}
+        newly_dead: list[str] = []
+        with self._lock:
+            for backend_id, (verdict, payload) in verdicts.items():
+                entry = self._entries.get(backend_id)
+                if entry is None:
+                    continue
+                self._apply_locked(entry, verdict, payload, newly_dead)
+            states = {bid: e.state for bid, e in self._entries.items()}
+        for backend_id in newly_dead:
+            if self._on_dead is not None:
+                self._on_dead(backend_id)
+        self._export_gauges(states)
+        if self._on_tick is not None:
+            self._on_tick()  # periodic upkeep rides the poll cadence
+        return states
+
+    def _apply_locked(self, entry: _Entry, verdict: str,
+                      payload, newly_dead: list[str]) -> None:
+        from min_tfs_client_tpu.server import metrics
+
+        entry.polls += 1
+        entry.last_poll_s = time.monotonic()
+        entry.last_verdict = verdict
+        previous = entry.state
+        if verdict == SERVING:
+            entry.consecutive_failures = 0
+            entry.state = LIVE
+            if payload is not None:
+                # Keep the cached per-model availability when only the
+                # REST probe hiccuped (gRPC SERVING + readyz timeout
+                # reads as (SERVING, None)): wiping it would flip the
+                # router's per-model health answers to NOT_FOUND for a
+                # model that is serving fine.
+                entry.last_readyz = payload
+            if previous in (DRAINING, DEAD):
+                log.info("backend %s reinstated (was %s)",
+                         entry.backend.backend_id, previous)
+        elif verdict == NOT_SERVING:
+            entry.consecutive_failures = 0
+            entry.state = DRAINING
+            if payload is not None:
+                entry.last_readyz = payload
+            if previous == LIVE:
+                metrics.router_backend_ejections.increment(
+                    entry.backend.backend_id, "drain")
+                log.info("backend %s entered drain (NOT_SERVING)",
+                         entry.backend.backend_id)
+        else:  # UNREACHABLE
+            entry.consecutive_failures += 1
+            if entry.consecutive_failures >= self.eject_after_failures:
+                if previous != DEAD:
+                    metrics.router_backend_ejections.increment(
+                        entry.backend.backend_id, "dead")
+                    log.warning(
+                        "backend %s ejected: health plane unreachable "
+                        "(%d consecutive failures)",
+                        entry.backend.backend_id,
+                        entry.consecutive_failures)
+                    newly_dead.append(entry.backend.backend_id)
+                entry.state = DEAD
+            # Below the threshold the previous state stands: one flaky
+            # probe must not flap a LIVE backend out of the rotation.
+
+    def _export_gauges(self, states: dict[str, str]) -> None:
+        from min_tfs_client_tpu.router import ring as ring_mod
+        from min_tfs_client_tpu.server import metrics
+
+        live = sorted(bid for bid, s in states.items() if s == LIVE)
+        metrics.safe_set(metrics.router_live_backends, float(len(live)))
+        if tuple(live) == self._gauged_live:
+            return  # membership unchanged: the shares gauged last time hold
+        shares = ring_mod.occupancy(live)
+        for backend_id in states:
+            metrics.safe_set(metrics.router_ring_occupancy,
+                             shares.get(backend_id, 0.0), backend_id)
+        # servelint: thread-ok atomic reference swap of a never-mutated
+        # dict; readers (occupancy_shares) only take the reference
+        self._occupancy = shares
+        self._gauged_live = tuple(live)
+
+    # -- queries -------------------------------------------------------------
+
+    def poll_thread_alive(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    def occupancy_shares(self) -> dict[str, float]:
+        """The ring-occupancy shares computed at the last live-set
+        change (a fresh atomic dict reference — at most one poll stale),
+        so monitoring reads never pay the 1024-probe recompute."""
+        return self._occupancy
+
+    def note_error(self, backend_id: str) -> None:
+        """Data plane observed a forwarding failure: re-poll promptly so
+        a crash is ejected within one poll interval of the failure."""
+        self._poke.set()
+
+    def live_ids(self) -> list[str]:
+        """Backends eligible for NEW work (sorted for determinism)."""
+        with self._lock:
+            return sorted(bid for bid, e in self._entries.items()
+                          if e.state == LIVE)
+
+    def state_of(self, backend_id: str) -> str:
+        with self._lock:
+            entry = self._entries.get(backend_id)
+            return entry.state if entry is not None else UNKNOWN
+
+    def backend(self, backend_id: str) -> Optional[Backend]:
+        with self._lock:
+            entry = self._entries.get(backend_id)
+            return entry.backend if entry is not None else None
+
+    def backends(self) -> list[Backend]:
+        with self._lock:
+            return [e.backend for e in self._entries.values()]
+
+    def model_available(self, model: str) -> Optional[bool]:
+        """Per-model health from the polled readyz payloads: True when
+        some LIVE backend reports an AVAILABLE version of `model`; None
+        when NO backend has ever mentioned it (-> NOT_FOUND)."""
+        seen = False
+        with self._lock:
+            for entry in self._entries.values():
+                payload = entry.last_readyz or {}
+                info = payload.get("models", {}).get(model)
+                if info is None:
+                    continue
+                seen = True
+                if entry.state == LIVE and info.get("available_versions"):
+                    return True
+        return False if seen else None
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            now = time.monotonic()
+            backends = {
+                bid: {
+                    "state": e.state,
+                    "grpc": e.backend.grpc_target,
+                    "rest_port": e.backend.rest_port,
+                    "consecutive_failures": e.consecutive_failures,
+                    "polls": e.polls,
+                    "last_poll_age_s": (round(now - e.last_poll_s, 3)
+                                        if e.polls else None),
+                    "last_verdict": e.last_verdict,
+                    "models": sorted((e.last_readyz or {}).get(
+                        "models", {})),
+                }
+                for bid, e in sorted(self._entries.items())
+            }
+        return {
+            "backends": backends,
+            "poll_interval_s": self.poll_interval_s,
+            "eject_after_failures": self.eject_after_failures,
+        }

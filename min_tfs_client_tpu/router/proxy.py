@@ -1,0 +1,496 @@
+"""The router's data plane: a pure byte proxy on both transports.
+
+gRPC requests are received as RAW bytes (deserializer None on the
+generic handler) and forwarded to the chosen backend's channel as the
+SAME bytes — the router parses a copy for its routing key (model,
+signature, session id) but never re-serializes, so the proxied request
+is bit-identical to what the client sent and the client SDK works
+against the router with zero changes. REST requests forward the same
+way: path + body verbatim to the chosen backend's REST port.
+
+Two control-plane exceptions to pure pass-through:
+
+ * HandleReloadConfigRequest broadcasts to every reachable backend — a
+   fleet must apply config as a unit; the first error wins the reply;
+ * `grpc.health.v1.Health/Check` on the ROUTER port answers for the
+   SERVICE (>= 1 LIVE backend; per-model from the polled readyz
+   payloads), not for any single process.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import logging
+from typing import Optional
+
+from min_tfs_client_tpu.protos import tfs_apis_pb2 as apis
+from min_tfs_client_tpu.protos.grpc_service import SERVICE_SCHEMAS
+from min_tfs_client_tpu.router.core import RouterCore
+from min_tfs_client_tpu.router.membership import DEAD, Backend
+from min_tfs_client_tpu.utils.status import (
+    ServingError,
+    error_from_exception,
+    to_grpc_code,
+)
+
+log = logging.getLogger(__name__)
+
+_PKG = "tensorflow.serving"
+
+# Sessioned Predict signatures whose successful close releases the pin.
+_SESSION_CLOSE_SIGNATURE = "decode_close"
+
+# Incoming metadata keys never forwarded: transport-owned or reserved.
+_HOP_METADATA_PREFIXES = (":", "grpc-")
+_HOP_METADATA_KEYS = frozenset({"te", "content-type", "user-agent"})
+
+
+def _forwardable_metadata(context) -> list[tuple[str, object]]:
+    out = []
+    for key, value in (context.invocation_metadata() or ()):
+        lower = key.lower()
+        if lower in _HOP_METADATA_KEYS or \
+                lower.startswith(_HOP_METADATA_PREFIXES):
+            continue
+        out.append((key, value))
+    return out
+
+
+# -- routing-key wire scan ---------------------------------------------------
+#
+# The router must NOT pay a full protobuf parse per proxied request: a
+# PredictRequest routinely carries multi-MB tensors (the channels run
+# unlimited sizes for exactly that reason), and materializing them in
+# the proxy just to read two short strings would double the fleet's
+# deserialization work. Instead the routing key is lifted with a wire-
+# format scan that SKIPS over payload fields by their length prefix:
+# every serving request type puts model_spec (or, for MultiInference,
+# tasks whose field 1 is model_spec) at field 1, ModelSpec.name is
+# field 1 / signature_name field 3, and a Predict `inputs` map entry is
+# {1: key, 2: TensorProto} with string_val at field 8. Cost is O(field
+# count), not O(bytes).
+
+
+def _read_varint(data, pos: int) -> tuple[int, int]:
+    result, shift = 0, 0
+    while shift <= 63:
+        if pos >= len(data):
+            raise ValueError("truncated varint")
+        byte = data[pos]
+        result |= (byte & 0x7F) << shift
+        pos += 1
+        if not byte & 0x80:
+            return result, pos
+        shift += 7
+    raise ValueError("varint overflow")
+
+
+def _iter_fields(data):
+    """Yield (field_number, wire_type, value) over one message's wire
+    bytes; length-delimited values come back as zero-copy memoryview
+    slices, numeric wire types as skipped placeholders."""
+    pos, end = 0, len(data)
+    while pos < end:
+        tag, pos = _read_varint(data, pos)
+        field, wire_type = tag >> 3, tag & 7
+        if wire_type == 0:
+            value, pos = _read_varint(data, pos)
+        elif wire_type == 2:
+            length, pos = _read_varint(data, pos)
+            if pos + length > end:
+                raise ValueError("length past buffer")
+            value = data[pos:pos + length]
+            pos += length
+        elif wire_type == 5:
+            value, pos = None, pos + 4
+        elif wire_type == 1:
+            value, pos = None, pos + 8
+        else:
+            raise ValueError(f"unsupported wire type {wire_type}")
+        if pos > end:
+            raise ValueError("field past buffer")
+        yield field, wire_type, value
+
+
+def _scan_model_spec(spec_bytes) -> tuple[str, str]:
+    name = signature = ""
+    for field, wire_type, value in _iter_fields(spec_bytes):
+        if wire_type != 2:
+            continue
+        if field == 1:
+            name = bytes(value).decode("utf-8", "replace")
+        elif field == 3:
+            signature = bytes(value).decode("utf-8", "replace")
+    return name, signature
+
+
+def _scan_session_tensor(tensor_bytes) -> Optional[bytes]:
+    """string_val[0] (field 8), falling back to tensor_content (field
+    4) — the same precedence the full parse used."""
+    first_string = content = None
+    for field, wire_type, value in _iter_fields(tensor_bytes):
+        if wire_type != 2:
+            continue
+        if field == 8 and first_string is None:
+            first_string = bytes(value)
+        elif field == 4:
+            content = bytes(value)
+    return first_string if first_string is not None else content
+
+
+def routing_info(service: str, method: str,
+                 request_bytes: bytes) -> tuple[str, Optional[bytes], str]:
+    """(model, session_id|None, signature_name) lifted from the wire
+    bytes without deserializing payload tensors; the forwarded bytes
+    stay untouched. Unparseable requests route stateless under model ""
+    — the backend will answer INVALID_ARGUMENT with full fidelity."""
+    try:
+        return _scan_routing_info(
+            memoryview(request_bytes),
+            multi_inference=(method == "MultiInference"),
+            predict=(method == "Predict"))
+    except Exception:  # noqa: BLE001 - malformed bytes still get routed
+        return "", None, ""
+
+
+def _scan_routing_info(data, *, multi_inference: bool,
+                       predict: bool) -> tuple[str, Optional[bytes], str]:
+    model = signature = ""
+    session_id: Optional[bytes] = None
+    saw_task = False
+    for field, wire_type, value in _iter_fields(data):
+        if field == 1 and wire_type == 2:
+            if multi_inference:
+                if saw_task:
+                    continue  # route by the FIRST task, like the parse did
+                saw_task = True
+                for tfield, twt, tvalue in _iter_fields(value):
+                    if tfield == 1 and twt == 2:
+                        model, signature = _scan_model_spec(tvalue)
+            else:
+                model, signature = _scan_model_spec(value)
+        elif field == 2 and wire_type == 2 and predict and \
+                session_id is None:
+            entry_key = entry_value = None
+            for efield, ewt, evalue in _iter_fields(value):
+                if ewt != 2:
+                    continue
+                if efield == 1:
+                    entry_key = bytes(evalue)
+                elif efield == 2:
+                    entry_value = evalue
+            if entry_key == b"session_id" and entry_value is not None:
+                session_id = _scan_session_tensor(entry_value)
+    return model, session_id, signature
+
+
+class GrpcProxy:
+    """Generic raw-bytes handlers for the three serving services plus
+    the router's own grpc.health.v1."""
+
+    def __init__(self, core: RouterCore,
+                 default_timeout_s: float = 60.0):
+        self._core = core
+        self._default_timeout_s = default_timeout_s
+
+    # -- forwarding ----------------------------------------------------------
+
+    def _forward(self, backend: Backend, full_method: str,
+                 request_bytes: bytes, context,
+                 on_rpc_error=None) -> bytes:
+        """`on_rpc_error(code)` runs before the abort with the BACKEND'S
+        status code — the caller's chance to undo routing side effects
+        selectively (the abort exception itself carries no code)."""
+        import grpc
+
+        channel = self._core.channels.get(backend)
+        call = channel.unary_unary(full_method)  # None serializers: bytes
+        timeout = context.time_remaining()
+        if timeout is None:
+            timeout = self._default_timeout_s
+        try:
+            response = call(request_bytes, timeout=timeout,
+                            metadata=_forwardable_metadata(context))
+        except grpc.RpcError as err:
+            code = err.code()
+            unreachable = code in (grpc.StatusCode.UNAVAILABLE,
+                                   grpc.StatusCode.DEADLINE_EXCEEDED)
+            self._core.note_result(backend, full_method,
+                                   error_code=code.name,
+                                   unreachable=unreachable)
+            if on_rpc_error is not None:
+                on_rpc_error(code)
+            context.abort(code, err.details() or code.name)
+        self._core.note_result(backend, full_method)
+        return response
+
+    def _handle(self, service: str, method: str,
+                request_bytes: bytes, context) -> bytes:
+        full_method = f"/{_PKG}.{service}/{method}"
+        try:
+            model, session_id, signature = routing_info(
+                service, method, request_bytes)
+            decision = self._core.route(model, session_id, request_bytes)
+        except ServingError as exc:
+            context.abort(to_grpc_code(exc.code), exc.message)
+        except Exception as exc:  # noqa: BLE001 - mapped onto the wire
+            err = error_from_exception(exc)
+            context.abort(to_grpc_code(err.code), err.message)
+        on_rpc_error = None
+        if decision.fresh_pin:
+            import grpc
+
+            def on_rpc_error(code):
+                # Roll the brand-new pin back ONLY when the failure
+                # proves non-delivery (connection-level UNAVAILABLE): a
+                # DEADLINE_EXCEEDED init may have succeeded server-side,
+                # and un-pinning then would strand that orphan session
+                # unreachable behind the router.
+                if code == grpc.StatusCode.UNAVAILABLE:
+                    self._core.sessions.release(model, session_id)
+
+        response = self._forward(decision.backend, full_method,
+                                 request_bytes, context,
+                                 on_rpc_error=on_rpc_error)
+        if session_id is not None and \
+                signature == _SESSION_CLOSE_SIGNATURE:
+            self._core.session_closed(model, session_id)
+        return response
+
+    def _broadcast_reload(self, request_bytes: bytes, context) -> bytes:
+        """Config must apply fleet-wide: forward to every backend that is
+        not DEAD; reply with the first backend-reported error, else the
+        last OK. A backend that fails mid-broadcast does not veto the
+        others — its failure is reported as the reply only when NO
+        backend answered."""
+        import grpc
+
+        targets = [b for b in self._core.membership.backends()
+                   if self._core.membership.state_of(b.backend_id) != DEAD]
+        if not targets:
+            context.abort(grpc.StatusCode.UNAVAILABLE,
+                          "no reachable backends for config reload")
+        full_method = f"/{_PKG}.ModelService/HandleReloadConfigRequest"
+        # EVERY backend is sent the reload before any reply is chosen —
+        # an early return on the first error would leave the tail of the
+        # fleet on the old config while the head already applied the new
+        # one (exactly the divergence a broadcast exists to prevent).
+        last_ok: Optional[bytes] = None
+        first_error: Optional[bytes] = None
+        first_failure: Optional[tuple] = None
+        for backend in targets:
+            # Per-backend deadline from what the CLIENT has left: 0.0 is
+            # a real (expired) deadline, not "use the default" — keep
+            # grinding through the fleet after the caller gave up and
+            # each forward would burn a fresh 60s against slow backends.
+            remaining = context.time_remaining()
+            if remaining is None:
+                remaining = self._default_timeout_s
+            elif remaining <= 0:
+                context.abort(grpc.StatusCode.DEADLINE_EXCEEDED,
+                              "client deadline expired mid-broadcast")
+            channel = self._core.channels.get(backend)
+            call = channel.unary_unary(full_method)
+            try:
+                response = call(request_bytes, timeout=remaining,
+                                metadata=_forwardable_metadata(context))
+            except grpc.RpcError as err:
+                code = err.code()
+                self._core.note_result(
+                    backend, full_method, error_code=code.name,
+                    unreachable=code in (
+                        grpc.StatusCode.UNAVAILABLE,
+                        grpc.StatusCode.DEADLINE_EXCEEDED))
+                if first_failure is None:
+                    first_failure = (code, err.details() or code.name,
+                                     backend.backend_id)
+                continue
+            self._core.note_result(backend, full_method)
+            try:
+                parsed = apis.ReloadConfigResponse.FromString(response)
+            except Exception:  # noqa: BLE001 - treat unparseable as OK-ish
+                parsed = None
+            if parsed is not None and parsed.status.error_code != 0:
+                if first_error is None:
+                    first_error = response
+            else:
+                last_ok = response
+        if first_error is not None:
+            return first_error  # first backend-REPORTED error wins the reply
+        if last_ok is None:
+            code, details, backend_id = first_failure
+            context.abort(code, f"config reload failed against every "
+                                f"backend (first: {backend_id}: {details})")
+        return last_ok
+
+    # -- registration --------------------------------------------------------
+
+    def generic_handlers(self):
+        import grpc
+
+        handlers = []
+        for service, methods in SERVICE_SCHEMAS.items():
+            method_handlers = {}
+            for method in methods:
+                if (service, method) == ("ModelService",
+                                         "HandleReloadConfigRequest"):
+                    fn = self._broadcast_reload
+                else:
+                    def fn(request_bytes, context,
+                           _service=service, _method=method):
+                        return self._handle(_service, _method,
+                                            request_bytes, context)
+                method_handlers[method] = grpc.unary_unary_rpc_method_handler(
+                    fn, request_deserializer=None,  # raw bytes in
+                    response_serializer=None)       # raw bytes out
+            handlers.append(grpc.method_handlers_generic_handler(
+                f"{_PKG}.{service}", method_handlers))
+        handlers.append(self._health_handler())
+        return handlers
+
+    def _health_handler(self):
+        """grpc.health.v1 for the SERVICE: "" = any LIVE backend;
+        "<model>" = some LIVE backend reports it AVAILABLE (from the
+        polled readyz payloads)."""
+        import grpc
+
+        from min_tfs_client_tpu.observability.health import (
+            _NOT_SERVING,
+            _SERVING,
+            _encode_status,
+            _parse_service,
+        )
+
+        def check(request_bytes, context):
+            service = _parse_service(request_bytes)
+            if service is None:
+                context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                              "malformed HealthCheckRequest")
+            if not service:
+                return _encode_status(
+                    _SERVING if self._core.ready() else _NOT_SERVING)
+            available = self._core.membership.model_available(service)
+            if available is None:
+                context.abort(grpc.StatusCode.NOT_FOUND,
+                              "unknown service for health check")
+            return _encode_status(_SERVING if available else _NOT_SERVING)
+
+        return grpc.method_handlers_generic_handler(
+            "grpc.health.v1.Health",
+            {"Check": grpc.unary_unary_rpc_method_handler(
+                check, request_deserializer=None,
+                response_serializer=None)})
+
+
+# -- REST data plane ---------------------------------------------------------
+
+ROUTER_PAYLOAD_PATH = "/monitoring/router"
+
+# Request headers forwarded to the backend (everything else is
+# hop-by-hop or transport-owned).
+_REST_FORWARD_HEADERS = ("Content-Type", "Content-Encoding",
+                         "Accept-Encoding")
+
+
+def rest_route_request(core: RouterCore, method: str, path: str,
+                       body_bytes: bytes,
+                       headers) -> tuple[int, str, bytes]:
+    """Transport-independent REST router: local /monitoring answers, or
+    a verbatim /v1 forward to the chosen backend's REST port."""
+    from min_tfs_client_tpu.server import rest as rest_mod
+
+    bare, _, _query = path.partition("?")
+    if method == "GET" and bare == ROUTER_PAYLOAD_PATH:
+        return 200, "application/json", json.dumps(
+            core.snapshot()).encode()
+    if method == "GET" and bare == rest_mod.HEALTHZ_PATH:
+        ok = core.membership.poll_thread_alive()
+        return ((200 if ok else 503), "application/json",
+                json.dumps({"ok": ok, "checks":
+                            {"membership_poll": ok}}).encode())
+    if method == "GET" and bare == rest_mod.READYZ_PATH:
+        ready = core.ready()
+        return ((200 if ready else 503), "application/json", json.dumps(
+            {"ready": ready,
+             "reasons": [] if ready else ["no live backends"]}).encode())
+    if method == "GET" and bare == rest_mod.PROMETHEUS_DEFAULT_PATH:
+        from min_tfs_client_tpu.server.metrics import prometheus_text
+
+        return 200, "text/plain; version=0.0.4", prometheus_text().encode()
+    if not bare.startswith("/v1/"):
+        return 404, "application/json", json.dumps(
+            {"error": f"Malformed request: {method} {path}"}).encode()
+    return _rest_forward(core, method, path, body_bytes, headers)
+
+
+def _rest_forward(core: RouterCore, method: str, path: str,
+                  body_bytes: bytes, headers) -> tuple[int, str, bytes]:
+    from min_tfs_client_tpu.router import ring as ring_mod
+
+    match = (rest_mod_model(path) or "")
+    routing_id = ring_mod.request_fingerprint(
+        method.encode() + b"\x00" + path.encode() + b"\x00" + body_bytes)
+    try:
+        backend = _rest_backend(core, match, routing_id)
+    except ServingError as exc:
+        return 503, "application/json", json.dumps(
+            {"error": exc.message}).encode()
+    fwd_headers = {}
+    for key in _REST_FORWARD_HEADERS:
+        value = headers.get(key) if headers is not None else None
+        if value:
+            fwd_headers[key] = value
+    conn = http.client.HTTPConnection(backend.host, backend.rest_port,
+                                      timeout=60)
+    try:
+        conn.request(method, path, body=body_bytes or None,
+                     headers=fwd_headers)
+        resp = conn.getresponse()
+        data = resp.read()
+        # Backend error REPLIES count like the gRPC path counts
+        # non-OK statuses — a REST-only outage must move
+        # router_backend_errors, not just the unreachable case.
+        core.note_result(backend, "rest",
+                         error_code=(str(resp.status)
+                                     if resp.status >= 400 else None))
+        return (resp.status,
+                resp.getheader("Content-Type", "application/json"), data)
+    except (OSError, http.client.HTTPException) as exc:
+        core.note_result(backend, "rest", error_code="UNREACHABLE",
+                         unreachable=True)
+        return 503, "application/json", json.dumps(
+            {"error": f"backend {backend.backend_id} unreachable over "
+                      f"REST: {exc}"}).encode()
+    finally:
+        conn.close()
+
+
+def rest_mod_model(path: str) -> Optional[str]:
+    from min_tfs_client_tpu.server import rest as rest_mod
+
+    for pattern in (rest_mod._METADATA_PATH, rest_mod._MODEL_PATH):
+        match = pattern.match(path.partition("?")[0])
+        if match:
+            return match.group("model")
+    return None
+
+
+def _rest_backend(core: RouterCore, model: str,
+                  routing_id: bytes) -> Backend:
+    """REST routes statelessly (the sessioned surface is gRPC Predict;
+    docs/ROUTING.md) and only over live backends that HAVE a REST
+    port."""
+    from min_tfs_client_tpu.router import ring as ring_mod
+
+    candidates = []
+    for backend_id in core.membership.live_ids():
+        backend = core.membership.backend(backend_id)
+        if backend is not None and backend.rest_port:
+            candidates.append(backend_id)
+    if not candidates:
+        raise ServingError.unavailable(
+            "no live backends with a REST port")
+    chosen = ring_mod.assign(ring_mod.ring_key(model, routing_id),
+                             candidates)
+    return core.membership.backend(chosen)

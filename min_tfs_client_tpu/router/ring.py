@@ -1,0 +1,93 @@
+"""Consistent hashing for the routing tier: rendezvous (highest-random-
+weight) hashing over FarmHash Fingerprint64.
+
+Why rendezvous rather than a vnode token ring: the rebalance bound is a
+theorem, not a tuning outcome. For every key the ring scores each backend
+with `Fingerprint64(key || backend)` and picks the max, so
+
+ * the assignment is a pure function of (key, backend set) — identical
+   across processes, restarts, and router replicas (the fingerprint is
+   the frozen farmhash contract `utils/farmhash.py`, the same hash the
+   serving path uses for StringToHashBucketFast);
+ * when a backend LEAVES, exactly the keys it owned move (every other
+   key's argmax is untouched);
+ * when a backend JOINS, the only keys that move are those the joiner
+   now wins — every move is TO the new backend, ~K/N of them in
+   expectation.
+
+Keys are `(model, routing-id)` pairs; the routing-id is a session id for
+sessioned traffic (stickiness then comes from the session table, which
+overrides the ring for pinned sessions) or the request fingerprint for
+stateless traffic (identical requests land on the same backend's warm
+caches).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+from min_tfs_client_tpu.utils.farmhash import fingerprint64
+
+# Fixed probe keyspace for the occupancy gauge: big enough that a 3-10
+# backend fleet's shares resolve to ~1%, small enough to recompute on
+# every membership flip without showing up in a profile.
+OCCUPANCY_PROBES = 1024
+
+
+# Stateless requests route by a fingerprint of their bytes. Hashing the
+# WHOLE body would re-introduce the O(bytes) per-request cost the data
+# plane's wire scanner exists to avoid (the fingerprint is pure Python),
+# so the fingerprint samples a bounded head + tail + the exact length —
+# deterministic across router replicas, still separating any two
+# requests that differ in size or anywhere near either end (tensor
+# payload differences overwhelmingly do).
+FINGERPRINT_SAMPLE_BYTES = 4096
+
+
+def request_fingerprint(data: bytes) -> bytes:
+    if len(data) <= 2 * FINGERPRINT_SAMPLE_BYTES:
+        sample = data
+    else:
+        sample = (bytes(data[:FINGERPRINT_SAMPLE_BYTES])
+                  + bytes(data[-FINGERPRINT_SAMPLE_BYTES:]))
+    return b"%016x" % fingerprint64(
+        len(data).to_bytes(8, "little") + sample)
+
+
+def ring_key(model: str, routing_id: bytes | str) -> bytes:
+    """The hashed key for one request: model and routing-id are length-
+    prefixed so ("ab","c") can never collide with ("a","bc")."""
+    m = model.encode("utf-8") if isinstance(model, str) else bytes(model)
+    r = (routing_id.encode("utf-8") if isinstance(routing_id, str)
+         else bytes(routing_id))
+    return len(m).to_bytes(4, "little") + m + r
+
+
+def assign(key: bytes, backends: Sequence[str]) -> str | None:
+    """The backend that owns `key` among `backends` (ids are opaque
+    strings, conventionally "host:grpc_port"). None when the fleet is
+    empty. Ties (a 2^-64 event) break by backend id so the choice stays
+    total and deterministic."""
+    best_id: str | None = None
+    best_score = -1
+    for backend in backends:
+        score = fingerprint64(key + b"|" + backend.encode("utf-8"))
+        if score > best_score or (score == best_score
+                                  and (best_id is None
+                                       or backend < best_id)):
+            best_id, best_score = backend, score
+    return best_id
+
+
+def occupancy(backends: Sequence[str],
+              probes: int = OCCUPANCY_PROBES) -> dict[str, float]:
+    """Share of a fixed probe keyspace each backend owns (sums to 1.0);
+    the `router_ring_occupancy` gauge and the /monitoring/router
+    payload's balance evidence."""
+    counts = {b: 0 for b in backends}
+    if not backends:
+        return {}
+    for i in range(probes):
+        owner = assign(ring_key("", b"probe:%d" % i), backends)
+        counts[owner] += 1
+    return {b: counts[b] / probes for b in backends}

@@ -1,0 +1,108 @@
+"""The stickiness table: (model, session-id) -> backend.
+
+A decode session's KV cache lives in exactly one server process
+(servables/decode_sessions.py), so the ring alone cannot route it: ring
+assignments move when membership changes, but a session physically
+cannot. The table pins a session to the backend that served its
+decode_init and overrides the ring for every later request carrying that
+session id — including while that backend DRAINS (new sessions stop, the
+pinned ones finish).
+
+Entries leave three ways: the session's decode_close forwards
+successfully, the backend dies (the membership table's on_dead drops
+every session pinned there — the state is gone, re-routing would only
+manufacture NOT_FOUNDs), or the idle TTL expires (a client that vanished
+mid-stream must not leak table entries forever; the backend's own store
+expires the HBM side independently).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+
+
+@dataclass
+class _Pin:
+    backend_id: str       # guarded_by: SessionTable._lock
+    last_used_s: float    # guarded_by: SessionTable._lock
+
+
+class SessionTable:
+    def __init__(self, idle_timeout_s: float = 3600.0):
+        self.idle_timeout_s = idle_timeout_s
+        self._lock = threading.Lock()
+        self._pins: dict[tuple[str, bytes], _Pin] = {}  # guarded_by: self._lock
+
+    @staticmethod
+    def key(model: str, session_id: bytes) -> tuple[str, bytes]:
+        return (model, bytes(session_id))
+
+    def lookup(self, model: str, session_id: bytes) -> str | None:
+        """The pinned backend id, refreshing the idle clock; None when
+        the session is unknown (new, expired, or dropped)."""
+        with self._lock:
+            pin = self._pins.get(self.key(model, session_id))
+            if pin is None:
+                return None
+            pin.last_used_s = time.monotonic()
+            return pin.backend_id
+
+    def pin(self, model: str, session_id: bytes, backend_id: str) -> None:
+        with self._lock:
+            self._pins[self.key(model, session_id)] = _Pin(
+                backend_id, time.monotonic())
+
+    def pin_if_absent(self, model: str, session_id: bytes,
+                      backend_id: str) -> tuple[str, bool]:
+        """Atomic first-writer-wins pin: returns (winning backend id,
+        we_pinned). Concurrent duplicate first-requests for one session
+        then agree on a single owner instead of the loser clobbering
+        (or later un-pinning) the winner's assignment."""
+        key = self.key(model, session_id)
+        with self._lock:
+            existing = self._pins.get(key)
+            if existing is not None:
+                existing.last_used_s = time.monotonic()
+                return existing.backend_id, False
+            self._pins[key] = _Pin(backend_id, time.monotonic())
+            return backend_id, True
+
+    def release(self, model: str, session_id: bytes) -> bool:
+        with self._lock:
+            return self._pins.pop(self.key(model, session_id),
+                                  None) is not None
+
+    def drop_backend(self, backend_id: str) -> int:
+        """Forget every session pinned to a dead backend; returns how
+        many were lost (their next request gets UNAVAILABLE and the
+        caller starts over — the KV state died with the process)."""
+        with self._lock:
+            doomed = [k for k, pin in self._pins.items()
+                      if pin.backend_id == backend_id]
+            for k in doomed:
+                del self._pins[k]
+            return len(doomed)
+
+    def evict_idle(self) -> int:
+        """Drop pins idle past the TTL (called from the membership poll
+        tick — no extra thread)."""
+        cutoff = time.monotonic() - self.idle_timeout_s
+        with self._lock:
+            stale = [k for k, pin in self._pins.items()
+                     if pin.last_used_s < cutoff]
+            for k in stale:
+                del self._pins[k]
+            return len(stale)
+
+    def count_by_backend(self) -> dict[str, int]:
+        with self._lock:
+            counts: dict[str, int] = {}
+            for pin in self._pins.values():
+                counts[pin.backend_id] = counts.get(pin.backend_id, 0) + 1
+            return counts
+
+    def size(self) -> int:
+        with self._lock:
+            return len(self._pins)

@@ -162,6 +162,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="directory for flight-recorder JSON dumps "
                         "(first INTERNAL error / SIGUSR2); empty = "
                         "TPU_SERVING_FLIGHT_DIR or the system tempdir")
+    p.add_argument("--drain_grace_seconds", type=float, default=0.0,
+                   help="graceful-drain window on stop()/SIGTERM: the "
+                        "health plane flips NOT_SERVING immediately, "
+                        "then serving stays up this long while live "
+                        "decode sessions finish (their KV state pins "
+                        "them to this process; docs/ROUTING.md). 0 = "
+                        "flip and stop without waiting for sessions")
     p.add_argument("--version", action="store_true",
                    help="print the server version and exit")
     return p
@@ -218,7 +225,30 @@ def options_from_args(args) -> ServerOptions:
         slo_window_seconds=args.slo_window_seconds,
         slo_shed_burn_rate=args.slo_shed_burn_rate,
         flight_recorder_dir=args.flight_recorder_dir,
+        drain_grace_seconds=args.drain_grace_seconds,
     )
+
+
+def install_sigterm_handler(server: Server) -> None:
+    """SIGTERM = graceful drain (the k8s/pod-eviction contract): flip
+    NOT_SERVING first, wait out live decode sessions up to
+    --drain_grace_seconds, then stop. The actual stop runs on a worker
+    thread — signal handlers must return promptly, and Server.stop can
+    legitimately block for the whole drain window."""
+    import signal
+    import threading
+
+    def _on_sigterm(signum, frame):
+        # NON-daemon: wait_for_termination() returns the moment the gRPC
+        # server stops, and main() returning must not let the
+        # interpreter kill this thread before the REST shutdown and
+        # core.stop() (model unload, manager teardown) finish — the
+        # interpreter joins non-daemon threads on exit. Server.stop's
+        # waits are internally bounded, so this cannot wedge shutdown.
+        threading.Thread(target=server.stop, name="sigterm-drain",
+                         daemon=False).start()
+
+    signal.signal(signal.SIGTERM, _on_sigterm)
 
 
 def main(argv=None) -> int:
@@ -242,6 +272,7 @@ def main(argv=None) -> int:
 
         jax.config.update("jax_platforms", plat)
     server = Server(options_from_args(args)).build_and_start()
+    install_sigterm_handler(server)
     ports = f"gRPC on {server.grpc_port}"
     if getattr(server, "rest_port", None):
         ports += f", REST on {server.rest_port}"

@@ -227,6 +227,41 @@ request_log_count = Counter(
     "(logged | sampled_out | dropped).", ("model", "outcome"))
 
 
+# -- routing-tier metrics (min_tfs_client_tpu/router/; docs/ROUTING.md) ------
+router_backend_requests = Counter(
+    ":tpu/serving/router_backend_requests",
+    "Requests the router forwarded, by backend and gRPC method (or "
+    "'rest' for proxied HTTP).", ("backend", "method"))
+router_backend_errors = Counter(
+    ":tpu/serving/router_backend_errors",
+    "Forwarded requests that came back as errors (or failed to reach "
+    "the backend at all), by backend and status code.",
+    ("backend", "code"))
+router_backend_ejections = Counter(
+    ":tpu/serving/router_backend_ejections",
+    "Backend removals from the new-work rotation, by backend and kind "
+    "(drain = health answered NOT_SERVING; dead = health plane "
+    "unreachable).", ("backend", "kind"))
+router_ring_occupancy = Gauge(
+    ":tpu/serving/router_ring_occupancy",
+    "Share of a fixed probe keyspace the hash ring currently assigns to "
+    "each live backend (sums to ~1.0 across the fleet).", ("backend",))
+router_sticky_sessions = Gauge(
+    ":tpu/serving/router_sticky_sessions",
+    "Sessions pinned to each backend in the router's stickiness table.",
+    ("backend",))
+router_live_backends = Gauge(
+    ":tpu/serving/router_live_backends",
+    "Backends currently in the new-work rotation (state LIVE).", ())
+
+
+def gauge_total(gauge: Gauge) -> float:
+    """Sum of a gauge over all label combinations (e.g. live decode
+    sessions across every model) — the drain loop's one read."""
+    with gauge._lock:
+        return float(sum(gauge._cells.values()))
+
+
 def safe_set(gauge: Gauge, value: float, *labels) -> None:
     """Set a gauge without ever letting metrics break serving (the one
     place the swallow-everything policy lives)."""

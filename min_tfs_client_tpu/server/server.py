@@ -14,6 +14,7 @@ import logging
 import os
 import pathlib
 import threading
+import time
 from concurrent import futures
 from dataclasses import dataclass, field
 from typing import Optional
@@ -149,6 +150,13 @@ class ServerOptions:
     # Flight-recorder dump directory ("" = TPU_SERVING_FLIGHT_DIR env or
     # the system tempdir).
     flight_recorder_dir: str = ""
+    # Graceful drain (docs/ROUTING.md "Drain semantics"): on stop()/
+    # SIGTERM the health plane flips NOT_SERVING immediately, then the
+    # server keeps serving for up to this many seconds while live decode
+    # sessions finish — their KV state is pinned to this process, so a
+    # router cannot move them; it can only stop sending NEW sessions.
+    # 0 = flip and stop without waiting for sessions (old behavior).
+    drain_grace_seconds: float = 0.0
 
     def effective_inter_op_parallelism(self) -> int:
         """<= 0 = auto (leave grpc_max_threads alone; TF spells auto as
@@ -403,8 +411,21 @@ class Server:
     def wait_for_termination(self) -> None:
         self._grpc_server.wait_for_termination()
 
-    def stop(self, grace: float = 5.0) -> None:
+    def stop(self, grace: float = 5.0,
+             drain_grace: Optional[float] = None) -> None:
+        # Drain contract (docs/ROUTING.md): flip the health plane to
+        # NOT_SERVING FIRST — before any in-flight work is waited out —
+        # so routers polling readyz/grpc.health stop sending new traffic
+        # during the grace window instead of discovering the corpse.
+        from min_tfs_client_tpu.observability import health
+
+        if self.core is not None:
+            health.mark_draining(self.core)
         self._config_poll_stop.set()
+        dg = (self.options.drain_grace_seconds if drain_grace is None
+              else drain_grace)
+        if dg > 0:
+            self._await_session_drain(dg)
         if self._grpc_server is not None:
             # Bounded (servelint DL003): grpc's stop() event fires when
             # in-flight RPCs finish, but a handler wedged on a sick
@@ -416,6 +437,28 @@ class Server:
             self._rest_server.shutdown()
         if self.core is not None:
             self.core.stop()
+
+    def _await_session_drain(self, drain_grace: float) -> None:
+        """Keep the full serving surface up until every live decode
+        session closes (their HBM state cannot move to another replica)
+        or the drain grace expires. Routed fleets stop sending new
+        sessions the moment the health plane flipped above; in-flight
+        sessions keep stepping against this process until they finish.
+
+        Reads the process-global decode_session_count gauge: with more
+        than one Server in a process (tests) another server's sessions
+        extend this wait — bounded by drain_grace either way."""
+        from min_tfs_client_tpu.server import metrics
+
+        deadline = time.monotonic() + drain_grace
+        while time.monotonic() < deadline:
+            if metrics.gauge_total(metrics.decode_session_count) <= 0:
+                return
+            time.sleep(0.05)
+        logging.getLogger(__name__).warning(
+            "drain grace %.1fs expired with %d decode session(s) still "
+            "live; proceeding with shutdown", drain_grace,
+            int(metrics.gauge_total(metrics.decode_session_count)))
 
 
 def _parse_mesh_axes(spec: str) -> dict[str, int]:

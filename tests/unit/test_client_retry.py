@@ -1,0 +1,112 @@
+"""Client SDK retry satellite: opt-in bounded retry with exponential
+backoff + jitter on UNAVAILABLE, idempotent Predict only, OFF by
+default — so a router-side backend eject is invisible to callers
+without ever double-stepping a decode session."""
+
+import time
+
+import grpc
+import numpy as np
+import pytest
+
+from min_tfs_client_tpu.client import TensorServingClient
+
+
+class FakeUnavailable(grpc.RpcError):
+    def code(self):
+        return grpc.StatusCode.UNAVAILABLE
+
+    def details(self):
+        return "planted"
+
+
+class FakeInternal(grpc.RpcError):
+    def code(self):
+        return grpc.StatusCode.INTERNAL
+
+    def details(self):
+        return "planted"
+
+
+class FlakyCall:
+    """Fails `failures` times with `error`, then answers."""
+
+    def __init__(self, failures, error=None):
+        self.failures = failures
+        self.error = error or FakeUnavailable()
+        self.attempts = 0
+
+    def __call__(self, request, timeout):
+        self.attempts += 1
+        if self.attempts <= self.failures:
+            raise self.error
+        return "ok"
+
+
+def make_client(**kw):
+    # 127.0.0.1:1 never answers; these tests exercise the retry wrapper
+    # directly, the channel is inert.
+    return TensorServingClient("127.0.0.1", 1, **kw)
+
+
+class TestRetryWrapper:
+    def test_off_by_default(self):
+        client = make_client()
+        call = FlakyCall(failures=1)
+        with pytest.raises(grpc.RpcError):
+            client._call_idempotent(call, None, 1)
+        assert call.attempts == 1
+
+    def test_opt_in_retries_unavailable(self):
+        client = make_client(retry_unavailable=True, max_retries=3,
+                             retry_backoff_s=0.001)
+        call = FlakyCall(failures=2)
+        assert client._call_idempotent(call, None, 1) == "ok"
+        assert call.attempts == 3
+
+    def test_bounded_then_propagates(self):
+        client = make_client(retry_unavailable=True, max_retries=2,
+                             retry_backoff_s=0.001)
+        call = FlakyCall(failures=10)
+        with pytest.raises(grpc.RpcError):
+            client._call_idempotent(call, None, 1)
+        assert call.attempts == 3  # 1 try + 2 retries, never more
+
+    def test_other_codes_never_retried(self):
+        client = make_client(retry_unavailable=True, max_retries=3,
+                             retry_backoff_s=0.001)
+        call = FlakyCall(failures=1, error=FakeInternal())
+        with pytest.raises(grpc.RpcError):
+            client._call_idempotent(call, None, 1)
+        assert call.attempts == 1
+
+    def test_backoff_grows_but_is_capped(self):
+        client = make_client(retry_unavailable=True, max_retries=4,
+                             retry_backoff_s=0.01,
+                             retry_backoff_max_s=0.02)
+        call = FlakyCall(failures=4)
+        start = time.monotonic()
+        assert client._call_idempotent(call, None, 1) == "ok"
+        elapsed = time.monotonic() - start
+        # full jitter in [0, min(cap, base*2^k)]: worst case
+        # 0.01+0.02+0.02+0.02 = 0.07s; generous ceiling for slow boxes
+        assert elapsed < 2.0
+
+
+class TestIdempotenceGate:
+    def test_plain_predict_is_idempotent(self):
+        assert TensorServingClient._predict_is_idempotent(
+            None, {"x": np.zeros(1)})
+        assert TensorServingClient._predict_is_idempotent(
+            "serving_default", {"x": np.zeros(1)})
+
+    def test_decode_signatures_are_not(self):
+        for signature in ("decode_init", "decode_step", "decode_close"):
+            assert not TensorServingClient._predict_is_idempotent(
+                signature, {"session_id": np.asarray(b"s", object)})
+
+    def test_session_id_input_is_not(self):
+        # even under a custom signature name, carrying session state
+        # means re-running mutates it
+        assert not TensorServingClient._predict_is_idempotent(
+            "my_stateful_sig", {"session_id": np.asarray(b"s", object)})

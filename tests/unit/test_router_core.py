@@ -1,0 +1,466 @@
+"""Routing-tier control plane, no sockets: membership transitions fed by
+planted pollers, drain-aware routing, session stickiness/loss, and the
+raw-bytes routing-key parser."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from min_tfs_client_tpu.protos import tfs_apis_pb2 as apis
+from min_tfs_client_tpu.router import proxy as proxy_mod
+from min_tfs_client_tpu.router.core import RouterCore
+from min_tfs_client_tpu.router.membership import (
+    DEAD,
+    DRAINING,
+    LIVE,
+    NOT_SERVING,
+    SERVING,
+    UNKNOWN,
+    UNREACHABLE,
+    Backend,
+    MembershipTable,
+    parse_backend,
+    parse_backends,
+)
+from min_tfs_client_tpu.router.sessions import SessionTable
+from min_tfs_client_tpu.tensor.codec import ndarray_to_tensor_proto
+from min_tfs_client_tpu.utils.status import Code, ServingError
+
+B1 = Backend("127.0.0.1", 18500, 18501)
+B2 = Backend("127.0.0.1", 18502, 18503)
+B3 = Backend("127.0.0.1", 18504)
+
+
+class PlantedPoller:
+    """Scripted health plane: verdicts flip per backend at will."""
+
+    def __init__(self, backends, verdict=SERVING):
+        self.verdicts = {b.backend_id: verdict for b in backends}
+        self.payloads = {}
+
+    def __call__(self, backend):
+        return (self.verdicts[backend.backend_id],
+                self.payloads.get(backend.backend_id))
+
+
+def make_core(backends=(B1, B2, B3), verdict=SERVING, **kw):
+    poller = PlantedPoller(backends, verdict)
+    core = RouterCore(list(backends), poll_interval_s=0.05,
+                      probe_timeout_s=0.1, poller=poller, **kw)
+    return core, poller
+
+
+class TestBackendParsing:
+    def test_with_and_without_rest_port(self):
+        assert parse_backend("h:8500").rest_port is None
+        b = parse_backend("h:8500:8501")
+        assert (b.host, b.grpc_port, b.rest_port) == ("h", 8500, 8501)
+
+    def test_malformed_and_duplicates_rejected(self):
+        with pytest.raises(ServingError):
+            parse_backend("nonsense")
+        with pytest.raises(ServingError):
+            parse_backends("h:1,h:1")
+        with pytest.raises(ServingError):
+            parse_backends("  ,  ")
+
+
+class TestMembershipTransitions:
+    def test_boot_unknown_until_polled(self):
+        core, poller = make_core()
+        assert core.membership.state_of(B1.backend_id) == UNKNOWN
+        assert core.membership.live_ids() == []
+        core.membership.poll_once()
+        assert core.membership.live_ids() == sorted(
+            b.backend_id for b in (B1, B2, B3))
+
+    def test_not_serving_drains_within_one_poll(self):
+        core, poller = make_core()
+        core.membership.poll_once()
+        poller.verdicts[B2.backend_id] = NOT_SERVING
+        states = core.membership.poll_once()
+        assert states[B2.backend_id] == DRAINING
+        assert B2.backend_id not in core.membership.live_ids()
+
+    def test_unreachable_dead_within_one_poll_at_threshold_one(self):
+        """The planted-failure contract the ISSUE pins: a dead backend
+        is ejected within ONE poll interval (eject_after_failures=1)."""
+        core, poller = make_core()
+        core.membership.poll_once()
+        poller.verdicts[B3.backend_id] = UNREACHABLE
+        states = core.membership.poll_once()
+        assert states[B3.backend_id] == DEAD
+
+    def test_eject_threshold_tolerates_flaky_probe(self):
+        core, poller = make_core(eject_after_failures=2)
+        core.membership.poll_once()
+        poller.verdicts[B1.backend_id] = UNREACHABLE
+        assert core.membership.poll_once()[B1.backend_id] == LIVE
+        assert core.membership.poll_once()[B1.backend_id] == DEAD
+        poller.verdicts[B1.backend_id] = SERVING
+        assert core.membership.poll_once()[B1.backend_id] == LIVE
+
+    def test_dead_backend_ejected_within_interval_with_live_thread(self):
+        core, poller = make_core()
+        core.start()
+        try:
+            deadline = time.monotonic() + 2.0
+            while time.monotonic() < deadline and \
+                    len(core.membership.live_ids()) < 3:
+                time.sleep(0.01)
+            poller.verdicts[B1.backend_id] = UNREACHABLE
+            t0 = time.monotonic()
+            while core.membership.state_of(B1.backend_id) != DEAD:
+                assert time.monotonic() - t0 < 1.0, \
+                    "not ejected within budget"
+                time.sleep(0.005)
+            # one 0.05s interval + one probe; scheduler slack allowed
+            assert time.monotonic() - t0 < 1.0
+        finally:
+            core.stop()
+
+    def test_note_error_triggers_prompt_recheck(self):
+        """A data-plane failure must not wait out a long poll interval:
+        note_error pulses the loop awake."""
+        backends = (B1, B2)
+        poller = PlantedPoller(backends)
+        core = RouterCore(list(backends), poll_interval_s=30.0,
+                          probe_timeout_s=0.1, poller=poller)
+        core.start()
+        try:
+            poller.verdicts[B1.backend_id] = UNREACHABLE
+            core.membership.note_error(B1.backend_id)
+            t0 = time.monotonic()
+            while core.membership.state_of(B1.backend_id) != DEAD:
+                assert time.monotonic() - t0 < 2.0, \
+                    "note_error did not short-circuit the 30s interval"
+                time.sleep(0.005)
+        finally:
+            core.stop()
+
+    def test_ejection_counters(self):
+        from min_tfs_client_tpu.server import metrics
+
+        core, poller = make_core()
+        core.membership.poll_once()
+        drain0 = metrics.router_backend_ejections.value(
+            B1.backend_id, "drain")
+        dead0 = metrics.router_backend_ejections.value(
+            B2.backend_id, "dead")
+        poller.verdicts[B1.backend_id] = NOT_SERVING
+        poller.verdicts[B2.backend_id] = UNREACHABLE
+        core.membership.poll_once()
+        core.membership.poll_once()  # repeated polls must not re-count
+        assert metrics.router_backend_ejections.value(
+            B1.backend_id, "drain") == drain0 + 1
+        assert metrics.router_backend_ejections.value(
+            B2.backend_id, "dead") == dead0 + 1
+
+    def test_readyz_payload_survives_rest_hiccup(self):
+        """gRPC SERVING + readyz timeout polls as (SERVING, None): the
+        cached per-model availability must NOT be wiped, or the router's
+        per-model health would answer NOT_FOUND for a serving model."""
+        core, poller = make_core()
+        poller.payloads[B1.backend_id] = {
+            "ready": True,
+            "models": {"t5": {"available_versions": [1]}}}
+        core.membership.poll_once()
+        assert core.membership.model_available("t5") is True
+        del poller.payloads[B1.backend_id]  # transient REST hiccup
+        core.membership.poll_once()
+        assert core.membership.model_available("t5") is True
+
+    def test_model_available_from_readyz_payloads(self):
+        core, poller = make_core()
+        poller.payloads[B1.backend_id] = {
+            "ready": True,
+            "models": {"t5": {"available_versions": [1]}}}
+        core.membership.poll_once()
+        assert core.membership.model_available("t5") is True
+        assert core.membership.model_available("ghost") is None
+        poller.verdicts[B1.backend_id] = NOT_SERVING
+        core.membership.poll_once()
+        # the only backend advertising t5 left the rotation
+        assert core.membership.model_available("t5") is False
+
+
+class TestSessionTable:
+    def test_pin_lookup_release(self):
+        table = SessionTable()
+        assert table.lookup("m", b"s1") is None
+        table.pin("m", b"s1", "b1")
+        assert table.lookup("m", b"s1") == "b1"
+        assert table.lookup("other", b"s1") is None  # model-scoped keys
+        assert table.release("m", b"s1")
+        assert not table.release("m", b"s1")
+
+    def test_drop_backend(self):
+        table = SessionTable()
+        table.pin("m", b"s1", "b1")
+        table.pin("m", b"s2", "b2")
+        table.pin("m", b"s3", "b1")
+        assert table.drop_backend("b1") == 2
+        assert table.lookup("m", b"s2") == "b2"
+        assert table.count_by_backend() == {"b2": 1}
+
+    def test_idle_ttl_eviction(self):
+        table = SessionTable(idle_timeout_s=0.05)
+        table.pin("m", b"old", "b1")
+        table.pin("m", b"hot", "b1")
+        time.sleep(0.08)
+        assert table.lookup("m", b"hot") == "b1"  # touch refreshes
+        assert table.evict_idle() == 1
+        assert table.lookup("m", b"old") is None
+        assert table.lookup("m", b"hot") == "b1"
+
+
+class TestRouting:
+    def test_stateless_deterministic_and_live_only(self):
+        core, poller = make_core()
+        core.membership.poll_once()
+        payload = b"some-request-bytes"
+        first = core.route("m", None, payload)
+        assert first.fresh_pin is False
+        assert core.route("m", None, payload).backend.backend_id == \
+            first.backend.backend_id
+        poller.verdicts[first.backend.backend_id] = NOT_SERVING
+        core.membership.poll_once()
+        rerouted = core.route("m", None, payload)
+        assert rerouted.backend.backend_id != first.backend.backend_id
+
+    def test_new_sessions_avoid_draining_backend(self):
+        core, poller = make_core()
+        core.membership.poll_once()
+        poller.verdicts[B1.backend_id] = NOT_SERVING
+        core.membership.poll_once()
+        for i in range(40):
+            decision = core.route("m", b"session-%d" % i, b"")
+            assert decision.backend.backend_id != B1.backend_id
+            assert decision.fresh_pin is True
+
+    def test_pinned_session_survives_drain(self):
+        core, poller = make_core()
+        core.membership.poll_once()
+        backend = core.route("m", b"sess-x", b"").backend
+        poller.verdicts[backend.backend_id] = NOT_SERVING
+        core.membership.poll_once()
+        assert core.membership.state_of(backend.backend_id) == DRAINING
+        # sticky: the pinned session keeps flowing to the drainer
+        followup = core.route("m", b"sess-x", b"")
+        assert followup.backend.backend_id == backend.backend_id
+        assert followup.fresh_pin is False
+
+    def test_dead_backend_drops_its_pins(self):
+        """on_dead forgets every session pinned to the corpse; a later
+        request for that id routes as a NEW session to a live backend
+        (which answers NOT_FOUND honestly — the state died)."""
+        core, poller = make_core()
+        core.membership.poll_once()
+        backend = core.route("m", b"sess-y", b"").backend
+        poller.verdicts[backend.backend_id] = UNREACHABLE
+        core.membership.poll_once()  # on_dead drops the pin
+        assert core.sessions.lookup("m", b"sess-y") is None
+        rerouted = core.route("m", b"sess-y", b"").backend
+        assert rerouted.backend_id != backend.backend_id
+        assert core.membership.state_of(rerouted.backend_id) == LIVE
+
+    def test_session_lost_when_pin_outlives_backend(self):
+        """The pin pointing at a DEAD backend (dropped-callback raced)
+        fails UNAVAILABLE and clears."""
+        core, poller = make_core()
+        core.membership.poll_once()
+        backend = core.route("m", b"sess-z", b"").backend
+        poller.verdicts[backend.backend_id] = UNREACHABLE
+        core.membership.poll_once()
+        core.sessions.pin("m", b"sess-z", backend.backend_id)  # re-plant
+        with pytest.raises(ServingError) as err:
+            core.route("m", b"sess-z", b"")
+        assert err.value.code == Code.UNAVAILABLE
+        assert "lost" in err.value.message
+        assert core.sessions.lookup("m", b"sess-z") is None
+
+    def test_no_live_backends_unavailable(self):
+        core, poller = make_core(verdict=UNREACHABLE)
+        core.membership.poll_once()
+        with pytest.raises(ServingError) as err:
+            core.route("m", None, b"x")
+        assert err.value.code == Code.UNAVAILABLE
+
+    def test_session_closed_releases_pin(self):
+        core, poller = make_core()
+        core.membership.poll_once()
+        first = core.route("m", b"s", b"")
+        assert first.fresh_pin is True
+        core.session_closed("m", b"s")
+        assert core.sessions.lookup("m", b"s") is None
+        # a NEW session with the same id re-pins (possibly elsewhere)
+        again = core.route("m", b"s", b"")
+        assert again.backend.backend_id == first.backend.backend_id
+        assert again.fresh_pin is True
+
+    def test_concurrent_first_requests_agree_on_one_owner(self):
+        """pin_if_absent is first-writer-wins: the losing thread of a
+        duplicate first-request follows the winner and is NOT marked
+        fresh (so a failure on its side can't un-pin the winner)."""
+        core, poller = make_core()
+        core.membership.poll_once()
+        winner_id, we_pinned = core.sessions.pin_if_absent(
+            "m", b"race", B2.backend_id)
+        assert (winner_id, we_pinned) == (B2.backend_id, True)
+        loser_id, loser_pinned = core.sessions.pin_if_absent(
+            "m", b"race", B3.backend_id)
+        assert (loser_id, loser_pinned) == (B2.backend_id, False)
+        decision = core.route("m", b"race", b"")
+        assert decision.backend.backend_id == B2.backend_id
+        assert decision.fresh_pin is False
+
+    def test_snapshot_shape(self):
+        core, poller = make_core()
+        core.membership.poll_once()
+        core.route("m", b"snap-sess", b"")
+        snap = core.snapshot()
+        assert snap["ready"] is True
+        assert set(snap["backends"]) == {
+            b.backend_id for b in (B1, B2, B3)}
+        assert abs(sum(snap["ring"]["occupancy"].values()) - 1.0) < 0.01
+        assert snap["sessions"]["total"] == 1
+
+
+class TestRoutingInfoParser:
+    def test_predict_with_session_id(self):
+        request = apis.PredictRequest()
+        request.model_spec.name = "t5"
+        request.model_spec.signature_name = "decode_step"
+        request.inputs["session_id"].CopyFrom(
+            ndarray_to_tensor_proto(np.asarray(b"sess-1", object)))
+        model, sid, signature = proxy_mod.routing_info(
+            "PredictionService", "Predict",
+            request.SerializeToString())
+        assert (model, sid, signature) == ("t5", b"sess-1", "decode_step")
+
+    def test_predict_stateless(self):
+        request = apis.PredictRequest()
+        request.model_spec.name = "resnet"
+        request.inputs["x"].CopyFrom(
+            ndarray_to_tensor_proto(np.zeros((2, 2), np.float32)))
+        model, sid, _ = proxy_mod.routing_info(
+            "PredictionService", "Predict", request.SerializeToString())
+        assert (model, sid) == ("resnet", None)
+
+    def test_multi_inference_uses_first_task(self):
+        request = apis.MultiInferenceRequest()
+        task = request.tasks.add()
+        task.model_spec.name = "native"
+        model, sid, _ = proxy_mod.routing_info(
+            "PredictionService", "MultiInference",
+            request.SerializeToString())
+        assert (model, sid) == ("native", None)
+
+    def test_model_status(self):
+        request = apis.GetModelStatusRequest()
+        request.model_spec.name = "bert"
+        model, _, _ = proxy_mod.routing_info(
+            "ModelService", "GetModelStatus", request.SerializeToString())
+        assert model == "bert"
+
+    def test_malformed_bytes_route_stateless(self):
+        model, sid, signature = proxy_mod.routing_info(
+            "PredictionService", "Predict", b"\xff\xff\xff garbage")
+        assert (model, sid, signature) == ("", None, "")
+
+    def test_scanner_matches_full_parse(self):
+        """routing_info is a wire-format SCAN (it must not materialize
+        multi-MB payload tensors); this pins its answers to what a full
+        protobuf parse extracts, across payload shapes/dtypes, version
+        fields, output filters, and a tensor_content session id."""
+        from min_tfs_client_tpu.protos.grpc_service import SERVICE_SCHEMAS
+
+        def reference(service, method, request_bytes):
+            req_cls, _ = SERVICE_SCHEMAS[service][method]
+            request = req_cls.FromString(request_bytes)
+            spec = getattr(request, "model_spec", None)
+            if spec is None:
+                tasks = getattr(request, "tasks", None)
+                spec = tasks[0].model_spec if tasks else None
+            model = spec.name if spec is not None else ""
+            signature = spec.signature_name if spec is not None else ""
+            sid = None
+            if isinstance(request, apis.PredictRequest) and \
+                    "session_id" in request.inputs:
+                tensor = request.inputs["session_id"]
+                if tensor.string_val:
+                    sid = bytes(tensor.string_val[0])
+                elif tensor.tensor_content:
+                    sid = bytes(tensor.tensor_content)
+            return model, sid, signature
+
+        cases = []
+        for i, payload in enumerate([
+                np.zeros((64, 128), np.float32),      # sizable tensor
+                np.asarray([b"a", b"bb"], object),    # string payload
+                np.arange(7, dtype=np.int64)]):
+            request = apis.PredictRequest()
+            request.model_spec.name = f"model-{i}"
+            request.model_spec.version.value = 3
+            request.model_spec.signature_name = "sig-%d" % i
+            request.inputs["x"].CopyFrom(ndarray_to_tensor_proto(payload))
+            if i % 2 == 0:
+                request.inputs["session_id"].CopyFrom(
+                    ndarray_to_tensor_proto(
+                        np.asarray(b"sess-%d" % i, object)))
+            request.output_filter.append("y")
+            cases.append(("PredictionService", "Predict", request))
+        content_request = apis.PredictRequest()
+        content_request.model_spec.name = "raw"
+        content_request.inputs["session_id"].tensor_content = b"raw-sid"
+        cases.append(("PredictionService", "Predict", content_request))
+        status = apis.GetModelStatusRequest()
+        status.model_spec.name = "status-model"
+        cases.append((("ModelService"), "GetModelStatus", status))
+        for service, method, request in cases:
+            raw = request.SerializeToString()
+            assert proxy_mod.routing_info(service, method, raw) == \
+                reference(service, method, raw), (service, method)
+
+
+class TestDrainFlag:
+    """Server-side half of the drain contract (observability/health.py):
+    mark_draining flips readiness and grpc health BEFORE any teardown."""
+
+    class _FakeCore:
+        def configured_model_names(self):
+            return []
+
+        def model_exists(self, name):
+            return False
+
+    def test_mark_draining_flips_readiness_and_health(self):
+        from min_tfs_client_tpu.observability import health
+
+        core = self._FakeCore()
+        health.register_core(core)
+        try:
+            base = health.readiness()
+            assert "draining" not in " ".join(base["reasons"])
+            health.mark_draining(core)
+            verdict = health.readiness()
+            assert verdict["ready"] is False
+            assert verdict["draining"] is True
+            assert any("draining" in r for r in verdict["reasons"])
+            known, status = health.check_service("")
+            assert known and status == health._NOT_SERVING
+            health.clear_draining(core)
+            assert health.readiness()["draining"] is False
+        finally:
+            health.unregister_core(core)
+
+    def test_gauge_total_sums_cells(self):
+        from min_tfs_client_tpu.server import metrics
+
+        gauge = metrics.Gauge(":test/router/gauge_total_probe",
+                              "test gauge", ("model",))
+        gauge.set(2.0, "a")
+        gauge.set(3.0, "b")
+        assert metrics.gauge_total(gauge) == 5.0

@@ -1,0 +1,117 @@
+"""Hash-ring contract (router/ring.py): deterministic across processes,
+bounded rebalance on membership change, sticky under churn, balanced.
+
+These properties are what make a fleet of routers safe: every router
+replica (and every restart) must compute the SAME assignment from the
+same membership, and a membership flip must move the minimum possible
+keys — sessions are pinned separately, but warm-cache affinity for
+stateless traffic is only as good as the ring's stability.
+"""
+
+import json
+import math
+import subprocess
+import sys
+
+from min_tfs_client_tpu.router import ring
+
+BACKENDS = ["10.0.0.1:8500", "10.0.0.2:8500", "10.0.0.3:8500"]
+K = 1000
+KEYS = [("model-a", b"key-%d" % i) for i in range(K)]
+
+
+def _assignments(backends):
+    return {ring.ring_key(m, r): ring.assign(ring.ring_key(m, r), backends)
+            for m, r in KEYS}
+
+
+class TestDeterminism:
+    def test_same_process_stable(self):
+        a = _assignments(BACKENDS)
+        b = _assignments(list(reversed(BACKENDS)))
+        assert a == b  # membership ORDER must not matter
+
+    def test_deterministic_across_processes(self):
+        """A second router process (fresh interpreter: no shared seeds,
+        no hash randomization leakage) assigns identically."""
+        script = (
+            "import json, sys\n"
+            "from min_tfs_client_tpu.router import ring\n"
+            "backends = json.loads(sys.argv[1])\n"
+            "out = [ring.assign(ring.ring_key('model-a', b'key-%d' % i),"
+            " backends) for i in range(50)]\n"
+            "print(json.dumps(out))\n")
+        result = subprocess.run(
+            [sys.executable, "-c", script, json.dumps(BACKENDS)],
+            capture_output=True, text=True, timeout=60, check=True)
+        child = json.loads(result.stdout)
+        local = [ring.assign(ring.ring_key("model-a", b"key-%d" % i),
+                             BACKENDS) for i in range(50)]
+        assert child == local
+
+    def test_ring_key_length_prefix_disambiguates(self):
+        assert ring.ring_key("ab", b"c") != ring.ring_key("a", b"bc")
+
+
+class TestBoundedRebalance:
+    """The fixture keyspace is fixed and the hash is a frozen contract,
+    so these counts are exact, repeatable numbers — the assertions
+    document the rebalance bound ceil(K/N) for a fleet of N backends at
+    the membership-change event."""
+
+    def test_join_moves_at_most_ceil_k_over_n_and_only_to_joiner(self):
+        before = _assignments(BACKENDS)
+        joined = BACKENDS + ["10.0.0.4:8500"]
+        after = {k: ring.assign(k, joined) for k in before}
+        moved = [k for k in before if before[k] != after[k]]
+        # Structural theorem: a rendezvous join can only move keys TO
+        # the joiner — nothing reshuffles between the incumbents.
+        assert all(after[k] == "10.0.0.4:8500" for k in moved)
+        assert len(moved) <= math.ceil(K / len(BACKENDS))
+        # And the joiner takes roughly its fair share (K/N_after), not
+        # a token trickle.
+        assert len(moved) >= K / len(joined) * 0.8
+
+    def test_leave_moves_exactly_the_departed_keys(self):
+        before = _assignments(BACKENDS)
+        departed = BACKENDS[1]
+        remaining = [b for b in BACKENDS if b != departed]
+        after = {k: ring.assign(k, remaining) for k in before}
+        moved = {k for k in before if before[k] != after[k]}
+        owned = {k for k, b in before.items() if b == departed}
+        assert moved == owned  # exact minimality: nobody else moves
+        assert len(moved) <= math.ceil(K / len(BACKENDS)) * 1.2
+
+    def test_session_keys_sticky_under_unrelated_churn(self):
+        """A session key's assignment survives ANY membership change
+        that keeps its owner: joins and unrelated leaves never move
+        it (the ring half of session stickiness; the session table
+        covers the rest)."""
+        session_keys = [ring.ring_key("t5", b"session-%d" % i)
+                        for i in range(200)]
+        before = {k: ring.assign(k, BACKENDS) for k in session_keys}
+        scenarios = [
+            BACKENDS + ["10.0.0.9:8500"],                   # join
+            BACKENDS + ["10.0.0.9:8500", "10.0.0.10:8500"],  # double join
+        ]
+        for membership in scenarios:
+            for k in session_keys:
+                owner = ring.assign(k, membership)
+                assert owner == before[k] or owner not in BACKENDS
+        for victim in BACKENDS:
+            remaining = [b for b in BACKENDS if b != victim]
+            for k in session_keys:
+                if before[k] != victim:
+                    assert ring.assign(k, remaining) == before[k]
+
+
+class TestOccupancy:
+    def test_shares_sum_to_one_and_balance(self):
+        shares = ring.occupancy(BACKENDS)
+        assert abs(sum(shares.values()) - 1.0) < 1e-9
+        for backend, share in shares.items():
+            assert abs(share - 1 / 3) < 0.06, (backend, share)
+
+    def test_empty_fleet(self):
+        assert ring.occupancy([]) == {}
+        assert ring.assign(ring.ring_key("m", b"x"), []) is None
