@@ -302,6 +302,149 @@ def _decoder_step(params: dict, config: T5Config, token: jax.Array,
     return logits[:, 0], new_caches
 
 
+# -- paging-aware decoder (block-table KV: the step contract's math) ----------
+
+
+def _cache_key(layer: int, name: str) -> tuple:
+    """PagedKV arena key for decoder layer `layer`'s self-attention K or V
+    — the pytree path of that leaf in the session state, which is how the
+    pooled tick (decode_sessions.PagedSlotPool) keys the arenas it hands
+    the step contract."""
+    return ("caches", layer, "self", name)
+
+
+def paged_decoder_positions(params: dict, config: T5Config,
+                            tokens: jax.Array, q_start: jax.Array,
+                            kv, encoded: jax.Array,
+                            enc_lengths: jax.Array, *,
+                            chunk_lens: jax.Array | None = None,
+                            need_logits: bool = True
+                            ) -> tuple[jax.Array | None, object]:
+    """_decoder_positions over a block-table-paged KV store: tokens (B, L)
+    at per-example absolute positions q_start (B,) .. q_start+L-1, with
+    the decoder self-attention caches living in `kv` (an
+    ops/attention.PagedKV keyed by _cache_key) instead of dense
+    max-length blocks. Per layer the new K/V rows are APPENDED into the
+    arenas (this position's rows — exactly what the dense path's
+    dynamic_update_slice wrote) and attention runs through the block
+    tables via ops/attention.paged_attention — the ragged Pallas kernel
+    on TPU, the gather oracle elsewhere; either way reads scale with the
+    pages the sequences own, not max length.
+
+    chunk_lens (B,) marks how many of the L rows are real (a chunked
+    prefill's short final chunk): rows past it write to the trash page
+    and attend nothing beyond the valid keys. need_logits=False skips the
+    final norm + vocab projection (prefill chunks only fill the cache).
+    Returns (logits (B, L, vocab) or None, updated kv)."""
+    dec = params["decoder"]
+    b, length = tokens.shape
+    x = nn.embed(params["shared_embedding"], tokens)
+    klen = kv.tables.shape[1] * kv.block_size
+    # Per-example absolute query offsets: vmap the shared bias builder.
+    bias = jax.vmap(
+        lambda off: relative_bias(dec["rel_bias"], config, length, klen,
+                                  bidirectional=False, q_offset=off)[0]
+    )(q_start)                                      # (B, H, L, klen)
+    lengths_in = q_start + (chunk_lens if chunk_lens is not None
+                            else jnp.int32(length))
+    for i, layer in enumerate(dec["layers"]):
+        h = nn.rms_norm(layer["self_norm"], x)
+        p = layer["self_attention"]
+        q = nn._heads(nn.dense(p["query"], h), config.num_heads)
+        k_new = nn._heads(nn.dense(p["key"], h), config.num_heads)
+        v_new = nn._heads(nn.dense(p["value"], h), config.num_heads)
+        kv = kv.append(
+            {_cache_key(i, "k"): k_new.transpose(0, 2, 1, 3),
+             _cache_key(i, "v"): v_new.transpose(0, 2, 1, 3)},
+            row_valid=chunk_lens)
+        out = kv.attend(q, _cache_key(i, "k"), _cache_key(i, "v"),
+                        bias=bias, scale=1.0, lengths=lengths_in,
+                        q_start=q_start)
+        x = x + nn.dense(p["out"], nn._unheads(out))
+        h = nn.rms_norm(layer["cross_norm"], x)
+        cross, _ = nn.mha(
+            layer["cross_attention"], h, num_heads=config.num_heads,
+            kv=encoded, lengths=enc_lengths, scale=1.0)
+        x = x + cross
+        h = nn.rms_norm(layer["mlp_norm"], x)
+        x = x + nn.mlp(layer["mlp"], h, activation=jax.nn.relu)
+    if not need_logits:
+        return None, kv
+    x = nn.rms_norm(dec["final_norm"], x)
+    logits = jnp.einsum(
+        "bld,vd->blv", x.astype(jnp.float32) / np.sqrt(config.d_model),
+        params["shared_embedding"]["embedding"])
+    return logits, kv
+
+
+class _T5PagedStep:
+    """T5's paging-aware step contract (decode_sessions.PagedSlotPool
+    `paged_step`): the pooled tick hands slot-batched dense state plus a
+    PagedKV handle; decode() advances one token per active slot through
+    paged_decoder_positions, prefill_chunk() streams a forced decoder
+    prefix through the same Sq>1 path. Token-for-token equal to the
+    dense-gather fallback (the paged-decode suite asserts it) — the only
+    difference is what the tick reads."""
+
+    def __init__(self, config: T5Config, *, sampling: bool = False,
+                 top_k: int = 0):
+        self._config = config
+        self._sampling = sampling
+        self._top_k = top_k
+
+    def decode(self, params: dict, tree: dict, kv):
+        from min_tfs_client_tpu.models.quantize import maybe_dequantize
+
+        config = self._config
+        p = maybe_dequantize(params) if params is not None else params
+        logits, kv = paged_decoder_positions(
+            p, config, tree["token"][:, 0], kv.lengths, kv,
+            tree["encoded"][:, 0], tree["enc_lengths"][:, 0])
+        logits = logits[:, 0]                      # (slots, vocab)
+        finished = tree["finished"][:, 0]
+        if self._sampling:
+            keys, subs = _split_keys(tree["key"][:, 0])
+            next_token = _sample_token(
+                logits, subs, tree["temperature"][:, 0], self._top_k,
+                config.pad_id,
+                tree["top_p"][:, 0] if "top_p" in tree else None)
+        else:
+            next_token = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        next_token = jnp.where(finished, config.pad_id, next_token)
+        new_finished = jnp.logical_or(finished, next_token == config.eos_id)
+        new_tree = {
+            "encoded": tree["encoded"],
+            "enc_lengths": tree["enc_lengths"],
+            "caches": tree["caches"],              # None leaves: in arenas
+            "token": next_token[:, None, None],
+            "finished": new_finished[:, None],
+            "step": tree["step"] + 1,
+        }
+        if self._sampling:
+            new_tree["temperature"] = tree["temperature"]
+            new_tree["key"] = keys[:, None]
+            if "top_p" in tree:
+                new_tree["top_p"] = tree["top_p"]
+        outputs = {"token": next_token[:, None],
+                   "finished": new_finished[:, None]}
+        return new_tree, kv, outputs
+
+    def prefill_chunk(self, params: dict, tree: dict, kv,
+                      tokens: jax.Array, chunk_lens: jax.Array,
+                      next_tokens: jax.Array):
+        from min_tfs_client_tpu.models.quantize import maybe_dequantize
+
+        p = maybe_dequantize(params) if params is not None else params
+        _, kv = paged_decoder_positions(
+            p, self._config, tokens, kv.lengths, kv,
+            tree["encoded"][:, 0], tree["enc_lengths"][:, 0],
+            chunk_lens=chunk_lens, need_logits=False)
+        new_tree = dict(tree)
+        new_tree["token"] = next_tokens[:, :, None]
+        new_tree["step"] = tree["step"] + chunk_lens
+        return new_tree, kv
+
+
 def greedy_decode(params: dict, config: T5Config, input_ids: jax.Array,
                   lengths: jax.Array, *, max_decode_len: int,
                   encoded: jax.Array | None = None
@@ -561,9 +704,19 @@ def speculative_decode(
     *,
     max_decode_len: int,
     k: int = 4,
+    kv_block_size: int = 0,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Greedy speculative decoding: draft proposes k tokens, the target
     verifies all of them in ONE decoder pass (`_decoder_positions` block).
+
+    kv_block_size > 0 composes speculation with paging: the TARGET's
+    self-attention caches live in block-table page arenas and every
+    verify block (Sq=k+1, the multi-query path) runs through
+    ops/attention.paged_attention — the ragged Pallas kernel on TPU —
+    instead of dense max-length caches. The draft's caches stay dense
+    (it is a throwaway helper model whose quality never touches
+    outputs). Token streams are identical either way; the paged-decode
+    suite asserts it.
 
     Token-exact versus `greedy_decode(params, config, ...)` by
     construction: only tokens the target's own greedy argmax would emit
@@ -583,9 +736,28 @@ def speculative_decode(
     encoded_t = encode(params, config, input_ids, lengths)
     encoded_d = encode(draft_params, draft_config, input_ids, lengths)
     cache_len = max_decode_len + k  # room for the last round's overshoot
-    caches_t = [{"self": nn.init_cache(b, config.num_heads, cache_len,
-                                       config.d_kv)}
-                for _ in range(config.num_decoder_layers)]
+    if kv_block_size:
+        # Target caches as page arenas + per-example block tables (each
+        # example owns a contiguous page range; the layout under test is
+        # the block-table indirection the serving pool uses, so verify
+        # blocks exercise the kernel's Sq>1 path end to end).
+        bs = int(kv_block_size)
+        pages_per = -(-cache_len // bs)
+        n_pages = b * pages_per
+        caches_t = {}
+        spec_row_axes = {}
+        for i in range(config.num_decoder_layers):
+            for name in ("k", "v"):
+                caches_t[_cache_key(i, name)] = jnp.zeros(
+                    (n_pages + 1, config.num_heads, bs, config.d_kv),
+                    nn.COMPUTE_DTYPE)
+                spec_row_axes[_cache_key(i, name)] = 2
+        spec_tables = jnp.asarray(
+            np.arange(n_pages, dtype=np.int32).reshape(b, pages_per))
+    else:
+        caches_t = [{"self": nn.init_cache(b, config.num_heads, cache_len,
+                                           config.d_kv)}
+                    for _ in range(config.num_decoder_layers)]
     caches_d = [{"self": nn.init_cache(b, draft_config.num_heads, cache_len,
                                        draft_config.d_kv)}
                 for _ in range(draft_config.num_decoder_layers)]
@@ -615,8 +787,19 @@ def speculative_decode(
 
         # Target: ONE pass over the k+1-position block [cur, d_1..d_k].
         block = jnp.concatenate([cur, d_tokens], axis=1)  # (B, k+1)
-        logits, caches_t = _decoder_positions(
-            params, config, block, step, caches_t, encoded_t, lengths)
+        if kv_block_size:
+            from min_tfs_client_tpu.ops.attention import PagedKV
+
+            q_start = jnp.full((b,), step, jnp.int32)
+            kv = PagedKV(caches_t, spec_tables, q_start,
+                         block_size=bs, trash=n_pages,
+                         row_axes=spec_row_axes)
+            logits, kv = paged_decoder_positions(
+                params, config, block, q_start, kv, encoded_t, lengths)
+            caches_t = kv.arenas
+        else:
+            logits, caches_t = _decoder_positions(
+                params, config, block, step, caches_t, encoded_t, lengths)
         t_pred = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # (B, k+1)
 
         # Acceptance: longest prefix where the draft matched the target's
@@ -686,7 +869,8 @@ def build_signatures(params: dict, config: T5Config, *, seq_len: int,
                      pipeline_n_micro: int | None = None,
                      kv_block_size: int | None = None,
                      kv_num_blocks: int | None = None,
-                     kv_evict_policy: str | None = None) -> dict:
+                     kv_evict_policy: str | None = None,
+                     kv_prefill_chunk: int | None = None) -> dict:
     from min_tfs_client_tpu.servables.servable import Signature, TensorSpec
 
     # With `pipeline_mesh` (a Mesh carrying a "stage" axis) the ENCODER
@@ -812,6 +996,15 @@ def build_signatures(params: dict, config: T5Config, *, seq_len: int,
     if draft_params is not None:
         if draft_config is None:
             raise ValueError("draft_params requires draft_config")
+        # Speculation composes with paging: when the export/server enables
+        # the paged KV store, the target's verify blocks run through the
+        # block-table kernel path too (same knob, same default-off).
+        from min_tfs_client_tpu.servables.decode_sessions import (
+            default_paging,
+        )
+
+        spec_kv_block = (kv_block_size if kv_block_size is not None
+                         else default_paging()["block_size"])
 
         def spec_fn(bundle, inputs):
             ids = jnp.asarray(inputs["input_ids"], jnp.int32)
@@ -820,7 +1013,8 @@ def build_signatures(params: dict, config: T5Config, *, seq_len: int,
             out_ids, out_lengths, passes = speculative_decode(
                 bundle["target"], config, bundle["draft"],
                 draft_config, ids, lens,
-                max_decode_len=max_decode_len, k=speculative_k)
+                max_decode_len=max_decode_len, k=speculative_k,
+                kv_block_size=spec_kv_block or 0)
             return {"output_ids": out_ids,
                     "output_lengths": out_lengths,
                     "target_passes": jnp.broadcast_to(
@@ -848,7 +1042,8 @@ def build_signatures(params: dict, config: T5Config, *, seq_len: int,
         sampling=session_sampling, sampling_top_k=sampling_top_k,
         sampling_top_p=sampling_top_p,
         kv_block_size=kv_block_size, kv_num_blocks=kv_num_blocks,
-        kv_evict_policy=kv_evict_policy))
+        kv_evict_policy=kv_evict_policy,
+        kv_prefill_chunk=kv_prefill_chunk))
     return signatures
 
 
@@ -859,12 +1054,24 @@ def prefill_state(params: dict, config: T5Config, input_ids: jax.Array,
                   *, max_decode_len: int,
                   temperature: jax.Array | None = None,
                   seed: jax.Array | None = None,
-                  top_p: jax.Array | None = None) -> dict:
+                  top_p: jax.Array | None = None,
+                  prefix_ids: jax.Array | None = None) -> dict:
     """Encode the prompt and build empty caches: the device state one
     decode session carries between Predict("decode_step") calls. With
     `temperature`/`seed` (B,) the state also carries per-example PRNG
     keys and sampling temperature (sampled sessions); absent, steps are
-    greedy."""
+    greedy.
+
+    `prefix_ids` (B, max_decode_len; pad-suffixed, at least one real
+    token, one shared length per batch) is a FORCED decoder prefix: the
+    MONOLITHIC prefill runs the decoder over the whole (static-width)
+    block in one pass — _decoder_positions' causal prompt mode — leaving
+    the caches warm through position P-1, step=P, and the last prefix
+    token queued as the next decode input. Cache rows past P hold
+    garbage; they are masked (and later overwritten) exactly like the
+    unwritten zeros of a fresh cache. The paged step-contract pool skips
+    this path and streams the same prefix CHUNKED through the ragged
+    kernel instead — token streams are asserted identical."""
     b = input_ids.shape[0]
     lengths = jnp.sum((input_ids != config.pad_id).astype(jnp.int32), axis=-1)
     encoded = encode(params, config, input_ids, lengths)
@@ -879,6 +1086,19 @@ def prefill_state(params: dict, config: T5Config, input_ids: jax.Array,
         "finished": jnp.zeros((b,), jnp.bool_),
         "step": jnp.int32(0),
     }
+    if prefix_ids is not None:
+        prefix = jnp.asarray(prefix_ids, jnp.int32)
+        plen = jnp.sum((prefix[0] != config.pad_id).astype(jnp.int32))
+        # Decoder inputs for positions 0..W-1: start token, then the
+        # prefix shifted right; rows at or past plen compute garbage
+        # K/V that stays masked behind `step` until overwritten.
+        block = jnp.concatenate([state["token"], prefix[:, :-1]], axis=1)
+        _, caches = _decoder_positions(
+            params, config, block, jnp.int32(0), caches, encoded, lengths)
+        state["caches"] = caches
+        state["step"] = plen
+        state["token"] = jnp.take_along_axis(
+            prefix, jnp.full((b, 1), plen - 1, jnp.int32), axis=1)
     if temperature is not None:
         state["temperature"] = jnp.asarray(temperature, jnp.float32)
         state["key"] = _per_example_keys(jnp.asarray(seed, jnp.int32))
@@ -933,23 +1153,28 @@ def _sampling_session_helpers(config: T5Config, max_decode_len: int,
     from min_tfs_client_tpu.servables.servable import TensorSpec
     from min_tfs_client_tpu.utils.status import ServingError
 
+    names = (("temperature", np.float32), ("seed", np.int32))
+    if use_top_p:
+        names += (("top_p", np.float32),)
+    n_extra = len(names) if sampling else 0
+
+    def prefill_fn(p, ids, *rest):
+        """rest: the sampling extras (when built with sampling), then
+        optionally a forced decoder prefix — the trailing-arity call is
+        decode_init_prefix's monolithic dense path; each arity jits its
+        own trace."""
+        extras = rest[:n_extra]
+        prefix = rest[n_extra] if len(rest) > n_extra else None
+        kw = {}
+        if sampling:
+            kw["temperature"], kw["seed"] = extras[0], extras[1]
+            if use_top_p:
+                kw["top_p"] = extras[2]
+        return prefill_state(maybe_dequantize(p), config, ids,
+                             max_decode_len=max_decode_len,
+                             prefix_ids=prefix, **kw)
+
     if sampling:
-        if use_top_p:
-            def prefill_fn(p, ids, temp, seed, top_p):
-                return prefill_state(maybe_dequantize(p), config, ids,
-                                     max_decode_len=max_decode_len,
-                                     temperature=temp, seed=seed,
-                                     top_p=top_p)
-        else:
-            def prefill_fn(p, ids, temp, seed):
-                return prefill_state(maybe_dequantize(p), config, ids,
-                                     max_decode_len=max_decode_len,
-                                     temperature=temp, seed=seed)
-
-        names = (("temperature", np.float32), ("seed", np.int32))
-        if use_top_p:
-            names += (("top_p", np.float32),)
-
         def read_inputs(inputs, batch):
             out = []
             for name, dtype in names:
@@ -964,13 +1189,43 @@ def _sampling_session_helpers(config: T5Config, max_decode_len: int,
         extra_specs = {name: TensorSpec(dtype, (None,))
                        for name, dtype in names}
     else:
-        def prefill_fn(p, ids):
-            return prefill_state(maybe_dequantize(p), config, ids,
-                                 max_decode_len=max_decode_len)
-
         read_inputs = None
         extra_specs = {}
     return prefill_fn, read_inputs, extra_specs
+
+
+def _read_prefix(inputs, config: T5Config):
+    """decode_init_prefix's prefix_ids: (1, max_decode_len) int32, real
+    tokens then pad — returns (array, true length). Single-sequence: the
+    session state carries ONE step scalar, so a multi-row prefix init
+    would need per-row lengths it cannot represent."""
+    from min_tfs_client_tpu.utils.status import ServingError
+
+    pre = np.asarray(inputs["prefix_ids"]).astype(np.int32)
+    if pre.ndim != 2 or pre.shape[0] != 1:
+        raise ServingError.invalid_argument(
+            "prefix_ids must be a single-sequence (1, max_decode_len) "
+            f"tensor; got shape {pre.shape}")
+    row = pre[0]
+    pads = np.flatnonzero(row == config.pad_id)
+    plen = int(pads[0]) if pads.size else int(row.shape[0])
+    if plen == 0:
+        raise ServingError.invalid_argument(
+            "prefix_ids holds no tokens (row starts with pad)")
+    if plen >= row.shape[0]:
+        # A full-width prefix leaves zero decode budget — and the first
+        # step would write K/V at max_decode_len, which the cache write
+        # CLAMPS to the last row, silently corrupting the prefix.
+        raise ServingError.invalid_argument(
+            f"prefix_ids fills the entire max_decode_len budget "
+            f"({row.shape[0]}); at least one position must remain to "
+            "decode")
+    if pads.size and not (row[plen:] == config.pad_id).all():
+        raise ServingError.invalid_argument(
+            "prefix_ids must be real tokens followed only by pad "
+            f"(pad_id {config.pad_id}); found tokens after position "
+            f"{plen}")
+    return pre, plen
 
 
 def build_session_signatures(params: dict, config: T5Config, *, seq_len: int,
@@ -983,11 +1238,20 @@ def build_session_signatures(params: dict, config: T5Config, *, seq_len: int,
                              sampling_top_p: bool = False,
                              kv_block_size: int | None = None,
                              kv_num_blocks: int | None = None,
-                             kv_evict_policy: str | None = None) -> dict:
+                             kv_evict_policy: str | None = None,
+                             kv_prefill_chunk: int | None = None,
+                             kv_use_step_contract: bool = True) -> dict:
     """The repeated-Predict decode surface (BASELINE config 5):
 
       decode_init:  session_id + input_ids -> prefill; KV cache parked in
                     HBM under the session id
+      decode_init_prefix:  decode_init plus prefix_ids — a FORCED decoder
+                    prefix (continuation/forced decoding): the session
+                    resumes as if it had already emitted those tokens.
+                    Dense pools prefill the prefix monolithically; the
+                    paged step-contract pool streams it through the
+                    ragged kernel in kv_prefill_chunk-token chunks
+                    interleaved with other sessions' decode ticks.
       decode_step:  session_id -> one greedy token per call (donated
                     buffers: caches update in place, one token crosses
                     the wire each way)
@@ -1014,7 +1278,9 @@ def build_session_signatures(params: dict, config: T5Config, *, seq_len: int,
             sampling=sampling, sampling_top_k=sampling_top_k,
             sampling_top_p=sampling_top_p,
             kv_block_size=kv_block_size, kv_num_blocks=kv_num_blocks,
-            kv_evict_policy=kv_evict_policy)
+            kv_evict_policy=kv_evict_policy,
+            kv_prefill_chunk=kv_prefill_chunk,
+            kv_use_step_contract=kv_use_step_contract)
     from min_tfs_client_tpu.servables.decode_sessions import (
         DecodeSessionStore,
     )
@@ -1056,6 +1322,26 @@ def build_session_signatures(params: dict, config: T5Config, *, seq_len: int,
         store.put(sid, (state, 0))  # host-side step mirror: no fetch later
         return {"session_id": np.asarray(sid, object),
                 "batch": np.asarray(ids.shape[0], np.int32)}
+
+    def init_prefix_fn(inputs):
+        sid = _session_id(inputs)
+        ids = np.asarray(inputs["input_ids"]).astype(np.int32)
+        if ids.shape[0] != 1:
+            raise ServingError.invalid_argument(
+                "decode_init_prefix sessions are single-sequence: "
+                f"input_ids batch must be 1, got {ids.shape[0]}")
+        pre, plen = _read_prefix(inputs, config)
+        args = (params, jax.device_put(ids))
+        if read_sampling is not None:
+            args += read_sampling(inputs, 1)
+        # Monolithic prefill: prompt encode + the decoder run over the
+        # whole forced prefix in one pass; step mirror starts at plen so
+        # the session decodes max_decode_len - plen further tokens.
+        state = prefill_jit(*args, jax.device_put(pre))
+        store.put(sid, (state, plen))
+        return {"session_id": np.asarray(sid, object),
+                "batch": np.asarray(1, np.int32),
+                "prefix_len": np.asarray(plen, np.int32)}
 
     def step_fn(inputs):
         from min_tfs_client_tpu.servables.servable import fetch_outputs
@@ -1105,34 +1391,67 @@ def build_session_signatures(params: dict, config: T5Config, *, seq_len: int,
         outputs={"closed": TensorSpec(np.int32, ())},
         on_host=True, batched=False,
     )
+    init_prefix_sig = Signature(
+        fn=init_prefix_fn,
+        inputs={**init_inputs,
+                "prefix_ids": TensorSpec(np.int32, (None, max_decode_len))},
+        outputs={"session_id": TensorSpec("DT_STRING", ()),
+                 "batch": TensorSpec(np.int32, ()),
+                 "prefix_len": TensorSpec(np.int32, ())},
+        on_host=True, batched=False,
+    )
     init_sig.warmup_fn = _session_warmup_fn(
         init_fn, step_fn, close_fn, seq_len, sampling=sampling,
-        use_top_p=sampling_top_p)
+        use_top_p=sampling_top_p, init_prefix_fn=init_prefix_fn,
+        warmup_prefix=_warmup_prefix(config, max_decode_len))
     # The loader re-labels the store's gauge with the real model:version
     # (platforms.make_loader) — the family builder doesn't know it.
-    for sig in (init_sig, step_sig, close_sig):
+    for sig in (init_sig, init_prefix_sig, step_sig, close_sig):
         sig._decode_store = store
-    return {"decode_init": init_sig, "decode_step": step_sig,
-            "decode_close": close_sig}
+    return {"decode_init": init_sig, "decode_init_prefix": init_prefix_sig,
+            "decode_step": step_sig, "decode_close": close_sig}
+
+
+def _warmup_prefix(config: T5Config, max_decode_len: int) -> np.ndarray:
+    """A minimal valid decode_init_prefix row for warmup: one non-pad
+    token, pad-suffixed."""
+    row = np.full((1, max_decode_len), config.pad_id, np.int32)
+    row[0, 0] = 1 if config.pad_id != 1 else 2
+    return row
 
 
 def _session_warmup_fn(init_fn, step_fn, close_fn, seq_len: int,
-                       sampling: bool = False, use_top_p: bool = False):
+                       sampling: bool = False, use_top_p: bool = False,
+                       init_prefix_fn=None, warmup_prefix=None):
     """Prime prefill + step/tick executables with a throwaway session so
     the first real decode_init/step never compiles (synthesize_warmup
-    calls this through the warmup_fn hook)."""
+    calls this through the warmup_fn hook). With `init_prefix_fn` +
+    `warmup_prefix` (a 1-token pad-suffixed prefix row) a second
+    throwaway session also primes the decode_init_prefix path — the
+    prefix-arity monolithic prefill on dense pools, the chunked-prefill
+    program on step-contract pools."""
     def _warm():
+        def _base_inputs(sid):
+            inputs = {"session_id": np.asarray(sid, object),
+                      "input_ids": np.zeros((1, seq_len), np.int32)}
+            if sampling:
+                inputs["temperature"] = np.zeros((1,), np.float32)
+                inputs["seed"] = np.zeros((1,), np.int32)
+                if use_top_p:
+                    inputs["top_p"] = np.ones((1,), np.float32)
+            return inputs
+
         sid = b"__warmup__"
-        inputs = {"session_id": np.asarray(sid, object),
-                  "input_ids": np.zeros((1, seq_len), np.int32)}
-        if sampling:
-            inputs["temperature"] = np.zeros((1,), np.float32)
-            inputs["seed"] = np.zeros((1,), np.int32)
-            if use_top_p:
-                inputs["top_p"] = np.ones((1,), np.float32)
-        init_fn(inputs)
+        init_fn(_base_inputs(sid))
         step_fn({"session_id": np.asarray(sid, object)})
         close_fn({"session_id": np.asarray(sid, object)})
+        if init_prefix_fn is not None:
+            pid = b"__warmup_prefix__"
+            inputs = _base_inputs(pid)
+            inputs["prefix_ids"] = warmup_prefix
+            init_prefix_fn(inputs)
+            step_fn({"session_id": np.asarray(pid, object)})
+            close_fn({"session_id": np.asarray(pid, object)})
     return _warm
 
 
@@ -1145,13 +1464,22 @@ def _build_pooled_session_signatures(params: dict, config: T5Config, *,
                                      sampling_top_p: bool = False,
                                      kv_block_size: int | None = None,
                                      kv_num_blocks: int | None = None,
-                                     kv_evict_policy: str | None = None
+                                     kv_evict_policy: str | None = None,
+                                     kv_prefill_chunk: int | None = None,
+                                     kv_use_step_contract: bool = True
                                      ) -> dict:
     """Continuous-batching variant: same wire surface, slot-pool device
     state, one vmapped tick per token across all concurrently-stepping
     sessions. See decode_sessions.SlotPool; with kv_block_size > 0 the KV
-    caches live in the block-table-paged PagedSlotPool instead."""
+    caches live in the block-table-paged PagedSlotPool, driven through
+    the _T5PagedStep paging-aware contract (the tick reads block tables,
+    never a dense gather). kv_use_step_contract=False is the testing
+    escape hatch that builds the paged pool WITHOUT the contract — the
+    dense-gather fallback — so suites can A/B the two programs on one
+    model; prefix sessions then raise UNIMPLEMENTED (chunked prefill
+    needs the contract's multi-row program)."""
     from min_tfs_client_tpu.servables.decode_sessions import (
+        PREFILL_PENDING,
         DecodeSessionStore,
         PagedSlotPool,
         SlotPool,
@@ -1186,6 +1514,8 @@ def _build_pooled_session_signatures(params: dict, config: T5Config, *,
         kv_num_blocks = defaults["num_blocks"]
     if kv_evict_policy is None:
         kv_evict_policy = defaults["evict_policy"]
+    if kv_prefill_chunk is None:
+        kv_prefill_chunk = defaults["prefill_chunk"]
 
     paged = bool(kv_block_size)
     if paged:
@@ -1197,10 +1527,14 @@ def _build_pooled_session_signatures(params: dict, config: T5Config, *,
             return 2 if ("caches" in path and path[-1] in ("k", "v")) \
                 else None
 
+        contract = _T5PagedStep(config, sampling=sampling,
+                                top_k=sampling_top_k) \
+            if kv_use_step_contract else None
         pool = PagedSlotPool(
             template, one_step, max_slots=max_slots, params=params,
             block_size=kv_block_size, num_blocks=kv_num_blocks or None,
             paged_axis_fn=paged_axis_fn, evict_policy=kv_evict_policy,
+            paged_step=contract, prefill_chunk=kv_prefill_chunk or 0,
             metric_label="t5-paged")
     else:
         pool = SlotPool(template, one_step, max_slots=max_slots,
@@ -1244,11 +1578,60 @@ def _build_pooled_session_signatures(params: dict, config: T5Config, *,
         return {"session_id": np.asarray(sid, object),
                 "batch": np.asarray(1, np.int32)}
 
+    def init_prefix_fn(inputs):
+        sid = _session_id(inputs)
+        ids = np.asarray(inputs["input_ids"]).astype(np.int32)
+        if ids.shape[0] != 1:
+            raise ServingError.invalid_argument(
+                "continuous-batching decode sessions are single-sequence: "
+                f"input_ids batch must be 1, got {ids.shape[0]}")
+        pre, plen = _read_prefix(inputs, config)
+        args = (params, jax.device_put(ids))
+        if read_sampling is not None:
+            args += read_sampling(inputs, 1)
+        if paged and getattr(pool, "_paged_step", None) is None:
+            # A monolithic prefill's cache rows would be silently DROPPED
+            # by the paged write program (paged leaves live in arenas, and
+            # only the contract has a multi-row program to fill them).
+            raise ServingError.unimplemented(
+                "decode_init_prefix on a paged pool needs the paging-aware "
+                "step contract; this pool runs the dense-gather fallback")
+        slot = pool.acquire_slot()
+        try:
+            if paged:
+                # Step-contract pool: encoder-only prefill; the forced
+                # prefix streams through the ragged kernel in chunks,
+                # interleaved with other sessions' decode ticks.
+                state = prefill_jit(*args)
+                tokens = pre[0][:plen]
+                prefix_inputs = np.concatenate(
+                    [np.asarray([config.decoder_start_id], np.int32),
+                     tokens[:-1].astype(np.int32)])
+                pool.write(state, slot, prefill_inputs=prefix_inputs,
+                           prefill_next=int(tokens[-1]))
+            else:
+                # Dense slot pool: one monolithic prefill.
+                state = prefill_jit(*args, jax.device_put(pre))
+                pool.write(state, slot)
+            store.put(sid, (slot, plen))
+        except Exception:
+            pool.release_slot(slot)
+            raise
+        return {"session_id": np.asarray(sid, object),
+                "batch": np.asarray(1, np.int32),
+                "prefix_len": np.asarray(plen, np.int32)}
+
     def step_fn(inputs):
         sid = _session_id(inputs)
         slot, host_step = store.take(sid)
         try:
             row = batcher.step(slot)
+            while row is PREFILL_PENDING:
+                # The slot is mid-prefix: each batcher round streamed one
+                # chunk; re-entering lets tick-mates' decode steps (and
+                # other prefills) interleave until this session's first
+                # real token arrives.
+                row = batcher.step(slot)
         except Exception:
             # The pool row may be in an undefined state; retire the slot
             # rather than hand it to a future session mid-generation.
@@ -1303,12 +1686,26 @@ def _build_pooled_session_signatures(params: dict, config: T5Config, *,
         on_host=True, batched=False,
     )
 
+    init_prefix_sig = Signature(
+        fn=init_prefix_fn,
+        inputs={**init_inputs,
+                "prefix_ids": TensorSpec(np.int32, (None, max_decode_len))},
+        outputs={"session_id": TensorSpec("DT_STRING", ()),
+                 "batch": TensorSpec(np.int32, ()),
+                 "prefix_len": TensorSpec(np.int32, ())},
+        on_host=True, batched=False,
+    )
+    # Paged pools without the contract have no prefix program to warm
+    # (decode_init_prefix raises UNIMPLEMENTED there).
+    can_prefix = not paged or getattr(pool, "_paged_step", None) is not None
     init_sig.warmup_fn = _session_warmup_fn(
         init_fn, step_fn, close_fn, seq_len, sampling=sampling,
-        use_top_p=sampling_top_p)
-    for sig in (init_sig, step_sig, close_sig):
+        use_top_p=sampling_top_p,
+        init_prefix_fn=init_prefix_fn if can_prefix else None,
+        warmup_prefix=_warmup_prefix(config, max_decode_len))
+    for sig in (init_sig, init_prefix_sig, step_sig, close_sig):
         sig._decode_store = store
         if paged:
             sig._kv_pool = pool  # loader re-labels gauges with model:version
-    return {"decode_init": init_sig, "decode_step": step_sig,
-            "decode_close": close_sig}
+    return {"decode_init": init_sig, "decode_init_prefix": init_prefix_sig,
+            "decode_step": step_sig, "decode_close": close_sig}

@@ -254,7 +254,12 @@ def register_kv_pool(pool) -> None:
 def kv_pool_stats() -> list[dict]:
     """Per-pool occupancy/pressure snapshot, read at scrape time (the
     pools update their gauges on allocation events; this walks the pool
-    state off the hot path per the deferred-export discipline)."""
+    state off the hot path per the deferred-export discipline). Each
+    entry is the pool's published stats() snapshot: occupancy, table
+    width, phase + pressure counters, byte accounting, and the
+    step-contract fields (`step_contract`, `kv_gather_bytes_per_tick`,
+    `prefill_chunk_size`, `chunking_sessions`, `prefill_chunks`) — see
+    docs/OBSERVABILITY.md's reading guide."""
     with _kv_pools_lock:
         pools = [r() for r in _kv_pools]
     out = []

@@ -257,23 +257,37 @@ def paged_attention_reference(
     lengths: jax.Array,
     *,
     scale: Optional[float] = None,
+    bias: Optional[jax.Array] = None,
+    q_start: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Oracle: gather pages per true sequence length, then masked dense
-    attention. q (B, H, Sq, D) holds the NEWEST Sq positions (right-
-    aligned, the KV-cache decode convention); lengths (B,) counts valid
-    keys INCLUDING the query rows' own (already-written) K/V. Query row r
-    attends keys < lengths - (Sq-1-r), so Sq=1 reduces to pure lengths
-    masking and Sq>1 is causal within the block. Returns (B, H, Sq, D)."""
+    attention. q (B, H, Sq, D) holds Sq consecutive positions; lengths
+    (B,) counts valid keys INCLUDING the query rows' own (already-
+    written) K/V. `q_start` (B,) is query row 0's absolute position —
+    default lengths - Sq (right-aligned, the KV-cache decode/verify
+    convention); a chunked prefill passes its chunk's start explicitly so
+    a partial final chunk (valid rows < Sq) still masks per true row
+    position. Query row r attends keys < min(lengths, q_start + r + 1),
+    so Sq=1 reduces to pure lengths masking and Sq>1 is causal within the
+    block. `bias` broadcastable to (B, H, Sq, P*block_size) is added
+    after scaling (T5's relative position bias over the gathered key
+    positions). Returns (B, H, Sq, D)."""
     b, h, sq, d = q.shape
     if scale is None:
         scale = 1.0 / float(np.sqrt(d))
+    if q_start is None:
+        q_start = lengths - sq
     k = gather_kv_pages(k_pages, block_tables)
     v = gather_kv_pages(v_pages, block_tables)
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
                    preferred_element_type=jnp.float32) * scale
+    if bias is not None:
+        s = s + bias.astype(jnp.float32)
     ki = jnp.arange(k.shape[-2])[None, None, None, :]
-    row_limit = (lengths[:, None, None, None]
-                 - (sq - 1 - jnp.arange(sq))[None, None, :, None])
+    row_limit = jnp.minimum(
+        lengths[:, None, None, None],
+        q_start[:, None, None, None]
+        + (jnp.arange(sq) + 1)[None, None, :, None])
     s = jnp.where(ki < row_limit, s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
     all_masked = jnp.max(s, axis=-1, keepdims=True) <= NEG_INF * 0.5
@@ -283,12 +297,17 @@ def paged_attention_reference(
         preferred_element_type=jnp.float32).astype(q.dtype)
 
 
-def _paged_kernel(tbl_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
-                  m_ref, l_ref, acc_ref, *,
-                  scale: float, block_size: int, num_heads: int, sq: int):
+def _paged_kernel(tbl_ref, len_ref, qstart_ref, *rest,
+                  scale: float, block_size: int, num_heads: int, sq: int,
+                  has_bias: bool):
     """One (batch*head, page) grid cell. The index_map already routed this
     cell's K/V refs at the table's page; here we accumulate online softmax
     across the page grid dim in VMEM scratch and emit on the last page."""
+    if has_bias:
+        bias_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref = rest
+    else:
+        q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref = rest
+        bias_ref = None
     bh = pl.program_id(0)
     page = pl.program_id(1)
     sq_p, d = q_ref.shape
@@ -300,17 +319,21 @@ def _paged_kernel(tbl_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
         acc_ref[...] = jnp.zeros_like(acc_ref)
 
     valid_len = len_ref[bh // num_heads]
+    q_start = qstart_ref[bh // num_heads]
     q = q_ref[...].astype(jnp.float32) * scale
     k = k_ref[...].astype(jnp.float32)  # (block_size, D)
     v = v_ref[...].astype(jnp.float32)
     s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32)
+    if bias_ref is not None:
+        s = s + bias_ref[...].astype(jnp.float32)
     ki = (page * block_size
           + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1))
-    # Query row r is the (sq-1-r)-th newest position; padded rows
-    # (r >= sq) mask everything and emit zeros.
+    # Query row r sits at absolute position q_start + r: it attends keys
+    # < min(valid_len, q_start + r + 1). Padded rows (r >= sq) mask
+    # everything and emit zeros.
     qi = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-    row_limit = valid_len - (sq - 1 - qi)
+    row_limit = jnp.minimum(valid_len, q_start + qi + 1)
     row_limit = jnp.where(qi < sq, row_limit, 0)
     s = jnp.where(ki < row_limit, s, NEG_INF)
 
@@ -343,17 +366,24 @@ def paged_flash_attention(
     lengths: jax.Array,
     *,
     scale: Optional[float] = None,
+    bias: Optional[jax.Array] = None,
+    q_start: Optional[jax.Array] = None,
     interpret: bool = False,
 ) -> jax.Array:
     """Pallas ragged paged attention. Same contract as
-    paged_attention_reference; the block table and lengths ride as
-    scalar-prefetch operands so each grid step's BlockSpec index_map picks
-    the right arena page — gathered pages never materialize in HBM."""
+    paged_attention_reference; the block table, lengths, and q_start ride
+    as scalar-prefetch operands so each grid step's BlockSpec index_map
+    picks the right arena page — gathered pages never materialize in HBM.
+    `bias` (broadcastable to (B, H, Sq, P*block_size)) streams one
+    (Sq, block_size) tile per page alongside the K/V pages; its bytes are
+    ~Sq/(2·D) of the KV traffic, so the used-token byte scaling holds."""
     b, h, sq, d = q.shape
     num_pages, _, block_size, _ = k_pages.shape
     _, max_pages = block_tables.shape
     if scale is None:
         scale = 1.0 / float(np.sqrt(d))
+    if q_start is None:
+        q_start = lengths - sq
 
     sq_p = max(8, 1 << (sq - 1).bit_length())  # MXU-friendly query rows
     q_p = _pad_to(q, 2, sq_p)
@@ -361,20 +391,35 @@ def paged_flash_attention(
     tbl = jnp.repeat(block_tables.astype(jnp.int32), h, axis=0)  # (b*h, P)
     num_heads_outer = h  # closed over by the index maps below
 
+    in_specs = [
+        pl.BlockSpec((None, sq_p, d), lambda bh, p, tbl, lens, qs: (bh, 0, 0)),
+        pl.BlockSpec((None, None, block_size, d),
+                     lambda bh, p, tbl, lens, qs: (tbl[bh, p],
+                                                   bh % num_heads_outer, 0, 0)),
+        pl.BlockSpec((None, None, block_size, d),
+                     lambda bh, p, tbl, lens, qs: (tbl[bh, p],
+                                                   bh % num_heads_outer, 0, 0)),
+    ]
+    operands = [q_f, k_pages, v_pages]
+    if bias is not None:
+        # Key axis laid out in table order: tile (Sq, block_size) at page
+        # p of the flattened (b*h, Sq_p, P*bs) bias rides the page grid.
+        bias_f = jnp.broadcast_to(
+            bias.astype(jnp.float32),
+            (b, h, sq, max_pages * block_size))
+        bias_f = _pad_to(bias_f, 2, sq_p).reshape(
+            b * h, sq_p, max_pages * block_size)
+        in_specs.insert(0, pl.BlockSpec(
+            (None, sq_p, block_size),
+            lambda bh, p, tbl, lens, qs: (bh, 0, p)))
+        operands.insert(0, bias_f)
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,  # block tables, lengths
+        num_scalar_prefetch=3,  # block tables, lengths, q_start
         grid=(b * h, max_pages),
-        in_specs=[
-            pl.BlockSpec((None, sq_p, d), lambda bh, p, tbl, lens: (bh, 0, 0)),
-            pl.BlockSpec((None, None, block_size, d),
-                         lambda bh, p, tbl, lens: (tbl[bh, p],
-                                                   bh % num_heads_outer, 0, 0)),
-            pl.BlockSpec((None, None, block_size, d),
-                         lambda bh, p, tbl, lens: (tbl[bh, p],
-                                                   bh % num_heads_outer, 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((None, sq_p, d),
-                               lambda bh, p, tbl, lens: (bh, 0, 0)),
+                               lambda bh, p, tbl, lens, qs: (bh, 0, 0)),
         scratch_shapes=[
             pltpu.VMEM((sq_p, 1), jnp.float32),
             pltpu.VMEM((sq_p, 1), jnp.float32),
@@ -383,13 +428,13 @@ def paged_flash_attention(
     )
     kernel = functools.partial(
         _paged_kernel, scale=scale, block_size=block_size,
-        num_heads=h, sq=sq)
+        num_heads=h, sq=sq, has_bias=bias is not None)
     out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b * h, sq_p, d), q.dtype),
         interpret=interpret,
-    )(tbl, lengths.astype(jnp.int32), q_f, k_pages, v_pages)
+    )(tbl, lengths.astype(jnp.int32), q_start.astype(jnp.int32), *operands)
     return out.reshape(b, h, sq_p, d)[:, :, :sq, :]
 
 
@@ -401,11 +446,15 @@ def paged_attention(
     lengths: jax.Array,
     *,
     scale: Optional[float] = None,
+    bias: Optional[jax.Array] = None,
+    q_start: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Dispatch: Pallas ragged kernel on TPU when it applies (MXU-friendly
     head dim, lane-aligned pages), gather-based jnp reference otherwise.
-    Semantics identical; the paged-decode suites assert token-exactness of
-    both against the dense path."""
+    Sq>1 (speculative verify blocks, chunked prefill) routes through the
+    same kernel — the query rows pad to the MXU sublane floor and mask per
+    row. Semantics identical; the paged-decode suites assert
+    token-exactness of both against the dense path."""
     use_pallas = (
         _HAVE_PALLAS
         and _on_tpu()
@@ -414,9 +463,122 @@ def paged_attention(
     )
     if use_pallas:
         return paged_flash_attention(q, k_pages, v_pages, block_tables,
-                                     lengths, scale=scale)
+                                     lengths, scale=scale, bias=bias,
+                                     q_start=q_start)
     return paged_attention_reference(q, k_pages, v_pages, block_tables,
-                                     lengths, scale=scale)
+                                     lengths, scale=scale, bias=bias,
+                                     q_start=q_start)
+
+
+def paged_prefill_attention(
+    q: jax.Array,
+    k_pages: jax.Array,
+    v_pages: jax.Array,
+    block_tables: jax.Array,
+    chunk_start: jax.Array,
+    chunk_lens: jax.Array,
+    *,
+    scale: Optional[float] = None,
+    bias: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Chunked-prefill entry: q (B, Sq, ...) holds a fixed-size chunk of
+    prompt positions starting at `chunk_start` (B,), of which only the
+    first `chunk_lens` (B,) rows are real (a non-divisible prompt's final
+    chunk is short; padded rows attend nothing real and their K/V rows
+    must have been routed to the trash page by the caller's append).
+    Valid keys = chunk_start + chunk_lens: the chunk's own already-written
+    rows included, later garbage excluded. Row r attends keys
+    < min(chunk_start + chunk_lens, chunk_start + r + 1)."""
+    return paged_attention(q, k_pages, v_pages, block_tables,
+                           chunk_start + chunk_lens, scale=scale, bias=bias,
+                           q_start=chunk_start)
+
+
+class PagedKV:
+    """Block-table KV handle for paging-aware decode steps.
+
+    The value a PagedSlotPool (servables/decode_sessions.py) hands a
+    model's paged step contract, and the layout paged speculative decode
+    builds internally: per KV leaf one page arena `(num_pages(+trash),
+    ..., block_size, ...)`, one shared `(B, W)` int32 block table, and
+    per-sequence token counts. Purely functional — `append` returns a new
+    handle with updated arenas; the model never sees a gathered dense
+    cache.
+
+    Fields:
+      arenas     {key: arena}; key is caller-chosen (the pool uses the
+                 leaf's pytree path, e.g. ("caches", 0, "self", "k"))
+      row_axes   {key: arena axis holding the block_size rows}
+      tables     (B, W) int32; entries past a sequence's pages may name
+                 any in-range page (the pool points them at trash)
+      lengths    (B,) int32 tokens written BEFORE this step/chunk
+      active     (B,) bool or None (None = all rows live)
+      block_size, trash  static ints
+    """
+
+    __slots__ = ("arenas", "row_axes", "tables", "lengths", "active",
+                 "block_size", "trash")
+
+    def __init__(self, arenas: dict, tables: jax.Array, lengths: jax.Array,
+                 *, block_size: int, trash: int, row_axes: dict,
+                 active: Optional[jax.Array] = None):
+        self.arenas = dict(arenas)
+        self.row_axes = dict(row_axes)
+        self.tables = tables
+        self.lengths = lengths
+        self.active = active
+        self.block_size = int(block_size)
+        self.trash = int(trash)
+
+    def append(self, updates: dict, *,
+               row_valid: Optional[jax.Array] = None) -> "PagedKV":
+        """Scatter this step's new rows into the arenas at positions
+        lengths .. lengths+Sq-1. updates: {key: rows} with rows
+        (B, Sq, *unit-minus-row-axis) — e.g. a (P, H, bs, D) arena takes
+        (B, Sq, H, D) rows. Rows of inactive sequences, and rows at or
+        past `row_valid` (B,) (a partial final prefill chunk), land on
+        the trash page. Returns the updated handle."""
+        first = next(iter(updates.values()))
+        b, sq = first.shape[:2]
+        pos = self.lengths[:, None] + jnp.arange(sq)[None, :]     # (B, Sq)
+        page = jnp.take_along_axis(
+            self.tables, pos // self.block_size, axis=1)
+        keep = jnp.ones((b, sq), bool)
+        if self.active is not None:
+            keep = jnp.logical_and(keep, self.active[:, None])
+        if row_valid is not None:
+            keep = jnp.logical_and(keep,
+                                   jnp.arange(sq)[None, :] < row_valid[:, None])
+        page = jnp.where(keep, page, self.trash).reshape(-1)
+        off = (pos % self.block_size).reshape(-1)
+        arenas = dict(self.arenas)
+        for key, rows in updates.items():
+            arena = arenas[key]
+            ua = self.row_axes[key] - 1  # row axis inside the page unit
+            idx = (page,) + (slice(None),) * ua + (off,)
+            flat = rows.reshape((b * sq,) + rows.shape[2:])
+            arenas[key] = arena.at[idx].set(flat.astype(arena.dtype))
+        return PagedKV(arenas, self.tables, self.lengths,
+                       block_size=self.block_size, trash=self.trash,
+                       row_axes=self.row_axes, active=self.active)
+
+    def attend(self, q: jax.Array, k_key, v_key, *,
+               scale: Optional[float] = None,
+               bias: Optional[jax.Array] = None,
+               lengths: Optional[jax.Array] = None,
+               q_start: Optional[jax.Array] = None) -> jax.Array:
+        """paged_attention over this handle's arenas. Default convention:
+        the Sq query rows are the block just appended — valid keys =
+        lengths + Sq, q_start = lengths. A partial prefill chunk passes
+        explicit lengths (= chunk_start + chunk_lens) and q_start."""
+        sq = q.shape[2]
+        if lengths is None:
+            lengths = self.lengths + sq
+        if q_start is None:
+            q_start = self.lengths
+        return paged_attention(q, self.arenas[k_key], self.arenas[v_key],
+                               self.tables, lengths, scale=scale, bias=bias,
+                               q_start=q_start)
 
 
 def _on_tpu() -> bool:

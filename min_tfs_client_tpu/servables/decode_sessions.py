@@ -39,15 +39,19 @@ from min_tfs_client_tpu.utils.status import ServingError
 
 _paging_defaults_lock = threading.Lock()
 _paging_defaults = {"block_size": 0, "num_blocks": 0,
-                    "evict_policy": "swap"}  # guarded_by: _paging_defaults_lock
+                    "evict_policy": "swap",
+                    "prefill_chunk": 0}  # guarded_by: _paging_defaults_lock
 
 EVICT_POLICIES = ("swap", "close", "refuse")
 
 
 def set_default_paging(block_size: int = 0, num_blocks: int = 0,
-                       evict_policy: str = "swap") -> dict:
+                       evict_policy: str = "swap",
+                       prefill_chunk: int = 0) -> dict:
     """Install process defaults for new decode pools; returns the previous
-    defaults so a loader can scope them to one factory call."""
+    defaults so a loader can scope them to one factory call.
+    prefill_chunk sizes chunked-prefill rounds (0 = one page per round,
+    i.e. block_size tokens)."""
     if evict_policy not in EVICT_POLICIES:
         raise ServingError.invalid_argument(
             f"kv_evict_policy must be one of {EVICT_POLICIES}, "
@@ -57,7 +61,8 @@ def set_default_paging(block_size: int = 0, num_blocks: int = 0,
         previous = dict(_paging_defaults)
         _paging_defaults = {"block_size": int(block_size),
                             "num_blocks": int(num_blocks),
-                            "evict_policy": evict_policy}
+                            "evict_policy": evict_policy,
+                            "prefill_chunk": int(prefill_chunk)}
     return previous
 
 
@@ -77,7 +82,7 @@ _paging_tls = threading.local()
 
 @contextlib.contextmanager
 def paging_scope(block_size: int = 0, num_blocks: int = 0,
-                 evict_policy: str = "swap"):
+                 evict_policy: str = "swap", prefill_chunk: int = 0):
     """Scope paging knobs to ONE loader factory call via a THREAD-LOCAL
     override (the factory and the builders it invokes run synchronously on
     this thread). A process-global set/restore pair — even a locked one —
@@ -91,7 +96,8 @@ def paging_scope(block_size: int = 0, num_blocks: int = 0,
     previous = getattr(_paging_tls, "override", None)
     _paging_tls.override = {"block_size": int(block_size),
                             "num_blocks": int(num_blocks),
-                            "evict_policy": evict_policy}
+                            "evict_policy": evict_policy,
+                            "prefill_chunk": int(prefill_chunk)}
     try:
         yield
     finally:
@@ -375,6 +381,13 @@ def _plain_path(path) -> tuple:
     return tuple(out)
 
 
+# Sentinel a paged tick returns for a slot still streaming its prefill
+# chunks: the session consumed a chunk round but has no token yet — the
+# caller re-enters the tick batcher (other sessions' decode steps ride the
+# rounds in between) until a real row arrives.
+PREFILL_PENDING = object()
+
+
 class _SwappedSession:
     """Host-side copy of an evicted session's pages (bit-identical bf16/f32
     round trip; restored by scatter on the session's next tick)."""
@@ -403,21 +416,34 @@ class PagedSlotPool:
         not max_decode_len × max_slots;
       * a free-list PageAllocator guarded by its own declared lock.
 
-    The tick gathers each session's pages back to a contiguous view sized
-    by the CURRENT table width (the same gather as the ragged paged
-    attention oracle, ops/attention.paged_attention_reference — on every
-    backend: the generic step_fn runs its own dense attention internally,
-    so the Pallas ragged kernel (ops/attention.paged_flash_attention),
-    while token-exact and TPU-gated via paged_attention(), is NOT yet
-    driven by this tick; wiring it in needs a paging-aware step
-    contract), runs the unmodified per-session step_fn under vmap, and
-    scatters back each session's NEWEST page only — the step contract for paged leaves is append-only
-    along the paged axis (one new row per step at the step index, earlier
-    rows pass through), which is what makes them KV caches at all.
+    Two decode programs, dispatched on whether the model declares a
+    paging-aware step contract (`paged_step`):
+
+      direct (contract declared)  the tick hands the model a PagedKV
+          handle (ops/attention.PagedKV): arenas + block tables +
+          per-session lengths, no dense materialization. The model
+          appends exactly this step's new K/V rows (inactive slots and
+          padded chunk rows route to the trash page) and attends via
+          ops/attention.paged_attention() — the ragged Pallas kernel on
+          TPU, the gather oracle elsewhere — so per-tick KV reads scale
+          with the pages sessions actually own, not the table width.
+          The same contract powers chunked prefill (`prefill_chunk`
+          rounds streaming a forced decoder prefix through the Sq>1
+          kernel path) and is what paged speculative verify blocks ride.
+
+      dense-gather (fallback, byte-for-byte the pre-contract behavior)
+          gather each session's pages back to a contiguous view sized by
+          the CURRENT table width, run the unmodified per-session
+          step_fn under vmap, scatter back each session's NEWEST page
+          only — the step contract for paged leaves is append-only along
+          the paged axis (one new row per step at the step index,
+          earlier rows pass through), which is what makes them KV caches
+          at all.
+
     Recycled pages are NOT zeroed: rows at or beyond a session's written
     length are masked inside the model (exp(NEG_INF) underflows to exactly
     0.0), so garbage never reaches an output — the paged-decode suite
-    asserts token-exactness against the dense pool.
+    asserts token-exactness against the dense pool on both programs.
 
     Phase separation: `write()` only QUEUES a prefilled state (prefill
     phase); the next tick integrates pending prefills through a separate
@@ -441,7 +467,22 @@ class PagedSlotPool:
                  paged_axis_fn: Callable[[tuple], Optional[int]] = None,
                  evict_policy: str = "swap",
                  max_prefills_per_tick: int = 8,
+                 paged_step=None,
+                 prefill_chunk: int = 0,
                  metric_label: str = "default"):
+        """`paged_step` declares the paging-aware step contract: an object
+        with
+          decode(params, tree, kv) -> (new_tree, kv, outputs)
+          prefill_chunk(params, tree, kv, tokens, chunk_lens, next_tokens)
+              -> (new_tree, kv)
+        where `tree` is the session-state template with dense leaves
+        slot-batched `(max_slots, *leaf)` and paged leaves replaced by
+        None, and `kv` is an ops/attention.PagedKV keyed by the paged
+        leaves' pytree paths. Both are traced (called inside jit, state
+        donated); decode's outputs and every returned dense leaf must be
+        slot-batched, inactive rows merge away. `prefill_chunk` (tokens,
+        default block_size) sizes the chunk a forced decoder prefix
+        streams through per round."""
         import jax
         import jax.numpy as jnp
 
@@ -459,12 +500,16 @@ class PagedSlotPool:
         self._params = params
         self._policy = evict_policy
         self._max_prefills = int(max_prefills_per_tick)
+        self._paged_step = paged_step
+        self.prefill_chunk = int(prefill_chunk) if prefill_chunk \
+            else int(block_size)
         self.metric_label = metric_label
 
         shapes = jax.eval_shape(lambda: template_state)
         flat, treedef = jax.tree_util.tree_flatten_with_path(shapes)
         self._treedef = treedef
         self._leaves = [leaf for _, leaf in flat]
+        self._paths = [_plain_path(p) for p, _ in flat]
         paged_axes: dict[int, int] = {}
         seq_len = None
         for i, (path, leaf) in enumerate(flat):
@@ -502,6 +547,7 @@ class PagedSlotPool:
         self._units: dict[int, tuple] = {}
         arena_bytes = 0
         dense_equiv = 0
+        page_bytes_total = 0  # bytes one page holds across ALL paged leaves
         for i, axis in paged_axes.items():
             shape = self._leaves[i].shape
             unit = tuple(shape[1:axis]) + (self.block_size,) \
@@ -512,12 +558,14 @@ class PagedSlotPool:
             for d in unit:
                 per_page *= int(d)
             arena_bytes += (self.num_blocks + 1) * per_page
+            page_bytes_total += per_page
             per_leaf = itemsize
             for d in shape:
                 per_leaf *= int(d)
             dense_equiv += self.max_slots * per_leaf
         self.arena_bytes = arena_bytes
         self.dense_equivalent_bytes = dense_equiv
+        self.page_bytes = page_bytes_total
 
         self._lock = threading.Lock()
         # Tuples, not lists: the pools are identity-swapped wholesale under
@@ -539,10 +587,13 @@ class PagedSlotPool:
         self._swapped: dict[int, _SwappedSession] = {}  # guarded_by: self._lock
         self._dead: dict[int, ServingError] = {}   # guarded_by: self._lock
         self._pending: dict[int, object] = {}      # guarded_by: self._lock
+        self._prefix: dict[int, dict] = {}         # guarded_by: self._lock
         self._width = 1                            # guarded_by: self._lock
+        self._gather_bytes_last = 0                # guarded_by: self._lock
         self._counters = {"prefill_flushed": 0, "decode_ticks": 0,
                           "evicted_swap": 0, "evicted_close": 0,
-                          "restored": 0}           # guarded_by: self._lock
+                          "restored": 0,
+                          "prefill_chunks": 0}     # guarded_by: self._lock
         self._stats_lock = threading.Lock()
         self._stats_cache: dict = {}               # guarded_by: self._stats_lock
 
@@ -628,6 +679,70 @@ class PagedSlotPool:
                         page.astype(arenas[self._arena_pos[i]].dtype))
             return out_dense, out_arenas, outputs
 
+        def _contract_tree(dense_list):
+            """Session-state tree for the step contract: dense leaves
+            slot-batched, paged leaves None (they live in the arenas the
+            PagedKV handle carries)."""
+            leaves = [dense_list[i] if i not in paged_axes else None
+                      for i in range(len(self._leaves))]
+            return jax.tree_util.tree_unflatten(treedef, leaves)
+
+        def _contract_kv(arenas, tables, lengths, active):
+            from min_tfs_client_tpu.ops.attention import PagedKV
+
+            return PagedKV(
+                {self._paths[i]: arenas[self._arena_pos[i]]
+                 for i in paged_axes},
+                tables, lengths,
+                block_size=self.block_size, trash=self._trash,
+                row_axes={self._paths[i]: paged_axes[i]
+                          for i in paged_axes},
+                active=active)
+
+        def _merge_dense(dense_list, new_tree, active):
+            """Masked merge of the contract's returned dense leaves,
+            matched BY PATH (the model returns paged leaves as None, so
+            positional zip would mis-align on structure drift)."""
+            new_by_path = {
+                _plain_path(p): leaf for p, leaf in
+                jax.tree_util.tree_flatten_with_path(new_tree)[0]}
+            out = list(dense_list)
+            for i in dense_idx:
+                n = new_by_path[self._paths[i]]
+                mask = active.reshape((-1,) + (1,) * (n.ndim - 1))
+                out[i] = jnp.where(mask, n, dense_list[i])
+            return out
+
+        def direct_tick_fn(params, dense_list, arenas, tables, active,
+                           lengths):
+            """Contract decode program: no dense materialization — the
+            model appends this step's K/V rows and attends through the
+            block tables (ops/attention.paged_attention)."""
+            kv = _contract_kv(arenas, tables, lengths, active)
+            new_tree, kv, outputs = paged_step.decode(
+                params, _contract_tree(dense_list), kv)
+            out_dense = _merge_dense(dense_list, new_tree, active)
+            out_arenas = [kv.arenas[self._paths[i]]
+                          for i in sorted(paged_axes)]
+            return out_dense, out_arenas, outputs
+
+        def chunk_fn(params, dense_list, arenas, tables, tokens,
+                     chunk_lens, next_tokens, lengths):
+            """Chunked-prefill program: stream `prefill_chunk` forced
+            decoder-prefix positions per chunking slot through the Sq>1
+            contract path. chunk_lens[slot] == 0 marks a slot not
+            chunking this round; a short final chunk's padded rows route
+            to the trash page inside the contract's append."""
+            active = chunk_lens > 0
+            kv = _contract_kv(arenas, tables, lengths, active)
+            new_tree, kv = paged_step.prefill_chunk(
+                params, _contract_tree(dense_list), kv, tokens,
+                chunk_lens, next_tokens)
+            out_dense = _merge_dense(dense_list, new_tree, active)
+            out_arenas = [kv.arenas[self._paths[i]]
+                          for i in sorted(paged_axes)]
+            return out_dense, out_arenas
+
         def gather_fn(arenas, table_row):
             """Swap-out program: one session's pages, trash-padded up to a
             pow2 width bucket (_swap_width) — transfer and host RAM scale
@@ -646,9 +761,18 @@ class PagedSlotPool:
         self._write_jit = rt.instrument_jit(
             f"paged:{metric_label}:prefill_write",
             jax.jit(write_fn, donate_argnums=(0,)))
-        self._tick_jit = rt.instrument_jit(
-            f"paged:{metric_label}:tick",
-            jax.jit(tick_fn, donate_argnums=(1, 2)))
+        if paged_step is not None:
+            self._tick_jit = rt.instrument_jit(
+                f"paged:{metric_label}:tick_direct",
+                jax.jit(direct_tick_fn, donate_argnums=(1, 2)))
+            self._chunk_jit = rt.instrument_jit(
+                f"paged:{metric_label}:prefill_chunk",
+                jax.jit(chunk_fn, donate_argnums=(1, 2)))
+        else:
+            self._tick_jit = rt.instrument_jit(
+                f"paged:{metric_label}:tick",
+                jax.jit(tick_fn, donate_argnums=(1, 2)))
+            self._chunk_jit = None
         self._gather_jit = jax.jit(gather_fn)
         self._restore_jit = jax.jit(restore_fn, donate_argnums=(0,))
         with self._lock:
@@ -691,6 +815,10 @@ class PagedSlotPool:
             "evict_policy": self._policy,
             "arena_bytes": self.arena_bytes,
             "dense_equivalent_bytes": self.dense_equivalent_bytes,
+            "step_contract": self._paged_step is not None,
+            "prefill_chunk_size": self.prefill_chunk,
+            "chunking_sessions": len(self._prefix),
+            "kv_gather_bytes_per_tick": self._gather_bytes_last,
             **dict(self._counters),
         }
         with self._stats_lock:
@@ -713,6 +841,7 @@ class PagedSlotPool:
 
     def _release_locked(self, slot: int) -> None:
         self._pending.pop(slot, None)
+        self._prefix.pop(slot, None)
         self._dead.pop(slot, None)
         self._swapped.pop(slot, None)
         self._tokens.pop(slot, None)
@@ -722,15 +851,56 @@ class PagedSlotPool:
             self.allocator.free(pages)
         if slot not in self._free_slots:
             self._free_slots.append(slot)
+        self._shrink_width_locked()
+
+    def _shrink_width_locked(self) -> None:
+        """Table-width shrink: when the high-water session departs, drop
+        the pow2 width bucket back to what live sessions actually hold —
+        one long-dead outlier must not pin wide (recompile-prone) tick
+        shapes forever. Growth stays monotone within a session's life;
+        shrink only fires on close/eviction, so compile count stays
+        bounded by churn of the longest session, not by tokens."""
+        held = max((len(p) for p in self._pages.values()), default=0)
+        target = min(self.pages_per_session,
+                     1 << max(0, held - 1).bit_length())
+        if target < self._width:
+            self._width = max(1, target)
 
     # -- prefill phase --------------------------------------------------------
 
-    def write(self, state, slot: int) -> None:
+    def write(self, state, slot: int, *, prefill_inputs=None,
+              prefill_next: int = 0) -> None:
         """Queue a freshly-prefilled session (PREFILL phase). The state is
         integrated by the next tick's write program, so a long prefill
-        burst never blocks in-flight decode rounds on the pool lock."""
+        burst never blocks in-flight decode rounds on the pool lock.
+
+        `prefill_inputs` (1-D int array) queues a forced decoder prefix
+        for CHUNKED prefill: the positions stream through the step
+        contract's Sq>1 path `prefill_chunk` tokens per round, interleaved
+        with in-flight decode ticks, instead of one monolithic prefill
+        stalling the pool. `prefill_next` is the input token the first
+        decode step after the prefix consumes. Requires a step contract —
+        the dense-gather fallback has no multi-row program to stream
+        through."""
+        import numpy as np
+
+        if prefill_inputs is not None and self._paged_step is None:
+            raise ServingError.unimplemented(
+                "chunked prefill needs a paging-aware step contract; this "
+                "pool runs the dense-gather fallback (model declared no "
+                "paged_step)")
         with self._lock:
             self._pending[slot] = state
+            if prefill_inputs is not None:
+                inputs = np.asarray(prefill_inputs, np.int32).reshape(-1)
+                if inputs.size > self.max_len:
+                    raise ServingError.invalid_argument(
+                        f"decoder prefix ({inputs.size} positions) exceeds "
+                        f"max_decode_len {self.max_len}")
+                if inputs.size:
+                    self._prefix[slot] = {"inputs": inputs,
+                                          "next": int(prefill_next),
+                                          "done": 0}
             self._last_tick[slot] = time.monotonic()
             self._publish_stats_locked()
 
@@ -831,6 +1001,7 @@ class PagedSlotPool:
             self._counters["evicted_close"] += 1
             self._report_eviction("close")
         self.allocator.free(pages)
+        self._shrink_width_locked()
 
     def _restore_locked(self, slot: int, busy: tuple) -> None:
         from min_tfs_client_tpu.observability import runtime
@@ -872,10 +1043,14 @@ class PagedSlotPool:
     # -- decode phase ---------------------------------------------------------
 
     def tick(self, slots: list[int]) -> dict[int, object]:
-        """Advance the given slots in ONE device call. Returns per-slot
-        host outputs; slots that could not run carry their TYPED error as
-        the value (per-slot failure isolation — a capacity refusal for one
-        session must not poison its tick-mates)."""
+        """Advance the given slots in ONE device call (plus, on the
+        contract path, at most one chunked-prefill round for sessions
+        still streaming a forced prefix). Returns per-slot host outputs;
+        slots that could not run carry their TYPED error as the value
+        (per-slot failure isolation — a capacity refusal for one session
+        must not poison its tick-mates), and slots still mid-prefix carry
+        the PREFILL_PENDING sentinel (the caller re-enters the batcher so
+        tick-mates' decodes interleave with the remaining chunks)."""
         import numpy as np
 
         from min_tfs_client_tpu.servables.servable import fetch_outputs
@@ -887,11 +1062,25 @@ class PagedSlotPool:
         with self._lock:
             self._flush_prefills_locked(limit=self._max_prefills,
                                         urgent=tuple(slots))
+            chunk_errors: dict[int, ServingError] = {}
+            if self._prefix:
+                chunk_errors = self._run_chunk_round_locked(
+                    requested=tuple(slots))
             for s in slots:
                 err = self._dead.get(s)
                 if err is not None:
                     err.slot_fatal = True
                     results[s] = err
+                    continue
+                if s in chunk_errors:
+                    # A capacity refusal mid-prefix must surface to the
+                    # requester (session + progress intact, retryable) —
+                    # swallowing it would spin the caller on
+                    # PREFILL_PENDING with no possible progress.
+                    results[s] = chunk_errors[s]
+                    continue
+                if s in self._prefix:
+                    results[s] = PREFILL_PENDING
                     continue
                 try:
                     self._prepare_slot_locked(s, busy=tuple(slots))
@@ -911,13 +1100,30 @@ class PagedSlotPool:
                     tables[s, :len(pages)] = pages
                 active = np.zeros((self.max_slots,), bool)
                 active[live] = True
-                cur_pages = np.zeros((self.max_slots,), np.int32)
-                for s in live:
-                    cur_pages[s] = self._tokens[s] // self.block_size
-                dense, arenas, outputs = self._tick_jit(
-                    self._params, self._dense_pool, self._arenas,
-                    self._jnp.asarray(tables), self._jnp.asarray(active),
-                    self._jnp.asarray(cur_pages))
+                if self._paged_step is not None:
+                    lengths = np.zeros((self.max_slots,), np.int32)
+                    for s, t in self._tokens.items():
+                        lengths[s] = t
+                    dense, arenas, outputs = self._tick_jit(
+                        self._params, self._dense_pool, self._arenas,
+                        self._jnp.asarray(tables),
+                        self._jnp.asarray(active),
+                        self._jnp.asarray(lengths))
+                    # What the ragged kernel actually reads: the pages
+                    # live sessions own — not slots × table width.
+                    gather_bytes = self.page_bytes * sum(
+                        len(self._pages[s]) for s in live)
+                else:
+                    cur_pages = np.zeros((self.max_slots,), np.int32)
+                    for s in live:
+                        cur_pages[s] = self._tokens[s] // self.block_size
+                    dense, arenas, outputs = self._tick_jit(
+                        self._params, self._dense_pool, self._arenas,
+                        self._jnp.asarray(tables),
+                        self._jnp.asarray(active),
+                        self._jnp.asarray(cur_pages))
+                    # The fallback materializes the full gathered view.
+                    gather_bytes = self.page_bytes * self.max_slots * width
                 self._dense_pool = tuple(dense)
                 self._arenas = tuple(arenas)
                 now = time.monotonic()
@@ -925,12 +1131,115 @@ class PagedSlotPool:
                     self._tokens[s] += 1
                     self._last_tick[s] = now
                 self._counters["decode_ticks"] += 1
+                self._gather_bytes_last = gather_bytes
+                self._report_gather_bytes(gather_bytes)
             self._publish_stats_locked()
         if live:
             fetched = fetch_outputs(outputs)
             for s in live:
                 results[s] = {k: np.asarray(v)[s] for k, v in fetched.items()}
         return results
+
+    def _report_gather_bytes(self, gather_bytes: int) -> None:
+        try:
+            from min_tfs_client_tpu.server import metrics
+
+            metrics.safe_set(metrics.kv_gather_bytes_per_tick, gather_bytes,
+                             self.metric_label)
+        except Exception:  # pragma: no cover - metrics must not break serving
+            pass
+
+    def _run_chunk_round_locked(self, requested: tuple) -> dict:
+        """ONE chunked-prefill round: stream the next `prefill_chunk`
+        forced-prefix positions for up to max_prefills_per_tick chunking
+        slots (requested slots always ride — their callers are parked on
+        this very round) through the contract's Sq>1 program. Bounded per
+        tick so an init flood of long prefixes cannot stall in-flight
+        decodes; callers of still-chunking slots get PREFILL_PENDING and
+        re-enter, so chunks interleave with tick-mates' decode rounds.
+        Returns {slot: ServingError} for REQUESTED slots whose chunk hit
+        a capacity refusal (progress intact, caller retries)."""
+        import numpy as np
+
+        errors: dict[int, ServingError] = {}
+        urgent = [s for s in requested if s in self._prefix]
+        order = urgent + [s for s in self._prefix if s not in set(urgent)]
+        # Only flushed sessions hold a block table; unflushed ones catch
+        # the next round after their write-program flush.
+        ready = [s for s in order
+                 if s in self._pages or s in self._swapped]
+        chosen = ready[:max(self._max_prefills, len(urgent))]
+        if not chosen:
+            return errors
+        busy = tuple(set(chosen) | set(requested))
+        chunk = self.prefill_chunk
+        tokens = np.zeros((self.max_slots, chunk), np.int32)
+        chunk_lens = np.zeros((self.max_slots,), np.int32)
+        next_tokens = np.zeros((self.max_slots, 1), np.int32)
+        lengths = np.zeros((self.max_slots,), np.int32)
+        ran: list[tuple[int, int]] = []
+        for s in chosen:
+            pf = self._prefix[s]
+            try:
+                if s in self._swapped:
+                    self._restore_locked(s, busy)
+                inputs, done = pf["inputs"], pf["done"]
+                n = min(chunk, len(inputs) - done)
+                needed = -(-(done + n) // self.block_size)
+                while len(self._pages[s]) < needed:
+                    self._pages[s].append(self._alloc_page_locked(busy))
+                if needed > self._width:
+                    grown = 1 << (needed - 1).bit_length()
+                    self._width = min(self.pages_per_session, grown)
+            except ServingError as exc:
+                # Capacity refusal mid-prefix: the session keeps its
+                # progress and retries; a REQUESTED slot's error surfaces
+                # to its caller (else it would spin on PREFILL_PENDING
+                # against a dry pool), others retry next round.
+                if s in requested:
+                    if not hasattr(exc, "slot_fatal"):
+                        exc.slot_fatal = False
+                    errors[s] = exc
+                continue
+            tokens[s, :n] = inputs[done:done + n]
+            chunk_lens[s] = n
+            next_tokens[s, 0] = (inputs[done + n]
+                                 if done + n < len(inputs) else pf["next"])
+            lengths[s] = done
+            ran.append((s, n))
+        if not ran:
+            return errors
+        width = self._width
+        tables = np.full((self.max_slots, width), self._trash, np.int32)
+        for s, pages in self._pages.items():
+            tables[s, :len(pages)] = pages
+        dense, arenas = self._chunk_jit(
+            self._params, self._dense_pool, self._arenas,
+            self._jnp.asarray(tables), self._jnp.asarray(tokens),
+            self._jnp.asarray(chunk_lens), self._jnp.asarray(next_tokens),
+            self._jnp.asarray(lengths))
+        self._dense_pool = tuple(dense)
+        self._arenas = tuple(arenas)
+        now = time.monotonic()
+        for s, n in ran:
+            pf = self._prefix[s]
+            pf["done"] += n
+            self._tokens[s] = pf["done"]
+            self._last_tick[s] = now
+            self._counters["prefill_chunks"] += 1
+            if pf["done"] >= len(pf["inputs"]):
+                del self._prefix[s]
+        self._report_prefill_chunks(len(ran))
+        return errors
+
+    def _report_prefill_chunks(self, n: int) -> None:
+        try:
+            from min_tfs_client_tpu.server import metrics
+
+            metrics.kv_prefill_chunks.increment(self.metric_label,
+                                                by=float(n))
+        except Exception:  # pragma: no cover - metrics must not break serving
+            pass
 
     def _prepare_slot_locked(self, slot: int, busy: tuple) -> None:
         if slot in self._swapped:

@@ -70,7 +70,9 @@ def make_loader(
             with decode_sessions.paging_scope(
                     block_size=kv_block_size,
                     num_blocks=int(config.get("kv_num_blocks", 0) or 0),
-                    evict_policy=config.get("kv_evict_policy", "swap")):
+                    evict_policy=config.get("kv_evict_policy", "swap"),
+                    prefill_chunk=int(
+                        config.get("kv_prefill_chunk", 0) or 0)):
                 servable = factory(name, version, path, config)
         else:
             servable = factory(name, version, path, config)

@@ -64,6 +64,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "it (typed RESOURCE_EXHAUSTED on its next step), "
                         "or refuse the requesting step (session stays "
                         "live for retry)")
+    p.add_argument("--kv_prefill_chunk", type=int, default=0,
+                   help="tokens per chunked-prefill round: forced decoder "
+                        "prefixes (decode_init_prefix) stream through the "
+                        "paged kernel this many positions per tick, "
+                        "interleaved with in-flight decodes, instead of "
+                        "one monolithic prefill. 0 = one page "
+                        "(kv_block_size tokens) per round")
     p.add_argument("--monitoring_config_file", default="")
     p.add_argument("--ssl_config_file", default="")
     p.add_argument("--max_num_load_retries", type=int, default=5)
@@ -192,6 +199,7 @@ def options_from_args(args) -> ServerOptions:
         kv_block_size=args.kv_block_size,
         kv_num_blocks=args.kv_num_blocks,
         kv_evict_policy=args.kv_evict_policy,
+        kv_prefill_chunk=args.kv_prefill_chunk,
         monitoring_config_file=args.monitoring_config_file,
         ssl_config_file=args.ssl_config_file,
         max_num_load_retries=args.max_num_load_retries,

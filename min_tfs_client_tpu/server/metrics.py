@@ -155,6 +155,17 @@ kv_blocks_total = Gauge(
     ":tpu/serving/kv_blocks_total",
     "KV-cache page capacity of the paged decode pool, by model.",
     ("model",))
+kv_gather_bytes_per_tick = Gauge(
+    ":tpu/serving/kv_gather_bytes_per_tick",
+    "KV bytes the most recent paged decode tick read: pages owned by the "
+    "ticking sessions on the step-contract (direct) path, slots x table "
+    "width on the dense-gather fallback. Updated once per tick under the "
+    "pool lock (a dict write, no device sync).", ("model",))
+kv_prefill_chunks = Counter(
+    ":tpu/serving/kv_prefill_chunks",
+    "Chunked-prefill rounds executed per session (one increment per "
+    "session per chunk): forced decoder prefixes streaming through the "
+    "paged step contract's multi-query path.", ("model",))
 kv_evictions = Counter(
     ":tpu/serving/kv_evictions",
     "Paged-KV pressure events, by model and kind (swap = pages copied to "

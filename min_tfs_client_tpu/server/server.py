@@ -69,6 +69,7 @@ class ServerOptions:
     kv_block_size: int = 0
     kv_num_blocks: int = 0
     kv_evict_policy: str = "swap"
+    kv_prefill_chunk: int = 0
     monitoring_config_file: str = ""
     ssl_config_file: str = ""
     max_num_load_retries: int = 5
@@ -489,11 +490,14 @@ def _platform_configs(opts: ServerOptions, batching) -> dict:
         shared["kv_block_size"] = opts.kv_block_size
         shared["kv_num_blocks"] = opts.kv_num_blocks
         shared["kv_evict_policy"] = opts.kv_evict_policy
-    elif opts.kv_num_blocks or opts.kv_evict_policy != "swap":
+        shared["kv_prefill_chunk"] = opts.kv_prefill_chunk
+    elif (opts.kv_num_blocks or opts.kv_prefill_chunk
+          or opts.kv_evict_policy != "swap"):
         logging.getLogger(__name__).warning(
-            "--kv_num_blocks/--kv_evict_policy have no effect without "
-            "--kv_block_size > 0; the decode stack keeps the dense "
-            "max-length slot pool (docs/MIGRATING.md 'Paged KV cache')")
+            "--kv_num_blocks/--kv_evict_policy/--kv_prefill_chunk have no "
+            "effect without --kv_block_size > 0; the decode stack keeps "
+            "the dense max-length slot pool (docs/MIGRATING.md 'Paged KV "
+            "cache')")
     if batching is not None:
         shared["batching_parameters"] = batching
     mesh_axes = _parse_mesh_axes(opts.mesh_axes)

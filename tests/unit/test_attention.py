@@ -13,6 +13,7 @@ from min_tfs_client_tpu.ops.attention import (
     gather_kv_pages,
     paged_attention_reference,
     paged_flash_attention,
+    paged_prefill_attention,
 )
 
 
@@ -181,6 +182,86 @@ class TestPagedAttention:
                                          lengths, interpret=True)
             np.testing.assert_allclose(np.asarray(kern), np.asarray(got),
                                        atol=2e-5, rtol=2e-5)
+
+    def test_bias_parity_kernel_vs_oracle(self):
+        """Additive bias (T5's relative position bias over gathered key
+        positions) streams per page through the kernel; interpret-mode
+        parity against the oracle's post-scale add."""
+        rng = np.random.default_rng(21)
+        q, k, v, k_pages, v_pages, tables, lengths = _paged_case(
+            21, b=2, h=2, d=16, block_size=4, max_len=24, sq=2)
+        bias = jnp.asarray(rng.standard_normal(
+            (2, 2, 2, tables.shape[1] * 4)), jnp.float32)
+        want = paged_attention_reference(q, k_pages, v_pages, tables,
+                                         lengths, bias=bias)
+        got = paged_flash_attention(q, k_pages, v_pages, tables, lengths,
+                                    bias=bias, interpret=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=2e-5, rtol=2e-5)
+
+    def _chunk_case(self, seed, *, sq, starts, lens_valid, block_size=4,
+                    max_len=24, with_bias=False):
+        """Chunked-prefill fixture: q rows are chunk positions starting at
+        `starts`, only the first `lens_valid` rows real per example."""
+        rng = np.random.default_rng(seed)
+        q, _, _, k_pages, v_pages, tables, _ = _paged_case(
+            seed, b=len(starts), h=2, d=16, block_size=block_size,
+            max_len=max_len, sq=sq)
+        starts = jnp.asarray(starts, jnp.int32)
+        lens_valid = jnp.asarray(lens_valid, jnp.int32)
+        bias = None
+        if with_bias:
+            bias = jnp.asarray(rng.standard_normal(
+                (len(starts), 2, sq, tables.shape[1] * block_size)),
+                jnp.float32)
+        return q, k_pages, v_pages, tables, starts, lens_valid, bias
+
+    def test_chunked_prefill_parity_smoke(self):
+        """Tier-1 smoke for the Sq>1 chunked-prefill path: a divisible
+        chunk, a NON-DIVISIBLE final chunk (valid rows < Sq), and a
+        zero-length row, kernel (interpret) vs oracle."""
+        q, kp, vp, tbl, starts, lens_valid, bias = self._chunk_case(
+            31, sq=4, starts=[0, 9, 4], lens_valid=[4, 2, 0],
+            with_bias=True)
+        want = paged_prefill_attention(q, kp, vp, tbl, starts, lens_valid,
+                                       bias=bias)
+        got = paged_flash_attention(
+            q, kp, vp, tbl, starts + lens_valid, bias=bias,
+            q_start=starts, interpret=True)
+        # Rows past lens_valid are padding whose outputs the pool
+        # discards; compare the real rows only.
+        lv = np.asarray(lens_valid)
+        for i in range(len(lv)):
+            np.testing.assert_allclose(
+                np.asarray(got)[i, :, :lv[i]],
+                np.asarray(want)[i, :, :lv[i]], atol=2e-5, rtol=2e-5)
+        # Zero-length rows emit finite zeros on both paths.
+        np.testing.assert_array_equal(np.asarray(want)[2, :, :0], 0.0)
+        assert np.isfinite(np.asarray(got)).all()
+
+    @pytest.mark.slow
+    @pytest.mark.parametrize("block_size,sq", [(2, 3), (4, 4), (4, 8),
+                                               (8, 5)])
+    def test_chunked_prefill_parity_sweep(self, block_size, sq):
+        """Full sweep across chunk sizes/page sizes incl. ragged starts —
+        slow; tier-1 keeps the smoke above."""
+        rng = np.random.default_rng(block_size * 100 + sq)
+        for seed in range(4):
+            b = int(rng.integers(1, 4))
+            starts = rng.integers(0, 12, (b,)).tolist()
+            lens_valid = rng.integers(0, sq + 1, (b,)).tolist()
+            q, kp, vp, tbl, st, lv, bias = self._chunk_case(
+                seed, sq=sq, starts=starts, lens_valid=lens_valid,
+                block_size=block_size, with_bias=bool(seed % 2))
+            want = paged_attention_reference(q, kp, vp, tbl, st + lv,
+                                             bias=bias, q_start=st)
+            got = paged_flash_attention(q, kp, vp, tbl, st + lv, bias=bias,
+                                        q_start=st, interpret=True)
+            lvn = np.asarray(lv)
+            for i in range(b):
+                np.testing.assert_allclose(
+                    np.asarray(got)[i, :, :lvn[i]],
+                    np.asarray(want)[i, :, :lvn[i]], atol=2e-5, rtol=2e-5)
 
     def test_zero_length_rows_are_zero(self):
         q, k, v, k_pages, v_pages, tables, lengths = _paged_case(

@@ -314,6 +314,9 @@ class TestEviction:
         stats = pool.stats()
         assert stats["evicted_swap"] > 0
         assert stats["restored"] == stats["evicted_swap"]
+        # The satellite bar: this mid-stream swap/restore exactness ran
+        # THROUGH the paged step contract, not the dense-gather fallback.
+        assert stats["step_contract"] is True
 
     def test_close_policy_kills_oldest_idle_with_typed_error(self, model):
         config, _ = model
@@ -371,10 +374,11 @@ class TestEviction:
 class TestServerSurface:
     def test_module_paging_defaults_scope(self):
         prev = set_default_paging(block_size=4, num_blocks=7,
-                                  evict_policy="close")
+                                  evict_policy="close", prefill_chunk=6)
         try:
             assert default_paging() == {"block_size": 4, "num_blocks": 7,
-                                        "evict_policy": "close"}
+                                        "evict_policy": "close",
+                                        "prefill_chunk": 6}
         finally:
             set_default_paging(**prev)
         assert default_paging()["block_size"] == 0
@@ -487,6 +491,373 @@ class TestServerSurface:
                 signature_name="decode_close", timeout=600)
 
 
+class TestStepContract:
+    """ISSUE 11 tentpole: the pooled tick drives the ragged paged path
+    through the model's paging-aware step contract — no dense
+    materialization — with the dense-gather tick as the byte-for-byte
+    fallback for models that don't declare it."""
+
+    def test_contract_on_by_default_and_fallback_matches(self, model):
+        config, _ = model
+        rng = np.random.default_rng(20)
+        prompts = [_prompt(config, rng) for _ in range(3)]
+        direct = _sigs(model, kv_block_size=3)
+        assert direct["decode_init"]._kv_pool.stats()["step_contract"] \
+            is True
+        fallback = _sigs(model, kv_block_size=3, kv_use_step_contract=False)
+        assert fallback["decode_init"]._kv_pool.stats()["step_contract"] \
+            is False
+        for i, ids in enumerate(prompts):
+            want = _run(fallback, _sid(f"fb-{i}"), ids)
+            got = _run(direct, _sid(f"dc-{i}"), ids)
+            assert got == want
+
+    def test_sampled_sessions_through_contract_match_dense(self, model):
+        """The contract's sampling branch (per-slot PRNG keys riding the
+        dense state, _sample_token after the paged logits): same
+        temperature/seed must reproduce the dense pool's stream."""
+        config, _ = model
+        rng = np.random.default_rng(32)
+        ids = _prompt(config, rng)
+
+        def run_sampled(sigs, name):
+            sigs["decode_init"].run(
+                {"session_id": _sid(name), "input_ids": ids,
+                 "temperature": np.asarray([0.8], np.float32),
+                 "seed": np.asarray([7], np.int32)})
+            return [int(sigs["decode_step"].run(
+                {"session_id": _sid(name)})["token"][0])
+                for _ in range(MAXDEC)]
+
+        dense = _sigs(model, sampling=True)
+        want = run_sampled(dense, "sm-d")
+        paged = _sigs(model, sampling=True, kv_block_size=3)
+        assert paged["decode_init"]._kv_pool.stats()["step_contract"]
+        got = run_sampled(paged, "sm-p")
+        assert got == want
+
+    def test_gather_bytes_scale_with_used_tokens(self, model):
+        """THE bandwidth bar, asserted: the direct tick's KV reads are
+        the pages live sessions own; the fallback materializes
+        slots x table-width. At low occupancy direct << fallback."""
+        config, _ = model
+        ids = _prompt(config, np.random.default_rng(21))
+        sigs = _sigs(model, kv_block_size=2, max_sessions=8)
+        pool = sigs["decode_init"]._kv_pool
+        sigs["decode_init"].run({"session_id": _sid("gb"),
+                                 "input_ids": ids})
+        for step in range(4):
+            sigs["decode_step"].run({"session_id": _sid("gb")})
+            stats = pool.stats()
+            pages_held = -(-(step + 1) // pool.block_size)
+            assert stats["kv_gather_bytes_per_tick"] == \
+                pool.page_bytes * pages_held
+        # The dense-gather fallback on the same tick shape reads the
+        # whole (slots, width) table; the direct path read 1 session's
+        # 2 pages of it.
+        fallback_bytes = pool.page_bytes * pool.max_slots * \
+            pool.stats()["table_width"]
+        assert stats["kv_gather_bytes_per_tick"] * 4 <= fallback_bytes
+        from min_tfs_client_tpu.server import metrics
+
+        assert metrics.kv_gather_bytes_per_tick.value("t5-paged") == \
+            stats["kv_gather_bytes_per_tick"]
+        sigs["decode_close"].run({"session_id": _sid("gb")})
+
+    def test_table_width_shrinks_when_high_water_session_departs(
+            self, model):
+        """Satellite regression: one long-dead outlier must not pin wide
+        tick shapes forever — and the shrunk-width program must keep the
+        survivors' streams exact."""
+        config, _ = model
+        rng = np.random.default_rng(22)
+        p_long, p_short = _prompt(config, rng), _prompt(config, rng)
+        ref = _sigs(model, kv_block_size=2)
+        want_short = _run(ref, _sid("ws-ref"), p_short)
+
+        sigs = _sigs(model, kv_block_size=2)
+        pool = sigs["decode_init"]._kv_pool
+        # Long session: 7 tokens -> 4 pages -> width bucket 4.
+        sigs["decode_init"].run({"session_id": _sid("ws-long"),
+                                 "input_ids": p_long})
+        for _ in range(7):
+            sigs["decode_step"].run({"session_id": _sid("ws-long")})
+        assert pool.stats()["table_width"] == 4
+        # Short session: 1 token so far -> 1 page.
+        sigs["decode_init"].run({"session_id": _sid("ws-short"),
+                                 "input_ids": p_short})
+        toks = [int(sigs["decode_step"].run(
+            {"session_id": _sid("ws-short")})["token"][0])]
+        # High-water session departs -> width drops to the survivor's.
+        sigs["decode_close"].run({"session_id": _sid("ws-long")})
+        assert pool.stats()["table_width"] == 1
+        while len(toks) < MAXDEC - 1:
+            toks.append(int(sigs["decode_step"].run(
+                {"session_id": _sid("ws-short")})["token"][0]))
+        # ...and it re-grew on demand as the survivor's pages grew (the
+        # final step below releases the slot, shrinking width again).
+        assert pool.stats()["table_width"] == 4
+        toks.append(int(sigs["decode_step"].run(
+            {"session_id": _sid("ws-short")})["token"][0]))
+        assert toks == want_short
+        assert pool.stats()["table_width"] == 1
+
+
+class TestChunkedPrefill:
+    """decode_init_prefix: forced decoder prefixes stream through the
+    contract's Sq>1 kernel path in bounded chunks, interleaved with
+    decode ticks; dense pools prefill monolithically. Streams identical."""
+
+    def _prefix(self, config, rng, n):
+        pre = np.full((1, MAXDEC), config.pad_id, np.int32)
+        pre[0, :n] = rng.integers(2, config.vocab_size, n)
+        return pre
+
+    def _run_prefix(self, sigs, name, ids, pre, steps):
+        out = sigs["decode_init_prefix"].run(
+            {"session_id": _sid(name), "input_ids": ids,
+             "prefix_ids": pre})
+        toks = []
+        for _ in range(steps):
+            row = sigs["decode_step"].run({"session_id": _sid(name)})
+            toks.append((int(row["token"][0]), int(row["step"])))
+        return int(out["prefix_len"]), toks
+
+    @pytest.mark.parametrize("block_size,chunk", [(2, 0), (3, 2)])
+    def test_chunked_matches_dense_monolithic(self, model, block_size,
+                                              chunk):
+        """Tier-1 smoke: non-divisible chunks (5 positions in rounds of
+        2) and page-aligned default chunks both reproduce the dense
+        pool's monolithic-prefill continuation exactly."""
+        config, _ = model
+        rng = np.random.default_rng(23)
+        ids, pre = _prompt(config, rng), self._prefix(config, rng, 5)
+        dense = _sigs(model)
+        want = self._run_prefix(dense, "cp-d", ids, pre, MAXDEC - 5)
+        paged = _sigs(model, kv_block_size=block_size,
+                      kv_prefill_chunk=chunk)
+        got = self._run_prefix(paged, "cp-p", ids, pre, MAXDEC - 5)
+        assert got == want
+        stats = paged["decode_init"]._kv_pool.stats()
+        expect_rounds = -(-5 // (chunk or block_size))
+        assert stats["prefill_chunks"] == expect_rounds
+        assert stats["chunking_sessions"] == 0
+
+    @pytest.mark.slow
+    @pytest.mark.parametrize("block_size,chunk,plen",
+                             [(1, 1, 7), (2, 3, 6), (3, 1, 4), (4, 4, 7),
+                              (8, 2, 5)])
+    def test_chunked_matches_dense_sweep(self, model, block_size, chunk,
+                                         plen):
+        config, _ = model
+        rng = np.random.default_rng(block_size * 100 + chunk * 10 + plen)
+        ids, pre = _prompt(config, rng), self._prefix(config, rng, plen)
+        dense = _sigs(model)
+        want = self._run_prefix(dense, "cs-d", ids, pre, MAXDEC - plen)
+        paged = _sigs(model, kv_block_size=block_size,
+                      kv_prefill_chunk=chunk)
+        got = self._run_prefix(paged, "cs-p", ids, pre, MAXDEC - plen)
+        assert got == want
+
+    def test_prefix_interleaves_with_decode_ticks(self, model):
+        """A long prefix streaming chunk-by-chunk must not perturb a
+        concurrently decoding session — and both finish exact."""
+        config, _ = model
+        rng = np.random.default_rng(24)
+        ids_a, ids_b = _prompt(config, rng), _prompt(config, rng)
+        pre = self._prefix(config, rng, 6)
+        ref = _sigs(model, kv_block_size=2)
+        want_a = _run(ref, _sid("il2-ra"), ids_a)
+        want_b = self._run_prefix(ref, "il2-rb", ids_b, pre, MAXDEC - 6)
+
+        sigs = _sigs(model, kv_block_size=2, kv_prefill_chunk=2)
+        sigs["decode_init"].run({"session_id": _sid("il2-a"),
+                                 "input_ids": ids_a})
+        toks_a = [int(sigs["decode_step"].run(
+            {"session_id": _sid("il2-a")})["token"][0]) for _ in range(3)]
+        got_b = self._run_prefix(sigs, "il2-b", ids_b, pre, MAXDEC - 6)
+        while len(toks_a) < MAXDEC:
+            toks_a.append(int(sigs["decode_step"].run(
+                {"session_id": _sid("il2-a")})["token"][0]))
+        assert toks_a == want_a
+        assert got_b == want_b
+
+    def test_chunked_prefill_under_page_pressure_swaps_exact(self, model):
+        """Chunking sessions hold pages and can be swap victims mid-
+        prefix; the restore must continue the chunk stream bit-exact."""
+        config, _ = model
+        rng = np.random.default_rng(25)
+        ids_a, ids_b = _prompt(config, rng), _prompt(config, rng)
+        pre = self._prefix(config, rng, 6)
+        ref = _sigs(model, kv_block_size=2)
+        want_b = self._run_prefix(ref, "pp-rb", ids_b, pre, MAXDEC - 6)
+        # 5 blocks for two sessions needing up to 4 each -> guaranteed
+        # eviction traffic while B's prefix streams.
+        sigs = _sigs(model, kv_block_size=2, kv_num_blocks=5,
+                     kv_prefill_chunk=2)
+        pool = sigs["decode_init"]._kv_pool
+        sigs["decode_init"].run({"session_id": _sid("pp-a"),
+                                 "input_ids": ids_a})
+        for _ in range(6):
+            sigs["decode_step"].run({"session_id": _sid("pp-a")})
+        got_b = self._run_prefix(sigs, "pp-b", ids_b, pre, MAXDEC - 6)
+        assert got_b == want_b
+        assert pool.stats()["evicted_swap"] > 0
+
+    def test_refuse_policy_mid_prefix_surfaces_typed_error_then_resumes(
+            self, model):
+        """Liveness regression: with kv_evict_policy=refuse and a dry
+        pool, a mid-prefix capacity refusal must surface to the
+        requesting step as RESOURCE_EXHAUSTED (session + chunk progress
+        intact) — NOT leave the caller spinning on the prefill sentinel.
+        After pressure clears, the retry finishes the exact stream."""
+        config, _ = model
+        rng = np.random.default_rng(33)
+        ids_a, ids_b = _prompt(config, rng), _prompt(config, rng)
+        pre = self._prefix(config, rng, 6)
+        ref = _sigs(model, kv_block_size=2)
+        want_b = self._run_prefix(ref, "rfp-rb", ids_b, pre, MAXDEC - 6)
+
+        # 4 blocks: A pins 2 (4 tokens); B's 6-position prefix needs 3.
+        sigs = _sigs(model, kv_block_size=2, kv_num_blocks=4,
+                     kv_evict_policy="refuse", kv_prefill_chunk=2)
+        sigs["decode_init"].run({"session_id": _sid("rfp-a"),
+                                 "input_ids": ids_a})
+        for _ in range(4):
+            sigs["decode_step"].run({"session_id": _sid("rfp-a")})
+        sigs["decode_init_prefix"].run(
+            {"session_id": _sid("rfp-b"), "input_ids": ids_b,
+             "prefix_ids": pre})
+        with pytest.raises(ServingError) as err:
+            sigs["decode_step"].run({"session_id": _sid("rfp-b")})
+        assert err.value.code == RESOURCE_EXHAUSTED
+        sigs["decode_close"].run({"session_id": _sid("rfp-a")})
+        toks = []
+        for _ in range(MAXDEC - 6):
+            row = sigs["decode_step"].run({"session_id": _sid("rfp-b")})
+            toks.append((int(row["token"][0]), int(row["step"])))
+        assert toks == want_b[1]
+
+    def test_close_mid_prefix_leaks_nothing(self, model):
+        config, _ = model
+        rng = np.random.default_rng(26)
+        ids, pre = _prompt(config, rng), self._prefix(config, rng, 6)
+        sigs = _sigs(model, kv_block_size=2, kv_prefill_chunk=2)
+        pool = sigs["decode_init"]._kv_pool
+        sigs["decode_init_prefix"].run(
+            {"session_id": _sid("cm"), "input_ids": ids,
+             "prefix_ids": pre})
+        sigs["decode_close"].run({"session_id": _sid("cm")})
+        stats = pool.stats()
+        assert stats["sessions"] == 0
+        assert stats["blocks_used"] == 0
+        assert stats["chunking_sessions"] == 0
+
+    def test_unpooled_prefix_matches_pooled_dense(self, model):
+        config, _ = model
+        rng = np.random.default_rng(27)
+        ids, pre = _prompt(config, rng), self._prefix(config, rng, 4)
+        dense = _sigs(model)
+        want = self._run_prefix(dense, "up-d", ids, pre, MAXDEC - 4)
+        unpooled = _sigs(model, continuous_batching=False)
+        got = self._run_prefix(unpooled, "up-u", ids, pre, MAXDEC - 4)
+        assert got == want
+
+    def test_prefix_on_contractless_paged_pool_is_typed(self, model):
+        config, _ = model
+        rng = np.random.default_rng(28)
+        ids, pre = _prompt(config, rng), self._prefix(config, rng, 4)
+        sigs = _sigs(model, kv_block_size=2, kv_use_step_contract=False)
+        with pytest.raises(ServingError) as err:
+            sigs["decode_init_prefix"].run(
+                {"session_id": _sid("nc"), "input_ids": ids,
+                 "prefix_ids": pre})
+        assert err.value.code == 12  # UNIMPLEMENTED, never INTERNAL
+
+    def test_bad_prefixes_rejected(self, model):
+        config, _ = model
+        ids = _prompt(config, np.random.default_rng(29))
+        sigs = _sigs(model, kv_block_size=2)
+        empty = np.full((1, MAXDEC), config.pad_id, np.int32)
+        with pytest.raises(ServingError) as err:
+            sigs["decode_init_prefix"].run(
+                {"session_id": _sid("bp"), "input_ids": ids,
+                 "prefix_ids": empty})
+        assert err.value.code == 3  # INVALID_ARGUMENT
+        holey = np.full((1, MAXDEC), config.pad_id, np.int32)
+        holey[0, 0], holey[0, 2] = 5, 7  # pad in the middle
+        with pytest.raises(ServingError) as err:
+            sigs["decode_init_prefix"].run(
+                {"session_id": _sid("bp"), "input_ids": ids,
+                 "prefix_ids": holey})
+        assert err.value.code == 3
+        # Full-width prefix (review finding): zero decode budget remains,
+        # and on dense pools the first step's clamped cache write would
+        # silently corrupt the last prefix row — typed rejection instead.
+        full = np.full((1, MAXDEC), 5, np.int32)
+        for surface in (sigs,
+                        _sigs(model),              # dense pool
+                        _sigs(model, continuous_batching=False)):
+            with pytest.raises(ServingError) as err:
+                surface["decode_init_prefix"].run(
+                    {"session_id": _sid("bp2"), "input_ids": ids,
+                     "prefix_ids": full})
+            assert err.value.code == 3
+
+
+class TestPagedSpeculative:
+    def test_verify_blocks_through_block_tables_token_exact(self, model):
+        """Speculative decoding composes with paging: the target's Sq>1
+        verify blocks run through block tables, streams bitwise equal to
+        the dense-cache speculative path AND to plain greedy."""
+        import jax.numpy as jnp
+
+        config, params = model
+        draft_cfg = t5.T5Config.tiny(num_decoder_layers=1,
+                                     num_encoder_layers=1)
+        draft = t5.init_params(jax.random.PRNGKey(5), draft_cfg)
+        rng = np.random.default_rng(30)
+        ids = jnp.asarray(rng.integers(2, config.vocab_size, (2, SEQ)),
+                          jnp.int32)
+        lens = jnp.sum((ids != config.pad_id).astype(jnp.int32), axis=-1)
+        g_ids, _ = t5.greedy_decode(params, config, ids, lens,
+                                    max_decode_len=MAXDEC)
+        dense = t5.speculative_decode(params, config, draft, draft_cfg,
+                                      ids, lens, max_decode_len=MAXDEC,
+                                      k=3)
+        for bs in (2, 3):
+            paged = t5.speculative_decode(
+                params, config, draft, draft_cfg, ids, lens,
+                max_decode_len=MAXDEC, k=3, kv_block_size=bs)
+            assert jnp.array_equal(paged[0], dense[0])
+            assert jnp.array_equal(paged[1], dense[1])
+            assert int(paged[2]) == int(dense[2])
+        assert jnp.array_equal(dense[0], g_ids)
+
+    def test_builder_routes_speculative_through_paging(self, model):
+        """build_signatures with paging on serves decode_speculative
+        through the paged verify path, same bytes on the wire."""
+        config, params = model
+        draft_cfg = t5.T5Config.tiny(num_decoder_layers=1,
+                                     num_encoder_layers=1)
+        draft = t5.init_params(jax.random.PRNGKey(5), draft_cfg)
+        rng = np.random.default_rng(31)
+        ids = rng.integers(2, config.vocab_size, (2, SEQ)).astype(np.int32)
+
+        def build(**kw):
+            return t5.build_signatures(
+                params, config, seq_len=SEQ, max_decode_len=MAXDEC,
+                draft_params=draft, draft_config=draft_cfg,
+                speculative_k=3, **kw)["decode_speculative"]
+
+        want = build().run({"input_ids": ids})
+        got = build(kv_block_size=2).run({"input_ids": ids})
+        np.testing.assert_array_equal(got["output_ids"],
+                                      want["output_ids"])
+        np.testing.assert_array_equal(got["output_lengths"],
+                                      want["output_lengths"])
+
+
 def test_synthesize_warmup_primes_paged_executables(model):
     """The warmup hook drives prefill + paged tick end to end and leaves
     no pages, pending prefills, or sessions behind."""
@@ -505,3 +876,7 @@ def test_synthesize_warmup_primes_paged_executables(model):
     assert stats["blocks_used"] == 0
     assert stats["sessions"] == 0
     assert stats["decode_ticks"] >= 1
+    # The warmup also primes the decode_init_prefix path (review
+    # finding): the chunked-prefill program must have run and cleaned up.
+    assert stats["prefill_chunks"] >= 1
+    assert stats["chunking_sessions"] == 0
