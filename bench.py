@@ -1086,12 +1086,19 @@ def _t5_pooled_run(config, params, seq: int, decode_len: int, *,
     steps = decode_len - 2
     barrier = threading.Barrier(n_sessions)
 
+    # Each timed step runs under a request trace so the leg's
+    # --breakdown table attributes pooled decode time per stage
+    # (decode/tick, decode/fetch, host/execute) — the tick leader's
+    # trace carries the shared device-round spans.
+    from min_tfs_client_tpu.observability import tracing
+
     def worker(i):
         sid = np.asarray(f"b{i}".encode(), object)
         barrier.wait()
         start = 0 if i else 1  # session 0 already stepped once
         for _ in range(start, steps):
-            row = sigs["decode_step"].run({"session_id": sid})
+            with tracing.request_trace("decode_step", model="t5"):
+                row = sigs["decode_step"].run({"session_id": sid})
             streams[i].append(int(row["token"][0]))
 
     threads = [threading.Thread(target=worker, args=(i,))
@@ -1910,6 +1917,28 @@ def bench_routed(max_iters: int) -> dict:
         direct_ms = p50(direct, iters)
         routed_ms = p50(routed, iters)
 
+        # -- trace-context propagation overhead (ASSERTED in-bench):
+        # tracing off disables the router's span recording, trace-id
+        # minting, and header injection — the whole fleet-tracing tax on
+        # a forward. Adjacent best-of-2 pairs, <5% + 60us floor (same
+        # discipline as the tracing overhead smoke: CPU-noise on a
+        # shared box must not fail an honest implementation).
+        from min_tfs_client_tpu.observability import tracing
+
+        tracing.enable(False)
+        try:
+            p50(routed, 5)
+            prop_off_ms = min(p50(routed, iters), p50(routed, iters))
+        finally:
+            tracing.enable(True)
+        p50(routed, 5)
+        prop_on_ms = min(p50(routed, iters), p50(routed, iters))
+        propagation_overhead = prop_on_ms / max(prop_off_ms, 1e-9)
+        assert prop_on_ms <= prop_off_ms * 1.05 + 0.06, (
+            f"trace propagation costs {propagation_overhead:.3f}x on the "
+            f"routed leg ({prop_on_ms:.3f} vs {prop_off_ms:.3f} ms p50); "
+            "the <5% budget is the fleet-tracing contract")
+
         # -- concurrent throughput through the full stack (8 in-flight)
         def qps(client, total=64, threads=8):
             import concurrent.futures as cf
@@ -1945,8 +1974,26 @@ def bench_routed(max_iters: int) -> dict:
                                signature_name="decode_close")
         step_ts.sort()
 
+        # Per-stage tables for the routed leg: the ROUTER's lanes come
+        # from this process's tracing ring (child_main attaches them as
+        # extra.stage_breakdown under --breakdown); the BACKEND's lanes
+        # are fetched from a backend's own trace ring over its
+        # monitoring port, so the record shows both sides of the hop.
+        backend_stages = None
+        if os.environ.get("BENCH_BREAKDOWN", "") not in ("", "0"):
+            import urllib.request as _urlreq
+
+            rest_port = int(backends[0].rsplit(":", 1)[1])
+            with _urlreq.urlopen(
+                    f"http://127.0.0.1:{rest_port}"
+                    "/monitoring/traces?summary=1", timeout=10) as resp:
+                backend_stages = json.loads(resp.read()).get("stages")
+
         routed.close()
         direct.close()
+        extra_breakdown = (
+            {"stage_breakdown_backend": backend_stages}
+            if backend_stages else {})
         return {
             "metric": "routed_predict_p50_ms", "value": routed_ms,
             "unit": "ms",
@@ -1960,9 +2007,14 @@ def bench_routed(max_iters: int) -> dict:
                 "qps_ratio": round(qps_routed / max(qps_direct, 1e-9), 3),
                 "session_step_p50_ms": round(
                     step_ts[len(step_ts) // 2], 3),
+                "propagation_p50_on_ms": round(prop_on_ms, 3),
+                "propagation_p50_off_ms": round(prop_off_ms, 3),
+                "propagation_overhead_ratio": round(
+                    propagation_overhead, 3),
                 "backends": 3,
                 "bit_identical": True,
                 "sticky_session_verified": True,
+                **extra_breakdown,
             },
         }
     finally:

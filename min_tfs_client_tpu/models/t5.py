@@ -1538,7 +1538,7 @@ def _build_pooled_session_signatures(params: dict, config: T5Config, *,
             metric_label="t5-paged")
     else:
         pool = SlotPool(template, one_step, max_slots=max_slots,
-                        params=params)
+                        params=params, metric_label="t5-pooled")
     batcher = TickBatcher(pool.tick)
     store = DecodeSessionStore(
         max_sessions=max_slots, ttl_s=session_ttl_s,
@@ -1570,7 +1570,7 @@ def _build_pooled_session_signatures(params: dict, config: T5Config, *,
         state = prefill_jit(*args)
         slot = pool.acquire_slot()
         try:
-            pool.write(state, slot)
+            pool.write(state, slot, session_key=sid)
             store.put(sid, (slot, 0))
         except Exception:
             pool.release_slot(slot)
@@ -1608,11 +1608,11 @@ def _build_pooled_session_signatures(params: dict, config: T5Config, *,
                     [np.asarray([config.decoder_start_id], np.int32),
                      tokens[:-1].astype(np.int32)])
                 pool.write(state, slot, prefill_inputs=prefix_inputs,
-                           prefill_next=int(tokens[-1]))
+                           prefill_next=int(tokens[-1]), session_key=sid)
             else:
                 # Dense slot pool: one monolithic prefill.
                 state = prefill_jit(*args, jax.device_put(pre))
-                pool.write(state, slot)
+                pool.write(state, slot, session_key=sid)
             store.put(sid, (slot, plen))
         except Exception:
             pool.release_slot(slot)

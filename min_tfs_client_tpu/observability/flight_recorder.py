@@ -77,13 +77,16 @@ class FlightRecorder:
             self._events.append(event)
 
     def record_error(self, api: str, model: str, signature: str,
-                     code: int, message: str) -> None:
+                     code: int, message: str, trace_id: str = "") -> None:
         """An error leaving a handler. INTERNAL (the "this should never
         happen" code) additionally triggers the one-shot dump.
         `error_digest` is a stable id of the FAILURE MODE (target +
         code + message with request-varying numbers masked), for
         grouping/dedup across dumps and log correlation without logging
-        request payloads."""
+        request payloads. `trace_id` is the request's fleet-scope trace
+        id (observability/tracing.py): with both the router's and the
+        backend's recorders carrying it, a latched dump on either side
+        joins to the other process's view of the same request."""
         import hashlib
         import re
 
@@ -95,13 +98,21 @@ class FlightRecorder:
             digest_size=4).hexdigest()
         self.record("error", api=api, model=model, signature=signature,
                     code=int(code), error_digest=digest,
+                    trace_id=str(trace_id or ""),
                     message=str(message)[:300])
         if int(code) == _INTERNAL:
-            with self._lock:
-                if self._dumped:
-                    return
-                self._dumped = True
-            self.dump(reason="first INTERNAL error")
+            self.latch_dump("first INTERNAL error")
+
+    def latch_dump(self, reason: str) -> None:
+        """One-shot dump sharing the INTERNAL latch: the first caller
+        dumps, every later trigger (more INTERNALs, the router's
+        UNAVAILABLE-from-all) only ring-records — a crash loop must not
+        fill the disk."""
+        with self._lock:
+            if self._dumped:
+                return
+            self._dumped = True
+        self.dump(reason=reason)
 
     def snapshot(self) -> list[tuple]:
         with self._lock:
@@ -156,6 +167,7 @@ recorder = FlightRecorder()
 
 record = recorder.record
 record_error = recorder.record_error
+latch_dump = recorder.latch_dump
 snapshot = recorder.snapshot
 to_json = recorder.to_json
 dump = recorder.dump

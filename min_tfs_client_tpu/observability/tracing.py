@@ -32,6 +32,17 @@ Sinks, fed when a trace finishes:
 Clocks: spans record `time.perf_counter()` (CLOCK_MONOTONIC — comparable
 across threads); Chrome-trace `ts` values are microseconds relative to one
 process-wide epoch so concurrent requests align on a single timeline.
+Every trace also captures `time.time()` at open, so cross-process
+stitching (the router's fleet view, docs/OBSERVABILITY.md "Fleet
+tracing") can render all processes on the shared wall clock.
+
+Fleet scope: a trace carries a globally-unique `trace_id`. The router
+mints one per routed request and propagates it as the
+`x-tpu-serving-trace` gRPC metadata / HTTP header; server transports
+ADOPT an incoming id (`adopt()`), so the backend's stage spans land in
+the same logical trace as the router's routing/forward spans and
+`/monitoring/traces?trace_id=` on the router can stitch both processes
+into one timeline.
 """
 
 from __future__ import annotations
@@ -41,6 +52,7 @@ import contextlib
 import contextvars
 import itertools
 import os
+import re
 import threading
 import time
 
@@ -48,9 +60,42 @@ _current: contextvars.ContextVar = contextvars.ContextVar(
     "request_trace", default=None)
 _transport: contextvars.ContextVar = contextvars.ContextVar(
     "request_transport", default="")
+_incoming_id: contextvars.ContextVar = contextvars.ContextVar(
+    "incoming_trace_id", default=None)
 
 _EPOCH = time.perf_counter()
 _ids = itertools.count(1)
+
+# The cross-process trace-context header: lowercase (gRPC metadata keys
+# must be), carried as gRPC metadata on forwarded RPCs and as an HTTP
+# request header on proxied REST calls. Metadata only — the proxied body
+# stays byte-identical.
+TRACE_HEADER = "x-tpu-serving-trace"
+
+# Minted ids are <process-random 12 hex><per-process seq>: globally
+# unique without paying os.urandom per request (~a string format, not a
+# syscall, on the hot path).
+_ID_PREFIX = os.urandom(6).hex()
+
+# What an ADOPTED (wire-supplied) id may look like — anything else is
+# dropped and a fresh id minted, so junk metadata can't inject into the
+# monitoring JSON or grow unbounded keys.
+_TRACE_ID_RE = re.compile(r"^[0-9a-zA-Z_.\-]{4,64}$")
+
+
+def valid_trace_id(value) -> str | None:
+    """Sanitized wire-supplied trace id, or None when unusable."""
+    if isinstance(value, bytes):
+        try:
+            value = value.decode("ascii")
+        except UnicodeDecodeError:
+            return None
+    if isinstance(value, str) and _TRACE_ID_RE.fullmatch(value):
+        # fullmatch, not match: '$' alone still accepts a trailing
+        # newline, which would defeat the sanitizer (URL injection into
+        # the stitcher's backend fetch).
+        return value
+    return None
 
 _enabled = True
 _bridge = os.environ.get("TPU_SERVING_TRACE_XPROF", "") not in ("", "0")
@@ -60,6 +105,15 @@ _ann_cls = None  # lazily resolved jax.profiler.TraceAnnotation; False = n/a
 # stage should reuse these where they apply so dashboards/bench breakdowns
 # aggregate across models (docs/OBSERVABILITY.md documents them).
 STAGES = (
+    # Router data plane (router/proxy.py), recorded in the ROUTER
+    # process: routing-key wire scan, the routing decision (pin only on
+    # a fresh sessioned request), the whole forward, and the inner
+    # blocking RPC to the chosen backend.
+    "router/parse",
+    "router/route",
+    "router/pin",
+    "router/forward",
+    "router/backend_wait",
     "serving/resolve",
     "serving/deserialize",
     "serving/parse_examples",
@@ -86,6 +140,12 @@ STAGES = (
     "pipeline/host",
     "pipeline/dispatch",
     "pipeline/materialize",
+    # Pooled decode tick (servables/decode_sessions.py), recorded on the
+    # tick leader's trace: one chunked-prefill round, the decode device
+    # program itself, and the overlapped per-slot output fetch.
+    "decode/prefill_chunk",
+    "decode/tick",
+    "decode/fetch",
     "serving/serialize",
 )
 
@@ -136,18 +196,27 @@ class RequestTrace:
     append.
     """
 
-    __slots__ = ("id", "api", "model", "signature", "transport", "status",
-                 "start", "end", "spans", "meta")
+    __slots__ = ("id", "trace_id", "api", "model", "signature", "transport",
+                 "status", "start", "wall_start", "end", "spans", "meta")
 
     def __init__(self, api: str, model: str = "", signature: str = "",
-                 transport: str = ""):
+                 transport: str = "", trace_id: str | None = None):
         self.id = next(_ids)
+        # Adopt the caller-supplied id (the router's, propagated over the
+        # wire) when one is active; otherwise mint — every trace is
+        # fleet-addressable either way.
+        self.trace_id = (trace_id or _incoming_id.get()
+                         or f"{_ID_PREFIX}{self.id:06x}")
         self.api = api
         self.model = model
         self.signature = signature
         self.transport = transport
         self.status = "0"
         self.start = time.perf_counter()
+        # Wall-clock anchor for cross-process stitching: perf_counter
+        # epochs differ per process, time.time() is shared (modulo the
+        # clock skew the stitcher annotates).
+        self.wall_start = time.time()
         self.end: float | None = None
         self.spans: list[tuple] = []  # (name, t0, t1, args|None)
         self.meta: dict = {}
@@ -263,6 +332,38 @@ class transport:
         return False
 
 
+class adopt:
+    """Make `trace_id` the incoming trace context for the block: any
+    RequestTrace opened inside joins the caller's fleet-scope trace
+    instead of minting its own id. The transports enter this with the
+    sanitized `x-tpu-serving-trace` metadata/header value; a None or
+    invalid id makes the block a no-op (fresh ids are minted as before).
+    Class-based like `transport` — wraps every request."""
+
+    __slots__ = ("_id", "_token")
+
+    def __init__(self, trace_id):
+        self._id = valid_trace_id(trace_id) if trace_id else None
+
+    def __enter__(self):
+        self._token = _incoming_id.set(self._id) if self._id else None
+        return self
+
+    def __exit__(self, *exc):
+        if self._token is not None:
+            _incoming_id.reset(self._token)
+        return False
+
+
+def set_status(status) -> None:
+    """Record the terminal status on the current trace without raising
+    through it (the router data plane aborts via grpc context.abort,
+    whose control-flow exception would otherwise mis-map to INTERNAL)."""
+    tr = _current.get()
+    if tr is not None and hasattr(tr, "status"):
+        tr.status = str(status)
+
+
 class request_trace:
     """Open a RequestTrace for one handler invocation (context manager).
     Enters yielding the trace (None when tracing is disabled); always
@@ -295,7 +396,9 @@ class request_trace:
             self._ann.__exit__(exc_type, exc, tb)
         _current.reset(self._token)
         if exc is None:
-            status = "0"
+            # A handler may have recorded a terminal status explicitly
+            # (set_status) on a non-raising path; keep it.
+            status = self._trace.status
         else:
             # The SAME mapping the transports apply to the wire
             # (error_from_exception): a raw ValueError must record as
@@ -476,6 +579,21 @@ def _ring_capacity() -> int:
 _ring = _Ring(_ring_capacity())
 
 
+def configure_ring(capacity: int) -> None:
+    """Resize the trace ring (the --trace_ring_size flag on server and
+    router). Boot-time configuration: the ring is replaced, so traces
+    recorded before the call are dropped. <= 0 keeps the env/default."""
+    global _ring
+    if capacity and int(capacity) > 0:
+        _ring = _Ring(max(1, int(capacity)))
+
+
+def ring_capacity() -> int:
+    # servelint: lock-ok maxlen is set once at construction; the global
+    # rebind in configure_ring is an atomic reference swap
+    return _ring._traces.maxlen
+
+
 def ring_snapshot(limit: int | None = None) -> list[RequestTrace]:
     return _ring.snapshot(limit)
 
@@ -484,36 +602,59 @@ def ring_clear() -> None:
     _ring.clear()
 
 
+def find_traces(trace_id: str) -> list[RequestTrace]:
+    """Every ring entry belonging to one fleet-scope trace id (a routed
+    request yields one per process; within a process usually one)."""
+    return [tr for tr in _ring.snapshot() if tr.trace_id == trace_id]
+
+
 def _us(t: float) -> float:
     return round((t - _EPOCH) * 1e6, 3)
 
 
-def chrome_trace(traces=None, limit: int | None = None) -> dict:
+def chrome_trace(traces=None, limit: int | None = None, *, pid: int = 1,
+                 process_name: str | None = None,
+                 clock: str = "process") -> dict:
     """Recent traces as a Chrome-trace (chrome://tracing / Perfetto
     "trace event") JSON object: one pid for the server, one tid per
     request, complete ("X") events for the request envelope and every
-    stage span, plus thread_name metadata so the timeline is labelled."""
+    stage span, plus thread_name metadata so the timeline is labelled.
+
+    `pid`/`process_name` label the process lane (the fleet stitcher
+    renders router and each backend as separate lanes); clock="wall"
+    emits ts as wall-clock microseconds since the unix epoch — the only
+    time base comparable ACROSS processes — instead of the process-local
+    perf_counter epoch."""
     if traces is None:
         traces = _ring.snapshot(limit)
     events = []
+    if process_name:
+        events.append({"name": "process_name", "ph": "M", "pid": pid,
+                       "args": {"name": process_name}})
     for tr in traces:
         end = tr.end if tr.end is not None else tr.start
+        if clock == "wall":
+            def ts(t, _tr=tr):
+                return round((_tr.wall_start + (t - _tr.start)) * 1e6, 3)
+        else:
+            ts = _us
         events.append({
-            "name": "thread_name", "ph": "M", "pid": 1, "tid": tr.id,
+            "name": "thread_name", "ph": "M", "pid": pid, "tid": tr.id,
             "args": {"name": f"{tr.api} {tr.model} #{tr.id}".strip()},
         })
         args = dict(tr.meta)
         args.update(model=tr.model, signature=tr.signature,
-                    transport=tr.transport, status=tr.status)
+                    transport=tr.transport, status=tr.status,
+                    trace_id=tr.trace_id)
         events.append({
             "name": f"request/{tr.api}", "cat": "request", "ph": "X",
-            "pid": 1, "tid": tr.id, "ts": _us(tr.start),
+            "pid": pid, "tid": tr.id, "ts": ts(tr.start),
             "dur": round(max(0.0, end - tr.start) * 1e6, 3), "args": args,
         })
         for name, t0, t1, sargs in list(tr.spans):
             events.append({
-                "name": name, "cat": "stage", "ph": "X", "pid": 1,
-                "tid": tr.id, "ts": _us(t0),
+                "name": name, "cat": "stage", "ph": "X", "pid": pid,
+                "tid": tr.id, "ts": ts(t0),
                 "dur": round(max(0.0, t1 - t0) * 1e6, 3),
                 "args": dict(sargs or {}),
             })

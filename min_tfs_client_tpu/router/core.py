@@ -23,6 +23,7 @@ import threading
 from dataclasses import dataclass
 from typing import Optional, Sequence
 
+from min_tfs_client_tpu.observability import tracing
 from min_tfs_client_tpu.router import ring as ring_mod
 from min_tfs_client_tpu.router.membership import (
     DEAD,
@@ -162,8 +163,9 @@ class RouterCore:
                     f"{pinned} which is {state}; the session's state is "
                     "lost — start a new session")
             candidate = self._assign_new(model, session_id)
-            winner_id, we_pinned = self.sessions.pin_if_absent(
-                model, session_id, candidate.backend_id)
+            with tracing.span("router/pin"):
+                winner_id, we_pinned = self.sessions.pin_if_absent(
+                    model, session_id, candidate.backend_id)
             if we_pinned:
                 return RouteResult(candidate, True)
             # a concurrent first-request won the pin: follow the winner
@@ -174,6 +176,26 @@ class RouterCore:
     def _assign_new(self, model: str, routing_id: bytes) -> Backend:
         live = self.membership.live_ids()
         if not live:
+            # UNAVAILABLE-from-all: the router's own black-box moment —
+            # record the fleet state and latch the one-shot dump (shares
+            # the INTERNAL latch; a storm of these must not fill the
+            # disk) so the 10 seconds of membership/forward history
+            # leading here survive.
+            try:
+                from min_tfs_client_tpu.observability import (
+                    flight_recorder,
+                )
+
+                states = {b.backend_id: self.membership.state_of(
+                    b.backend_id) for b in self.membership.backends()}
+                flight_recorder.record(
+                    "no_live_backends", model=model,
+                    states=",".join(f"{k}={v}"
+                                    for k, v in sorted(states.items())))
+                flight_recorder.latch_dump(
+                    "UNAVAILABLE from every backend")
+            except Exception:  # pragma: no cover - recorder must not
+                pass           # turn an outage into a crash
             raise ServingError.unavailable(
                 "no live backends: every backend is draining, dead, or "
                 "not yet polled")

@@ -33,6 +33,13 @@ class RouterOptions:
     session_idle_timeout_s: float = 3600.0
     forward_timeout_s: float = 60.0
     grpc_max_threads: int = 16
+    # Router flight recorder (observability/flight_recorder.py): dump
+    # directory for the one-shot ring dump (first INTERNAL through the
+    # proxy / first UNAVAILABLE-from-all / SIGUSR2). "" = env or tempdir.
+    flight_recorder_dir: str = ""
+    # Router-local request-trace ring capacity (/monitoring/traces);
+    # 0 = TPU_SERVING_TRACE_RING env or the 256 default.
+    trace_ring_size: int = 0
 
 
 class RouterServer:
@@ -50,6 +57,19 @@ class RouterServer:
         from min_tfs_client_tpu.router.proxy import GrpcProxy
 
         opts = self.options
+        # The router process gets the same black-box/observability
+        # surface a backend has: its own flight recorder (dumped on the
+        # first INTERNAL / UNAVAILABLE-from-all, or SIGUSR2) and its own
+        # trace ring behind /monitoring/traces.
+        from min_tfs_client_tpu.observability import (
+            flight_recorder,
+            tracing,
+        )
+
+        flight_recorder.configure(opts.flight_recorder_dir or None)
+        flight_recorder.install_signal_handler()
+        if opts.trace_ring_size:
+            tracing.configure_ring(opts.trace_ring_size)
         self.core = RouterCore(
             parse_backends(opts.backends),
             poll_interval_s=opts.health_poll_interval_s,
@@ -152,6 +172,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--forward_timeout_s", type=float, default=60.0,
                    help="forward deadline when the client sent none")
     p.add_argument("--grpc_max_threads", type=int, default=16)
+    p.add_argument("--flight_recorder_dir", default="",
+                   help="directory for the router's flight-recorder "
+                        "JSON dumps (first INTERNAL through the proxy, "
+                        "first UNAVAILABLE-from-all, or SIGUSR2); empty "
+                        "= TPU_SERVING_FLIGHT_DIR or the system tempdir")
+    p.add_argument("--trace_ring_size", type=int, default=0,
+                   help="capacity of the router-local request-trace "
+                        "ring behind /monitoring/traces (0 = "
+                        "TPU_SERVING_TRACE_RING env or the 256 default)")
     return p
 
 
@@ -166,6 +195,8 @@ def options_from_args(args) -> RouterOptions:
         session_idle_timeout_s=args.session_idle_timeout_s,
         forward_timeout_s=args.forward_timeout_s,
         grpc_max_threads=args.grpc_max_threads,
+        flight_recorder_dir=args.flight_recorder_dir,
+        trace_ring_size=args.trace_ring_size,
     )
 
 

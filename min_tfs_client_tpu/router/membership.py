@@ -336,6 +336,21 @@ class MembershipTable:
                 entry.state = DEAD
             # Below the threshold the previous state stands: one flaky
             # probe must not flap a LIVE backend out of the rotation.
+        if entry.state != previous:
+            # Fleet state transitions are exactly the context a router
+            # flight-recorder dump needs ("which backends went where in
+            # the 10s before the outage"); the recorder append is a
+            # ~100ns deque push under its own uncontended lock.
+            try:
+                from min_tfs_client_tpu.observability import (
+                    flight_recorder,
+                )
+
+                flight_recorder.record(
+                    "backend_state", backend=entry.backend.backend_id,
+                    state=entry.state, was=previous, verdict=verdict)
+            except Exception:  # pragma: no cover - sources never fail
+                pass           # the poll loop
 
     def _export_gauges(self, states: dict[str, str]) -> None:
         from min_tfs_client_tpu.router import ring as ring_mod

@@ -22,8 +22,10 @@ from __future__ import annotations
 import http.client
 import json
 import logging
+import urllib.request
 from typing import Optional
 
+from min_tfs_client_tpu.observability import tracing
 from min_tfs_client_tpu.protos import tfs_apis_pb2 as apis
 from min_tfs_client_tpu.protos.grpc_service import SERVICE_SCHEMAS
 from min_tfs_client_tpu.router.core import RouterCore
@@ -199,9 +201,12 @@ class GrpcProxy:
     def _forward(self, backend: Backend, full_method: str,
                  request_bytes: bytes, context,
                  on_rpc_error=None) -> bytes:
-        """`on_rpc_error(code)` runs before the abort with the BACKEND'S
-        status code — the caller's chance to undo routing side effects
-        selectively (the abort exception itself carries no code)."""
+        """`on_rpc_error(code, details)` runs before the abort with the
+        BACKEND'S status — the caller's chance to undo routing side
+        effects selectively and to record the failure (the abort
+        exception itself carries no code). The forwarded metadata gains
+        the router's fleet-scope trace id (x-tpu-serving-trace) —
+        metadata ONLY; the request bytes stay untouched."""
         import grpc
 
         channel = self._core.channels.get(backend)
@@ -209,9 +214,21 @@ class GrpcProxy:
         timeout = context.time_remaining()
         if timeout is None:
             timeout = self._default_timeout_s
+        metadata = _forwardable_metadata(context)
+        trace = tracing.current_trace()
+        if trace is not None:
+            # The backend adopts this id into its own RequestTrace, so
+            # its stage spans join the router's trace. Any client-sent
+            # copy is dropped — the adopted/minted id is authoritative.
+            metadata = [(k, v) for k, v in metadata
+                        if k.lower() != tracing.TRACE_HEADER]
+            metadata.append((tracing.TRACE_HEADER, trace.trace_id))
         try:
-            response = call(request_bytes, timeout=timeout,
-                            metadata=_forwardable_metadata(context))
+            with tracing.span("router/forward", backend=backend.backend_id):
+                with tracing.span("router/backend_wait",
+                                  backend=backend.backend_id):
+                    response = call(request_bytes, timeout=timeout,
+                                    metadata=metadata)
         except grpc.RpcError as err:
             code = err.code()
             unreachable = code in (grpc.StatusCode.UNAVAILABLE,
@@ -219,36 +236,91 @@ class GrpcProxy:
             self._core.note_result(backend, full_method,
                                    error_code=code.name,
                                    unreachable=unreachable)
+            tracing.set_status(code.name)
             if on_rpc_error is not None:
-                on_rpc_error(code)
+                on_rpc_error(code, err.details() or code.name)
             context.abort(code, err.details() or code.name)
         self._core.note_result(backend, full_method)
         return response
 
     def _handle(self, service: str, method: str,
                 request_bytes: bytes, context) -> bytes:
-        full_method = f"/{_PKG}.{service}/{method}"
+        """Trace envelope around one routed request: adopt the caller's
+        x-tpu-serving-trace id (or mint one), record the router's own
+        spans in the router-local ring, and echo the id back as trailing
+        metadata so callers can pull the stitched timeline from
+        /monitoring/traces?trace_id= without parsing anything."""
+        if not tracing.enabled():
+            return self._handle_routed(service, method, request_bytes,
+                                       context, None)
+        incoming = None
+        for key, value in (context.invocation_metadata() or ()):
+            if key.lower() == tracing.TRACE_HEADER:
+                incoming = value
+                break
+        trace = tracing.RequestTrace(
+            f"route/{method}", transport="grpc",
+            trace_id=tracing.valid_trace_id(incoming) if incoming else None)
         try:
-            model, session_id, signature = routing_info(
-                service, method, request_bytes)
-            decision = self._core.route(model, session_id, request_bytes)
+            with tracing.activate(trace):
+                context.set_trailing_metadata(
+                    ((tracing.TRACE_HEADER, trace.trace_id),))
+                return self._handle_routed(service, method, request_bytes,
+                                           context, trace)
+        finally:
+            # context.abort raises grpc's control-flow exception; the
+            # real status was recorded via set_status before the raise,
+            # so finish with it instead of mis-mapping to INTERNAL.
+            trace.finish(status=trace.status)
+
+    def _handle_routed(self, service: str, method: str,
+                       request_bytes: bytes, context, trace) -> bytes:
+        from min_tfs_client_tpu.observability import flight_recorder
+
+        full_method = f"/{_PKG}.{service}/{method}"
+        model = signature = ""
+        session_id: Optional[bytes] = None
+        try:
+            with tracing.span("router/parse"):
+                model, session_id, signature = routing_info(
+                    service, method, request_bytes)
+            with tracing.span("router/route"):
+                decision = self._core.route(model, session_id,
+                                            request_bytes)
         except ServingError as exc:
+            tracing.set_status(exc.code)
             context.abort(to_grpc_code(exc.code), exc.message)
         except Exception as exc:  # noqa: BLE001 - mapped onto the wire
             err = error_from_exception(exc)
+            tracing.set_status(err.code)
+            flight_recorder.record_error(
+                f"route/{method}", model, signature, err.code,
+                str(exc), trace_id=trace.trace_id if trace else "")
             context.abort(to_grpc_code(err.code), err.message)
-        on_rpc_error = None
-        if decision.fresh_pin:
-            import grpc
+        if trace is not None:
+            trace.model = model
+            trace.signature = signature
+            trace.annotate(backend=decision.backend.backend_id,
+                           sessioned=session_id is not None,
+                           fresh_pin=decision.fresh_pin)
+        import grpc
 
-            def on_rpc_error(code):
-                # Roll the brand-new pin back ONLY when the failure
-                # proves non-delivery (connection-level UNAVAILABLE): a
-                # DEADLINE_EXCEEDED init may have succeeded server-side,
-                # and un-pinning then would strand that orphan session
-                # unreachable behind the router.
-                if code == grpc.StatusCode.UNAVAILABLE:
-                    self._core.sessions.release(model, session_id)
+        def on_rpc_error(code, details):
+            # Request digest into the router's flight recorder (latched
+            # dump on INTERNAL — the "should never happen" code): the
+            # trace id joins this entry to the backend recorder's view
+            # of the same request.
+            flight_recorder.record_error(
+                f"route/{method}", model, signature, code.value[0],
+                f"{decision.backend.backend_id}: {details}",
+                trace_id=trace.trace_id if trace else "")
+            # Roll a brand-new pin back ONLY when the failure proves
+            # non-delivery (connection-level UNAVAILABLE): a
+            # DEADLINE_EXCEEDED init may have succeeded server-side,
+            # and un-pinning then would strand that orphan session
+            # unreachable behind the router.
+            if decision.fresh_pin and code == grpc.StatusCode.UNAVAILABLE:
+                self._core.sessions.release(model, session_id)
 
         response = self._forward(decision.backend, full_method,
                                  request_bytes, context,
@@ -396,14 +468,23 @@ _REST_FORWARD_HEADERS = ("Content-Type", "Content-Encoding",
 def rest_route_request(core: RouterCore, method: str, path: str,
                        body_bytes: bytes,
                        headers) -> tuple[int, str, bytes]:
-    """Transport-independent REST router: local /monitoring answers, or
-    a verbatim /v1 forward to the chosen backend's REST port."""
+    """Transport-independent REST router: local /monitoring answers
+    (including the fleet-stitched /monitoring/traces and the router's
+    own flight recorder), or a verbatim /v1 forward to the chosen
+    backend's REST port."""
     from min_tfs_client_tpu.server import rest as rest_mod
 
     bare, _, _query = path.partition("?")
     if method == "GET" and bare == ROUTER_PAYLOAD_PATH:
         return 200, "application/json", json.dumps(
             core.snapshot()).encode()
+    if method == "GET" and bare == rest_mod.TRACES_DEFAULT_PATH:
+        return _router_traces_reply(core, _query)
+    if method == "GET" and bare == rest_mod.FLIGHT_RECORDER_PATH:
+        from min_tfs_client_tpu.observability import flight_recorder
+
+        return 200, "application/json", json.dumps(
+            flight_recorder.to_json()).encode()
     if method == "GET" and bare == rest_mod.HEALTHZ_PATH:
         ok = core.membership.poll_thread_alive()
         return ((200 if ok else 503), "application/json",
@@ -421,7 +502,29 @@ def rest_route_request(core: RouterCore, method: str, path: str,
     if not bare.startswith("/v1/"):
         return 404, "application/json", json.dumps(
             {"error": f"Malformed request: {method} {path}"}).encode()
-    return _rest_forward(core, method, path, body_bytes, headers)
+    if not tracing.enabled():
+        return _rest_forward(core, method, path, body_bytes, headers)
+    incoming = headers.get(tracing.TRACE_HEADER) if headers is not None \
+        else None
+    trace = tracing.RequestTrace(
+        "route/rest", transport="rest",
+        trace_id=tracing.valid_trace_id(incoming) if incoming else None)
+    try:
+        with tracing.activate(trace):
+            try:
+                status, ctype, body = _rest_forward(
+                    core, method, path, body_bytes, headers)
+            except Exception as exc:
+                # An unexpected escape must not archive as success in
+                # the router ring (the gRPC path maps its aborts via
+                # set_status the same way).
+                trace.status = str(error_from_exception(exc).code)
+                raise
+            if status >= 400:
+                trace.status = str(status)
+            return status, ctype, body
+    finally:
+        trace.finish(status=trace.status)
 
 
 def _rest_forward(core: RouterCore, method: str, path: str,
@@ -441,13 +544,22 @@ def _rest_forward(core: RouterCore, method: str, path: str,
         value = headers.get(key) if headers is not None else None
         if value:
             fwd_headers[key] = value
+    trace = tracing.current_trace()
+    if trace is not None:
+        # Propagate the fleet-scope trace id (header only, body
+        # verbatim). NOTE: the backend adopts it only on its Python REST
+        # backend — the native epoll front-end surfaces no headers.
+        fwd_headers[tracing.TRACE_HEADER] = trace.trace_id
     conn = http.client.HTTPConnection(backend.host, backend.rest_port,
                                       timeout=60)
     try:
-        conn.request(method, path, body=body_bytes or None,
-                     headers=fwd_headers)
-        resp = conn.getresponse()
-        data = resp.read()
+        with tracing.span("router/forward", backend=backend.backend_id):
+            conn.request(method, path, body=body_bytes or None,
+                         headers=fwd_headers)
+            with tracing.span("router/backend_wait",
+                              backend=backend.backend_id):
+                resp = conn.getresponse()
+                data = resp.read()
         # Backend error REPLIES count like the gRPC path counts
         # non-OK statuses — a REST-only outage must move
         # router_backend_errors, not just the unreachable case.
@@ -464,6 +576,140 @@ def _rest_forward(core: RouterCore, method: str, path: str,
                       f"REST: {exc}"}).encode()
     finally:
         conn.close()
+
+
+# -- fleet-stitched traces ---------------------------------------------------
+
+
+def _router_traces_reply(core: RouterCore,
+                         query: str) -> tuple[int, str, bytes]:
+    """GET /monitoring/traces on the ROUTER. Without `trace_id`: the
+    router-local ring (same semantics as a backend's endpoint —
+    ?summary=1 for the per-stage table, ?limit=N). With
+    ?trace_id=<id>: ONE stitched Chrome-trace JSON — router spans plus
+    the matching backend trace fetched by id, rendered as per-process
+    lanes on the shared wall clock with a clock-skew annotation
+    (docs/OBSERVABILITY.md "Fleet tracing")."""
+    from urllib.parse import parse_qs
+
+    from min_tfs_client_tpu.server import rest as rest_mod
+
+    params = parse_qs(query)
+    trace_id = params.get("trace_id", [""])[0]
+    if trace_id:
+        return (200, "application/json",
+                json.dumps(stitch_chrome_trace(core, trace_id)).encode())
+    # Everything else (?limit, ?summary, the default ring render) is
+    # exactly a backend's endpoint — one implementation, shared.
+    return rest_mod._traces_reply(query)
+
+
+def _forward_wall_interval(traces,
+                           backend_id: str) -> Optional[tuple[float, float]]:
+    """The router's forward window TO THIS BACKEND on the wall clock
+    (us): the inner blocking RPC span — what the backend's request
+    envelope should nest inside, modulo clock skew. Filtered by the
+    span's backend arg: one trace id may cover forwards to several
+    backends (adoption enforces no uniqueness), and estimating B's skew
+    against a window spent waiting on A would manufacture bogus skew."""
+    best = None
+    for tr in traces:
+        for name, t0, t1, args in list(tr.spans):
+            if name == "router/backend_wait" and \
+                    (args or {}).get("backend") == backend_id:
+                best = ((tr.wall_start + (t0 - tr.start)) * 1e6,
+                        (tr.wall_start + (t1 - tr.start)) * 1e6)
+    return best
+
+
+def stitch_chrome_trace(core: RouterCore, trace_id: str,
+                        timeout_s: float = 5.0) -> dict:
+    """Merge the router's ring entries for `trace_id` with the matching
+    backend trace(s), fetched by id over each backend's REST monitoring
+    port. Lanes: pid 1 = router, pid 2.. = one per backend that had the
+    trace. All timestamps are wall-clock, rebased to the earliest event;
+    `otherData.clock_skew_us` estimates each backend's clock offset as
+    (backend request midpoint - router forward midpoint) — ~0 on one
+    host, NTP offset plus RTT asymmetry across hosts (annotated, never
+    corrected: rewriting timestamps would hide the very skew an operator
+    needs to see)."""
+    if tracing.valid_trace_id(trace_id) is None:
+        # Every real id satisfies the wire charset; anything else would
+        # only build malformed backend fetch URLs and report confusing
+        # per-backend errors instead of an honest empty stitch.
+        return {"traceEvents": [], "displayTimeUnit": "ms",
+                "otherData": {
+                    "source": "tpu-serving-router /monitoring/traces",
+                    "trace_id": str(trace_id)[:64],
+                    "error": "invalid trace id", "processes": {},
+                    "router_matches": 0, "clock_skew_us": {},
+                    "fetch_errors": {}}}
+    local = tracing.find_traces(trace_id)
+    merged = tracing.chrome_trace(local, clock="wall", pid=1,
+                                  process_name="router")
+    events = merged["traceEvents"]
+    # Ask the backend(s) this trace was actually forwarded to; fall back
+    # to every REST-capable backend when the router has no entry (e.g.
+    # its ring rolled over but the backend's has not).
+    forwarded_to = {tr.meta.get("backend") for tr in local
+                    if tr.meta.get("backend")}
+    candidates = [b for b in core.membership.backends()
+                  if b.rest_port and (not forwarded_to
+                                      or b.backend_id in forwarded_to)]
+    processes = {"1": "router"}
+    skews: dict[str, float] = {}
+    fetch_errors: dict[str, str] = {}
+    pid = 2
+    for backend in candidates:
+        url = (f"http://{backend.host}:{backend.rest_port}"
+               f"/monitoring/traces?trace_id={trace_id}")
+        try:
+            with urllib.request.urlopen(url, timeout=timeout_s) as resp:
+                payload = json.loads(resp.read())
+        except Exception as exc:  # noqa: BLE001 - stitch what answers
+            fetch_errors[backend.backend_id] = str(exc)
+            continue
+        backend_events = payload.get("traceEvents", [])
+        envelopes = [e for e in backend_events
+                     if e.get("cat") == "request"]
+        if not envelopes:
+            continue  # this backend never saw the trace
+        name = f"backend {backend.backend_id}"
+        processes[str(pid)] = name
+        fwd = _forward_wall_interval(local, backend.backend_id)
+        if fwd is not None:
+            b0 = min(e["ts"] for e in envelopes)
+            b1 = max(e["ts"] + e.get("dur", 0.0) for e in envelopes)
+            skews[backend.backend_id] = round(
+                ((b0 + b1) - (fwd[0] + fwd[1])) / 2.0, 3)
+        events.append({"name": "process_name", "ph": "M", "pid": pid,
+                       "args": {"name": name}})
+        for event in backend_events:
+            if event.get("name") == "process_name":
+                continue  # re-labelled above with the backend id
+            event = dict(event)
+            event["pid"] = pid
+            events.append(event)
+        pid += 1
+    # Rebase wall-clock us (~1.7e15) to the earliest event so the
+    # timeline opens at ~0 in chrome://tracing.
+    timed = [e for e in events if "ts" in e]
+    if timed:
+        base = min(e["ts"] for e in timed)
+        for event in timed:
+            event["ts"] = round(event["ts"] - base, 3)
+    return {
+        "traceEvents": events, "displayTimeUnit": "ms",
+        "otherData": {
+            "source": "tpu-serving-router /monitoring/traces",
+            "trace_id": trace_id,
+            "processes": processes,
+            "router_matches": len(local),
+            "clock": "wall, rebased to the earliest event",
+            "clock_skew_us": skews,
+            "fetch_errors": fetch_errors,
+        },
+    }
 
 
 def rest_mod_model(path: str) -> Optional[str]:

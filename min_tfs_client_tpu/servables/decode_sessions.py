@@ -20,11 +20,14 @@ silent eviction of a live session mid-generation.
 
 from __future__ import annotations
 
+import collections
 import contextlib
 import threading
 import time
+import weakref
 from typing import Callable, Optional
 
+from min_tfs_client_tpu.observability import tracing
 from min_tfs_client_tpu.utils.status import ServingError
 
 # -- server-level paging defaults --------------------------------------------
@@ -102,6 +105,178 @@ def paging_scope(block_size: int = 0, num_blocks: int = 0,
         yield
     finally:
         _paging_tls.override = previous
+
+
+# -- per-session decode timelines --------------------------------------------
+#
+# The slot pools are where a decode session's lifecycle actually happens
+# (init, prefill-chunk rounds, per-tick progress, swap/restore under page
+# pressure, eviction, close) — but until now that lifecycle was visible
+# only as aggregate gauges. SessionTimelines is the bounded, lock-light
+# event log behind `/monitoring/sessions`: every pool owns one, events
+# are pre-built tuples appended under one short lock (never while a
+# device call is in flight — tick events are pushed after the dispatch),
+# and both the per-session event count and the closed-session archive
+# are rings, so a long-lived server cannot grow without bound.
+#
+# Cross-linking: decode-step request traces annotate `session_id`
+# (server/handlers.py), so a span timeline at /monitoring/traces and a
+# session timeline here join on the id.
+
+
+class _SessionTimeline:
+    __slots__ = ("session_id", "slot", "started", "state", "events")
+
+    def __init__(self, slot: int, session_id: Optional[str],
+                 events_per_session: int):
+        self.session_id = session_id or f"slot-{slot}"
+        self.slot = slot
+        self.started = time.time()
+        self.state = "live"
+        self.events: collections.deque = collections.deque(
+            maxlen=events_per_session)
+
+    def to_dict(self, max_events: Optional[int] = None) -> dict:
+        events = list(self.events)
+        dropped = 0
+        if max_events is not None and len(events) > max_events:
+            dropped = len(events) - max_events
+            events = events[-max_events:]
+        return {
+            "session_id": self.session_id,
+            "slot": self.slot,
+            "state": self.state,
+            "started": round(self.started, 6),
+            "age_s": round(time.time() - self.started, 3),
+            "events_dropped": dropped,
+            "events": [
+                {"t": round(ts, 6), "kind": kind, **(fields or {})}
+                for ts, kind, fields in events
+            ],
+        }
+
+
+class SessionTimelines:
+    """Bounded per-session event logs for one slot pool.
+
+    Keyed by slot while live (the pool's unit of identity); `begin`
+    archives any previous occupant of the slot, so slot reuse never
+    splices two sessions into one timeline. All methods build the event
+    tuple first and hold `_lock` only for the append — callers may hold
+    the pool lock (pool lock -> timeline lock, never reversed)."""
+
+    def __init__(self, label: str = "default", *,
+                 events_per_session: int = 256,
+                 closed_capacity: int = 64):
+        self.label = label
+        self.events_per_session = int(events_per_session)
+        self._lock = threading.Lock()
+        self._live: dict[int, _SessionTimeline] = {}  # guarded_by: self._lock
+        self._closed: collections.deque = collections.deque(
+            maxlen=closed_capacity)                   # guarded_by: self._lock
+        register_timelines(self)
+
+    def begin(self, slot: int, session_id=None) -> None:
+        if isinstance(session_id, bytes):
+            session_id = session_id.decode("utf-8", "replace")
+        timeline = _SessionTimeline(slot, session_id,
+                                    self.events_per_session)
+        timeline.events.append((time.time(), "init", None))
+        with self._lock:
+            previous = self._live.pop(slot, None)
+            if previous is not None:
+                # The pool reused the slot without an observed close
+                # (store-level eviction raced): archive, never splice.
+                previous.state = "superseded"
+                self._closed.append(previous)
+            self._live[slot] = timeline
+
+    def event(self, slot: int, kind: str, **fields) -> None:
+        entry = (time.time(), kind, fields or None)
+        with self._lock:
+            timeline = self._live.get(slot)
+            if timeline is not None:
+                timeline.events.append(entry)
+
+    def events_many(self, entries) -> None:
+        """[(slot, kind, fields|None)] under ONE lock acquisition — the
+        tick path records one event per advanced session per round."""
+        now = time.time()
+        with self._lock:
+            for slot, kind, fields in entries:
+                timeline = self._live.get(slot)
+                if timeline is not None:
+                    timeline.events.append((now, kind, fields))
+
+    def close(self, slot: int, kind: str = "close") -> None:
+        entry = (time.time(), kind, None)
+        with self._lock:
+            timeline = self._live.pop(slot, None)
+            if timeline is None:
+                return
+            timeline.events.append(entry)
+            timeline.state = "closed" if kind == "close" else kind
+            self._closed.append(timeline)
+
+    def snapshot(self, max_events: Optional[int] = None) -> dict:
+        with self._lock:
+            live = list(self._live.values())
+            closed = list(self._closed)
+        return {
+            "pool": self.label,
+            "events_per_session": self.events_per_session,
+            "live": [t.to_dict(max_events) for t in live],
+            "closed": [t.to_dict(max_events) for t in closed],
+        }
+
+    def find(self, session_id: str,
+             max_events: Optional[int] = None) -> list[dict]:
+        with self._lock:
+            matches = [t for t in self._live.values()
+                       if t.session_id == session_id]
+            matches += [t for t in self._closed
+                        if t.session_id == session_id]
+        return [dict(t.to_dict(max_events), pool=self.label)
+                for t in matches]
+
+
+_timelines_lock = threading.Lock()
+_timelines: list = []  # weakrefs to live SessionTimelines  # guarded_by: _timelines_lock
+
+
+def register_timelines(timelines: SessionTimelines) -> None:
+    """Weakly register a pool's timeline log for /monitoring/sessions
+    (telemetry must not extend a pool's lifetime)."""
+    with _timelines_lock:
+        _timelines[:] = [r for r in _timelines if r() is not None]
+        _timelines.append(weakref.ref(timelines))
+
+
+def _registered_timelines() -> list[SessionTimelines]:
+    with _timelines_lock:
+        refs = list(_timelines)
+    return [t for t in (r() for r in refs) if t is not None]
+
+
+# Default event cap for the LIST view: the summary must stay scrapeable
+# with hundreds of live sessions; ?session= detail returns the full ring.
+_LIST_VIEW_EVENTS = 8
+
+
+def sessions_payload(session: Optional[str] = None,
+                     max_events: Optional[int] = None) -> dict:
+    """The /monitoring/sessions payload. Bare: one summary block per
+    registered pool (live + recently-closed sessions, last few events
+    each). With `session`: every timeline matching that session id
+    (live or archived, any pool) with its full event list."""
+    if session is not None:
+        timelines: list[dict] = []
+        for tl in _registered_timelines():
+            timelines.extend(tl.find(session, max_events))
+        return {"session": session, "found": bool(timelines),
+                "timelines": timelines}
+    cap = _LIST_VIEW_EVENTS if max_events is None else max_events
+    return {"pools": [tl.snapshot(cap) for tl in _registered_timelines()]}
 
 
 class DecodeSessionStore:
@@ -231,13 +406,14 @@ class SlotPool:
     """
 
     def __init__(self, template_state, step_fn, *, max_slots: int,
-                 params=None):
+                 params=None, metric_label: str = "dense"):
         import jax
         import jax.numpy as jnp
 
         self._jax = jax
         self.max_slots = max_slots
         self._params = params
+        self.timeline = SessionTimelines(label=metric_label)
         shapes = jax.eval_shape(lambda: template_state)
         self._pool = jax.tree_util.tree_map(
             lambda sd: jnp.zeros((max_slots,) + sd.shape, sd.dtype), shapes)
@@ -277,12 +453,16 @@ class SlotPool:
             return self._free.pop()
 
     def release_slot(self, slot: int) -> None:
+        self.timeline.close(slot)
         with self._lock:
             if slot not in self._free:
                 self._free.append(slot)
 
-    def write(self, state, slot: int) -> None:
-        """Park a freshly-prefilled session state into its slot."""
+    def write(self, state, slot: int, *, session_key=None) -> None:
+        """Park a freshly-prefilled session state into its slot.
+        `session_key` labels the slot's timeline at
+        /monitoring/sessions (the wire-visible session id)."""
+        self.timeline.begin(slot, session_key)
         with self._lock:
             self._pool = self._write_jit(self._pool, state,
                                          self._jax.numpy.int32(slot))
@@ -295,12 +475,19 @@ class SlotPool:
 
         from min_tfs_client_tpu.servables.servable import fetch_outputs
 
+        t0 = time.perf_counter()
         with self._lock:
             active = np.zeros((self.max_slots,), bool)
             active[list(slots)] = True
-            self._pool, outputs = self._tick_jit(
-                self._params, self._pool, self._jax.numpy.asarray(active))
-        fetched = fetch_outputs(outputs)
+            with tracing.span("decode/tick", slots=len(slots)):
+                self._pool, outputs = self._tick_jit(
+                    self._params, self._pool,
+                    self._jax.numpy.asarray(active))
+        with tracing.span("decode/fetch"):
+            fetched = fetch_outputs(outputs)
+        round_ms = round((time.perf_counter() - t0) * 1e3, 3)
+        self.timeline.events_many(
+            [(s, "tick", {"tick_ms": round_ms}) for s in slots])
         return {s: {k: np.asarray(v)[s] for k, v in fetched.items()}
                 for s in slots}
 
@@ -596,6 +783,10 @@ class PagedSlotPool:
                           "prefill_chunks": 0}     # guarded_by: self._lock
         self._stats_lock = threading.Lock()
         self._stats_cache: dict = {}               # guarded_by: self._stats_lock
+        # Per-session lifecycle event log behind /monitoring/sessions:
+        # appended off the device path (tick events push after the
+        # fetch), rings bound both axes.
+        self.timeline = SessionTimelines(label=metric_label)
 
         dense_idx = [i for i in range(len(self._leaves))
                      if i not in paged_axes]
@@ -784,6 +975,7 @@ class PagedSlotPool:
     def set_metric_label(self, label: str) -> None:
         self.metric_label = label
         self.allocator.set_metric_label(label)
+        self.timeline.label = label
 
     def stats(self) -> dict:
         """Last published snapshot. Reads ONLY the stats lock — the pool
@@ -840,6 +1032,7 @@ class PagedSlotPool:
             self._publish_stats_locked()
 
     def _release_locked(self, slot: int) -> None:
+        self.timeline.close(slot)
         self._pending.pop(slot, None)
         self._prefix.pop(slot, None)
         self._dead.pop(slot, None)
@@ -869,7 +1062,7 @@ class PagedSlotPool:
     # -- prefill phase --------------------------------------------------------
 
     def write(self, state, slot: int, *, prefill_inputs=None,
-              prefill_next: int = 0) -> None:
+              prefill_next: int = 0, session_key=None) -> None:
         """Queue a freshly-prefilled session (PREFILL phase). The state is
         integrated by the next tick's write program, so a long prefill
         burst never blocks in-flight decode rounds on the pool lock.
@@ -889,6 +1082,7 @@ class PagedSlotPool:
                 "chunked prefill needs a paging-aware step contract; this "
                 "pool runs the dense-gather fallback (model declared no "
                 "paged_step)")
+        self.timeline.begin(slot, session_key)
         with self._lock:
             self._pending[slot] = state
             if prefill_inputs is not None:
@@ -901,6 +1095,9 @@ class PagedSlotPool:
                     self._prefix[slot] = {"inputs": inputs,
                                           "next": int(prefill_next),
                                           "done": 0}
+                    self.timeline.event(
+                        slot, "prefill_queued", prefix_len=int(inputs.size),
+                        chunk_tokens=self.prefill_chunk)
             self._last_tick[slot] = time.monotonic()
             self._publish_stats_locked()
 
@@ -929,6 +1126,7 @@ class PagedSlotPool:
                 self._dense_pool, leaves, self._jnp.int32(slot)))
             self._pages[slot] = []
             self._tokens[slot] = 0
+            self.timeline.event(slot, "prefill_flush")
             flushed += 1
         self._counters["prefill_flushed"] += flushed
         return flushed
@@ -988,11 +1186,15 @@ class PagedSlotPool:
             # pages can be reallocated under this same lock
             host = fetch_outputs(
                 {str(k): g for k, g in enumerate(gathered)})
-            self._swapped[victim] = _SwappedSession(
+            swap = _SwappedSession(
                 [host[str(k)] for k in range(len(gathered))],
                 tokens, len(pages))
+            self._swapped[victim] = swap
             self._counters["evicted_swap"] += 1
             self._report_eviction("swap")
+            self.timeline.event(
+                victim, "swap_out", pages=len(pages), tokens=tokens,
+                host_bytes=int(sum(h.nbytes for h in swap.pages_host)))
         else:
             self._dead[victim] = ServingError.resource_exhausted(
                 "decode session preempted: KV page pool exhausted and "
@@ -1000,6 +1202,8 @@ class PagedSlotPool:
                 "re-run decode_init to start over")
             self._counters["evicted_close"] += 1
             self._report_eviction("close")
+            self.timeline.event(victim, "evict_close",
+                                pages=len(pages), tokens=tokens)
         self.allocator.free(pages)
         self._shrink_width_locked()
 
@@ -1031,6 +1235,8 @@ class PagedSlotPool:
         self._tokens[slot] = swap.tokens
         self._counters["restored"] += 1
         self._report_eviction("restore")
+        self.timeline.event(slot, "restore", pages=swap.n_pages,
+                            tokens=swap.tokens)
 
     def _report_eviction(self, kind: str) -> None:
         try:
@@ -1059,13 +1265,16 @@ class PagedSlotPool:
         results: dict[int, object] = {}
         live: list[int] = []
         outputs = None
+        tick_events: list[tuple] = []
+        t0 = time.perf_counter()
         with self._lock:
             self._flush_prefills_locked(limit=self._max_prefills,
                                         urgent=tuple(slots))
             chunk_errors: dict[int, ServingError] = {}
             if self._prefix:
-                chunk_errors = self._run_chunk_round_locked(
-                    requested=tuple(slots))
+                with tracing.span("decode/prefill_chunk"):
+                    chunk_errors = self._run_chunk_round_locked(
+                        requested=tuple(slots))
             for s in slots:
                 err = self._dead.get(s)
                 if err is not None:
@@ -1100,42 +1309,52 @@ class PagedSlotPool:
                     tables[s, :len(pages)] = pages
                 active = np.zeros((self.max_slots,), bool)
                 active[live] = True
-                if self._paged_step is not None:
-                    lengths = np.zeros((self.max_slots,), np.int32)
-                    for s, t in self._tokens.items():
-                        lengths[s] = t
-                    dense, arenas, outputs = self._tick_jit(
-                        self._params, self._dense_pool, self._arenas,
-                        self._jnp.asarray(tables),
-                        self._jnp.asarray(active),
-                        self._jnp.asarray(lengths))
-                    # What the ragged kernel actually reads: the pages
-                    # live sessions own — not slots × table width.
-                    gather_bytes = self.page_bytes * sum(
-                        len(self._pages[s]) for s in live)
-                else:
-                    cur_pages = np.zeros((self.max_slots,), np.int32)
-                    for s in live:
-                        cur_pages[s] = self._tokens[s] // self.block_size
-                    dense, arenas, outputs = self._tick_jit(
-                        self._params, self._dense_pool, self._arenas,
-                        self._jnp.asarray(tables),
-                        self._jnp.asarray(active),
-                        self._jnp.asarray(cur_pages))
-                    # The fallback materializes the full gathered view.
-                    gather_bytes = self.page_bytes * self.max_slots * width
+                with tracing.span("decode/tick", slots=len(live)):
+                    if self._paged_step is not None:
+                        lengths = np.zeros((self.max_slots,), np.int32)
+                        for s, t in self._tokens.items():
+                            lengths[s] = t
+                        dense, arenas, outputs = self._tick_jit(
+                            self._params, self._dense_pool, self._arenas,
+                            self._jnp.asarray(tables),
+                            self._jnp.asarray(active),
+                            self._jnp.asarray(lengths))
+                        # What the ragged kernel actually reads: the pages
+                        # live sessions own — not slots × table width.
+                        gather_bytes = self.page_bytes * sum(
+                            len(self._pages[s]) for s in live)
+                    else:
+                        cur_pages = np.zeros((self.max_slots,), np.int32)
+                        for s in live:
+                            cur_pages[s] = self._tokens[s] // self.block_size
+                        dense, arenas, outputs = self._tick_jit(
+                            self._params, self._dense_pool, self._arenas,
+                            self._jnp.asarray(tables),
+                            self._jnp.asarray(active),
+                            self._jnp.asarray(cur_pages))
+                        # The fallback materializes the full gathered view.
+                        gather_bytes = self.page_bytes * self.max_slots \
+                            * width
                 self._dense_pool = tuple(dense)
                 self._arenas = tuple(arenas)
                 now = time.monotonic()
                 for s in live:
                     self._tokens[s] += 1
                     self._last_tick[s] = now
+                    tick_events.append(
+                        (s, "tick", {"tokens": self._tokens[s],
+                                     "pages": len(self._pages[s])}))
                 self._counters["decode_ticks"] += 1
                 self._gather_bytes_last = gather_bytes
                 self._report_gather_bytes(gather_bytes)
             self._publish_stats_locked()
         if live:
-            fetched = fetch_outputs(outputs)
+            with tracing.span("decode/fetch"):
+                fetched = fetch_outputs(outputs)
+            round_ms = round((time.perf_counter() - t0) * 1e3, 3)
+            for _, _, fields in tick_events:
+                fields["tick_ms"] = round_ms
+            self.timeline.events_many(tick_events)
             for s in live:
                 results[s] = {k: np.asarray(v)[s] for k, v in fetched.items()}
         return results
@@ -1221,14 +1440,20 @@ class PagedSlotPool:
         self._dense_pool = tuple(dense)
         self._arenas = tuple(arenas)
         now = time.monotonic()
+        chunk_events: list[tuple] = []
         for s, n in ran:
             pf = self._prefix[s]
             pf["done"] += n
             self._tokens[s] = pf["done"]
             self._last_tick[s] = now
             self._counters["prefill_chunks"] += 1
+            chunk_events.append(
+                (s, "prefill_chunk",
+                 {"done": pf["done"], "of": len(pf["inputs"]),
+                  "chunk_tokens": n, "pages": len(self._pages[s])}))
             if pf["done"] >= len(pf["inputs"]):
                 del self._prefix[s]
+        self.timeline.events_many(chunk_events)
         self._report_prefill_chunks(len(ran))
         return errors
 

@@ -18,11 +18,26 @@ from min_tfs_client_tpu.utils.status import (
 )
 
 
+def _incoming_trace_id(context):
+    """The caller's x-tpu-serving-trace metadata value, if any — the
+    router (or any upstream) propagating its fleet-scope trace id."""
+    from min_tfs_client_tpu.observability import tracing
+
+    for key, value in (context.invocation_metadata() or ()):
+        if key == tracing.TRACE_HEADER:
+            return value
+    return None
+
+
 def _guard(handler_fn, request, context):
     from min_tfs_client_tpu.observability import tracing
 
     try:
-        with tracing.transport("grpc"):
+        # Adopt the propagated trace id (None = mint locally): the
+        # RequestTrace the handler opens then shares the caller's id, so
+        # the router can stitch both processes' spans into one timeline.
+        with tracing.transport("grpc"), \
+                tracing.adopt(_incoming_trace_id(context)):
             return handler_fn(request)
     except Exception as exc:  # noqa: BLE001 - mapped onto the wire
         err = error_from_exception(exc)

@@ -67,12 +67,15 @@ def _instrumented(api: str):
                 tasks = getattr(request, "tasks", None)
                 spec = tasks[0].model_spec if tasks else None
             start = time.perf_counter()
+            trace_id = ""
             try:
                 with tracing.request_trace(
                         api,
                         model=spec.name if spec is not None else "",
                         signature=(spec.signature_name
-                                   if spec is not None else "")):
+                                   if spec is not None else "")) as trace:
+                    if trace is not None:
+                        trace_id = trace.trace_id
                     response = fn(self, request)
             except Exception as exc:
                 # Same mapping the transports apply to the wire status
@@ -94,7 +97,7 @@ def _instrumented(api: str):
                     api,
                     spec.name if spec is not None else "",
                     spec.signature_name if spec is not None else "",
-                    code, str(exc))
+                    code, str(exc), trace_id=trace_id)
                 raise
             metrics.request_count.increment(api, "0")
             metrics.request_latency.observe(
@@ -132,6 +135,17 @@ class Handlers:
             sig_name = request.model_spec.signature_name
             signature = servable.signature(sig_name)
             inputs = tensor_protos_to_dict(request.inputs, writable=False)
+            sid = inputs.get("session_id")
+            if sid is not None:
+                # Sessioned decode surface: the session id on the trace
+                # is what cross-links /monitoring/traces to the
+                # per-session timeline at /monitoring/sessions.
+                raw = np.asarray(sid).reshape(-1)
+                if raw.size == 1:
+                    value = raw[0]
+                    tracing.annotate(session_id=(
+                        value.decode("utf-8", "replace")
+                        if isinstance(value, bytes) else str(value)))
             outputs = signature.run(inputs, tuple(request.output_filter))
             response = apis.PredictResponse()
             with tracing.span("serving/serialize"):

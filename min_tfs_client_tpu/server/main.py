@@ -169,6 +169,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="directory for flight-recorder JSON dumps "
                         "(first INTERNAL error / SIGUSR2); empty = "
                         "TPU_SERVING_FLIGHT_DIR or the system tempdir")
+    p.add_argument("--trace_ring_size", type=int, default=0,
+                   help="capacity of the request-trace ring behind "
+                        "/monitoring/traces (0 = TPU_SERVING_TRACE_RING "
+                        "env or the 256 default)")
     p.add_argument("--drain_grace_seconds", type=float, default=0.0,
                    help="graceful-drain window on stop()/SIGTERM: the "
                         "health plane flips NOT_SERVING immediately, "
@@ -233,6 +237,7 @@ def options_from_args(args) -> ServerOptions:
         slo_window_seconds=args.slo_window_seconds,
         slo_shed_burn_rate=args.slo_shed_burn_rate,
         flight_recorder_dir=args.flight_recorder_dir,
+        trace_ring_size=args.trace_ring_size,
         drain_grace_seconds=args.drain_grace_seconds,
     )
 

@@ -48,6 +48,11 @@ READYZ_PATH = "/monitoring/readyz"
 SLO_PATH = "/monitoring/slo"
 RUNTIME_PATH = "/monitoring/runtime"
 FLIGHT_RECORDER_PATH = "/monitoring/flightrecorder"
+# Per-session decode timelines (servables/decode_sessions.py event
+# logs): ?session=<id> for one session's full event list, bare for the
+# fleet-debuggable summary. Cross-links with /monitoring/traces via the
+# session_id annotation on decode-step traces.
+SESSIONS_PATH = "/monitoring/sessions"
 
 
 def _fill_spec(spec: apis.ModelSpec, m: re.Match) -> None:
@@ -165,6 +170,7 @@ def route_request(
     method: str,
     path: str,
     body_bytes: bytes,
+    trace_id: str = "",
 ) -> tuple[int, str, bytes]:
     """Transport-independent /v1 router: (status, content_type, body).
 
@@ -172,10 +178,13 @@ def route_request(
     front-end (`server/native_http.py`). Mirrors the reference's route
     dispatch (http_rest_api_handler.cc:106-123); transport concerns
     (gzip, keep-alive, limits) live in the respective servers.
+    `trace_id` is the x-tpu-serving-trace request header when the
+    transport surfaces headers (the Python backend does; the native
+    front-end's C callback carries no headers and passes "").
     """
     from min_tfs_client_tpu.observability import tracing
 
-    with tracing.transport("rest"):
+    with tracing.transport("rest"), tracing.adopt(trace_id or None):
         return _route(handlers, prometheus_path, method, path, body_bytes)
 
 
@@ -258,8 +267,12 @@ def _json_reply(code: int, payload: dict) -> tuple[int, str, bytes]:
 
 
 def _traces_reply(query: str) -> tuple[int, str, bytes]:
-    """GET /monitoring/traces[?limit=N][&summary=1] — the in-memory trace
-    ring as Chrome-trace JSON (or the aggregated per-stage table)."""
+    """GET /monitoring/traces[?limit=N][&summary=1][&trace_id=ID] — the
+    in-memory trace ring as Chrome-trace JSON (or the aggregated
+    per-stage table). `trace_id` filters to one fleet-scope trace and
+    renders on the WALL clock (comparable across processes) — the form
+    the router's stitcher fetches (docs/OBSERVABILITY.md "Fleet
+    tracing")."""
     from urllib.parse import parse_qs
 
     from min_tfs_client_tpu.observability import tracing
@@ -271,6 +284,13 @@ def _traces_reply(query: str) -> tuple[int, str, bytes]:
             limit = max(1, int(params["limit"][0]))
         except ValueError:
             return _json_reply(400, {"error": "limit must be an integer"})
+    trace_id = params.get("trace_id", [""])[0]
+    if trace_id:
+        traces = tracing.find_traces(trace_id)
+        payload = tracing.chrome_trace(traces, clock="wall")
+        payload["otherData"]["trace_id"] = trace_id
+        payload["otherData"]["matches"] = len(traces)
+        return _json_reply(200, payload)
     traces = tracing.ring_snapshot(limit)
     if params.get("summary", [""])[0] not in ("", "0"):
         payload: dict = {"traces": len(traces),
@@ -327,12 +347,36 @@ def _flight_recorder_reply(query: str) -> tuple[int, str, bytes]:
     return _json_reply(200, flight_recorder.to_json())
 
 
+def _sessions_reply(query: str) -> tuple[int, str, bytes]:
+    """GET /monitoring/sessions[?session=ID][&events=N] — per-session
+    decode timelines from every live pool's event log: list view (one
+    summary row per live/recently-closed session) or, with ?session=,
+    that session's full event timeline (init -> prefill-chunk rounds ->
+    ticks -> swap/restore -> close, pages held over time)."""
+    from urllib.parse import parse_qs
+
+    from min_tfs_client_tpu.servables import decode_sessions
+
+    params = parse_qs(query)
+    session = params.get("session", [""])[0]  # parse_qs already unquotes
+    events = None
+    if params.get("events"):
+        try:
+            events = max(1, int(params["events"][0]))
+        except ValueError:
+            return _json_reply(400, {"error": "events must be an integer"})
+    payload = decode_sessions.sessions_payload(
+        session=session or None, max_events=events)
+    return _json_reply(200, payload)
+
+
 _MONITORING_ROUTES = {
     HEALTHZ_PATH: _healthz_reply,
     READYZ_PATH: _readyz_reply,
     SLO_PATH: _slo_reply,
     RUNTIME_PATH: _runtime_reply,
     FLIGHT_RECORDER_PATH: _flight_recorder_reply,
+    SESSIONS_PATH: _sessions_reply,
 }
 
 
@@ -404,16 +448,23 @@ class _RestHandler(BaseHTTPRequestHandler):
                 return None
         return raw
 
+    def _trace_header(self) -> str:
+        from min_tfs_client_tpu.observability import tracing
+
+        return self.headers.get(tracing.TRACE_HEADER, "")
+
     def do_GET(self):  # noqa: N802 - http.server API
         self._send(*route_request(
-            self.handlers, self.prometheus_path, "GET", self.path, b""))
+            self.handlers, self.prometheus_path, "GET", self.path, b"",
+            trace_id=self._trace_header()))
 
     def do_POST(self):  # noqa: N802 - http.server API
         raw = self._read_body()
         if raw is None:
             return
         self._send(*route_request(
-            self.handlers, self.prometheus_path, "POST", self.path, raw))
+            self.handlers, self.prometheus_path, "POST", self.path, raw,
+            trace_id=self._trace_header()))
 
 
 def _classify_regress(handlers: Handlers, verb: str, body: dict, m: re.Match):

@@ -151,6 +151,10 @@ class ServerOptions:
     # Flight-recorder dump directory ("" = TPU_SERVING_FLIGHT_DIR env or
     # the system tempdir).
     flight_recorder_dir: str = ""
+    # Capacity of the request-trace ring served at /monitoring/traces
+    # (observability/tracing.py); 0 = keep the TPU_SERVING_TRACE_RING
+    # env override or the 256 default.
+    trace_ring_size: int = 0
     # Graceful drain (docs/ROUTING.md "Drain semantics"): on stop()/
     # SIGTERM the health plane flips NOT_SERVING immediately, then the
     # server keeps serving for up to this many seconds while live decode
@@ -275,6 +279,10 @@ class Server:
         ))
         flight_recorder.configure(opts.flight_recorder_dir or None)
         flight_recorder.install_signal_handler()
+        if opts.trace_ring_size:
+            from min_tfs_client_tpu.observability import tracing
+
+            tracing.configure_ring(opts.trace_ring_size)
 
         # servelint: thread-ok published exactly once, BEFORE the
         # config-poll thread spawns below; the poll loop only reads it

@@ -1,0 +1,254 @@
+"""Fleet-scope tracing end-to-end (docs/OBSERVABILITY.md "Fleet
+tracing"): a routed request must read as ONE timeline.
+
+The acceptance bar from the fleet-tracing issue, verified here:
+
+ * a routed Predict yields ONE stitched Chrome-trace JSON at the
+   router's /monitoring/traces?trace_id= containing spans from BOTH
+   processes (router lane: parse/route/forward/backend-wait; backend
+   lane: the serving-stage spans) under a single trace id, which the
+   router also echoes to the caller as trailing metadata;
+ * a routed decode-session step stitches the same way, and the backend's
+   request envelope carries the session_id annotation that cross-links
+   the trace to /monitoring/sessions;
+ * routed response BYTES stay bit-identical to a direct connection with
+   propagation on (the trace context travels as metadata/headers only);
+ * forwarding errors land in the router's own flight recorder with the
+   request's trace id (the cross-process join key for latched dumps).
+
+Same fleet harness as test_router.py (tests/fixtures.ModelServerProcess
+subprocesses + in-process router) with the proc_timeout watchdog.
+"""
+
+import json
+import pathlib
+import threading
+import urllib.request
+
+import grpc
+import numpy as np
+import pytest
+
+from min_tfs_client_tpu.observability import tracing
+from min_tfs_client_tpu.protos import tfs_apis_pb2 as apis
+from min_tfs_client_tpu.protos.grpc_service import PredictionServiceStub
+from min_tfs_client_tpu.router.main import RouterOptions, RouterServer
+from min_tfs_client_tpu.tensor.codec import ndarray_to_tensor_proto
+from tests import fixtures
+
+pytestmark = pytest.mark.integration
+
+_ACTIVE_FLEETS: set = set()
+_DEFAULT_TIMEOUT_S = 240
+
+
+@pytest.fixture(autouse=True)
+def _proc_watchdog(request):
+    """Same contract as test_router.py: on expiry, SIGKILL every
+    registered fleet subprocess so a hung wait fails loudly."""
+    marker = request.node.get_closest_marker("proc_timeout")
+    seconds = marker.args[0] if marker else _DEFAULT_TIMEOUT_S
+    fired = threading.Event()
+
+    def _fire():
+        fired.set()
+        for fleet in list(_ACTIVE_FLEETS):
+            fleet.kill_all()
+
+    timer = threading.Timer(seconds, _fire)
+    timer.daemon = True
+    timer.start()
+    yield
+    timer.cancel()
+    assert not fired.is_set(), \
+        f"proc_timeout watchdog fired after {seconds}s; fleet was killed"
+
+
+class TracedFleet:
+    """2 server subprocesses + the in-process router (whose ring IS this
+    test process's tracing ring — the router-local ring contract)."""
+
+    def __init__(self, tmp: pathlib.Path, n: int = 2):
+        model_root = tmp / "model"
+        fixtures.write_session_jax_servable(model_root)
+        monitoring = tmp / "monitoring.config"
+        monitoring.write_text("prometheus_config { enable: true }\n")
+        self.servers = [fixtures.ModelServerProcess(model_root, monitoring)
+                        for _ in range(n)]
+        _ACTIVE_FLEETS.add(self)
+        try:
+            for server in self.servers:
+                server.wait_ready()
+            self.router = RouterServer(RouterOptions(
+                grpc_port=0, rest_api_port=0,
+                backends=",".join(s.backend_spec() for s in self.servers),
+                health_poll_interval_s=0.25, probe_timeout_s=2.0,
+            )).build_and_start()
+        except BaseException:
+            self.kill_all()
+            raise
+        self.channel = grpc.insecure_channel(
+            f"127.0.0.1:{self.router.grpc_port}")
+        self.stub = PredictionServiceStub(self.channel)
+
+    def wait_live(self, n: int, timeout_s: float = 30.0) -> None:
+        import time
+
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if len(self.router.core.membership.live_ids()) >= n:
+                return
+            time.sleep(0.05)
+        raise AssertionError(f"never saw {n} LIVE backends")
+
+    def stitched(self, trace_id: str) -> dict:
+        url = (f"http://127.0.0.1:{self.router.rest_port}"
+               f"/monitoring/traces?trace_id={trace_id}")
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            return json.loads(resp.read())
+
+    def kill_all(self) -> None:
+        for server in self.servers:
+            server.kill()
+
+    def close(self) -> None:
+        try:
+            self.channel.close()
+            self.router.stop()
+        except Exception:  # pragma: no cover - teardown best-effort
+            pass
+        self.kill_all()
+        _ACTIVE_FLEETS.discard(self)
+
+
+@pytest.fixture(scope="module")
+def fleet(tmp_path_factory):
+    f = TracedFleet(tmp_path_factory.mktemp("fleet_tracing"), n=2)
+    try:
+        f.wait_live(2)
+        yield f
+    finally:
+        f.close()
+
+
+def _predict_request(inputs: dict,
+                     signature_name: str = "") -> apis.PredictRequest:
+    request = apis.PredictRequest()
+    request.model_spec.name = "sess"
+    if signature_name:
+        request.model_spec.signature_name = signature_name
+    for name, value in inputs.items():
+        request.inputs[name].CopyFrom(
+            ndarray_to_tensor_proto(np.asarray(value)))
+    return request
+
+
+def _routed_call(fleet, inputs: dict, signature_name: str = ""):
+    """(response, trace_id-from-trailing-metadata)."""
+    response, call = fleet.stub.Predict.with_call(
+        _predict_request(inputs, signature_name), timeout=30)
+    trailing = {k: v for k, v in (call.trailing_metadata() or ())}
+    return response, trailing.get(tracing.TRACE_HEADER)
+
+
+def _events_by_pid(stitched: dict) -> dict:
+    out: dict = {}
+    for event in stitched["traceEvents"]:
+        out.setdefault(event.get("pid"), []).append(event)
+    return out
+
+
+@pytest.mark.proc_timeout(300)
+class TestStitchedTraces:
+    def test_routed_predict_yields_one_stitched_trace(self, fleet):
+        _, trace_id = _routed_call(
+            fleet, {"x": np.asarray([1.0, 2.0, 3.0], np.float32)})
+        assert trace_id, "router did not echo its trace id as trailing " \
+                         "metadata"
+        stitched = fleet.stitched(trace_id)
+        assert stitched["otherData"]["trace_id"] == trace_id
+        by_pid = _events_by_pid(stitched)
+        # Two process lanes: pid 1 = router, pid 2 = the one backend the
+        # request was forwarded to.
+        assert 1 in by_pid and 2 in by_pid, sorted(by_pid)
+        processes = stitched["otherData"]["processes"]
+        assert processes["1"] == "router"
+        assert processes["2"].startswith("backend 127.0.0.1:")
+        router_spans = {e["name"] for e in by_pid[1]
+                        if e.get("cat") == "stage"}
+        assert {"router/parse", "router/route", "router/forward",
+                "router/backend_wait"} <= router_spans, router_spans
+        backend_spans = {e["name"] for e in by_pid[2]
+                         if e.get("cat") == "stage"}
+        assert "serving/serialize" in backend_spans, backend_spans
+        # EVERY request envelope, both lanes, carries the one trace id.
+        envelopes = [e for e in stitched["traceEvents"]
+                     if e.get("cat") == "request"]
+        assert len(envelopes) >= 2
+        assert {e["args"]["trace_id"] for e in envelopes} == {trace_id}
+        # Clock-skew annotation for the stitched backend (same host here,
+        # so it must be present and sane — microseconds to low ms).
+        skews = stitched["otherData"]["clock_skew_us"]
+        assert processes["2"].split(" ", 1)[1] in skews
+        assert all(abs(v) < 5e6 for v in skews.values()), skews
+        # Rebase: the merged timeline opens near 0, not at wall epoch.
+        assert min(e["ts"] for e in envelopes) < 1e7
+
+    def test_routed_decode_step_stitches_with_session_id(self, fleet):
+        sid = b"traced-session-1"
+        _routed_call(
+            fleet,
+            {"session_id": np.asarray(sid, object),
+             "base": np.asarray(0, np.int32)},
+            signature_name="decode_init")
+        _, trace_id = _routed_call(
+            fleet, {"session_id": np.asarray(sid, object)},
+            signature_name="decode_step")
+        assert trace_id
+        stitched = fleet.stitched(trace_id)
+        by_pid = _events_by_pid(stitched)
+        assert 1 in by_pid and 2 in by_pid, sorted(by_pid)
+        router_env = [e for e in by_pid[1] if e.get("cat") == "request"]
+        assert router_env and router_env[0]["args"]["sessioned"] is True
+        backend_env = [e for e in by_pid[2] if e.get("cat") == "request"]
+        # The cross-link to /monitoring/sessions: the backend's decode
+        # trace is annotated with the session id.
+        assert backend_env[0]["args"]["session_id"] == sid.decode()
+        _routed_call(fleet, {"session_id": np.asarray(sid, object)},
+                     signature_name="decode_close")
+
+    def test_response_bytes_identical_with_propagation_on(self, fleet):
+        """The trace context is metadata-only: routed bytes must equal a
+        direct connection's byte-for-byte even while every forward
+        carries the x-tpu-serving-trace header."""
+        request = _predict_request(
+            {"x": np.asarray([0.5, -1.5, 9.0], np.float32)})
+        routed, _ = fleet.stub.Predict.with_call(request, timeout=30)
+        server = fleet.servers[0]
+        with grpc.insecure_channel(
+                f"127.0.0.1:{server.grpc_port}") as direct_channel:
+            direct = PredictionServiceStub(direct_channel).Predict(
+                request, timeout=30)
+        assert routed.SerializeToString(deterministic=True) == \
+            direct.SerializeToString(deterministic=True)
+
+    def test_forward_error_lands_in_router_recorder_with_trace_id(
+            self, fleet):
+        from min_tfs_client_tpu.observability import flight_recorder
+
+        request = _predict_request(
+            {"x": np.asarray([1.0], np.float32)})
+        request.model_spec.name = "no-such-model"
+        with pytest.raises(grpc.RpcError) as err:
+            fleet.stub.Predict.with_call(request, timeout=30)
+        assert err.value.code() == grpc.StatusCode.NOT_FOUND
+        trailing = {k: v for k, v in
+                    (err.value.trailing_metadata() or ())}
+        trace_id = trailing.get(tracing.TRACE_HEADER)
+        assert trace_id
+        events = [e for e in flight_recorder.to_json()["events"]
+                  if e["kind"] == "error"
+                  and e.get("trace_id") == trace_id]
+        assert events, "forward error did not reach the router recorder"
+        assert events[0]["error_digest"]
+        assert events[0]["code"] == 5  # NOT_FOUND, the backend's code

@@ -880,3 +880,109 @@ def test_synthesize_warmup_primes_paged_executables(model):
     # finding): the chunked-prefill program must have run and cleaned up.
     assert stats["prefill_chunks"] >= 1
     assert stats["chunking_sessions"] == 0
+
+
+class TestSessionTimelinesThroughPool:
+    """The fleet-observability acceptance bar at the pool level: a
+    session's /monitoring/sessions timeline shows its prefill-chunk
+    rounds, and swap/restore events when forced under page pressure —
+    and the ragged telemetry satellites export as Prometheus series."""
+
+    def _prefix(self, config, rng, n):
+        pre = np.full((1, MAXDEC), config.pad_id, np.int32)
+        pre[0, :n] = rng.integers(2, config.vocab_size, n)
+        return pre
+
+    def _timeline_kinds(self, session: str) -> list[str]:
+        from min_tfs_client_tpu.servables import decode_sessions
+
+        detail = decode_sessions.sessions_payload(session=session)
+        assert detail["found"], f"no timeline for {session}"
+        return [e["kind"] for t in detail["timelines"]
+                for e in t["events"]]
+
+    def test_timeline_shows_prefill_chunk_rounds(self, model):
+        config, _ = model
+        rng = np.random.default_rng(31)
+        ids, pre = _prompt(config, rng), self._prefix(config, rng, 5)
+        sigs = _sigs(model, kv_block_size=2, kv_prefill_chunk=2)
+        sigs["decode_init_prefix"].run(
+            {"session_id": _sid("tl-prefix"), "input_ids": ids,
+             "prefix_ids": pre})
+        for _ in range(2):
+            sigs["decode_step"].run({"session_id": _sid("tl-prefix")})
+        kinds = self._timeline_kinds("tl-prefix")
+        assert kinds[0] == "init"
+        assert "prefill_queued" in kinds
+        # 5 prefix positions in rounds of 2 -> 3 chunk rounds, each an
+        # event carrying progress + pages held.
+        assert kinds.count("prefill_chunk") == 3
+        assert "tick" in kinds
+        from min_tfs_client_tpu.servables import decode_sessions
+
+        detail = decode_sessions.sessions_payload(session="tl-prefix")
+        chunks = [e for t in detail["timelines"] for e in t["events"]
+                  if e["kind"] == "prefill_chunk"]
+        assert [c["done"] for c in chunks] == [2, 4, 5]
+        assert all(c["pages"] >= 1 for c in chunks)
+        ticks = [e for t in detail["timelines"] for e in t["events"]
+                 if e["kind"] == "tick"]
+        assert all("tokens" in t and "pages" in t and "tick_ms" in t
+                   for t in ticks)
+        sigs["decode_close"].run({"session_id": _sid("tl-prefix")})
+        assert self._timeline_kinds("tl-prefix")[-1] == "close"
+
+    def test_timeline_shows_swap_and_restore_under_pressure(self, model):
+        """Same 5-blocks-for-two-4-page-sessions squeeze as the
+        exactness suite — here the claim is the EVENTS: the victim's
+        timeline must show swap_out and the matching restore."""
+        config, _ = model
+        rng = np.random.default_rng(32)
+        pa, pb = _prompt(config, rng), _prompt(config, rng)
+        sigs = _sigs(model, kv_block_size=2, kv_num_blocks=5)
+        sa, sb = _sid("tl-sw-a"), _sid("tl-sw-b")
+        sigs["decode_init"].run({"session_id": sa, "input_ids": pa})
+        sigs["decode_init"].run({"session_id": sb, "input_ids": pb})
+        for _ in range(MAXDEC):
+            sigs["decode_step"].run({"session_id": sa})
+            sigs["decode_step"].run({"session_id": sb})
+        pool = sigs["decode_init"]._kv_pool
+        assert pool.stats()["evicted_swap"] > 0  # pressure actually hit
+        kinds_a = self._timeline_kinds("tl-sw-a")
+        kinds_b = self._timeline_kinds("tl-sw-b")
+        swapped = kinds_a if "swap_out" in kinds_a else kinds_b
+        assert "swap_out" in swapped
+        assert "restore" in swapped
+        # restore follows its swap_out on the same timeline
+        assert swapped.index("restore") > swapped.index("swap_out")
+        sigs["decode_close"].run({"session_id": sa})
+        sigs["decode_close"].run({"session_id": sb})
+
+    def test_kv_telemetry_exports_as_prometheus_series(self, model):
+        """Satellite pin: kv_gather_bytes_per_tick (gauge) and
+        kv_prefill_chunks (counter) must appear in the Prometheus text
+        export with the pool's model label after real pool traffic —
+        stats/payload-only telemetry cannot be dashboarded."""
+        from min_tfs_client_tpu.server.metrics import prometheus_text
+
+        config, _ = model
+        rng = np.random.default_rng(33)
+        ids, pre = _prompt(config, rng), self._prefix(config, rng, 4)
+        sigs = _sigs(model, kv_block_size=2, kv_prefill_chunk=2)
+        sigs["decode_init_prefix"].run(
+            {"session_id": _sid("prom-kv"), "input_ids": ids,
+             "prefix_ids": pre})
+        sigs["decode_step"].run({"session_id": _sid("prom-kv")})
+        text = prometheus_text()
+        label = sigs["decode_init"]._kv_pool.metric_label
+        gather = [line for line in text.splitlines()
+                  if line.startswith("tpu_serving_kv_gather_bytes_per_tick")
+                  and f'model="{label}"' in line]
+        assert gather, "gauge missing from the Prometheus export"
+        assert float(gather[0].rsplit(" ", 1)[1]) > 0
+        chunks = [line for line in text.splitlines()
+                  if line.startswith("tpu_serving_kv_prefill_chunks")
+                  and f'model="{label}"' in line]
+        assert chunks, "counter missing from the Prometheus export"
+        assert float(chunks[0].rsplit(" ", 1)[1]) >= 2  # 4 positions / 2
+        sigs["decode_close"].run({"session_id": _sid("prom-kv")})

@@ -1,0 +1,195 @@
+"""Per-session decode timelines (decode_sessions.SessionTimelines) and
+the cross-process flight-recorder correlation: the pure-Python halves of
+the fleet-observability issue — ring bounds, slot-reuse isolation, the
+/monitoring/sessions payload/endpoint, and trace ids in request digests
+joining the router's and a backend's latched dumps."""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from min_tfs_client_tpu.observability import flight_recorder
+from min_tfs_client_tpu.servables import decode_sessions
+from min_tfs_client_tpu.servables.decode_sessions import SessionTimelines
+
+
+class TestTimelineRings:
+    def test_events_per_session_is_a_ring(self):
+        tl = SessionTimelines(label="t", events_per_session=16)
+        tl.begin(0, b"s0")
+        for i in range(40):
+            tl.event(0, "tick", tokens=i)
+        detail = tl.find("s0")
+        assert len(detail) == 1
+        events = detail[0]["events"]
+        assert len(events) == 16  # bounded, newest kept
+        assert events[-1]["tokens"] == 39
+        assert events[0]["tokens"] == 24  # oldest 24 rolled out ("init" too)
+
+    def test_list_view_caps_events_and_counts_drops(self):
+        tl = SessionTimelines(label="t", events_per_session=64)
+        tl.begin(1, b"s1")
+        for i in range(20):
+            tl.event(1, "tick", tokens=i)
+        snap = tl.snapshot(max_events=4)
+        row = snap["live"][0]
+        assert len(row["events"]) == 4
+        assert row["events_dropped"] == 17  # init + 20 ticks - 4 shown
+
+    def test_closed_archive_is_a_ring(self):
+        tl = SessionTimelines(label="t", closed_capacity=3)
+        for i in range(5):
+            tl.begin(0, f"s{i}".encode())
+            tl.close(0)
+        snap = tl.snapshot()
+        assert snap["live"] == []
+        assert [t["session_id"] for t in snap["closed"]] == \
+            ["s2", "s3", "s4"]
+        assert all(t["state"] == "closed" for t in snap["closed"])
+
+    def test_slot_reuse_archives_never_splices(self):
+        tl = SessionTimelines(label="t")
+        tl.begin(2, b"first")
+        tl.event(2, "tick", tokens=1)
+        tl.begin(2, b"second")  # no observed close: supersede
+        tl.event(2, "tick", tokens=1)
+        first = tl.find("first")[0]
+        second = tl.find("second")[0]
+        assert first["state"] == "superseded"
+        assert len([e for e in first["events"] if e["kind"] == "tick"]) == 1
+        assert second["state"] == "live"
+
+    def test_events_on_unknown_slot_are_dropped(self):
+        tl = SessionTimelines(label="t")
+        tl.event(7, "tick")  # never began: no crash, no ghost session
+        tl.close(7)
+        assert tl.snapshot()["live"] == []
+        assert tl.snapshot()["closed"] == []
+
+
+class TestSessionsPayload:
+    def test_payload_lists_registered_pools_weakly(self):
+        tl = SessionTimelines(label="payload-pool")
+        tl.begin(0, b"alive")
+        pools = {p["pool"]: p
+                 for p in decode_sessions.sessions_payload()["pools"]}
+        assert "payload-pool" in pools
+        assert pools["payload-pool"]["live"][0]["session_id"] == "alive"
+        del tl, pools
+        import gc
+
+        gc.collect()
+        remaining = [p["pool"] for p in
+                     decode_sessions.sessions_payload()["pools"]]
+        assert "payload-pool" not in remaining  # registry is weak
+
+    def test_session_detail_spans_pools_and_archives(self):
+        a = SessionTimelines(label="pool-a")
+        b = SessionTimelines(label="pool-b")
+        a.begin(0, b"shared-id")
+        a.close(0)
+        b.begin(3, b"shared-id")
+        detail = decode_sessions.sessions_payload(session="shared-id")
+        assert detail["found"] is True
+        states = {(t["pool"], t["state"]) for t in detail["timelines"]}
+        assert states == {("pool-a", "closed"), ("pool-b", "live")}
+        missing = decode_sessions.sessions_payload(session="ghost")
+        assert missing["found"] is False and missing["timelines"] == []
+
+    def test_rest_endpoint_routes_and_validates(self):
+        from min_tfs_client_tpu.server import rest
+
+        tl = SessionTimelines(label="rest-pool")
+        tl.begin(1, b"rest-session")
+        status, ctype, body = rest._sessions_reply("")
+        assert status == 200 and ctype == "application/json"
+        payload = json.loads(body)
+        assert any(p["pool"] == "rest-pool" for p in payload["pools"])
+        status, _, body = rest._sessions_reply("session=rest-session")
+        assert status == 200
+        assert json.loads(body)["found"] is True
+        status, _, _ = rest._sessions_reply("events=zero")
+        assert status == 400
+
+
+class TestRecorderTraceCorrelation:
+    def test_error_digest_carries_trace_id(self):
+        rec = flight_recorder.FlightRecorder(capacity=16)
+        rec.dump = lambda reason="manual": None  # no files from unit tests
+        rec.record_error("predict", "m", "sig", 3, "boom 17",
+                         trace_id="trace-77")
+        event = rec.to_json()["events"][-1]
+        assert event["trace_id"] == "trace-77"
+        assert event["error_digest"]
+
+    def test_router_and_backend_digests_join_on_trace_id(self):
+        """The cross-process join the issue demands: one request's
+        failure shows up in BOTH processes' rings under one trace id,
+        with per-process digests (different failure-mode scope)."""
+        router = flight_recorder.FlightRecorder(capacity=16)
+        backend = flight_recorder.FlightRecorder(capacity=16)
+        for rec in (router, backend):
+            rec.dump = lambda reason="manual": None
+        trace_id = "fleet-trace-42"
+        backend.record_error("predict", "t5", "decode_step", 13,
+                             "buffer donated twice", trace_id=trace_id)
+        router.record_error("route/Predict", "t5", "decode_step", 13,
+                            "127.0.0.1:8500: buffer donated twice",
+                            trace_id=trace_id)
+        join = {
+            name: [e for e in rec.to_json()["events"]
+                   if e.get("trace_id") == trace_id]
+            for name, rec in (("router", router), ("backend", backend))
+        }
+        assert len(join["router"]) == 1 and len(join["backend"]) == 1
+        assert join["router"][0]["error_digest"]
+        assert join["backend"][0]["error_digest"]
+
+    def test_latch_dump_is_one_shot_shared_with_internal(self):
+        rec = flight_recorder.FlightRecorder(capacity=16)
+        dumps = []
+        rec.dump = lambda reason="manual": dumps.append(reason)
+        rec.latch_dump("UNAVAILABLE from every backend")
+        rec.latch_dump("UNAVAILABLE from every backend")
+        rec.record_error("predict", "m", "s", 13, "internal boom")
+        assert dumps == ["UNAVAILABLE from every backend"]
+        rec.reset()
+        rec.record_error("predict", "m", "s", 13, "internal boom")
+        assert dumps[-1] == "first INTERNAL error"
+
+
+class TestNoLiveBackendsLatch:
+    def test_router_core_records_and_latches(self):
+        from min_tfs_client_tpu.router.core import RouterCore
+        from min_tfs_client_tpu.router.membership import (
+            UNREACHABLE,
+            Backend,
+        )
+        from min_tfs_client_tpu.utils.status import ServingError
+
+        flight_recorder.reset()
+        dumps = []
+        original_dump = flight_recorder.recorder.dump
+        flight_recorder.recorder.dump = \
+            lambda reason="manual": dumps.append(reason)
+        try:
+            backends = [Backend("127.0.0.1", 18700)]
+            core = RouterCore(
+                backends, poll_interval_s=0.05, probe_timeout_s=0.05,
+                poller=lambda b: (UNREACHABLE, None))
+            core.membership.poll_once()  # -> DEAD
+            for _ in range(2):
+                with pytest.raises(ServingError) as err:
+                    core.route("m", None, b"req")
+                assert "no live backends" in err.value.message
+            kinds = [e["kind"] for e in flight_recorder.to_json()["events"]]
+            assert "no_live_backends" in kinds
+            # DEAD transition context rides the same ring.
+            assert "backend_state" in kinds
+            # One dump for N consecutive failures (latched).
+            assert dumps == ["UNAVAILABLE from every backend"]
+        finally:
+            flight_recorder.recorder.dump = original_dump
+            flight_recorder.reset()

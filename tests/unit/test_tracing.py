@@ -280,3 +280,152 @@ class TestPartitionedStageAttribution:
         with tracing.request_trace("predict", model="m") as tr:
             sig.run({"x": np.asarray([1.0], np.float32)})
         assert "host/execute" in tr.stage_durations()
+
+
+class TestFleetTraceContext:
+    """Fleet-scope trace ids (docs/OBSERVABILITY.md "Fleet tracing"):
+    minting, wire adoption, sanitization, and the multi-process
+    Chrome-trace rendering the router's stitcher builds on."""
+
+    def test_every_trace_gets_a_unique_id(self):
+        ids = {tracing.RequestTrace("predict").trace_id
+               for _ in range(64)}
+        assert len(ids) == 64
+        assert all(tracing.valid_trace_id(i) for i in ids)
+
+    def test_request_trace_adopts_incoming_id(self):
+        with tracing.adopt("router-abc-123"):
+            with tracing.request_trace("predict") as tr:
+                pass
+        assert tr.trace_id == "router-abc-123"
+        # Outside the adopt block a fresh id is minted again.
+        with tracing.request_trace("predict") as tr2:
+            pass
+        assert tr2.trace_id != "router-abc-123"
+
+    def test_adoption_sanitizes_wire_junk(self):
+        for junk in ("", "a" * 65, "bad id", "a\nb", "x" * 3, None):
+            with tracing.adopt(junk):
+                with tracing.request_trace("predict") as tr:
+                    pass
+            assert tr.trace_id != junk, junk
+            assert tracing.valid_trace_id(tr.trace_id)
+        # bytes-valued gRPC metadata adopts after decode
+        with tracing.adopt(b"deadbeef01"):
+            with tracing.request_trace("predict") as tr:
+                pass
+        assert tr.trace_id == "deadbeef01"
+
+    def test_find_traces_by_id(self):
+        with tracing.adopt("fleet-id-7"):
+            with tracing.request_trace("predict"):
+                pass
+        with tracing.request_trace("predict"):
+            pass
+        found = tracing.find_traces("fleet-id-7")
+        assert [t.trace_id for t in found] == ["fleet-id-7"]
+
+    def test_chrome_trace_process_lanes_and_wall_clock(self):
+        import time as _time
+
+        with tracing.adopt("lane-id-1"):
+            with tracing.request_trace("predict") as tr:
+                with tracing.span("serving/serialize"):
+                    pass
+        payload = tracing.chrome_trace([tr], pid=2,
+                                       process_name="backend b1",
+                                       clock="wall")
+        meta = [e for e in payload["traceEvents"]
+                if e.get("name") == "process_name"]
+        assert meta and meta[0]["args"]["name"] == "backend b1"
+        envelope = [e for e in payload["traceEvents"]
+                    if e.get("cat") == "request"][0]
+        assert envelope["pid"] == 2
+        assert envelope["args"]["trace_id"] == "lane-id-1"
+        # wall clock: microseconds since the unix epoch, ~now
+        assert abs(envelope["ts"] / 1e6 - _time.time()) < 60
+        # default clock stays process-relative (backward compatible)
+        legacy = tracing.chrome_trace([tr])
+        legacy_env = [e for e in legacy["traceEvents"]
+                      if e.get("cat") == "request"][0]
+        assert legacy_env["ts"] < 1e14 and legacy_env["pid"] == 1
+
+    def test_set_status_records_on_current_trace(self):
+        with tracing.request_trace("predict") as tr:
+            tracing.set_status("UNAVAILABLE")
+        assert tr.status == "UNAVAILABLE"
+
+    def test_configure_ring_resizes(self):
+        original = tracing.ring_capacity()
+        try:
+            tracing.configure_ring(3)
+            assert tracing.ring_capacity() == 3
+            for _ in range(5):
+                with tracing.request_trace("predict"):
+                    pass
+            assert len(tracing.ring_snapshot()) == 3
+            tracing.configure_ring(0)  # 0 = keep current
+            assert tracing.ring_capacity() == 3
+        finally:
+            tracing.configure_ring(original)
+
+    def test_traces_endpoint_trace_id_filter(self):
+        from min_tfs_client_tpu.server import rest
+
+        with tracing.adopt("endpoint-id-9"):
+            with tracing.request_trace("predict"):
+                pass
+        with tracing.request_trace("predict"):
+            pass
+        status, _, body = rest._traces_reply("trace_id=endpoint-id-9")
+        assert status == 200
+        payload = json.loads(body)
+        assert payload["otherData"]["trace_id"] == "endpoint-id-9"
+        envelopes = [e for e in payload["traceEvents"]
+                     if e.get("cat") == "request"]
+        assert len(envelopes) == 1
+        assert envelopes[0]["args"]["trace_id"] == "endpoint-id-9"
+        assert envelopes[0]["ts"] > 1e14  # wall clock for stitching
+
+    def test_rest_route_adopts_header(self):
+        from min_tfs_client_tpu.server import rest
+
+        sig = Signature(
+            fn=lambda inputs: {
+                "y": np.asarray(inputs["x"], np.float32) * 2.0},
+            inputs={"x": TensorSpec(np.float32, (None, 2))},
+            outputs={"y": TensorSpec(np.float32, (None, 2))},
+            on_host=True,
+        )
+        handlers = _FakeHandlers(sig)
+        status, _, _ = rest.route_request(
+            handlers, None, "POST", "/v1/models/m:predict",
+            json.dumps({"instances": [{"x": [1.0, 2.0]}]}).encode(),
+            trace_id="rest-adopted-1")
+        assert status == 200
+        assert tracing.find_traces("rest-adopted-1")
+
+
+class _FakeHandlers:
+    """Just enough of server.handlers.Handlers for the REST route: a
+    predict() that opens the standard request trace."""
+
+    def __init__(self, sig):
+        self._sig = sig
+
+    def predict(self, request):
+        from min_tfs_client_tpu.protos import tfs_apis_pb2 as apis
+        from min_tfs_client_tpu.tensor.codec import (
+            ndarray_to_tensor_proto,
+            tensor_proto_to_ndarray,
+        )
+
+        with tracing.request_trace("predict", model="m"):
+            inputs = {k: tensor_proto_to_ndarray(v)
+                      for k, v in request.inputs.items()}
+            outputs = self._sig.run(inputs)
+            response = apis.PredictResponse()
+            for alias, arr in outputs.items():
+                response.outputs[alias].CopyFrom(
+                    ndarray_to_tensor_proto(arr))
+            return response
