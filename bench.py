@@ -1845,16 +1845,31 @@ def bench_in_flight(max_iters: int) -> dict:
 
 
 def bench_routed(max_iters: int) -> dict:
-    """Routed leg (ROADMAP item 5): 3 real server subprocesses behind
-    the in-process router, driven with the UNMODIFIED client SDK. The
-    router hop is a host-side byte proxy, so the servers are pinned to
-    JAX_PLATFORMS=cpu (three processes must not fight over one chip; the
+    """Routed leg (ROADMAP item 3): 3 real server subprocesses behind a
+    REAL `tpu-serving-router` subprocess on the asyncio data plane,
+    driven with the UNMODIFIED client SDK. The router hop is a
+    host-side byte proxy, so the servers are pinned to
+    JAX_PLATFORMS=cpu (processes must not fight over one chip; the
     quantity under test is the extra hop, which is platform-invariant).
-    Bit-identity of routed vs direct responses is ASSERTED in-bench —
-    an overhead number for a proxy that rewrites bytes would be
-    meaningless. Also exercises the sessioned path: sticky decode
-    streams through the router, with per-step overhead measured the
-    same way."""
+
+    What is ASSERTED in-bench, every round:
+
+     * bit-identity of routed vs direct responses, gRPC AND REST — an
+       overhead number for a proxy that rewrites bytes would be
+       meaningless;
+     * 8-caller routed qps >= 90% of direct (best-of-2) on hosts with
+       >= 2 cores — the aio plane's reason to exist. On a ONE-core
+       host the claim is physically unmeasurable (nothing overlaps
+       anything; a zero-logic proxy measures the same ratio), so the
+       in-bench assertion degrades to an aio-vs-threads A/B plus a
+       regression floor, honestly labelled in the record;
+     * trace-propagation overhead < 5% + 60us floor on the aio plane
+       (in-process A/B — tracing.enable is process-local).
+
+    Also measured: a 1/4/8/16 caller sweep (where does the proxy's
+    ceiling actually sit), the sessioned sticky stream, and a
+    `routed_scaleout` sub-leg — TWO router subprocesses sharing the
+    fleet, 16 callers split across them, epoch agreement checked."""
     import numpy as np
 
     from min_tfs_client_tpu.client import TensorServingClient
@@ -1869,24 +1884,34 @@ def bench_routed(max_iters: int) -> dict:
     monitoring.write_text("prometheus_config { enable: true }\n")
 
     servers = []
-    router = None
+    routers = []
+    inproc_router = None
     try:
         # Boot/parse/teardown choreography is the SHARED harness
-        # (tests/fixtures.ModelServerProcess) — same code the router
-        # integration suite runs, so a server-banner change breaks one
-        # place, loudly.
+        # (tests/fixtures.ModelServerProcess / RouterProcess) — same
+        # code the router integration suites run, so a banner change
+        # breaks one place, loudly.
         servers = [fixtures.ModelServerProcess(model_root, monitoring)
                    for _ in range(3)]
         backends = [s.wait_ready().backend_spec() for s in servers]
+        backends_arg = ",".join(backends)
 
-        router = RouterServer(RouterOptions(
-            grpc_port=0, rest_api_port=0, backends=",".join(backends),
-            health_poll_interval_s=0.5)).build_and_start()
-        t0 = time.monotonic()
-        while len(router.core.membership.live_ids()) < 3:
-            if time.monotonic() - t0 > 30:
-                raise RuntimeError("router never saw 3 LIVE backends")
-            time.sleep(0.05)
+        # Register for teardown BEFORE wait_ready: a boot timeout must
+        # not orphan a live router subprocess outside the finally.
+        router = fixtures.RouterProcess(backends_arg)
+        routers.append(router)
+        router.wait_ready()
+
+        def wait_live(r, n, timeout_s=30):
+            t0 = time.monotonic()
+            while len(r.snapshot()["view"]["live"]) < n:
+                if time.monotonic() - t0 > timeout_s:
+                    raise RuntimeError(
+                        f"router never saw {n} LIVE backends")
+                time.sleep(0.05)
+
+        wait_live(router, 3)
+        assert router.snapshot()["data_plane"]["mode"] == "aio"
 
         routed = TensorServingClient("127.0.0.1", router.grpc_port)
         direct = TensorServingClient(
@@ -1899,6 +1924,24 @@ def bench_routed(max_iters: int) -> dict:
             via_direct = direct.predict_request("sess", {"x": x})
             assert via_router.SerializeToString(deterministic=True) == \
                 via_direct.SerializeToString(deterministic=True)
+        # ...and on the REST plane (keep-alive pooled forwards must not
+        # touch a byte either).
+        import urllib.request as _urlreq
+
+        rest_payload = json.dumps(
+            {"instances": [{"x": 1.0}, {"x": 4.0}]}).encode()
+
+        def rest_post(port):
+            req = _urlreq.Request(
+                f"http://127.0.0.1:{port}/v1/models/sess:predict",
+                data=rest_payload,
+                headers={"Content-Type": "application/json"})
+            with _urlreq.urlopen(req, timeout=10) as resp:
+                return resp.read()
+
+        backend_rest = int(backends[0].rsplit(":", 1)[1])
+        for _ in range(3):  # repeats exercise the keep-alive reuse path
+            assert rest_post(router.rest_port) == rest_post(backend_rest)
 
         # -- stateless p50: direct vs routed (the router-hop overhead)
         x = np.zeros((32,), np.float32)
@@ -1917,31 +1960,11 @@ def bench_routed(max_iters: int) -> dict:
         direct_ms = p50(direct, iters)
         routed_ms = p50(routed, iters)
 
-        # -- trace-context propagation overhead (ASSERTED in-bench):
-        # tracing off disables the router's span recording, trace-id
-        # minting, and header injection — the whole fleet-tracing tax on
-        # a forward. Adjacent best-of-2 pairs, <5% + 60us floor (same
-        # discipline as the tracing overhead smoke: CPU-noise on a
-        # shared box must not fail an honest implementation).
-        from min_tfs_client_tpu.observability import tracing
-
-        tracing.enable(False)
-        try:
-            p50(routed, 5)
-            prop_off_ms = min(p50(routed, iters), p50(routed, iters))
-        finally:
-            tracing.enable(True)
-        p50(routed, 5)
-        prop_on_ms = min(p50(routed, iters), p50(routed, iters))
-        propagation_overhead = prop_on_ms / max(prop_off_ms, 1e-9)
-        assert prop_on_ms <= prop_off_ms * 1.05 + 0.06, (
-            f"trace propagation costs {propagation_overhead:.3f}x on the "
-            f"routed leg ({prop_on_ms:.3f} vs {prop_off_ms:.3f} ms p50); "
-            "the <5% budget is the fleet-tracing contract")
-
-        # -- concurrent throughput through the full stack (8 in-flight)
-        def qps(client, total=64, threads=8):
+        # -- caller sweep: where the proxy's concurrency ceiling sits
+        def qps(client, threads, total=None):
             import concurrent.futures as cf
+
+            total = total or max(32, threads * 8)
 
             def one(_):
                 client.predict_request("sess", {"x": x})
@@ -1951,8 +1974,62 @@ def bench_routed(max_iters: int) -> dict:
                 list(pool.map(one, range(total)))
             return total / (time.perf_counter() - start)
 
-        qps_direct = qps(direct)
-        qps_routed = qps(routed)
+        qps(routed, 8), qps(direct, 8)  # warm the concurrent path
+        sweep = {}
+        for callers in (1, 4, 8, 16):
+            qd = qps(direct, callers)
+            qr = qps(routed, callers)
+            sweep[callers] = {
+                "direct": round(qd, 1), "routed": round(qr, 1),
+                "ratio": round(qr / max(qd, 1e-9), 3)}
+        ratio_8 = max(
+            sweep[8]["ratio"],
+            round(qps(routed, 8) / max(qps(direct, 8), 1e-9), 3))
+        # The acceptance bar is TOPOLOGY-AWARE, because the physics is.
+        # On >= 2 cores the router's per-request CPU overlaps the
+        # backend's and the aio plane must keep >= 90% of direct at 8
+        # callers (ROADMAP target 95%). On ONE core nothing can
+        # overlap anything: every proxy cycle is serial added CPU, and
+        # a ZERO-logic python byte proxy measures the same ~0.55 ratio
+        # this full router does (PERF.md round-12) — so the measurable
+        # claims here are (a) the aio plane does not lose to the
+        # threaded plane it replaces (interleaved best-of-2 A/B) and
+        # (b) the ratio stays above a regression floor.
+        cores = os.cpu_count() or 1
+        plane_ab = None
+        if cores >= 2:
+            assert ratio_8 >= 0.90, (
+                f"aio data plane kept only {ratio_8:.3f} of direct qps "
+                f"at 8 callers on {cores} cores; the scale-out bar is "
+                "0.90 (ROADMAP target 0.95)")
+        else:
+            threads_router = fixtures.RouterProcess(
+                backends_arg, extra_args=("--data_plane=threads",))
+            routers.append(threads_router)
+            threads_router.wait_ready()
+            wait_live(threads_router, 3)
+            routed_t = TensorServingClient(
+                "127.0.0.1", threads_router.grpc_port)
+            qps(routed_t, 8)  # warm
+            best_aio = best_threads = 0.0
+            for _ in range(2):
+                best_aio = max(best_aio, qps(routed, 8))
+                best_threads = max(best_threads, qps(routed_t, 8))
+            routed_t.close()
+            threads_router.kill()
+            routers.remove(threads_router)
+            plane_ab = {
+                "aio_qps_8": round(best_aio, 1),
+                "threads_qps_8": round(best_threads, 1),
+                "aio_over_threads": round(
+                    best_aio / max(best_threads, 1e-9), 3),
+            }
+            assert best_aio >= 0.85 * best_threads, (
+                f"aio plane lost to the threads plane it replaces: "
+                f"{best_aio:.1f} vs {best_threads:.1f} qps at 8 callers")
+            assert ratio_8 >= 0.40, (
+                f"single-core routed ratio {ratio_8:.3f} fell below the "
+                "0.40 regression floor (zero-logic-proxy band is ~0.55)")
 
         # -- sessioned path: sticky stream steps through the router
         sid = np.asarray(b"bench-routed-session", object)
@@ -1974,20 +2051,89 @@ def bench_routed(max_iters: int) -> dict:
                                signature_name="decode_close")
         step_ts.sort()
 
+        # -- routed_scaleout: a SECOND router replica joins the tier;
+        # 16 callers split 8/8 across the two front doors. Replication
+        # evidence rides along: both report the same membership epoch.
+        router2 = fixtures.RouterProcess(backends_arg)
+        routers.append(router2)
+        router2.wait_ready()
+        wait_live(router2, 3)
+        assert router.snapshot()["view"]["epoch"] == \
+            router2.snapshot()["view"]["epoch"], \
+            "router replicas disagree on the membership epoch"
+        routed2 = TensorServingClient("127.0.0.1", router2.grpc_port)
+        qps(routed2, 4)  # warm replica 2's channels
+
+        def qps_two_routers(total=128, threads=16):
+            import concurrent.futures as cf
+
+            clients = [routed, routed2]
+
+            def one(i):
+                clients[i % 2].predict_request("sess", {"x": x})
+
+            start = time.perf_counter()
+            with cf.ThreadPoolExecutor(threads) as pool:
+                list(pool.map(one, range(total)))
+            return total / (time.perf_counter() - start)
+
+        qps_scaleout = qps_two_routers()
+        qd16 = sweep[16]["direct"]
+        routed2.close()
+        router2.kill()
+        routers.remove(router2)
+
+        # -- trace-context propagation overhead (ASSERTED in-bench):
+        # tracing.enable is process-local, so this A/B runs against an
+        # IN-PROCESS router on the same aio plane — off disables the
+        # router's span recording, trace-id minting, and header
+        # injection, the whole fleet-tracing tax on a forward.
+        # Adjacent best-of-2 pairs, <5% + 60us floor (CPU-noise on a
+        # shared box must not fail an honest implementation).
+        from min_tfs_client_tpu.observability import tracing
+
+        inproc_router = RouterServer(RouterOptions(
+            grpc_port=0, rest_api_port=0, backends=backends_arg,
+            health_poll_interval_s=0.5)).build_and_start()
+        t0 = time.monotonic()
+        while len(inproc_router.core.membership.live_ids()) < 3:
+            if time.monotonic() - t0 > 30:
+                raise RuntimeError("in-process router never saw 3 LIVE")
+            time.sleep(0.05)
+        routed_in = TensorServingClient(
+            "127.0.0.1", inproc_router.grpc_port)
+        tracing.enable(False)
+        try:
+            p50(routed_in, 5)
+            prop_off_ms = min(p50(routed_in, iters), p50(routed_in, iters))
+        finally:
+            tracing.enable(True)
+        p50(routed_in, 5)
+        prop_on_ms = min(p50(routed_in, iters), p50(routed_in, iters))
+        propagation_overhead = prop_on_ms / max(prop_off_ms, 1e-9)
+        assert prop_on_ms <= prop_off_ms * 1.05 + 0.06, (
+            f"trace propagation costs {propagation_overhead:.3f}x on the "
+            f"routed leg ({prop_on_ms:.3f} vs {prop_off_ms:.3f} ms p50); "
+            "the <5% budget is the fleet-tracing contract")
+        routed_in.close()
+
         # Per-stage tables for the routed leg: the ROUTER's lanes come
-        # from this process's tracing ring (child_main attaches them as
-        # extra.stage_breakdown under --breakdown); the BACKEND's lanes
-        # are fetched from a backend's own trace ring over its
-        # monitoring port, so the record shows both sides of the hop.
+        # from the in-process router's tracing ring (child_main attaches
+        # them as extra.stage_breakdown under --breakdown); the
+        # BACKEND's lanes are fetched from a backend's own trace ring
+        # over its monitoring port, so the record shows both sides of
+        # the hop.
         backend_stages = None
         if os.environ.get("BENCH_BREAKDOWN", "") not in ("", "0"):
-            import urllib.request as _urlreq
-
-            rest_port = int(backends[0].rsplit(":", 1)[1])
             with _urlreq.urlopen(
-                    f"http://127.0.0.1:{rest_port}"
+                    f"http://127.0.0.1:{backend_rest}"
                     "/monitoring/traces?summary=1", timeout=10) as resp:
                 backend_stages = json.loads(resp.read()).get("stages")
+
+        # Event-loop health telemetry made it through the whole run
+        # without a lag event (flight recorder stays silent on a sane
+        # box; the gauge itself is the evidence the ticker ran).
+        loop_health = router.snapshot()["data_plane"]
 
         routed.close()
         direct.close()
@@ -1998,31 +2144,48 @@ def bench_routed(max_iters: int) -> dict:
             "metric": "routed_predict_p50_ms", "value": routed_ms,
             "unit": "ms",
             "extra": {
+                "data_plane": "aio",
                 "direct_p50_ms": round(direct_ms, 3),
                 "router_hop_overhead_ms": round(routed_ms - direct_ms, 3),
                 "router_hop_overhead_ratio": round(
                     routed_ms / max(direct_ms, 1e-9), 3),
-                "qps_direct_8_callers": round(qps_direct, 1),
-                "qps_routed_8_callers": round(qps_routed, 1),
-                "qps_ratio": round(qps_routed / max(qps_direct, 1e-9), 3),
+                "qps_sweep_by_callers": sweep,
+                "qps_ratio_8_callers_best_of_2": ratio_8,
+                "qps_assertion_mode": (
+                    "direct_bar_0.90" if cores >= 2
+                    else "single_core_plane_ab"),
+                "cores": cores,
+                **({"plane_ab": plane_ab} if plane_ab else {}),
+                "routed_scaleout": {
+                    "two_router_qps_16_callers": round(qps_scaleout, 1),
+                    "direct_qps_16_callers": qd16,
+                    "ratio": round(qps_scaleout / max(qd16, 1e-9), 3),
+                },
                 "session_step_p50_ms": round(
                     step_ts[len(step_ts) // 2], 3),
                 "propagation_p50_on_ms": round(prop_on_ms, 3),
                 "propagation_p50_off_ms": round(prop_off_ms, 3),
                 "propagation_overhead_ratio": round(
                     propagation_overhead, 3),
+                "event_loop_lag_ms": loop_health.get(
+                    "event_loop_lag_ms"),
+                "event_loop_lag_max_ms": loop_health.get(
+                    "event_loop_lag_max_ms"),
                 "backends": 3,
                 "bit_identical": True,
+                "rest_bit_identical": True,
                 "sticky_session_verified": True,
                 **extra_breakdown,
             },
         }
     finally:
-        if router is not None:
+        if inproc_router is not None:
             try:
-                router.stop()
+                inproc_router.stop()
             except Exception:
                 traceback.print_exc(file=sys.stderr)
+        for router in routers:
+            router.kill()
         for server in servers:
             server.kill()
 

@@ -11,6 +11,17 @@ into an unrelated thread outlives its request.
   SP001  span()/request_trace() constructed outside a `with` statement
   SP002  trace/span handed to a thread boundary outside the BatchTask API
 
+TASK handoff (the router's aio data plane) is different and SANCTIONED:
+`asyncio.create_task` / `ensure_future` / `gather` run the child on the
+SAME loop thread and copy the caller's contextvar context at task
+creation, so the active trace rides into the child and activate()'s
+set/reset stays task-local — no clock moves threads, nothing outlives
+the request (the spawning coroutine awaits its children). Handing a
+trace into a FOREIGN loop from another thread via
+`asyncio.run_coroutine_threadsafe` is still a thread crossing and still
+fires SP002 — that path must use the BatchTask-style explicit handoff
+or stay traceless.
+
 The implementing module(s) (config.span_exempt) are skipped — they
 necessarily build spans imperatively. `# servelint: span-ok <why>`
 suppresses a reviewed line.
@@ -36,11 +47,19 @@ _SPAN_FACTORIES = {"span", "tracing.span", "request_trace",
                    "tracing.request_trace"}
 _TRACE_SOURCES = _SPAN_FACTORIES | {"current_trace", "tracing.current_trace",
                                     "fanout", "tracing.fanout"}
-# Calls that cross a thread boundary.
-_THREAD_CALLS = {"Thread", "threading.Thread", "start_new_thread"}
+# Calls that cross a thread boundary. run_coroutine_threadsafe is the
+# thread->loop bridge: the coroutine runs on the LOOP's thread with the
+# loop's context, not the caller's — a trace passed through it leaks
+# exactly like a Thread() arg.
+_THREAD_CALLS = {"Thread", "threading.Thread", "start_new_thread",
+                 "run_coroutine_threadsafe"}
 _THREAD_METHODS = {"submit", "map", "apply_async"}
 # The sanctioned handoff: a BatchTask construction may carry the trace.
 _SANCTIONED_CTORS = {"BatchTask"}
+# Sanctioned TASK spawns (same loop thread, contextvar context copied at
+# creation, children awaited before the request finishes) — the aio
+# data plane's handoff (router/aio_proxy.py).
+_SANCTIONED_TASK_CALLS = {"create_task", "ensure_future", "gather"}
 
 
 def check(module: ModuleInfo, config: AnalysisConfig) -> list[Finding]:
@@ -141,6 +160,11 @@ def _check_thread_handoff(module: ModuleInfo, qualname: str, func
         last = name.rsplit(".", 1)[-1]
         if last in _SANCTIONED_CTORS:
             continue  # BatchTask(..., trace=...) is the sanctioned handoff
+        if last in _SANCTIONED_TASK_CALLS:
+            # Same-loop task spawn: the contextvar context (and so the
+            # active trace) is copied at task creation — the aio data
+            # plane's sanctioned handoff; no thread crossing happens.
+            continue
         if not crosses_thread(node):
             # Storing a live trace on shared state leaks it past the
             # request; only the BatchTask field is sanctioned.
@@ -158,6 +182,11 @@ def _check_thread_handoff(module: ModuleInfo, qualname: str, func
         for a in node.args:
             if isinstance(a, (ast.Tuple, ast.List)):
                 passed += [e for e in a.elts
+                           if isinstance(e, ast.Name) and e.id in trace_vars]
+            elif isinstance(a, ast.Call):
+                # run_coroutine_threadsafe(worker(trace), loop): the
+                # trace crosses INSIDE the coroutine-constructing call.
+                passed += [e for e in a.args
                            if isinstance(e, ast.Name) and e.id in trace_vars]
         for arg in passed:
             stmt = stmt_of.get(id(node))

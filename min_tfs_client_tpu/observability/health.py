@@ -38,6 +38,35 @@ _core_ref = None                                   # guarded_by: _lock
 # work is waited out, so routers stop sending new traffic during the
 # grace window. Weak — a drained core that gets collected must not pin.
 _draining = weakref.WeakSet()                      # guarded_by: _lock
+# Advertised routing weight (`--serving_weight`): published in the
+# readyz payload so a router's weighted rendezvous ring sees relative
+# capacity through the same plane it polls for liveness. 1.0 = a
+# homogeneous fleet (and exactly the unweighted ring assignment).
+_serving_weight = 1.0                              # guarded_by: _lock
+
+
+def set_serving_weight(weight: float) -> None:
+    """Boot-time (Server.build) capacity advertisement. A zero/negative
+    weight would (near-)silently remove the replica from every router's
+    rotation — which is drain's job, not a knob's — so it is coerced to
+    the homogeneous 1.0 with a loud log, keeping the replica serving."""
+    global _serving_weight
+    weight = float(weight)
+    if weight <= 0.0:
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "--serving_weight=%g is not positive; a non-positive weight "
+            "would remove this replica from router rotation (that is "
+            "drain's job) — serving with weight 1.0 instead", weight)
+        weight = 1.0
+    with _lock:
+        _serving_weight = weight
+
+
+def serving_weight() -> float:
+    with _lock:
+        return _serving_weight
 
 
 def register_core(core) -> None:
@@ -161,6 +190,7 @@ def readiness(max_burn: float | None = None) -> dict:
 
     ready = not reasons
     verdict = {"ready": ready, "draining": draining, "models": models,
+               "weight": serving_weight(),
                "slo": slo_detail, "reasons": reasons}
     _export_ready_gauge(ready)
     return verdict

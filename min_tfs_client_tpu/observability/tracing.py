@@ -14,7 +14,15 @@ connecting them into ONE per-request timeline:
  * the batching queue hands a request's trace across the caller->scheduler
    thread boundary explicitly (BatchTask.trace); the scheduler thread
    activates a `fanout` over every co-batched trace so one merged
-   execution is accounted to each caller that rode in the batch.
+   execution is accounted to each caller that rode in the batch;
+ * asyncio TASKS (the router's aio data plane) need no explicit handoff
+   at all: `_current` is a contextvar, every task created on the loop
+   (`create_task`/`ensure_future`/`gather`) copies the spawning task's
+   context, so the active trace rides into child coroutines and
+   `activate()`'s set/reset stays task-local — concurrent requests on
+   ONE loop thread cannot bleed spans into each other. Crossing into a
+   foreign loop from another thread (`run_coroutine_threadsafe`) gets
+   no such copy and is a span-rule violation (analysis/spans.py SP002).
 
 Sinks, fed when a trace finishes:
 
@@ -191,9 +199,11 @@ class RequestTrace:
     the in-flight window's completion thread closes its last span
     before `done.set()` (batching/session.py `_complete_batch`). Any
     new writer must keep that ordering: no span may be recorded after
-    the task's `done` event fires. Readers copy the list
-    (`list(spans)`), which is likewise GIL-safe against a concurrent
-    append.
+    the task's `done` event fires. The same argument covers asyncio
+    task writers (the aio router): gathered child tasks append on the
+    one loop thread and are awaited before the request's `finish()`.
+    Readers copy the list (`list(spans)`), which is likewise GIL-safe
+    against a concurrent append.
     """
 
     __slots__ = ("id", "trace_id", "api", "model", "signature", "transport",

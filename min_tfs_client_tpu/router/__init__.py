@@ -1,23 +1,36 @@
 """Sessioned routing tier: the drain-aware front door that turns "a
-server" into "a service" (ROADMAP item 5; docs/ROUTING.md).
+server" into "a service" (ROADMAP item 3; docs/ROUTING.md).
 
 A standalone process (`tpu-serving-router`) fronting N model-server
 processes speaking the SAME frozen wire protocol — the client SDK works
-against the router with zero changes:
+against the router with zero changes, and N router replicas serve one
+fleet with correct stickiness and zero shared state:
 
  * `ring.py`        deterministic consistent hashing (rendezvous/HRW over
                     FarmHash64) keyed on (model, session-id | request-hash)
-                    with provably bounded rebalance on membership change;
+                    with provably bounded rebalance on membership change,
+                    plus the weighted (-w/ln(h)) and bounded-load (c=1.25)
+                    variants for heterogeneous fleets;
  * `membership.py`  health-plane-fed membership: polls each backend's
                     `grpc.health.v1.Health/Check` and `/monitoring/readyz`,
                     ejects NOT_SERVING (drain) and unreachable (dead)
-                    backends from the new-work rotation;
+                    backends from the new-work rotation, and publishes the
+                    replicable membership VIEW (epoch = fingerprint of the
+                    sorted (live id, weight) pairs — content, not counter);
  * `sessions.py`    the stickiness table — a decode session's KV cache
                     lives in ONE process, so its requests must keep
-                    landing there even while that backend drains;
- * `core.py`        the routing decision tying the three together;
- * `proxy.py`       the pure proxy data plane: gRPC requests forwarded as
-                    raw bytes (never re-serialized), REST forwarded as-is,
-                    plus the router's own `/monitoring/router` payload;
+                    landing there even while that backend drains; pins
+                    carry the epoch they were minted under (fencing);
+ * `core.py`        the routing decision tying it together: epoch-fenced
+                    fast path, churn revalidation, deterministic minting,
+                    probe-based pin recovery, bounded-load stateless;
+ * `aio_proxy.py`   the DEFAULT data plane: a grpc.aio byte proxy on one
+                    asyncio event loop (requests forwarded as raw bytes,
+                    never re-serialized), with event-loop lag telemetry;
+ * `proxy.py`       the threaded gRPC plane (--data_plane=threads escape
+                    hatch, one release), the shared wire scan, the REST
+                    forwarding path, and `/monitoring/router`;
+ * `http_pool.py`   keep-alive HTTP connections for REST forwards and
+                    stitched-trace fetches;
  * `main.py`        CLI entry point.
 """

@@ -3,18 +3,28 @@
 One rule set, applied per request:
 
  * sessioned (the request carries a scalar DT_STRING `session_id`
-   input): a pinned session goes to ITS backend while that backend is
-   LIVE **or DRAINING** (drain stops new sessions, never in-flight
-   ones); if its backend is DEAD the pin is dropped and the request
-   fails UNAVAILABLE — the KV state died with the process. An unpinned
-   session id is a NEW session: assigned via the ring over LIVE
-   backends only, then pinned.
- * stateless: the ring over LIVE backends, keyed on (model,
-   request-fingerprint) so identical requests revisit warm caches.
+   input): a pinned session goes to ITS backend. Pins are EPOCH-FENCED
+   for router replication: each pin records the membership-view epoch
+   it was minted under; while the router's view still matches, the pin
+   is honored with no state check (the view proves the backend LIVE),
+   and on churn the pin REVALIDATES against the live table — kept while
+   its backend is LIVE **or DRAINING** (drain stops new sessions, never
+   in-flight ones), failed UNAVAILABLE when the backend is DEAD (the KV
+   state died with the process; re-routing would only manufacture
+   NOT_FOUNDs). An unpinned session id is a NEW session: placed by
+   WEIGHTED rendezvous over the view — a pure function of (model,
+   session id, view), so N router replicas mint the SAME pin for the
+   same session with zero shared state.
+ * stateless: the weighted ring with the BOUNDED-LOAD refinement
+   (c = 1.25 over the router's in-flight forward counts), keyed on
+   (model, request-fingerprint) so identical requests revisit warm
+   caches unless their preferred backend is running hot.
 
 The data plane reports outcomes back through note_result(): errors feed
 the per-backend error counters, and connectivity failures pulse the
-membership poll so ejection happens within one poll interval.
+membership poll so ejection happens within one poll interval. It also
+brackets every forward with note_forward_start/done — the load signal
+the bounded-load ring reads.
 """
 
 from __future__ import annotations
@@ -35,6 +45,12 @@ from min_tfs_client_tpu.router.membership import (
 from min_tfs_client_tpu.router.sessions import SessionTable
 from min_tfs_client_tpu.utils.status import ServingError
 
+# Signatures that CREATE a decode session (models/t5.py and the session
+# fixture follow this naming contract): their placement is minted
+# deterministically. Any other sessioned signature targets an EXISTING
+# session, so an unpinned one triggers pin recovery, not a fresh mint.
+SESSION_INIT_SIGNATURES = frozenset({"decode_init", "decode_init_prefix"})
+
 
 class ChannelPool:
     """One persistent gRPC channel per backend, shared by the data plane
@@ -44,6 +60,10 @@ class ChannelPool:
     def __init__(self):
         self._lock = threading.Lock()
         self._channels: dict[str, object] = {}   # guarded_by: self._lock
+        # channel.unary_unary() builds a fresh multicallable each time
+        # (~tens of us of cython setup) — cache per (backend, method);
+        # the method set is tiny and fixed (the serving surface).
+        self._calls: dict[tuple, object] = {}    # guarded_by: self._lock
 
     def get(self, backend: Backend):
         import grpc
@@ -58,20 +78,81 @@ class ChannelPool:
                 self._channels[backend.backend_id] = channel
             return channel
 
+    def unary_unary(self, backend: Backend, full_method: str):
+        """Cached raw-bytes multicallable for (backend, method)."""
+        cache_key = (backend.backend_id, full_method)
+        with self._lock:
+            call = self._calls.get(cache_key)
+        if call is None:
+            call = self.get(backend).unary_unary(full_method)
+            with self._lock:
+                self._calls[cache_key] = call
+        return call
+
     def close(self) -> None:
         with self._lock:
             channels, self._channels = list(self._channels.values()), {}
+            self._calls = {}
         for channel in channels:
             channel.close()
 
 
 @dataclass(frozen=True)
 class RouteResult:
-    """One routing decision: the backend, and whether THIS request
-    created the session pin (so a failed first forward can undo it)."""
+    """One routing decision: the backend, whether THIS request created
+    the session pin (so a failed first forward can undo it), and the
+    membership-view epoch the decision was computed under (annotated
+    onto the request trace — churn diagnosis needs to know which view
+    placed a request).
+
+    `probe_candidates` non-empty marks a PIN-RECOVERY decision (a
+    sessioned non-init request this replica holds no pin for): the data
+    plane forwards down the candidates in order, treats NOT_FOUND as
+    "wrong backend, try the next", and pins the backend that answers —
+    see RouterCore._route_sessioned."""
 
     backend: Backend
     fresh_pin: bool
+    epoch: int = 0
+    probe_candidates: tuple = ()
+
+
+class LoopHealth:
+    """Data-plane health the event-loop lag ticker feeds and
+    /monitoring/router reports. A lagging loop is the aio plane's
+    analogue of a saturated thread pool: every in-flight forward's
+    completion is late by the lag, so the ticker samples it
+    continuously and the snapshot carries last/max."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._mode = "threads"        # guarded_by: self._lock
+        self._lag_ms = 0.0            # guarded_by: self._lock
+        self._max_lag_ms = 0.0        # guarded_by: self._lock
+        self._samples = 0             # guarded_by: self._lock
+        self._over_threshold = 0      # guarded_by: self._lock
+
+    def set_mode(self, mode: str) -> None:
+        with self._lock:
+            self._mode = mode
+
+    def record_lag(self, lag_ms: float, over_threshold: bool) -> None:
+        with self._lock:
+            self._lag_ms = lag_ms
+            self._max_lag_ms = max(self._max_lag_ms, lag_ms)
+            self._samples += 1
+            if over_threshold:
+                self._over_threshold += 1
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            out = {"mode": self._mode}
+            if self._samples:
+                out["event_loop_lag_ms"] = round(self._lag_ms, 3)
+                out["event_loop_lag_max_ms"] = round(self._max_lag_ms, 3)
+                out["lag_samples"] = self._samples
+                out["lag_over_threshold"] = self._over_threshold
+            return out
 
 
 class RouterCore:
@@ -82,10 +163,26 @@ class RouterCore:
         probe_timeout_s: float = 1.0,
         eject_after_failures: int = 1,
         session_idle_timeout_s: float = 3600.0,
+        bounded_load_c: float = ring_mod.BOUNDED_LOAD_C,
         poller=None,
     ):
+        self.bounded_load_c = bounded_load_c
         self.channels = ChannelPool()
         self.sessions = SessionTable(idle_timeout_s=session_idle_timeout_s)
+        self.loop_health = LoopHealth()
+        self._inflight_lock = threading.Lock()
+        self._inflight: dict[str, int] = {}  # guarded_by: self._inflight_lock
+        self._recovered_sessions = 0         # guarded_by: self._inflight_lock
+        # Ranked-preference cache for stateless routing: the weighted
+        # ranking is a pure function of (key, view), and stateless
+        # traffic repeats keys BY DESIGN (identical requests revisit
+        # warm caches) — pure-Python farmhash scoring on every repeat
+        # was the single largest router CPU item in the profile.
+        # Invalidated wholesale on any epoch move; bounded so a
+        # high-cardinality key flood cannot grow it unboundedly.
+        self._ranked_lock = threading.Lock()
+        self._ranked_epoch = 0               # guarded_by: self._ranked_lock
+        self._ranked: dict[bytes, list] = {}  # guarded_by: self._ranked_lock
         self.membership = MembershipTable(
             backends,
             self.channels,
@@ -131,29 +228,94 @@ class RouterCore:
     # -- routing -------------------------------------------------------------
 
     def route(self, model: str, session_id: Optional[bytes],
-              request_bytes: bytes) -> "RouteResult":
+              request_bytes: bytes,
+              signature: str = "decode_init") -> "RouteResult":
         """The decision for one request — `.backend` plus whether this
         request CREATED its session pin (`.fresh_pin`, so the data plane
         can roll the pin back if the first forward never reaches the
         backend). Raises typed UNAVAILABLE when no backend can take it
-        (lost session / empty rotation)."""
+        (lost session / empty rotation). `signature` distinguishes a
+        session's INIT (deterministic placement mints the pin) from a
+        later request this replica has no pin for (pin recovery —
+        probe, don't guess). Defaulting to init keeps single-router
+        callers on the historical semantics."""
         if session_id is not None:
-            return self._route_sessioned(model, session_id)
+            return self._route_sessioned(model, session_id, signature)
         routing_id = ring_mod.request_fingerprint(request_bytes)
-        return RouteResult(self._assign_new(model, routing_id), False)
+        view = self.membership.view()
+        self._require_live(view, model)
+        order = self.ranked_order(
+            ring_mod.ring_key(model, routing_id), view)
+        backend_id = ring_mod.bounded_choice(
+            order, self.inflight_by_backend(), self.bounded_load_c,
+            view.weights)
+        return RouteResult(self._backend_or_raise(backend_id), False,
+                           view.epoch)
 
-    def _route_sessioned(self, model: str,
-                         session_id: bytes) -> "RouteResult":
+    _RANKED_CACHE_MAX = 4096
+
+    def ranked_order(self, key: bytes, view) -> list:
+        with self._ranked_lock:
+            if self._ranked_epoch != view.epoch:
+                self._ranked.clear()
+                self._ranked_epoch = view.epoch
+            order = self._ranked.get(key)
+        if order is None:
+            order = ring_mod.ranked_weighted(key, view.weights)
+            with self._ranked_lock:
+                if self._ranked_epoch == view.epoch:
+                    if len(self._ranked) >= self._RANKED_CACHE_MAX:
+                        # Evict ONE entry (the most recent — under a
+                        # never-repeating key flood that is another
+                        # flood key), not clear(): wholesale eviction
+                        # would dump every warm repeated key and
+                        # re-pay the full ranking pass on each.
+                        self._ranked.popitem()
+                    self._ranked[key] = order
+        return order
+
+    def _route_sessioned(self, model: str, session_id: bytes,
+                         signature: str) -> "RouteResult":
         # Two passes cover the lost-race re-read; pin churn beyond that
         # would need release() racing pin_if_absent in a tight loop.
         for _ in range(2):
-            pinned = self.sessions.lookup(model, session_id)
-            if pinned is not None:
+            view = self.membership.view()
+            fenced = self.sessions.lookup_fenced(model, session_id)
+            if fenced is not None:
+                pinned, pin_epoch = fenced
+                if pin_epoch == view.epoch and pinned in view.weights:
+                    # Fast path: the pin was minted (or last
+                    # revalidated) under THIS view, and the view names
+                    # the backend LIVE — no state read needed. The
+                    # membership check is load-bearing, not belt-and-
+                    # braces: epochs are CONTENT, so a fleet that
+                    # churns back to a previous live-set recreates an
+                    # old epoch value — a pin stamped under that old
+                    # view must not fast-path to a backend the
+                    # recreated view never contained (it may be DEAD).
+                    backend = self.membership.backend(pinned)
+                    if backend is not None:
+                        return RouteResult(backend, False, view.epoch)
+                # The view churned since the pin was stamped:
+                # REVALIDATE against the live table — the pre-epoch
+                # sticky semantics, verbatim. A live session is never
+                # silently re-routed by churn; it either keeps its
+                # backend or fails honestly.
                 state = self.membership.state_of(pinned)
                 if state in (LIVE, DRAINING):
                     backend = self.membership.backend(pinned)
                     if backend is not None:
-                        return RouteResult(backend, False)
+                        if state == LIVE and pinned in view.weights:
+                            # Re-stamp so later requests under this view
+                            # take the fast path again. DRAINING pins —
+                            # or a backend whose LIVE flip postdates
+                            # this view snapshot — are deliberately NOT
+                            # re-stamped: the fast path's invariant is
+                            # "stamped epoch == current view => backend
+                            # is IN that view", and neither is.
+                            self.sessions.restamp(
+                                model, session_id, pinned, view.epoch)
+                        return RouteResult(backend, False, view.epoch)
                 # DEAD (or removed): the KV state is gone; fail the
                 # stream honestly instead of manufacturing NOT_FOUNDs
                 # elsewhere.
@@ -162,52 +324,141 @@ class RouterCore:
                     f"session {session_id!r} was pinned to backend "
                     f"{pinned} which is {state}; the session's state is "
                     "lost — start a new session")
-            candidate = self._assign_new(model, session_id)
+            # UNPINNED. Two very different cases:
+            #
+            #  * the session's INIT: deterministic weighted rendezvous
+            #    over the view — a pure function of (model, session id,
+            #    view), so every router replica holding this view mints
+            #    the SAME pin. No bounded-load here: load is
+            #    replica-local, and cross-replica agreement is the
+            #    whole point.
+            #  * a NON-init request (step/close) this replica has never
+            #    seen: the session EXISTS somewhere — inited through a
+            #    sibling replica, possibly under an older view (a join
+            #    since then moves exactly the joiner-won keys, so the
+            #    current view's argmax may name a backend that has
+            #    never heard of the session). Guessing would silently
+            #    re-route a live stream; instead hand the data plane
+            #    the full preference order (live ranked, then DRAINING
+            #    ranked — a drainer still serves its pinned sessions)
+            #    for PIN RECOVERY: forward down the list, treat
+            #    NOT_FOUND as "wrong backend", pin whoever answers.
+            #    Under an unchurned view the first candidate IS the
+            #    init-time placement, so recovery costs zero extra
+            #    forwards exactly when replicas agree. The fan-out is
+            #    deliberately UNCAPPED (worst case: N forwards for a
+            #    genuinely-gone session before the honest NOT_FOUND):
+            #    after churn an old session can live on any backend,
+            #    so a probe cap would silently lose recoverable
+            #    sessions (docs/ROUTING.md "Limits").
+            key = ring_mod.ring_key(model, session_id)
+            if signature not in SESSION_INIT_SIGNATURES:
+                # ONE atomic states snapshot partitions the fleet —
+                # deriving LIVE from the view and DRAINING from a
+                # second read would let a poll landing in between drop
+                # (or double-probe) a backend that just flipped.
+                states = self.membership.states()
+                order = list(ring_mod.ranked_weighted(
+                    key, {bid: view.weights.get(bid, 1.0)
+                          for bid, state in states.items()
+                          if state == LIVE}))
+                order += ring_mod.ranked_weighted(
+                    key, {bid: 1.0 for bid, state in states.items()
+                          if state == DRAINING})
+                candidates = tuple(
+                    backend for backend in
+                    (self.membership.backend(bid) for bid in order)
+                    if backend is not None)
+                if not candidates:
+                    # No live AND no draining backend: nothing can
+                    # possibly hold the session. Deliberately NOT
+                    # gated on view.live alone — during a full-fleet
+                    # rolling drain the session may still be streaming
+                    # against a drainer, and a replica without the pin
+                    # must find it there, exactly like the replica
+                    # WITH the pin keeps serving it (revalidation).
+                    self._require_live(view, model)
+                    # _require_live judges the lock-free view, which
+                    # can lag the states() snapshot by one poll (a
+                    # note_error-pulsed sweep killing the last LIVE
+                    # backend mid-route): the snapshot is the honest
+                    # answer, so raise even when the stale view would
+                    # have let candidates[0] IndexError into INTERNAL.
+                    raise ServingError.unavailable(
+                        "no live backends: every backend is draining, "
+                        "dead, or not yet polled")
+                return RouteResult(candidates[0], False, view.epoch,
+                                   probe_candidates=candidates)
+            self._require_live(view, model)
+            candidate = self._backend_or_raise(
+                ring_mod.assign_weighted(key, view.weights))
             with tracing.span("router/pin"):
                 winner_id, we_pinned = self.sessions.pin_if_absent(
-                    model, session_id, candidate.backend_id)
+                    model, session_id, candidate.backend_id,
+                    epoch=view.epoch)
             if we_pinned:
-                return RouteResult(candidate, True)
+                return RouteResult(candidate, True, view.epoch)
             # a concurrent first-request won the pin: follow the winner
             # through the normal pinned path (state checks included)
         raise ServingError.unavailable(  # pragma: no cover - needs a
             f"session {session_id!r} pin is churning; retry")  # tight race
 
-    def _assign_new(self, model: str, routing_id: bytes) -> Backend:
-        live = self.membership.live_ids()
-        if not live:
-            # UNAVAILABLE-from-all: the router's own black-box moment —
-            # record the fleet state and latch the one-shot dump (shares
-            # the INTERNAL latch; a storm of these must not fill the
-            # disk) so the 10 seconds of membership/forward history
-            # leading here survive.
-            try:
-                from min_tfs_client_tpu.observability import (
-                    flight_recorder,
-                )
+    def _require_live(self, view, model: str) -> None:
+        if view.live:
+            return
+        # UNAVAILABLE-from-all: the router's own black-box moment —
+        # record the fleet state and latch the one-shot dump (shares
+        # the INTERNAL latch; a storm of these must not fill the
+        # disk) so the 10 seconds of membership/forward history
+        # leading here survive.
+        try:
+            from min_tfs_client_tpu.observability import (
+                flight_recorder,
+            )
 
-                states = {b.backend_id: self.membership.state_of(
-                    b.backend_id) for b in self.membership.backends()}
-                flight_recorder.record(
-                    "no_live_backends", model=model,
-                    states=",".join(f"{k}={v}"
-                                    for k, v in sorted(states.items())))
-                flight_recorder.latch_dump(
-                    "UNAVAILABLE from every backend")
-            except Exception:  # pragma: no cover - recorder must not
-                pass           # turn an outage into a crash
-            raise ServingError.unavailable(
-                "no live backends: every backend is draining, dead, or "
-                "not yet polled")
-        backend_id = ring_mod.assign(ring_mod.ring_key(model, routing_id),
-                                     live)
-        backend = self.membership.backend(backend_id)
+            states = {b.backend_id: self.membership.state_of(
+                b.backend_id) for b in self.membership.backends()}
+            flight_recorder.record(
+                "no_live_backends", model=model,
+                states=",".join(f"{k}={v}"
+                                for k, v in sorted(states.items())))
+            flight_recorder.latch_dump(
+                "UNAVAILABLE from every backend")
+        except Exception:  # pragma: no cover - recorder must not
+            pass           # turn an outage into a crash
+        raise ServingError.unavailable(
+            "no live backends: every backend is draining, dead, or "
+            "not yet polled")
+
+    def _backend_or_raise(self, backend_id: Optional[str]) -> Backend:
+        backend = (self.membership.backend(backend_id)
+                   if backend_id else None)
         if backend is None:  # pragma: no cover - ids come from membership
             raise ServingError.unavailable(
                 f"backend {backend_id} vanished from the membership table")
         return backend
 
     # -- data-plane feedback -------------------------------------------------
+
+    def note_forward_start(self, backend_id: str) -> None:
+        """A forward to `backend_id` is now in flight — the load signal
+        the bounded-load ring reads. Both data planes bracket every
+        forward (gRPC and REST) with start/done."""
+        with self._inflight_lock:
+            self._inflight[backend_id] = \
+                self._inflight.get(backend_id, 0) + 1
+
+    def note_forward_done(self, backend_id: str) -> None:
+        with self._inflight_lock:
+            count = self._inflight.get(backend_id, 0) - 1
+            if count > 0:
+                self._inflight[backend_id] = count
+            else:
+                self._inflight.pop(backend_id, None)
+
+    def inflight_by_backend(self) -> dict[str, int]:
+        with self._inflight_lock:
+            return dict(self._inflight)
 
     def note_result(self, backend: Backend, method: str,
                     error_code: Optional[str] = None,
@@ -225,6 +476,39 @@ class RouterCore:
     def session_closed(self, model: str, session_id: bytes) -> None:
         """decode_close round-tripped: forget the pin."""
         self.sessions.release(model, session_id)
+
+    def session_recovered(self, model: str, session_id: bytes,
+                          backend_id: str, probes: int) -> None:
+        """Pin recovery located the session on `backend_id` after
+        `probes` wrong-backend NOT_FOUNDs: pin it under the current
+        view so every later request takes the fast path, and count the
+        event (`router_session_recoveries` — a nonzero rate under a
+        STABLE view means replicas are computing different placements,
+        which the scale-out suite asserts never happens). The stamp
+        comes from the view CURRENT at recovery time, NOT the
+        route-time decision's epoch — the probe walk can span a poll, and
+        stamping a (possibly older, content-recurring) epoch for a
+        backend that view never contained would poison the fast path's
+        "epoch match => backend in that view" invariant."""
+        from min_tfs_client_tpu.server import metrics
+
+        view = self.membership.view()
+        if backend_id in view.weights:
+            epoch = view.epoch
+        else:
+            # Recovered onto a DRAINING (or not-currently-viewed)
+            # backend: stamp epoch 0 so every later request
+            # revalidates — it is not in any view's live set.
+            epoch = 0
+        self.sessions.pin(model, session_id, backend_id, epoch=epoch)
+        if probes:
+            with self._inflight_lock:
+                self._recovered_sessions += 1
+            metrics.router_session_recoveries.increment(backend_id)
+
+    def recovered_sessions(self) -> int:
+        with self._inflight_lock:
+            return self._recovered_sessions
 
     # -- observability -------------------------------------------------------
 
@@ -247,5 +531,8 @@ class RouterCore:
             "by_backend": self.sessions.count_by_backend(),
             "idle_timeout_s": self.sessions.idle_timeout_s,
         }
+        payload["data_plane"] = self.loop_health.snapshot()
+        payload["inflight_forwards"] = self.inflight_by_backend()
+        payload["sessions_recovered"] = self.recovered_sessions()
         payload["ready"] = bool(live)
         return payload

@@ -1,0 +1,204 @@
+"""Keep-alive HTTP/1.1 connections for the router's REST data plane.
+
+Before this module every proxied `/v1` forward and every stitched-trace
+backend fetch opened a fresh TCP connection (`http.client` /
+`urllib.request` one-shots): three-way handshake + slow-start on EVERY
+request, against backends the router talks to for its whole lifetime.
+The pool keeps idle persistent connections per (host, port) and reuses
+them across requests — HTTP/1.1 keep-alive, no external deps.
+
+Concurrency model: a connection is checked OUT of the idle list while
+in use (an `http.client.HTTPConnection` is not concurrency-safe), so N
+concurrent forwards to one backend briefly hold N connections; returns
+above the per-target cap are closed instead of pooled, bounding idle
+sockets at `max_idle_per_target`.
+
+Staleness: a kept-alive connection can be closed server-side between
+uses (idle timeout, backend restart). Checkout probes every reused
+socket with a zero-timeout readability check — a pending FIN/RST (or
+unsolicited bytes) means the connection is doomed, so it is discarded
+BEFORE anything is sent, which removes the common stale case without
+any resend question arising. For the residual race (the server closes
+between probe and use) the retry discipline is phase-split, because an
+error class alone cannot prove non-delivery: a closure error raised
+while SENDING the request means the backend saw at most a truncated
+request it cannot execute (Content-Length unmet), so one
+fresh-connection retry is safe for any method; a closure error from
+getresponse() — AFTER a complete send — is ambiguous (the classic
+stale signature and "backend executed, then died before replying"
+look identical on the wire), so the retry is restricted to IDEMPOTENT
+methods. A non-idempotent POST (the REST data plane forwards sessioned
+decode_* calls whose re-execution would advance state twice)
+propagates the error instead. Failures that prove nothing are never
+retried — a read timeout (the backend may be mid-execution) or any
+error after response headers arrived propagates. A failure on a fresh
+connection propagates too: that is a real backend error the caller's
+(unchanged) error paths must see.
+"""
+
+from __future__ import annotations
+
+import http.client
+import select
+import threading
+
+# Connection-closure signatures of a stale keep-alive socket: eligible
+# for ONE fresh-connection retry (always when raised mid-send, only for
+# idempotent methods when raised by getresponse — see module
+# docstring). socket.timeout (TimeoutError) is deliberately NOT here.
+_STALE_CLOSE_ERRORS = (ConnectionResetError, BrokenPipeError,
+                       ConnectionAbortedError,
+                       http.client.BadStatusLine)  # incl. RemoteDisconnected
+
+# RFC 9110 idempotent methods: re-sending after an AMBIGUOUS closure
+# (complete send, no response) is allowed only for these.
+_IDEMPOTENT_METHODS = frozenset(
+    {"GET", "HEAD", "PUT", "DELETE", "OPTIONS", "TRACE"})
+
+
+class KeepAliveHTTPPool:
+    """Bounded per-target idle pool of persistent HTTP connections."""
+
+    def __init__(self, timeout_s: float = 60.0,
+                 max_idle_per_target: int = 8):
+        self._timeout_s = timeout_s
+        self._max_idle = max_idle_per_target
+        self._lock = threading.Lock()
+        self._idle: dict[tuple[str, int], list] = {}  # guarded_by: self._lock
+
+    # -- connection checkout/return ------------------------------------------
+
+    def _checkout(self, host: str, port: int):
+        """(connection, reused) — an idle keep-alive connection when one
+        exists, else a fresh one (connected lazily by http.client).
+        Idle connections whose socket already has a FIN/RST (or junk)
+        pending are culled here, pre-send — the only point where
+        staleness is provable without a delivery question."""
+        while True:
+            with self._lock:
+                idle = self._idle.get((host, port))
+                conn = idle.pop() if idle else None
+            if conn is None:
+                return http.client.HTTPConnection(
+                    host, port, timeout=self._timeout_s), False
+            if self._sock_doomed(conn):
+                conn.close()
+                continue
+            return conn, True
+
+    @staticmethod
+    def _sock_doomed(conn) -> bool:
+        """True when a pooled connection's socket is readable with the
+        previous response fully drained: whatever is pending is EOF,
+        RST, or protocol junk — sending on it would only manufacture
+        an ambiguous mid-flight failure."""
+        sock = conn.sock
+        if sock is None:
+            return True
+        try:
+            readable, _, _ = select.select([sock], [], [], 0)
+        except (OSError, ValueError):
+            return True  # closed/invalid fd: locally dead
+        return bool(readable)
+
+    def _checkin(self, host: str, port: int, conn) -> None:
+        with self._lock:
+            idle = self._idle.setdefault((host, port), [])
+            if len(idle) < self._max_idle:
+                idle.append(conn)
+                return
+        conn.close()  # over the idle cap: don't hoard sockets
+
+    def close(self) -> None:
+        with self._lock:
+            idle, self._idle = list(self._idle.values()), {}
+        for conns in idle:
+            for conn in conns:
+                conn.close()
+
+    def idle_count(self, host: str, port: int) -> int:
+        with self._lock:
+            return len(self._idle.get((host, port), ()))
+
+    # -- the one entry point -------------------------------------------------
+
+    def request(self, host: str, port: int, method: str, path: str,
+                body: bytes | None = None,
+                headers: dict | None = None,
+                timeout_s: float | None = None
+                ) -> tuple[int, dict, bytes]:
+        """One round-trip over a pooled connection: (status, response
+        headers — keys Title-Cased so lookups stay case-insensitive in
+        practice like http.client's getheader was, body). Raises
+        OSError/http.client.HTTPException like a direct connection
+        would — after transparently retrying once when a REUSED
+        keep-alive socket turns out dead (see module docstring for the
+        exact non-delivery conditions). `timeout_s` overrides the pool
+        default for THIS round-trip only (a monitoring fetch wants a
+        tight bound; the forward path wants the default) — every
+        request re-applies its own timeout, so a pooled connection
+        never carries a previous caller's override."""
+        conn, reused = self._checkout(host, port)
+        sent = False
+        try:
+            try:
+                self._apply_timeout(conn, timeout_s)
+            except OSError:
+                # settimeout on a locally-dead socket object: nothing
+                # sent at all — unconditionally stale.
+                raise _STALE_CLOSE_ERRORS[0]("pooled socket unusable")
+            conn.request(method, path, body=body, headers=headers or {})
+            # The request is fully on the wire: from here a closure
+            # error no longer proves non-delivery.
+            sent = True
+            resp = conn.getresponse()
+        except _STALE_CLOSE_ERRORS:
+            conn.close()
+            if not reused:
+                raise  # a FRESH connection failing is a real error
+            if sent and method.upper() not in _IDEMPOTENT_METHODS:
+                # Complete send, closure before any response: the
+                # backend may have EXECUTED this — re-sending a
+                # non-idempotent request would double-apply it.
+                raise
+            conn = http.client.HTTPConnection(
+                host, port,
+                timeout=timeout_s if timeout_s is not None
+                else self._timeout_s)
+            try:
+                conn.request(method, path, body=body,
+                             headers=headers or {})
+                resp = conn.getresponse()
+            except (OSError, http.client.HTTPException):
+                conn.close()
+                raise
+        except (OSError, http.client.HTTPException):
+            # Anything else (timeouts included): the backend may be
+            # mid-execution — NEVER resend.
+            conn.close()
+            raise
+        # Response headers arrived: the backend processed the request.
+        # From here on, no failure may trigger a resend.
+        try:
+            data = resp.read()  # fully drained: REQUIRED for reuse
+        except (OSError, http.client.HTTPException):
+            conn.close()
+            raise
+        # Title-Case keys: http.client's getheader() was
+        # case-insensitive; a dict is not — normalize so a backend
+        # emitting 'content-type' still matches "Content-Type".
+        head = {k.title(): v for k, v in resp.getheaders()}
+        if resp.will_close:
+            # Server said Connection: close (HTTP/1.0 peer, or an
+            # explicit close) — honor it; pooling a doomed socket would
+            # guarantee a stale-retry on the next request.
+            conn.close()
+        else:
+            self._checkin(host, port, conn)
+        return resp.status, head, data
+
+    def _apply_timeout(self, conn, timeout_s: float | None) -> None:
+        timeout = timeout_s if timeout_s is not None else self._timeout_s
+        conn.timeout = timeout  # used at (re)connect
+        if conn.sock is not None:
+            conn.sock.settimeout(timeout)  # already-connected reuse

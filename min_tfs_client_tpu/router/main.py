@@ -4,10 +4,15 @@
         --backends=10.0.0.1:8500:8501,10.0.0.2:8500:8501
 
 The router is a pure front door: no jax, no model state — it boots in
-milliseconds and can run N replicas side by side (the ring is a pure
-function of (key, membership), so identical routers make identical
-choices; only the stickiness table is per-router, and sessions stay
-correct because a session id is pinned before its first forward).
+milliseconds and N replicas serve ONE fleet with correct stickiness:
+session placement is a pure function of (model, session id, membership
+view), every replica computes it identically, and pins are fenced by
+the membership-view epoch so churn forces revalidation instead of a
+silent re-route (docs/ROUTING.md "Replicated stickiness").
+
+The gRPC data plane runs on one asyncio event loop by default
+(`--data_plane=aio`, router/aio_proxy.py); `--data_plane=threads` keeps
+the previous thread-pool plane for one release.
 """
 
 from __future__ import annotations
@@ -32,6 +37,19 @@ class RouterOptions:
     eject_after_failures: int = 1
     session_idle_timeout_s: float = 3600.0
     forward_timeout_s: float = 60.0
+    # Data plane: "aio" (default — one asyncio event loop, grpc.aio
+    # byte proxy, the GIL-free-ish path) or "threads" (the pre-PR-13
+    # thread-pool plane, kept one release as the escape hatch;
+    # docs/MIGRATING.md).
+    data_plane: str = "aio"
+    # Flight-recorder event + gauge threshold for sampled event-loop
+    # lag on the aio plane (ms).
+    loop_lag_warn_ms: float = 100.0
+    # Bounded-load expansion factor for STATELESS routing: a backend
+    # may hold at most c * fleet-average in-flight forwards before a
+    # key spills to its next ring preference (sessioned placement never
+    # uses load — determinism across replicas is the contract).
+    bounded_load_c: float = 1.25
     grpc_max_threads: int = 16
     # Router flight recorder (observability/flight_recorder.py): dump
     # directory for the one-shot ring dump (first INTERNAL through the
@@ -47,15 +65,11 @@ class RouterServer:
         self.options = options
         self.core: Optional[RouterCore] = None
         self._grpc_server = None
+        self._aio_plane = None
         self._rest_server = None
         self._poller = poller
 
     def build_and_start(self) -> "RouterServer":
-        import grpc
-        from concurrent import futures
-
-        from min_tfs_client_tpu.router.proxy import GrpcProxy
-
         opts = self.options
         # The router process gets the same black-box/observability
         # surface a backend has: its own flight recorder (dumped on the
@@ -76,30 +90,54 @@ class RouterServer:
             probe_timeout_s=opts.probe_timeout_s,
             eject_after_failures=opts.eject_after_failures,
             session_idle_timeout_s=opts.session_idle_timeout_s,
+            bounded_load_c=opts.bounded_load_c,
             poller=self._poller,
         )
         self.core.start()
-        proxy = GrpcProxy(self.core,
-                          default_timeout_s=opts.forward_timeout_s)
-        self._grpc_server = grpc.server(
-            futures.ThreadPoolExecutor(
-                max_workers=opts.grpc_max_threads,
-                thread_name_prefix="router-grpc"),
-            options=[("grpc.max_send_message_length", -1),
-                     ("grpc.max_receive_message_length", -1)])
-        self._grpc_server.add_generic_rpc_handlers(
-            tuple(proxy.generic_handlers()))
-        self.grpc_port = self._grpc_server.add_insecure_port(
-            f"0.0.0.0:{opts.grpc_port}")
-        self._grpc_server.start()
+        if opts.data_plane == "aio":
+            from min_tfs_client_tpu.router.aio_proxy import AioDataPlane
+
+            self._aio_plane = AioDataPlane(
+                self.core,
+                default_timeout_s=opts.forward_timeout_s,
+                loop_lag_warn_ms=opts.loop_lag_warn_ms)
+            self.grpc_port = self._aio_plane.start(opts.grpc_port)
+        elif opts.data_plane == "threads":
+            import grpc
+            from concurrent import futures
+
+            from min_tfs_client_tpu.router.proxy import GrpcProxy
+
+            proxy = GrpcProxy(self.core,
+                              default_timeout_s=opts.forward_timeout_s)
+            self._grpc_server = grpc.server(
+                futures.ThreadPoolExecutor(
+                    max_workers=opts.grpc_max_threads,
+                    thread_name_prefix="router-grpc"),
+                options=[("grpc.max_send_message_length", -1),
+                         ("grpc.max_receive_message_length", -1)])
+            self._grpc_server.add_generic_rpc_handlers(
+                tuple(proxy.generic_handlers()))
+            self.grpc_port = self._grpc_server.add_insecure_port(
+                f"0.0.0.0:{opts.grpc_port}")
+            self._grpc_server.start()
+        else:
+            raise ValueError(
+                f"unknown --data_plane {opts.data_plane!r} "
+                "(want 'aio' or 'threads')")
         self._rest_server, self.rest_port = _start_rest(
             self.core, opts.rest_api_port)
         return self
 
     def wait_for_termination(self) -> None:
-        self._grpc_server.wait_for_termination()
+        if self._aio_plane is not None:
+            self._aio_plane.wait_for_termination()
+        else:
+            self._grpc_server.wait_for_termination()
 
     def stop(self, grace: float = 2.0) -> None:
+        if self._aio_plane is not None:
+            self._aio_plane.stop(grace)
         if self._grpc_server is not None:
             # Bounded teardown (servelint DL003): past grace + slack the
             # daemonized handler threads die with the process.
@@ -108,6 +146,13 @@ class RouterServer:
             self._rest_server.shutdown()
         if self.core is not None:
             self.core.stop()
+        # Drop the idle keep-alive sockets held against this router's
+        # backends. The pool is process-global (like the tracing ring);
+        # close() only empties the idle lists, so an in-process sibling
+        # router simply reopens fresh connections on its next forward.
+        from min_tfs_client_tpu.router import proxy as proxy_mod
+
+        proxy_mod._http_pool.close()
 
 
 def _start_rest(core: RouterCore, port: int):
@@ -171,6 +216,20 @@ def build_parser() -> argparse.ArgumentParser:
                         "(the backend expires its HBM side on its own)")
     p.add_argument("--forward_timeout_s", type=float, default=60.0,
                    help="forward deadline when the client sent none")
+    p.add_argument("--data_plane", choices=("aio", "threads"),
+                   default="aio",
+                   help="gRPC data-plane engine: 'aio' (asyncio byte "
+                        "proxy, default) or 'threads' (the pre-PR-13 "
+                        "thread pool — deprecated escape hatch, one "
+                        "release; docs/MIGRATING.md)")
+    p.add_argument("--loop_lag_warn_ms", type=float, default=100.0,
+                   help="aio plane: event-loop lag (ms) past which the "
+                        "sampled ticker drops a flight-recorder event")
+    p.add_argument("--bounded_load_c", type=float, default=1.25,
+                   help="bounded-load expansion factor for stateless "
+                        "routing (a backend holds at most c * fleet-"
+                        "average in-flight forwards before keys spill "
+                        "to their next ring preference)")
     p.add_argument("--grpc_max_threads", type=int, default=16)
     p.add_argument("--flight_recorder_dir", default="",
                    help="directory for the router's flight-recorder "
@@ -194,6 +253,9 @@ def options_from_args(args) -> RouterOptions:
         eject_after_failures=args.eject_after_failures,
         session_idle_timeout_s=args.session_idle_timeout_s,
         forward_timeout_s=args.forward_timeout_s,
+        data_plane=args.data_plane,
+        loop_lag_warn_ms=args.loop_lag_warn_ms,
+        bounded_load_c=args.bounded_load_c,
         grpc_max_threads=args.grpc_max_threads,
         flight_recorder_dir=args.flight_recorder_dir,
         trace_ring_size=args.trace_ring_size,
@@ -206,7 +268,8 @@ def main(argv=None) -> int:
     backends = ",".join(
         b.backend_id for b in router.core.membership.backends())
     print(f"[tpu-serving-router] routing: gRPC on {router.grpc_port}, "
-          f"REST on {router.rest_port}; backends: {backends}", flush=True)
+          f"REST on {router.rest_port}; data_plane={args.data_plane}; "
+          f"backends: {backends}", flush=True)
     try:
         router.wait_for_termination()
     except KeyboardInterrupt:

@@ -23,6 +23,16 @@ The data plane can `note_error()` a backend after a forwarding failure;
 that wakes the poll loop immediately so a crashed backend is ejected
 within one poll interval of the first failed request, not one interval
 plus the residual sleep.
+
+Replication: the table also publishes a **membership view** — the
+sorted (live backend id, weight) pairs plus an `epoch` that fingerprints
+them (utils/farmhash, the frozen hash). Two router replicas polling the
+same fleet converge on the SAME epoch for the same view, which is what
+lets sessioned pins be minted deterministically anywhere (router/core.py
+fences pins by this epoch; docs/ROUTING.md "Replicated stickiness").
+Weights come from the backend's readyz payload (`"weight"`, the server's
+`--serving_weight` flag) — a heterogeneous fleet advertises capacity
+through the same health plane that advertises liveness.
 """
 
 from __future__ import annotations
@@ -37,6 +47,7 @@ from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 from typing import Callable, Optional, Sequence
 
+from min_tfs_client_tpu.utils.farmhash import fingerprint64
 from min_tfs_client_tpu.utils.status import ServingError
 
 log = logging.getLogger(__name__)
@@ -155,6 +166,53 @@ class _Entry:
     last_verdict: str = ""               # guarded_by: MembershipTable._lock
     last_readyz: Optional[dict] = field(
         default=None)                    # guarded_by: MembershipTable._lock
+    weight: float = 1.0                  # guarded_by: MembershipTable._lock
+
+
+@dataclass(frozen=True)
+class MembershipView:
+    """One immutable snapshot of who may take NEW work.
+
+    `epoch` fingerprints the sorted (live id, weight) pairs: any two
+    router replicas whose polls agree on the view agree on the epoch,
+    with NO coordination — the epoch is content, not a counter. A pin
+    minted under epoch E is honored fast-path while the router still
+    holds E; any view change (eject, drain, join, reinstate, weight
+    flip) changes the epoch and forces the pin through revalidation
+    (router/core.py), so churn can never silently re-route a live
+    session."""
+
+    epoch: int
+    live: tuple        # sorted live backend ids
+    weights: dict      # live backend id -> weight (> 0)
+
+
+def _view_epoch(pairs) -> int:
+    """fingerprint64 over the canonical '<id>=<weight>' join. Weights
+    render via repr(float) — exact, locale-free, replica-stable."""
+    canon = "|".join(f"{bid}={float(w)!r}" for bid, w in pairs)
+    return fingerprint64(canon.encode("utf-8"))
+
+
+_EMPTY_VIEW = MembershipView(_view_epoch(()), (), {})
+
+
+def _payload_weight(payload: Optional[dict]) -> Optional[float]:
+    """The readyz payload's advertised weight, sanitized: finite and
+    > 0, else None (absent/garbage keeps the previous weight — same
+    retention the per-model availability cache uses)."""
+    if not isinstance(payload, dict):
+        return None
+    raw = payload.get("weight")
+    if raw is None:
+        return None
+    try:
+        weight = float(raw)
+    except (TypeError, ValueError):
+        return None
+    if weight <= 0.0 or weight != weight or weight == float("inf"):
+        return None
+    return weight
 
 
 class MembershipTable:
@@ -200,6 +258,10 @@ class MembershipTable:
         # reference (never mutated in place).
         self._gauged_live: Optional[tuple] = None
         self._occupancy: dict[str, float] = {}
+        # The replicable membership view (epoch + live ids + weights).
+        # Recomputed under the lock whenever a poll lands; readers take
+        # the immutable snapshot by atomic reference (no lock).
+        self._view: MembershipView = _EMPTY_VIEW  # guarded_by: self._lock
         # Probes run CONCURRENTLY: a wedged backend costs one sweep
         # max(probe_timeout), not sum — sequential probing would let one
         # sick process stretch everyone else's ejection latency to
@@ -282,6 +344,7 @@ class MembershipTable:
                     continue
                 self._apply_locked(entry, verdict, payload, newly_dead)
             states = {bid: e.state for bid, e in self._entries.items()}
+            self._refresh_view_locked()
         for backend_id in newly_dead:
             if self._on_dead is not None:
                 self._on_dead(backend_id)
@@ -308,6 +371,9 @@ class MembershipTable:
                 # router's per-model health answers to NOT_FOUND for a
                 # model that is serving fine.
                 entry.last_readyz = payload
+                weight = _payload_weight(payload)
+                if weight is not None:
+                    entry.weight = weight
             if previous in (DRAINING, DEAD):
                 log.info("backend %s reinstated (was %s)",
                          entry.backend.backend_id, previous)
@@ -369,7 +435,26 @@ class MembershipTable:
         self._occupancy = shares
         self._gauged_live = tuple(live)
 
+    def _refresh_view_locked(self) -> None:
+        """Rebuild the immutable membership view. Caller holds _lock.
+        The epoch moves if and only if the (live ids, weights) content
+        moved — a poll that confirms the status quo re-derives the same
+        fingerprint, so pins minted replicas apart stay comparable."""
+        pairs = sorted((bid, e.weight) for bid, e in self._entries.items()
+                       if e.state == LIVE)
+        epoch = _view_epoch(pairs)
+        if epoch != self._view.epoch:
+            # servelint: thread-ok immutable snapshot, atomic ref swap
+            self._view = MembershipView(
+                epoch, tuple(bid for bid, _ in pairs), dict(pairs))
+
     # -- queries -------------------------------------------------------------
+
+    def view(self) -> MembershipView:
+        """The current membership view (epoch + live ids + weights), by
+        atomic reference — the routing hot path reads this lock-free."""
+        # servelint: lock-ok immutable MembershipView, reference read
+        return self._view
 
     def poll_thread_alive(self) -> bool:
         return self._thread is not None and self._thread.is_alive()
@@ -395,6 +480,15 @@ class MembershipTable:
         with self._lock:
             entry = self._entries.get(backend_id)
             return entry.state if entry is not None else UNKNOWN
+
+    def states(self) -> dict[str, str]:
+        """Every backend's state in ONE lock acquisition — callers that
+        partition the fleet by state (pin recovery's live+draining
+        candidate build) need a single atomic snapshot; two separate
+        reads could drop or duplicate a backend that a poll flips
+        between them."""
+        with self._lock:
+            return {bid: e.state for bid, e in self._entries.items()}
 
     def backend(self, backend_id: str) -> Optional[Backend]:
         with self._lock:
@@ -434,13 +528,21 @@ class MembershipTable:
                     "last_poll_age_s": (round(now - e.last_poll_s, 3)
                                         if e.polls else None),
                     "last_verdict": e.last_verdict,
+                    "weight": e.weight,
                     "models": sorted((e.last_readyz or {}).get(
                         "models", {})),
                 }
                 for bid, e in sorted(self._entries.items())
             }
+            view = self._view
         return {
             "backends": backends,
             "poll_interval_s": self.poll_interval_s,
             "eject_after_failures": self.eject_after_failures,
+            # The replication evidence: two routers on one fleet must
+            # report the SAME epoch for the same view (the scale-out
+            # suite asserts exactly this across churn).
+            "view": {"epoch": f"{view.epoch:016x}",
+                     "live": list(view.live),
+                     "weights": dict(view.weights)},
         }

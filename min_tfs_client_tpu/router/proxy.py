@@ -22,13 +22,13 @@ from __future__ import annotations
 import http.client
 import json
 import logging
-import urllib.request
 from typing import Optional
 
 from min_tfs_client_tpu.observability import tracing
 from min_tfs_client_tpu.protos import tfs_apis_pb2 as apis
 from min_tfs_client_tpu.protos.grpc_service import SERVICE_SCHEMAS
 from min_tfs_client_tpu.router.core import RouterCore
+from min_tfs_client_tpu.router.http_pool import KeepAliveHTTPPool
 from min_tfs_client_tpu.router.membership import DEAD, Backend
 from min_tfs_client_tpu.utils.status import (
     ServingError,
@@ -187,6 +187,27 @@ def _scan_routing_info(data, *, multi_inference: bool,
     return model, session_id, signature
 
 
+def _recovery_verdict(first_not_found,
+                      unreachable: int) -> tuple:
+    """Terminal (code, details) for a pin-recovery walk that exhausted
+    its candidates — ONE implementation shared by both data planes so
+    their answers cannot drift for the release the planes coexist.
+    NOT_FOUND is only provable when EVERY candidate answered and
+    disclaimed the session; a single dark candidate may hold the live
+    session, so the verdict degrades to retryable UNAVAILABLE."""
+    import grpc
+
+    if first_not_found is None:
+        return (grpc.StatusCode.UNAVAILABLE,
+                "no reachable backend to recover the session")
+    if unreachable:
+        return (grpc.StatusCode.UNAVAILABLE,
+                f"session disclaimed by every reachable backend but "
+                f"{unreachable} candidate(s) unreachable — retry")
+    return (grpc.StatusCode.NOT_FOUND,
+            first_not_found.details() or "unknown session")
+
+
 class GrpcProxy:
     """Generic raw-bytes handlers for the three serving services plus
     the router's own grpc.health.v1."""
@@ -200,17 +221,25 @@ class GrpcProxy:
 
     def _forward(self, backend: Backend, full_method: str,
                  request_bytes: bytes, context,
-                 on_rpc_error=None) -> bytes:
+                 on_rpc_error=None,
+                 probing: bool = False) -> bytes:
         """`on_rpc_error(code, details)` runs before the abort with the
         BACKEND'S status — the caller's chance to undo routing side
         effects selectively and to record the failure (the abort
         exception itself carries no code). The forwarded metadata gains
         the router's fleet-scope trace id (x-tpu-serving-trace) —
-        metadata ONLY; the request bytes stay untouched."""
+        metadata ONLY; the request bytes stay untouched. `probing`
+        (pin recovery) re-raises a NOT_FOUND ("wrong backend") and a
+        connection-level UNAVAILABLE (candidate unreachable — says
+        nothing about the session) instead of aborting, so the probe
+        walk can continue; DEADLINE_EXCEEDED still aborts even while
+        probing — the request may have EXECUTED on that backend, and
+        walking on could double-apply a decode step elsewhere's
+        NOT_FOUND would mask."""
         import grpc
 
-        channel = self._core.channels.get(backend)
-        call = channel.unary_unary(full_method)  # None serializers: bytes
+        # Cached multicallable (None serializers: raw bytes in/out)
+        call = self._core.channels.unary_unary(backend, full_method)
         timeout = context.time_remaining()
         if timeout is None:
             timeout = self._default_timeout_s
@@ -223,25 +252,86 @@ class GrpcProxy:
             metadata = [(k, v) for k, v in metadata
                         if k.lower() != tracing.TRACE_HEADER]
             metadata.append((tracing.TRACE_HEADER, trace.trace_id))
+        self._core.note_forward_start(backend.backend_id)
         try:
-            with tracing.span("router/forward", backend=backend.backend_id):
-                with tracing.span("router/backend_wait",
+            try:
+                with tracing.span("router/forward",
                                   backend=backend.backend_id):
-                    response = call(request_bytes, timeout=timeout,
-                                    metadata=metadata)
-        except grpc.RpcError as err:
-            code = err.code()
-            unreachable = code in (grpc.StatusCode.UNAVAILABLE,
-                                   grpc.StatusCode.DEADLINE_EXCEEDED)
-            self._core.note_result(backend, full_method,
-                                   error_code=code.name,
-                                   unreachable=unreachable)
-            tracing.set_status(code.name)
-            if on_rpc_error is not None:
-                on_rpc_error(code, err.details() or code.name)
-            context.abort(code, err.details() or code.name)
+                    with tracing.span("router/backend_wait",
+                                      backend=backend.backend_id):
+                        response = call(request_bytes, timeout=timeout,
+                                        metadata=metadata)
+            except grpc.RpcError as err:
+                code = err.code()
+                if probing and code in (grpc.StatusCode.NOT_FOUND,
+                                        grpc.StatusCode.UNAVAILABLE):
+                    raise
+                unreachable = code in (grpc.StatusCode.UNAVAILABLE,
+                                       grpc.StatusCode.DEADLINE_EXCEEDED)
+                self._core.note_result(backend, full_method,
+                                       error_code=code.name,
+                                       unreachable=unreachable)
+                tracing.set_status(code.name)
+                if on_rpc_error is not None:
+                    on_rpc_error(code, err.details() or code.name)
+                context.abort(code, err.details() or code.name)
+        finally:
+            self._core.note_forward_done(backend.backend_id)
         self._core.note_result(backend, full_method)
         return response
+
+    def _forward_recovering(self, decision, full_method: str,
+                            request_bytes: bytes, context,
+                            model: str, session_id: bytes,
+                            trace, on_rpc_error) -> bytes:
+        """PIN RECOVERY, threaded-plane twin of the aio implementation
+        (docs/ROUTING.md "Replicated stickiness"): probe the preference
+        order, NOT_FOUND means "wrong backend", pin whoever answers."""
+        import grpc
+
+        first_not_found = None
+        unreachable = 0
+        for probes, backend in enumerate(decision.probe_candidates):
+            def candidate_error(code, details, _bid=backend.backend_id):
+                on_rpc_error(code, details, _bid)
+
+            try:
+                response = self._forward(
+                    backend, full_method, request_bytes, context,
+                    on_rpc_error=candidate_error, probing=True)
+            except grpc.RpcError as err:
+                if err.code() == grpc.StatusCode.NOT_FOUND:
+                    # Expected "wrong backend" answer from a healthy
+                    # backend: count the request but NOT a backend
+                    # error — router_session_recoveries is the
+                    # recovery signal, and error-keyed dashboards must
+                    # not fire during routine post-join recovery.
+                    self._core.note_result(backend, full_method)
+                    if first_not_found is None:
+                        first_not_found = err
+                else:
+                    # Connection-level UNAVAILABLE: this candidate is
+                    # unreachable (e.g. died after joining, before the
+                    # next poll ejects it) — that says nothing about
+                    # the SESSION, which may live on the next
+                    # candidate. Pulse ejection and keep walking; a
+                    # replica holding the pin would have served this
+                    # request, so aborting here would make replicas
+                    # answer divergently.
+                    self._core.note_result(backend, full_method,
+                                           error_code=err.code().name,
+                                           unreachable=True)
+                    unreachable += 1
+                continue
+            self._core.session_recovered(
+                model, session_id, backend.backend_id, probes)
+            if trace is not None and probes:
+                trace.annotate(backend=backend.backend_id,
+                               recovered_probes=probes)
+            return response
+        code, details = _recovery_verdict(first_not_found, unreachable)
+        tracing.set_status(code.name)
+        context.abort(code, details)
 
     def _handle(self, service: str, method: str,
                 request_bytes: bytes, context) -> bytes:
@@ -286,7 +376,7 @@ class GrpcProxy:
                     service, method, request_bytes)
             with tracing.span("router/route"):
                 decision = self._core.route(model, session_id,
-                                            request_bytes)
+                                            request_bytes, signature)
         except ServingError as exc:
             tracing.set_status(exc.code)
             context.abort(to_grpc_code(exc.code), exc.message)
@@ -305,14 +395,16 @@ class GrpcProxy:
                            fresh_pin=decision.fresh_pin)
         import grpc
 
-        def on_rpc_error(code, details):
+        def on_rpc_error(code, details, backend_id=None):
             # Request digest into the router's flight recorder (latched
             # dump on INTERNAL — the "should never happen" code): the
             # trace id joins this entry to the backend recorder's view
-            # of the same request.
+            # of the same request. `backend_id` names the backend that
+            # ACTUALLY failed — recovery probes pass it explicitly.
             flight_recorder.record_error(
                 f"route/{method}", model, signature, code.value[0],
-                f"{decision.backend.backend_id}: {details}",
+                f"{backend_id or decision.backend.backend_id}: "
+                f"{details}",
                 trace_id=trace.trace_id if trace else "")
             # Roll a brand-new pin back ONLY when the failure proves
             # non-delivery (connection-level UNAVAILABLE): a
@@ -322,9 +414,14 @@ class GrpcProxy:
             if decision.fresh_pin and code == grpc.StatusCode.UNAVAILABLE:
                 self._core.sessions.release(model, session_id)
 
-        response = self._forward(decision.backend, full_method,
-                                 request_bytes, context,
-                                 on_rpc_error=on_rpc_error)
+        if decision.probe_candidates:
+            response = self._forward_recovering(
+                decision, full_method, request_bytes, context,
+                model, session_id, trace, on_rpc_error)
+        else:
+            response = self._forward(decision.backend, full_method,
+                                     request_bytes, context,
+                                     on_rpc_error=on_rpc_error)
         if session_id is not None and \
                 signature == _SESSION_CLOSE_SIGNATURE:
             self._core.session_closed(model, session_id)
@@ -464,6 +561,13 @@ ROUTER_PAYLOAD_PATH = "/monitoring/router"
 _REST_FORWARD_HEADERS = ("Content-Type", "Content-Encoding",
                          "Accept-Encoding")
 
+# Keep-alive connections to backend REST ports, shared by the /v1
+# forward path and the stitched-trace backend fetches: without it every
+# proxied REST request paid a TCP handshake against a backend the
+# router talks to for its whole lifetime. Process-global like the
+# tracing ring — the REST surface is module-level functions.
+_http_pool = KeepAliveHTTPPool(timeout_s=60.0)
+
 
 def rest_route_request(core: RouterCore, method: str, path: str,
                        body_bytes: bytes,
@@ -550,24 +654,24 @@ def _rest_forward(core: RouterCore, method: str, path: str,
         # verbatim). NOTE: the backend adopts it only on its Python REST
         # backend — the native epoll front-end surfaces no headers.
         fwd_headers[tracing.TRACE_HEADER] = trace.trace_id
-    conn = http.client.HTTPConnection(backend.host, backend.rest_port,
-                                      timeout=60)
+    core.note_forward_start(backend.backend_id)
     try:
         with tracing.span("router/forward", backend=backend.backend_id):
-            conn.request(method, path, body=body_bytes or None,
-                         headers=fwd_headers)
             with tracing.span("router/backend_wait",
                               backend=backend.backend_id):
-                resp = conn.getresponse()
-                data = resp.read()
+                # Keep-alive pooled connection: reused across requests,
+                # one transparent fresh-socket retry on a stale reuse.
+                status, head, data = _http_pool.request(
+                    backend.host, backend.rest_port, method, path,
+                    body=body_bytes or None, headers=fwd_headers)
         # Backend error REPLIES count like the gRPC path counts
         # non-OK statuses — a REST-only outage must move
         # router_backend_errors, not just the unreachable case.
         core.note_result(backend, "rest",
-                         error_code=(str(resp.status)
-                                     if resp.status >= 400 else None))
-        return (resp.status,
-                resp.getheader("Content-Type", "application/json"), data)
+                         error_code=(str(status)
+                                     if status >= 400 else None))
+        return (status,
+                head.get("Content-Type", "application/json"), data)
     except (OSError, http.client.HTTPException) as exc:
         core.note_result(backend, "rest", error_code="UNREACHABLE",
                          unreachable=True)
@@ -575,7 +679,7 @@ def _rest_forward(core: RouterCore, method: str, path: str,
             {"error": f"backend {backend.backend_id} unreachable over "
                       f"REST: {exc}"}).encode()
     finally:
-        conn.close()
+        core.note_forward_done(backend.backend_id)
 
 
 # -- fleet-stitched traces ---------------------------------------------------
@@ -661,11 +765,17 @@ def stitch_chrome_trace(core: RouterCore, trace_id: str,
     fetch_errors: dict[str, str] = {}
     pid = 2
     for backend in candidates:
-        url = (f"http://{backend.host}:{backend.rest_port}"
-               f"/monitoring/traces?trace_id={trace_id}")
         try:
-            with urllib.request.urlopen(url, timeout=timeout_s) as resp:
-                payload = json.loads(resp.read())
+            # Same keep-alive pool the /v1 forwards use: a stitched
+            # fetch right after the routed request it's diagnosing
+            # rides the still-warm connection.
+            status, _, raw = _http_pool.request(
+                backend.host, backend.rest_port, "GET",
+                f"/monitoring/traces?trace_id={trace_id}",
+                timeout_s=timeout_s)
+            if status != 200:
+                raise ValueError(f"HTTP {status} from backend traces")
+            payload = json.loads(raw)
         except Exception as exc:  # noqa: BLE001 - stitch what answers
             fetch_errors[backend.backend_id] = str(exc)
             continue
@@ -726,17 +836,29 @@ def _rest_backend(core: RouterCore, model: str,
                   routing_id: bytes) -> Backend:
     """REST routes statelessly (the sessioned surface is gRPC Predict;
     docs/ROUTING.md) and only over live backends that HAVE a REST
-    port."""
+    port — with the SAME weighted + bounded-load discipline the gRPC
+    stateless path uses, so a `--serving_weight=4` backend gets its
+    advertised share on both transports and both feed the same
+    in-flight load signal."""
     from min_tfs_client_tpu.router import ring as ring_mod
 
-    candidates = []
-    for backend_id in core.membership.live_ids():
+    view = core.membership.view()
+    # The per-epoch ranked cache, not a per-request scoring pass (that
+    # pass was the single largest router CPU item before the cache).
+    # Rendezvous scores are per-backend, so filtering the full-view
+    # ranking to REST-capable backends equals ranking that subset.
+    order = core.ranked_order(ring_mod.ring_key(model, routing_id), view)
+    rest_order = []
+    weights = {}
+    for backend_id in order:
         backend = core.membership.backend(backend_id)
         if backend is not None and backend.rest_port:
-            candidates.append(backend_id)
-    if not candidates:
+            rest_order.append(backend_id)
+            weights[backend_id] = view.weights.get(backend_id, 1.0)
+    if not rest_order:
         raise ServingError.unavailable(
             "no live backends with a REST port")
-    chosen = ring_mod.assign(ring_mod.ring_key(model, routing_id),
-                             candidates)
+    chosen = ring_mod.bounded_choice(
+        rest_order, core.inflight_by_backend(), core.bounded_load_c,
+        weights)
     return core.membership.backend(chosen)

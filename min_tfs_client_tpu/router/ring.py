@@ -1,5 +1,6 @@
 """Consistent hashing for the routing tier: rendezvous (highest-random-
-weight) hashing over FarmHash Fingerprint64.
+weight) hashing over FarmHash Fingerprint64, with weighted and
+bounded-load variants.
 
 Why rendezvous rather than a vnode token ring: the rebalance bound is a
 theorem, not a tuning outcome. For every key the ring scores each backend
@@ -20,11 +21,28 @@ sessioned traffic (stickiness then comes from the session table, which
 overrides the ring for pinned sessions) or the request fingerprint for
 stateless traffic (identical requests land on the same backend's warm
 caches).
+
+Heterogeneous fleets use the WEIGHTED variant: each backend's raw
+64-bit score is mapped to a uniform (0, 1] draw `h` and re-scored as
+`-weight / ln(h)` (Weighted Rendezvous Hashing) — a backend with weight
+2 owns ~2x the keyspace, and because `-w/ln(h)` is monotonic in `h` at
+uniform weights, weight-1 fleets keep EXACTLY the unweighted
+assignment (pinned by the unit suite — upgrading a fleet to weighted
+routing moves zero keys until someone actually sets a weight != 1).
+
+Stateless traffic may additionally opt into the BOUNDED-LOAD variant
+(`assign_bounded`, consistent-hashing-with-bounded-loads, c = 1.25):
+walk the key's weighted preference order and take the first backend
+whose current load stays under ceil(c * total/N) — overload spills a
+key to its next-preferred backend instead of hot-spotting it. Sessioned
+placement never uses loads: pins must be a pure function of (key,
+membership view) so N router replicas mint identical pins.
 """
 
 from __future__ import annotations
 
-from typing import Sequence
+import math
+from typing import Mapping, Optional, Sequence
 
 from min_tfs_client_tpu.utils.farmhash import fingerprint64
 
@@ -77,6 +95,106 @@ def assign(key: bytes, backends: Sequence[str]) -> str | None:
                                        or backend < best_id)):
             best_id, best_score = backend, score
     return best_id
+
+
+# -- weighted / bounded-load variants ----------------------------------------
+
+# 2^64, the fingerprint range: maps a raw score onto (0, 1].
+_HASH_SPAN = float(1 << 64)
+
+# The bounded-load expansion factor: a backend may run at most c times
+# the fleet-average load before keys spill to their next preference
+# (Mirrokni et al., "Consistent Hashing with Bounded Loads" — c in
+# [1.2, 1.3] trades spill rate against hot-spot size; 1.25 is the
+# conventional middle).
+BOUNDED_LOAD_C = 1.25
+
+
+def _weighted_score(key: bytes, backend: str, weight: float) -> float:
+    """Weighted rendezvous score. `h` lands in (0, 1] (the +1 keeps a
+    raw 0 off ln's pole), ln(h) <= 0, so the score is positive and
+    scales linearly with weight; weight <= 0 removes the backend from
+    contention without perturbing anyone else's draw."""
+    if weight <= 0.0:
+        return -1.0
+    h = (fingerprint64(key + b"|" + backend.encode("utf-8")) + 1) \
+        / _HASH_SPAN
+    return -weight / math.log(h) if h < 1.0 else float("inf")
+
+
+def ranked_weighted(key: bytes,
+                    weights: Mapping[str, float]) -> list[str]:
+    """Every positive-weight backend in preference order for `key`
+    (best first). Deterministic across replicas: ties (a 2^-64 event)
+    break by backend id. At uniform weights the order equals the
+    unweighted fingerprint order (-w/ln(h) is monotonic in h)."""
+    scored = [(_weighted_score(key, b, w), b)
+              for b, w in weights.items() if w > 0.0]
+    # max score first; tie -> lexicographically SMALLER id first, same
+    # total order assign() uses.
+    scored.sort(key=lambda pair: (-pair[0], pair[1]))
+    return [b for _, b in scored]
+
+
+def assign_weighted(key: bytes,
+                    weights: Mapping[str, float]) -> Optional[str]:
+    """argmax of the weighted scores — the deterministic owner of `key`
+    in a heterogeneous fleet. None when no backend has weight > 0."""
+    best_id: Optional[str] = None
+    best_score = -1.0
+    for backend, weight in weights.items():
+        score = _weighted_score(key, backend, weight)
+        if score < 0.0:
+            continue
+        if score > best_score or (score == best_score
+                                  and (best_id is None
+                                       or backend < best_id)):
+            best_id, best_score = backend, score
+    return best_id
+
+
+def assign_bounded(key: bytes, weights: Mapping[str, float],
+                   loads: Mapping[str, int],
+                   c: float = BOUNDED_LOAD_C) -> Optional[str]:
+    """First backend in `key`'s weighted preference order whose load is
+    under the bounded-load cap ceil(c * (total_load + 1) / N) — the +1
+    counts the request being placed, so a single-backend fleet always
+    admits. Every backend at cap degenerates to plain weighted
+    assignment (the key's first preference) rather than failing: the
+    bound shapes load, it must never reject work the fleet could do."""
+    return bounded_choice(ranked_weighted(key, weights), loads, c,
+                          weights)
+
+
+def bounded_choice(order: Sequence[str], loads: Mapping[str, int],
+                   c: float = BOUNDED_LOAD_C,
+                   weights: Optional[Mapping[str, float]] = None
+                   ) -> Optional[str]:
+    """The bounded-load walk over an ALREADY-RANKED preference order —
+    split out so the router can cache the (pure, per-view) ranking and
+    re-apply only this O(N) load check per request. Caps scale with
+    each backend's WEIGHT share (cap_b = ceil(c * total * w_b / sum_w)):
+    a uniform cap would let overflow spill off a weight-4 backend onto
+    weight-1 replicas at 3x their advertised capacity — inverting the
+    very heterogeneity the weights exist to express. `weights` absent
+    or empty = uniform shares."""
+    if not order:
+        return None
+    total = sum(loads.get(b, 0) for b in order) + 1
+    if weights:
+        weight_sum = sum(max(weights.get(b, 1.0), 0.0)
+                         for b in order) or 1.0
+        caps = {b: math.ceil(c * total
+                             * max(weights.get(b, 1.0), 0.0)
+                             / weight_sum)
+                for b in order}
+    else:
+        cap = math.ceil(c * total / len(order))
+        caps = {b: cap for b in order}
+    for backend in order:
+        if loads.get(backend, 0) < caps[backend]:
+            return backend
+    return order[0]
 
 
 def occupancy(backends: Sequence[str],

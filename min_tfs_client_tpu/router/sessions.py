@@ -14,6 +14,17 @@ every session pinned there — the state is gone, re-routing would only
 manufacture NOT_FOUNDs), or the idle TTL expires (a client that vanished
 mid-stream must not leak table entries forever; the backend's own store
 expires the HBM side independently).
+
+Epoch fencing (router/core.py, docs/ROUTING.md "Replicated
+stickiness"): every pin records the membership-view epoch it was minted
+(or last revalidated) under. While the router's view still matches, the
+pin is honored on the fast path with no state check; when the view has
+churned, the pin is REVALIDATED against the live table — kept (and
+re-stamped) while its backend is LIVE or DRAINING, failed honestly when
+the backend is DEAD. The fence is what makes per-replica tables safe in
+an N-router tier: a replica that never saw the session's init computes
+the same deterministic placement from the same view, and any replica
+whose view disagrees refuses the shortcut instead of guessing.
 """
 
 from __future__ import annotations
@@ -27,6 +38,7 @@ from dataclasses import dataclass
 class _Pin:
     backend_id: str       # guarded_by: SessionTable._lock
     last_used_s: float    # guarded_by: SessionTable._lock
+    epoch: int = 0        # guarded_by: SessionTable._lock
 
 
 class SessionTable:
@@ -49,13 +61,26 @@ class SessionTable:
             pin.last_used_s = time.monotonic()
             return pin.backend_id
 
-    def pin(self, model: str, session_id: bytes, backend_id: str) -> None:
+    def lookup_fenced(self, model: str,
+                      session_id: bytes) -> tuple[str, int] | None:
+        """(backend id, minting epoch) with the idle clock refreshed —
+        the epoch-fencing read: the caller compares the pin's epoch to
+        its current membership view before trusting the fast path."""
+        with self._lock:
+            pin = self._pins.get(self.key(model, session_id))
+            if pin is None:
+                return None
+            pin.last_used_s = time.monotonic()
+            return pin.backend_id, pin.epoch
+
+    def pin(self, model: str, session_id: bytes, backend_id: str,
+            epoch: int = 0) -> None:
         with self._lock:
             self._pins[self.key(model, session_id)] = _Pin(
-                backend_id, time.monotonic())
+                backend_id, time.monotonic(), epoch)
 
     def pin_if_absent(self, model: str, session_id: bytes,
-                      backend_id: str) -> tuple[str, bool]:
+                      backend_id: str, epoch: int = 0) -> tuple[str, bool]:
         """Atomic first-writer-wins pin: returns (winning backend id,
         we_pinned). Concurrent duplicate first-requests for one session
         then agree on a single owner instead of the loser clobbering
@@ -66,8 +91,19 @@ class SessionTable:
             if existing is not None:
                 existing.last_used_s = time.monotonic()
                 return existing.backend_id, False
-            self._pins[key] = _Pin(backend_id, time.monotonic())
+            self._pins[key] = _Pin(backend_id, time.monotonic(), epoch)
             return backend_id, True
+
+    def restamp(self, model: str, session_id: bytes, backend_id: str,
+                epoch: int) -> None:
+        """Revalidation passed: record that this pin was checked against
+        (and survived) the CURRENT view, so later requests under the
+        same view take the fast path again. The backend-id guard keeps a
+        racing release+re-pin from being stamped with a stale verdict."""
+        with self._lock:
+            pin = self._pins.get(self.key(model, session_id))
+            if pin is not None and pin.backend_id == backend_id:
+                pin.epoch = epoch
 
     def release(self, model: str, session_id: bytes) -> bool:
         with self._lock:

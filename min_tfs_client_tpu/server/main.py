@@ -165,6 +165,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--slo_shed_burn_rate", type=float, default=0.0,
                    help="readiness sheds when the max SLO burn rate "
                         "reaches this (0 disables shedding)")
+    p.add_argument("--serving_weight", type=float, default=1.0,
+                   help="relative routing capacity advertised in the "
+                        "readyz payload; a router's weighted ring gives "
+                        "this replica ~weight/sum(weights) of new "
+                        "placements (docs/ROUTING.md)")
     p.add_argument("--flight_recorder_dir", default="",
                    help="directory for flight-recorder JSON dumps "
                         "(first INTERNAL error / SIGUSR2); empty = "
@@ -236,6 +241,7 @@ def options_from_args(args) -> ServerOptions:
         slo_error_budget=args.slo_error_budget,
         slo_window_seconds=args.slo_window_seconds,
         slo_shed_burn_rate=args.slo_shed_burn_rate,
+        serving_weight=args.serving_weight,
         flight_recorder_dir=args.flight_recorder_dir,
         trace_ring_size=args.trace_ring_size,
         drain_grace_seconds=args.drain_grace_seconds,

@@ -264,6 +264,19 @@ router_sticky_sessions = Gauge(
 router_live_backends = Gauge(
     ":tpu/serving/router_live_backends",
     "Backends currently in the new-work rotation (state LIVE).", ())
+router_session_recoveries = Counter(
+    ":tpu/serving/router_session_recoveries",
+    "Sessions whose pin was RECOVERED by probing the preference order "
+    "(a sessioned non-init request reached a replica holding no pin, "
+    "and the current view's first choice answered NOT_FOUND), by the "
+    "backend that actually held the session. Nonzero under a stable "
+    "view means replicas disagree on placement.", ("backend",))
+router_event_loop_lag_ms = Gauge(
+    ":tpu/serving/router_event_loop_lag_ms",
+    "Sampled scheduling lag of the router's asyncio data-plane event "
+    "loop (overshoot of a fixed-interval ticker, ms) — the aio "
+    "analogue of thread-pool saturation; every in-flight forward's "
+    "completion is late by about this much.", ())
 
 
 def gauge_total(gauge: Gauge) -> float:

@@ -148,6 +148,10 @@ class ServerOptions:
     # Readiness sheds (readyz 503, grpc NOT_SERVING, ready gauge 0) when
     # the max burn rate reaches this; 0 disables shedding.
     slo_shed_burn_rate: float = 0.0
+    # Relative routing capacity advertised in the readyz payload
+    # (`"weight"`): a router's weighted rendezvous ring gives this
+    # replica ~weight/sum(weights) of new placements. 1.0 = homogeneous.
+    serving_weight: float = 1.0
     # Flight-recorder dump directory ("" = TPU_SERVING_FLIGHT_DIR env or
     # the system tempdir).
     flight_recorder_dir: str = ""
@@ -277,6 +281,9 @@ class Server:
             window_s=opts.slo_window_seconds,
             shed_burn_rate=opts.slo_shed_burn_rate,
         ))
+        from min_tfs_client_tpu.observability import health
+
+        health.set_serving_weight(opts.serving_weight)
         flight_recorder.configure(opts.flight_recorder_dir or None)
         flight_recorder.install_signal_handler()
         if opts.trace_ring_size:

@@ -91,7 +91,8 @@ class Fleet:
 
     def __init__(self, tmp: pathlib.Path, n: int = 3,
                  drain_grace_s: float = 0.0,
-                 poll_interval_s: float = 0.25):
+                 poll_interval_s: float = 0.25,
+                 data_plane: str = "aio"):
         self.poll_interval_s = poll_interval_s
         model_root = tmp / "model"
         fixtures.write_session_jax_servable(model_root)
@@ -109,6 +110,7 @@ class Fleet:
                 backends=",".join(s.backend_spec() for s in self.servers),
                 health_poll_interval_s=poll_interval_s,
                 probe_timeout_s=2.0,
+                data_plane=data_plane,
             )).build_and_start()
         except BaseException:
             self.kill_all()
@@ -345,6 +347,33 @@ class TestEjection:
         snap = fleet.snapshot()
         assert snap["ready"] is True  # 2 of 3 still serving
         assert snap["ring"]["occupancy"].get(victim_id, 0.0) == 0.0
+
+
+@pytest.mark.proc_timeout(300)
+class TestThreadsPlaneEscapeHatch:
+    def test_threads_plane_keeps_the_full_contract(self, tmp_path_factory):
+        """--data_plane=threads (the pre-aio plane, kept one release;
+        docs/MIGRATING.md): bit-identity, stickiness, and the monitoring
+        surface all hold unchanged behind the flag."""
+        f = Fleet(tmp_path_factory.mktemp("threads_plane"), n=2,
+                  data_plane="threads")
+        try:
+            f.wait_live(2)
+            assert f.snapshot()["data_plane"]["mode"] == "threads"
+            with f.client() as client:
+                x = np.asarray([1.0, -2.0, 0.5], np.float32)
+                via_router = client.predict_request("sess", {"x": x})
+                with f.direct_client(f.servers[0]) as direct:
+                    direct_resp = direct.predict_request("sess", {"x": x})
+                assert via_router.SerializeToString(deterministic=True) \
+                    == direct_resp.SerializeToString(deterministic=True)
+                owner = _open_session(client, b"th-0", base=5)
+                for step in range(1, 4):
+                    token, pid = _step_session(client, b"th-0")
+                    assert (token, pid) == (5 + step, owner)
+                _close_session(client, b"th-0")
+        finally:
+            f.close()
 
 
 @pytest.mark.proc_timeout(300)

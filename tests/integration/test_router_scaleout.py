@@ -1,0 +1,410 @@
+"""Router scale-out: N router replicas, ONE fleet, correct stickiness
+under churn (ROADMAP item 3; docs/ROUTING.md "Replicated stickiness").
+
+Two REAL `tpu-serving-router` subprocesses front three (later four)
+real server subprocesses. What this suite proves, and how:
+
+ * **Deterministic pinning, subprocess-verified**: sessions are opened
+   alternately through router A and router B, then STEPPED through the
+   OTHER router. Neither router shares any state with the other; the
+   stepping router never saw the init. Token continuity + a stable
+   backend pid per session prove both replicas computed the identical
+   placement from (model, session id, membership view) alone.
+ * **Epoch fencing**: both routers report the SAME membership-view
+   epoch via /monitoring/router at every stable point, the epoch MOVES
+   on churn (SIGKILL, join) and moves to the same value on both — and
+   across both churn events every surviving session's token stream
+   stays continuous on its original backend (no silent re-route, the
+   fencing contract).
+ * **Kill churn**: SIGKILLing a backend loses exactly the sessions
+   pinned to it (UNAVAILABLE, state honestly gone) while zero
+   non-pinned requests are lost under the retry client.
+ * **Join mid-stream**: a backend named in --backends from boot (DEAD
+   until started) comes up mid-test; both routers converge on the new
+   view, live sessions stay put, new sessions start landing on the
+   joiner.
+
+Same watchdog discipline as test_router.py: every subprocess registers
+for a hard kill on timeout, so a hang fails loudly and leaks nothing.
+"""
+
+import threading
+import time
+
+import grpc
+import numpy as np
+import pytest
+
+from min_tfs_client_tpu.client import TensorServingClient
+from min_tfs_client_tpu.tensor.codec import tensor_proto_to_ndarray
+from tests import fixtures
+
+pytestmark = pytest.mark.integration
+
+_ACTIVE_PROCS: set = set()
+
+
+@pytest.fixture(autouse=True)
+def _proc_watchdog():
+    fired = threading.Event()
+
+    def _fire():
+        fired.set()
+        for proc in list(_ACTIVE_PROCS):
+            proc.kill()
+
+    timer = threading.Timer(300, _fire)
+    timer.daemon = True
+    timer.start()
+    yield
+    timer.cancel()
+    assert not fired.is_set(), \
+        "proc_timeout watchdog fired after 300s; fleet was killed"
+
+
+def wait_until(predicate, timeout_s: float, message: str,
+               interval_s: float = 0.05):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        value = predicate()
+        if value:
+            return value
+        time.sleep(interval_s)
+    raise AssertionError(f"timed out after {timeout_s}s: {message}")
+
+
+def _open_session(client, sid: bytes, base: int) -> int:
+    resp = client.predict_request(
+        "sess",
+        {"session_id": np.asarray(sid, object),
+         "base": np.asarray(base, np.int32)},
+        signature_name="decode_init")
+    return int(tensor_proto_to_ndarray(resp.outputs["pid"])[0])
+
+
+def _step_session(client, sid: bytes):
+    resp = client.predict_request(
+        "sess", {"session_id": np.asarray(sid, object)},
+        signature_name="decode_step")
+    return (int(tensor_proto_to_ndarray(resp.outputs["token"])[0]),
+            int(tensor_proto_to_ndarray(resp.outputs["pid"])[0]))
+
+
+class ScaleoutFleet:
+    """3 live backends + 1 reserved-but-unstarted joiner behind TWO
+    router subprocesses that share nothing but the --backends list."""
+
+    def __init__(self, tmp, poll_interval_s: float = 0.25):
+        self.poll_interval_s = poll_interval_s
+        model_root = tmp / "model"
+        fixtures.write_session_jax_servable(model_root)
+        self.monitoring = tmp / "monitoring.config"
+        self.monitoring.write_text("prometheus_config { enable: true }\n")
+        self.model_root = model_root
+        self.servers = []
+        self.routers = []
+        self.joiner = None
+        try:
+            self.servers = [
+                fixtures.ModelServerProcess(model_root, self.monitoring)
+                for _ in range(3)]
+            _ACTIVE_PROCS.update(self.servers)
+            specs = [s.wait_ready().backend_spec() for s in self.servers]
+            # The joiner's ports are reserved NOW so both routers can
+            # name it from boot; the process starts mid-test.
+            self.joiner_grpc, self.joiner_rest = fixtures.reserve_ports(2)
+            specs.append(
+                f"127.0.0.1:{self.joiner_grpc}:{self.joiner_rest}")
+            backends = ",".join(specs)
+            self.routers = [
+                fixtures.RouterProcess(
+                    backends, poll_interval_s=self.poll_interval_s)
+                for _ in range(2)]
+            _ACTIVE_PROCS.update(self.routers)
+            for router in self.routers:
+                router.wait_ready()
+        except BaseException:
+            self.close()
+            raise
+
+    def start_joiner(self) -> fixtures.ModelServerProcess:
+        self.joiner = fixtures.ModelServerProcess(
+            self.model_root, self.monitoring,
+            extra_args=(f"--port={self.joiner_grpc}",
+                        f"--rest_api_port={self.joiner_rest}"))
+        _ACTIVE_PROCS.add(self.joiner)
+        self.joiner.wait_ready()
+        return self.joiner
+
+    def client(self, router_idx: int, **kw) -> TensorServingClient:
+        return TensorServingClient(
+            "127.0.0.1", self.routers[router_idx].grpc_port, **kw)
+
+    def epochs(self) -> list:
+        return [r.snapshot()["view"]["epoch"] for r in self.routers]
+
+    def live_counts(self) -> list:
+        return [len(r.snapshot()["view"]["live"]) for r in self.routers]
+
+    def wait_converged(self, n_live: int, timeout_s: float = 30.0) -> str:
+        """Both routers see n_live LIVE backends AND agree on the
+        epoch; returns the agreed epoch."""
+        def check():
+            snaps = [r.snapshot()["view"] for r in self.routers]
+            if all(len(s["live"]) == n_live for s in snaps) and \
+                    snaps[0]["epoch"] == snaps[1]["epoch"]:
+                return snaps[0]["epoch"]
+            return None
+        return wait_until(
+            check, timeout_s,
+            f"routers never converged on {n_live} live backends "
+            f"(last: {[r.snapshot()['view'] for r in self.routers]})")
+
+    def close(self) -> None:
+        for proc in (*self.routers, *self.servers,
+                     *([self.joiner] if self.joiner else ())):
+            try:
+                proc.kill()
+            except Exception:  # pragma: no cover - teardown best-effort
+                pass
+            _ACTIVE_PROCS.discard(proc)
+
+
+@pytest.fixture(scope="module")
+def fleet(tmp_path_factory):
+    f = ScaleoutFleet(tmp_path_factory.mktemp("scaleout"))
+    try:
+        f.wait_converged(3, timeout_s=60)
+        yield f
+    finally:
+        f.close()
+
+
+class TestReplicatedStickiness:
+    def test_replicas_agree_on_view_and_weights(self, fleet):
+        snaps = [r.snapshot() for r in fleet.routers]
+        views = [s["view"] for s in snaps]
+        assert views[0]["epoch"] == views[1]["epoch"]
+        assert views[0]["live"] == views[1]["live"]
+        assert len(views[0]["live"]) == 3
+        # The default fleet is homogeneous: weights polled off readyz
+        # are 1.0 everywhere (the server's --serving_weight default).
+        assert all(w == 1.0 for w in views[0]["weights"].values())
+        assert views[0]["weights"] == views[1]["weights"]
+        # Both run the aio data plane (the default) and publish loop
+        # health through /monitoring/router.
+        for snap in snaps:
+            assert snap["data_plane"]["mode"] == "aio"
+
+    def test_pins_identical_across_replicas(self, fleet):
+        """12 sessions, init through one replica, STEP through the
+        other: the stepping router never saw the init, so continuity
+        proves it derived the same placement independently. Three
+        independent witnesses of determinism:
+
+         1. this test process computes the expected owner with the ring
+            functions directly — every init must land exactly there;
+         2. cross-router steps stay continuous on that backend;
+         3. neither router ever RECOVERS a pin (recovery would mask a
+            placement disagreement; under a stable view the counter
+            must stay zero)."""
+        from min_tfs_client_tpu.router import ring as ring_mod
+
+        view = fleet.routers[0].snapshot()["view"]
+        pid_by_id = {f"127.0.0.1:{s.grpc_port}": s.pid
+                     for s in fleet.servers}
+        owners = {}
+        with fleet.client(0) as ca, fleet.client(1) as cb:
+            for i in range(12):
+                sid = b"xr-%d" % i
+                opener = ca if i % 2 == 0 else cb
+                owners[sid] = _open_session(opener, sid, base=100 * i)
+                expected = ring_mod.assign_weighted(
+                    ring_mod.ring_key("sess", sid), view["weights"])
+                assert owners[sid] == pid_by_id[expected], \
+                    "a router diverged from the pure ring placement"
+            assert len(set(owners.values())) >= 2, \
+                "12 sessions all pinned to one backend"
+            for i, (sid, owner_pid) in enumerate(sorted(owners.items())):
+                stepper = cb if i % 2 == 0 else ca
+                base = 100 * int(sid.split(b"-")[1])
+                for step in range(1, 4):
+                    token, pid = _step_session(stepper, sid)
+                    assert pid == owner_pid, \
+                        "replicas disagreed on a session's backend"
+                    assert token == base + step, \
+                        "token stream broke crossing routers"
+        for router in fleet.routers:
+            assert router.snapshot()["sessions_recovered"] == 0, \
+                "a pin was RECOVERED under a stable view: the replicas " \
+                "computed different placements"
+        # Both session tables now hold all 12 pins, identically
+        # distributed — computed, not gossiped.
+        def tables_agree():
+            by_b = [r.snapshot()["sessions"]["by_backend"]
+                    for r in fleet.routers]
+            return by_b[0] == by_b[1] and \
+                sum(by_b[0].values()) == 12 and by_b[0]
+        wait_until(tables_agree, 10,
+                   "per-replica session tables never converged")
+
+    def test_kill_and_join_churn_epoch_fenced(self, fleet):
+        """The full churn choreography: SIGKILL one backend, then boot
+        the reserved joiner — across both events, every surviving
+        session's stream stays continuous on its original backend
+        through BOTH routers, the epoch moves twice and both replicas
+        agree on it at every stable point, and zero non-pinned requests
+        are lost under the retry client."""
+        epoch0 = fleet.wait_converged(3)
+        with fleet.client(0) as ca, fleet.client(1) as cb:
+            # Sessions spread over the 3 live backends, opened via A.
+            owners = {}
+            for i in range(24):
+                sid = b"churn-%d" % i
+                owners[sid] = _open_session(ca, sid, base=1000 * i)
+            victim = fleet.servers[0]
+            victim_pid = victim.pid
+            doomed = {s for s, p in owners.items() if p == victim_pid}
+            survivors = {s for s, p in owners.items() if p != victim_pid}
+            assert doomed and survivors, \
+                "sessions never spread over the victim + others"
+
+            victim.kill()
+            # Retry clients lose NOTHING stateless during the eject gap.
+            with fleet.client(0, retry_unavailable=True, max_retries=8,
+                              retry_backoff_s=0.1) as retrying:
+                for i in range(30):
+                    x = np.asarray([float(i)], np.float32)
+                    resp = retrying.predict_request("sess", {"x": x})
+                    np.testing.assert_allclose(
+                        tensor_proto_to_ndarray(resp.outputs["y"]),
+                        x * 3.0 + 1.0)
+            epoch1 = fleet.wait_converged(2)
+            assert epoch1 != epoch0, "kill did not move the epoch"
+
+            # Surviving sessions: continuous through BOTH routers
+            # (pins revalidated under the new epoch, never re-routed).
+            for j, sid in enumerate(sorted(survivors)):
+                base = 1000 * int(sid.split(b"-")[1])
+                token, pid = _step_session(ca if j % 2 else cb, sid)
+                assert pid == owners[sid]
+                assert token == base + 1
+            # Doomed sessions: honestly UNAVAILABLE on both replicas.
+            for client in (ca, cb):
+                sid = sorted(doomed)[0]
+                with pytest.raises(grpc.RpcError) as err:
+                    _step_session(client, sid)
+                assert err.value.code() in (
+                    grpc.StatusCode.UNAVAILABLE,
+                    grpc.StatusCode.NOT_FOUND)
+
+            # JOIN mid-stream: the reserved backend boots; both routers
+            # converge on 3 live again — at a NEW epoch.
+            joiner = fleet.start_joiner()
+            epoch2 = fleet.wait_converged(3, timeout_s=60)
+            assert epoch2 not in (epoch0, epoch1), \
+                "join did not move the epoch"
+
+            # Live sessions STILL never re-route (step 2 continues).
+            for j, sid in enumerate(sorted(survivors)):
+                base = 1000 * int(sid.split(b"-")[1])
+                token, pid = _step_session(cb if j % 2 else ca, sid)
+                assert pid == owners[sid], \
+                    "a live session silently re-routed on join"
+                assert token == base + 2
+            # The join moved exactly the joiner-won keys in the ring —
+            # for surviving sessions those placements are now WRONG,
+            # and any replica stepping one without a pin must have
+            # taken the recovery path (probed past the joiner's
+            # NOT_FOUND). Compute the stolen set with the ring
+            # functions; when it is non-empty, recovery must have
+            # fired somewhere in the tier.
+            from min_tfs_client_tpu.router import ring as ring_mod
+
+            weights3 = fleet.routers[0].snapshot()["view"]["weights"]
+            joiner_id = f"127.0.0.1:{fleet.joiner_grpc}"
+            stolen = [sid for sid in survivors
+                      if ring_mod.assign_weighted(
+                          ring_mod.ring_key("sess", sid),
+                          weights3) == joiner_id]
+            recovered = sum(r.snapshot()["sessions_recovered"]
+                            for r in fleet.routers)
+            if stolen:
+                assert recovered >= 1, \
+                    "joiner stole ring keys of live sessions but no " \
+                    "pin recovery ever fired"
+            # New sessions spread onto the joiner — identically placed
+            # by both replicas (init on one, step on the other).
+            joined = 0
+            for i in range(24):
+                sid = b"post-join-%d" % i
+                pid = _open_session(ca if i % 2 else cb, sid, base=7)
+                token, pid2 = _step_session(cb if i % 2 else ca, sid)
+                assert pid2 == pid and token == 8
+                if pid == joiner.pid:
+                    joined += 1
+            assert joined > 0, "no new session ever landed on the joiner"
+
+
+class TestRecoveryProbeWalk:
+    def test_recovery_walks_past_unreachable_candidate(
+            self, tmp_path_factory):
+        """A candidate that is UNREACHABLE (died after joining, before
+        the next poll ejects it) must not abort pin recovery: the walk
+        continues past it to the backend that actually holds the
+        session — a replica holding the pin would have served the same
+        request, so aborting would make replicas answer divergently.
+
+        Staged deterministically: a LONG poll interval keeps the
+        SIGKILLed joiner in the routers' views, and the probed sessions
+        are pre-chosen with the ring functions so the joiner is their
+        post-join first preference while they live elsewhere."""
+        from min_tfs_client_tpu.router import ring as ring_mod
+
+        f = ScaleoutFleet(tmp_path_factory.mktemp("probe-walk"),
+                          poll_interval_s=2.0)
+        try:
+            f.wait_converged(3, timeout_s=60)
+            server_ids = [f"127.0.0.1:{s.grpc_port}" for s in f.servers]
+            joiner_id = f"127.0.0.1:{f.joiner_grpc}"
+            post_join = {bid: 1.0 for bid in (*server_ids, joiner_id)}
+            # Sids the joiner WILL win once live — today they must pin
+            # elsewhere (the joiner is named but DEAD).
+            stolen = [sid for sid in (b"walk-%d" % i for i in range(64))
+                      if ring_mod.assign_weighted(
+                          ring_mod.ring_key("sess", sid),
+                          post_join) == joiner_id][:6]
+            assert stolen, "no sid hashed to the joiner's keyspace"
+            with f.client(0) as ca, f.client(1) as cb:
+                owners = {sid: _open_session(ca, sid, base=50)
+                          for sid in stolen}
+                joiner = f.start_joiner()
+                f.wait_converged(4, timeout_s=60)
+                joiner.kill()
+                # IMMEDIATELY step through the pinless replica B: its
+                # view still lists the joiner LIVE and ranks it first
+                # for these sids, so recovery forwards there, takes the
+                # connection-level UNAVAILABLE, and must keep walking
+                # to the true owner.
+                for step in (1, 2):
+                    for sid in stolen:
+                        token, pid = _step_session(cb, sid)
+                        assert pid == owners[sid], \
+                            "recovery re-routed a live session"
+                        assert token == 50 + step, \
+                            "token stream broke recovering past a " \
+                            "dead candidate"
+                # At least the FIRST step walked past the dead joiner
+                # (probes >= 1 -> counted); its failed probe pulses
+                # ejection, so later steps may find the owner first
+                # (probes == 0, deliberately uncounted).
+                assert f.routers[1].snapshot()["sessions_recovered"] >= 1
+                # The failed probes pulsed ejection: both replicas
+                # converge back to 3 live and the sessions keep
+                # stepping on their owners.
+                f.wait_converged(3, timeout_s=60)
+                for sid in stolen:
+                    token, pid = _step_session(cb, sid)
+                    assert pid == owners[sid] and token == 53
+        finally:
+            f.close()

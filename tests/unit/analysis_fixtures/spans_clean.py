@@ -32,6 +32,29 @@ def reviewed_exception(worker, pool):
     return pool.submit(worker, trace)
 
 
+def sanctioned_task_handoff_create_task(loop, stepper):
+    # The aio data plane's handoff: a SAME-loop task copies the
+    # contextvar context at creation, so the active trace rides into
+    # the child and tracing.activate's set/reset stays task-local
+    # (router/aio_proxy.py _broadcast_reload).
+    trace = tracing.current_trace()
+    return loop.create_task(stepper(trace))
+
+
+def sanctioned_task_handoff_ensure_future(forward):
+    import asyncio
+
+    trace = tracing.current_trace()
+    return asyncio.ensure_future(forward(trace))
+
+
+async def sanctioned_task_handoff_gather(backends, forward):
+    import asyncio
+
+    trace = tracing.current_trace()
+    return await asyncio.gather(*[forward(trace, b) for b in backends])
+
+
 def sanctioned_completion_thread_materialize(batch, handle, split):
     # The in-flight window's completion thread (batching/session.py
     # _complete_batch): the riders' traces crossed the queue ON their

@@ -33,3 +33,22 @@ def sp002_trace_to_completion_thread(window, materialize, handle):
     # completion thread activates the fanout).
     trace = tracing.current_trace()
     window.submit(materialize, handle, trace)    # SP002
+
+
+def sp002_trace_into_foreign_loop(worker, loop):
+    # asyncio.run_coroutine_threadsafe is a THREAD crossing: the
+    # coroutine runs on the loop's thread with the loop's context, so a
+    # trace passed through it leaks exactly like a Thread() arg. The
+    # sanctioned task handoff (create_task/ensure_future/gather) only
+    # covers same-loop spawns.
+    import asyncio
+
+    trace = tracing.current_trace()
+    return asyncio.run_coroutine_threadsafe(worker(trace), loop)  # SP002
+
+
+def sp002_trace_arg_into_foreign_loop(worker, loop):
+    import asyncio
+
+    trace = tracing.current_trace()
+    return asyncio.run_coroutine_threadsafe(worker, trace)        # SP002

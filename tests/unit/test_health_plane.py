@@ -488,6 +488,28 @@ class TestReadiness:
         assert health._current_core() is None
 
 
+class TestServingWeight:
+    def teardown_method(self):
+        health.set_serving_weight(1.0)
+
+    def test_positive_weight_published(self):
+        health.set_serving_weight(2.5)
+        assert health.serving_weight() == 2.5
+
+    def test_non_positive_weight_serves_at_homogeneous_default(
+            self, caplog):
+        """A zero/negative knob value must NOT (near-)silently remove
+        the replica from router rotation — that is drain's job. It
+        serves at the homogeneous 1.0, loudly."""
+        import logging
+
+        for bad in (0.0, -3.0):
+            with caplog.at_level(logging.WARNING):
+                health.set_serving_weight(bad)
+            assert health.serving_weight() == 1.0
+        assert "serving_weight" in caplog.text
+
+
 class TestRequestLogCounters:
     def test_logged_and_sampled_out_counted(self):
         from min_tfs_client_tpu.core.request_logger import (

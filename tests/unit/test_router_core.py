@@ -464,3 +464,414 @@ class TestDrainFlag:
         gauge.set(2.0, "a")
         gauge.set(3.0, "b")
         assert metrics.gauge_total(gauge) == 5.0
+
+
+class TestEpochFencing:
+    """The replication contract (docs/ROUTING.md "Replicated
+    stickiness"): pins are minted under a membership-view epoch, the
+    epoch is CONTENT (same view => same epoch, with no coordination),
+    churn forces revalidation, and a replica with no pin recovers an
+    existing session by probing instead of guessing."""
+
+    def test_epoch_is_content_not_a_counter(self):
+        core_a, _ = make_core()
+        core_b, _ = make_core()
+        core_a.membership.poll_once()
+        core_b.membership.poll_once()
+        assert core_a.membership.view().epoch == \
+            core_b.membership.view().epoch
+        assert core_a.membership.view().live == \
+            tuple(sorted(b.backend_id for b in (B1, B2, B3)))
+
+    def test_confirming_poll_keeps_epoch(self):
+        core, _ = make_core()
+        core.membership.poll_once()
+        epoch = core.membership.view().epoch
+        core.membership.poll_once()  # status quo confirmed
+        assert core.membership.view().epoch == epoch
+
+    def test_every_churn_kind_moves_epoch(self):
+        core, poller = make_core()
+        core.membership.poll_once()
+        epoch0 = core.membership.view().epoch
+        poller.verdicts[B1.backend_id] = NOT_SERVING      # drain
+        core.membership.poll_once()
+        drained = core.membership.view().epoch
+        assert drained != epoch0
+        poller.verdicts[B1.backend_id] = SERVING          # reinstate
+        core.membership.poll_once()
+        # Content, not a counter: restoring the exact view restores
+        # the exact epoch — replicas that took different churn paths
+        # to the same view still agree.
+        assert core.membership.view().epoch == epoch0
+        poller.verdicts[B2.backend_id] = UNREACHABLE      # eject
+        core.membership.poll_once()
+        assert core.membership.view().epoch not in (epoch0, drained)
+
+    def test_weight_change_moves_epoch_and_placement_inputs(self):
+        core, poller = make_core()
+        core.membership.poll_once()
+        epoch0 = core.membership.view().epoch
+        poller.payloads[B1.backend_id] = {"weight": 4.0, "models": {}}
+        core.membership.poll_once()
+        view = core.membership.view()
+        assert view.epoch != epoch0
+        assert view.weights[B1.backend_id] == 4.0
+        # garbage weights are ignored, not adopted
+        poller.payloads[B1.backend_id] = {"weight": "lots", "models": {}}
+        core.membership.poll_once()
+        assert core.membership.view().weights[B1.backend_id] == 4.0
+
+    def test_pin_fast_path_stamps_and_honors_epoch(self):
+        core, _ = make_core()
+        core.membership.poll_once()
+        epoch = core.membership.view().epoch
+        first = core.route("m", b"fenced", b"")
+        assert first.fresh_pin is True and first.epoch == epoch
+        assert core.sessions.lookup_fenced("m", b"fenced") == \
+            (first.backend.backend_id, epoch)
+
+    def test_churn_revalidates_and_restamps_live_pin(self):
+        """A view change that does NOT touch the pinned backend: the
+        pin survives revalidation and is re-stamped with the new epoch
+        so later requests fast-path again."""
+        core, poller = make_core()
+        core.membership.poll_once()
+        decision = core.route("m", b"keeper", b"")
+        pinned = decision.backend.backend_id
+        other = next(b.backend_id for b in (B1, B2, B3)
+                     if b.backend_id != pinned)
+        poller.verdicts[other] = UNREACHABLE
+        core.membership.poll_once()
+        new_epoch = core.membership.view().epoch
+        assert new_epoch != decision.epoch
+        followup = core.route("m", b"keeper", b"")
+        assert followup.backend.backend_id == pinned
+        assert core.sessions.lookup_fenced("m", b"keeper") == \
+            (pinned, new_epoch)
+
+    def test_draining_pin_revalidates_every_time(self):
+        """A pin on a DRAINING backend keeps routing there but is never
+        re-stamped: the fast path's invariant is 'epoch match =>
+        backend in the view', and a drainer is not."""
+        core, poller = make_core()
+        core.membership.poll_once()
+        decision = core.route("m", b"drainer", b"")
+        pinned = decision.backend.backend_id
+        poller.verdicts[pinned] = NOT_SERVING
+        core.membership.poll_once()
+        epoch = core.membership.view().epoch
+        followup = core.route("m", b"drainer", b"")
+        assert followup.backend.backend_id == pinned
+        stamped = core.sessions.lookup_fenced("m", b"drainer")
+        assert stamped[0] == pinned and stamped[1] != epoch
+
+    def test_unpinned_step_gets_probe_candidates(self):
+        """A sessioned NON-init request with no pin is a recovery
+        decision: full preference order, live first, nothing pinned
+        yet. The init signature still mints directly."""
+        core, poller = make_core()
+        core.membership.poll_once()
+        decision = core.route("m", b"elsewhere", b"x",
+                              signature="decode_step")
+        assert decision.fresh_pin is False
+        assert len(decision.probe_candidates) == 3
+        assert core.sessions.lookup("m", b"elsewhere") is None
+        from min_tfs_client_tpu.router import ring as ring_mod
+
+        expected = ring_mod.ranked_weighted(
+            ring_mod.ring_key("m", b"elsewhere"),
+            core.membership.view().weights)
+        assert [b.backend_id for b in decision.probe_candidates] == \
+            expected
+        assert decision.backend.backend_id == expected[0]
+
+    def test_probe_candidates_include_draining_tail(self):
+        core, poller = make_core()
+        core.membership.poll_once()
+        poller.verdicts[B1.backend_id] = NOT_SERVING
+        core.membership.poll_once()
+        decision = core.route("m", b"on-drainer", b"x",
+                              signature="decode_step")
+        ids = [b.backend_id for b in decision.probe_candidates]
+        assert ids[-1] == B1.backend_id  # drainer probed last
+        assert B1.backend_id not in ids[:-1]
+
+    def test_recovery_mid_race_fleet_death_is_clean_unavailable(self):
+        """The poll sweep (note_error-pulsed) can flip the last LIVE
+        backend DEAD between route()'s lock-free view read and the
+        locked states() snapshot. The snapshot is the honest answer:
+        the reply must be the same UNAVAILABLE every other empty-fleet
+        path raises, not an IndexError surfaced as INTERNAL."""
+        core, poller = make_core()
+        core.membership.poll_once()
+        # plant the race: the view still lists three LIVE backends,
+        # but the atomic snapshot says the sweep just killed them all
+        core.membership.states = lambda: {
+            b.backend_id: DEAD for b in core.membership.backends()}
+        assert core.membership.view().live  # the stale view disagrees
+        with pytest.raises(ServingError) as err:
+            core.route("m", b"mid-race", b"x", signature="decode_step")
+        assert err.value.code == Code.UNAVAILABLE
+
+    def test_session_recovered_pins_and_counts(self):
+        core, _ = make_core()
+        core.membership.poll_once()
+        view = core.membership.view()
+        core.session_recovered("m", b"found", B2.backend_id, probes=2)
+        assert core.sessions.lookup_fenced("m", b"found") == \
+            (B2.backend_id, view.epoch)
+        assert core.recovered_sessions() == 1
+        # zero-probe recovery (first candidate answered) is not an
+        # anomaly and is not counted
+        core.session_recovered("m", b"direct", B3.backend_id, probes=0)
+        assert core.recovered_sessions() == 1
+
+    def test_recovered_pin_on_drainer_never_fast_paths(self):
+        core, poller = make_core()
+        core.membership.poll_once()
+        poller.verdicts[B1.backend_id] = NOT_SERVING
+        core.membership.poll_once()
+        core.session_recovered("m", b"drainer-bound", B1.backend_id,
+                               probes=1)
+        stamped = core.sessions.lookup_fenced("m", b"drainer-bound")
+        assert stamped == (B1.backend_id, 0)
+
+    def test_recovery_stamp_is_recovery_time_not_route_time(self):
+        """The probe walk can span a poll: a backend that was DRAINING
+        at route time (probe tail, absent from the route-time view's
+        content) can be LIVE again by the time it answers. Stamping the
+        route-time epoch would poison the fast path — content epochs
+        RECUR, so a later fleet state equal to the route-time view
+        would fast-path to this backend even after it dies. The stamp
+        must come from the recovery-time view (which contains it)."""
+        core, poller = make_core(backends=(B1, B2))
+        core.membership.poll_once()
+        poller.verdicts[B1.backend_id] = NOT_SERVING
+        core.membership.poll_once()
+        route_epoch = core.membership.view().epoch  # live = {B2}
+        # B1 reinstated mid-walk; the recovery lands after the flip
+        poller.verdicts[B1.backend_id] = SERVING
+        core.membership.poll_once()
+        recovery_view = core.membership.view()      # live = {B1, B2}
+        core.session_recovered("m", b"spanning", B1.backend_id,
+                               probes=1)
+        assert core.sessions.lookup_fenced("m", b"spanning") == \
+            (B1.backend_id, recovery_view.epoch)
+        assert recovery_view.epoch != route_epoch
+        # B1 dies: the fleet's content is {B2} again — the SAME epoch
+        # value as route time. No request may reach dead B1: the death
+        # callback drops the pin, so the route becomes a pin-recovery
+        # decision whose candidates are live/draining only. (The case
+        # where a dead-backend pin PERSISTS is covered by
+        # test_fast_path_requires_membership_in_the_fenced_view.)
+        poller.verdicts[B1.backend_id] = UNREACHABLE
+        for _ in range(5):
+            core.membership.poll_once()
+        assert core.membership.view().epoch == route_epoch  # recurred
+        assert core.sessions.lookup("m", b"spanning") is None
+        decision = core.route("m", b"spanning", b"x",
+                              signature="decode_step")
+        assert decision.probe_candidates
+        assert B1.backend_id not in {
+            b.backend_id for b in decision.probe_candidates}
+        assert decision.backend.backend_id == B2.backend_id
+
+    def test_fast_path_requires_membership_in_the_fenced_view(self):
+        """Defense in depth for the same invariant: even a pin whose
+        stamped epoch equals the current view's must not fast-path to
+        a backend that view does not contain (content epochs recur;
+        membership.backend() still resolves DEAD entries)."""
+        core, poller = make_core(backends=(B1, B2))
+        core.membership.poll_once()
+        poller.verdicts[B1.backend_id] = UNREACHABLE
+        for _ in range(5):
+            core.membership.poll_once()
+        view = core.membership.view()               # live = {B2}
+        assert B1.backend_id not in view.weights
+        core.sessions.pin("m", b"poisoned", B1.backend_id,
+                          epoch=view.epoch)         # epoch matches...
+        with pytest.raises(ServingError) as err:    # ...but B1 is DEAD
+            core.route("m", b"poisoned", b"x", signature="decode_step")
+        assert err.value.code == Code.UNAVAILABLE
+        assert "state is lost" in str(err.value)
+
+
+class _AbortCalled(Exception):
+    def __init__(self, code, details):
+        super().__init__(details)
+        self.code = code
+        self.details = details
+
+
+class TestPinRecoveryVerdicts:
+    """Terminal verdicts of the pin-recovery walk (both data planes):
+    NOT_FOUND is only provable when EVERY candidate answered and
+    disclaimed the session. One unreachable candidate may hold the
+    live session, so the honest verdict is retryable UNAVAILABLE —
+    never the terminal NOT_FOUND clients give up on."""
+
+    _METHOD = "/tensorflow.serving.PredictionService/Predict"
+
+    @staticmethod
+    def _rpc_error(code, details=""):
+        import grpc
+
+        class _Err(grpc.RpcError):
+            def code(self):
+                return code
+
+            def details(self):
+                return details
+
+        return _Err()
+
+    def _decision(self):
+        core, _ = make_core(backends=(B1, B2))
+        core.membership.poll_once()
+        decision = core.route("m", b"elsewhere", b"x",
+                              signature="decode_step")
+        assert len(decision.probe_candidates) == 2
+        return core, decision
+
+    def _run_threaded(self, core, decision, outcomes):
+        proxy = proxy_mod.GrpcProxy(core)
+
+        def fake_forward(backend, full_method, request_bytes, context,
+                         on_rpc_error=None, probing=False):
+            out = outcomes[backend.backend_id]
+            if isinstance(out, Exception):
+                raise out
+            return out
+
+        proxy._forward = fake_forward
+
+        class Ctx:
+            def abort(self, code, details):
+                raise _AbortCalled(code, details)
+
+        return proxy._forward_recovering(
+            decision, self._METHOD, b"x", Ctx(), "m", b"elsewhere",
+            None, lambda *a: None)
+
+    def _run_aio(self, core, decision, outcomes):
+        import asyncio
+
+        from min_tfs_client_tpu.router.aio_proxy import AioDataPlane
+
+        plane = AioDataPlane(core)
+
+        async def fake_forward(backend, full_method, request_bytes,
+                               context, on_rpc_error=None,
+                               probing=False):
+            out = outcomes[backend.backend_id]
+            if isinstance(out, Exception):
+                raise out
+            return out
+
+        plane._forward = fake_forward
+
+        class Ctx:
+            async def abort(self, code, details):
+                raise _AbortCalled(code, details)
+
+        return asyncio.run(plane._forward_recovering(
+            decision, self._METHOD, b"x", Ctx(), "m", b"elsewhere",
+            None, lambda *a: None))
+
+    @pytest.mark.parametrize("plane", ["threads", "aio"])
+    def test_mixed_disclaimed_and_unreachable_is_unavailable(
+            self, plane):
+        import grpc
+
+        core, decision = self._decision()
+        first, second = (b.backend_id for b in decision.probe_candidates)
+        outcomes = {
+            first: self._rpc_error(grpc.StatusCode.NOT_FOUND,
+                                   "unknown session"),
+            second: self._rpc_error(grpc.StatusCode.UNAVAILABLE,
+                                    "connect failed"),
+        }
+        run = self._run_threaded if plane == "threads" else self._run_aio
+        with pytest.raises(_AbortCalled) as err:
+            run(core, decision, outcomes)
+        assert err.value.code == grpc.StatusCode.UNAVAILABLE
+        assert "unreachable" in err.value.details
+
+    @pytest.mark.parametrize("plane", ["threads", "aio"])
+    def test_every_candidate_disclaiming_is_not_found(self, plane):
+        import grpc
+
+        core, decision = self._decision()
+        outcomes = {
+            b.backend_id: self._rpc_error(grpc.StatusCode.NOT_FOUND,
+                                          "unknown session")
+            for b in decision.probe_candidates
+        }
+        run = self._run_threaded if plane == "threads" else self._run_aio
+        with pytest.raises(_AbortCalled) as err:
+            run(core, decision, outcomes)
+        assert err.value.code == grpc.StatusCode.NOT_FOUND
+
+    @pytest.mark.parametrize("plane", ["threads", "aio"])
+    def test_recovery_walks_past_unreachable_candidate(self, plane):
+        import grpc
+
+        core, decision = self._decision()
+        first, second = (b.backend_id for b in decision.probe_candidates)
+        outcomes = {
+            first: self._rpc_error(grpc.StatusCode.UNAVAILABLE,
+                                   "connect failed"),
+            second: b"answered",
+        }
+        run = self._run_threaded if plane == "threads" else self._run_aio
+        assert run(core, decision, outcomes) == b"answered"
+        assert core.sessions.lookup("m", b"elsewhere") == second
+
+
+class TestBoundedLoadRouting:
+    def test_stateless_spills_off_hot_backend(self):
+        core, _ = make_core()
+        core.membership.poll_once()
+        payload = b"hot-key-payload"
+        preferred = core.route("m", None, payload).backend.backend_id
+        for _ in range(50):
+            core.note_forward_start(preferred)
+        spilled = core.route("m", None, payload).backend.backend_id
+        assert spilled != preferred
+        for _ in range(50):
+            core.note_forward_done(preferred)
+        assert core.route("m", None, payload).backend.backend_id == \
+            preferred
+
+    def test_sessioned_placement_ignores_load(self):
+        """Pins must be a pure function of (key, view): cross-replica
+        agreement would die the moment replica-local load leaked in."""
+        core, _ = make_core()
+        core.membership.poll_once()
+        sid = b"load-blind"
+        expected = core.route("m", sid, b"").backend.backend_id
+        core.session_closed("m", sid)
+        for backend in (B1, B2, B3):
+            for _ in range(20):
+                core.note_forward_start(backend.backend_id)
+        assert core.route("m", sid, b"").backend.backend_id == expected
+
+    def test_full_fleet_drain_still_recovers_sessions(self):
+        """Both backends DRAINING (rolling deploy): a replica WITHOUT
+        the pin must still probe the drainers for an existing session —
+        the replica WITH the pin keeps serving it via revalidation, and
+        the two must behave the same."""
+        core, poller = make_core(backends=(B1, B2))
+        core.membership.poll_once()
+        for backend in (B1, B2):
+            poller.verdicts[backend.backend_id] = NOT_SERVING
+        core.membership.poll_once()
+        decision = core.route("m", b"drain-wide", b"x",
+                              signature="decode_step")
+        ids = sorted(b.backend_id for b in decision.probe_candidates)
+        assert ids == sorted([B1.backend_id, B2.backend_id])
+        # a NEW session (init) during a full drain still fails honestly
+        with pytest.raises(ServingError):
+            core.route("m", b"fresh-session", b"x",
+                       signature="decode_init")

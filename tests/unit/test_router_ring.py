@@ -115,3 +115,107 @@ class TestOccupancy:
     def test_empty_fleet(self):
         assert ring.occupancy([]) == {}
         assert ring.assign(ring.ring_key("m", b"x"), []) is None
+
+
+class TestWeightedRing:
+    def test_uniform_weights_equal_unweighted(self):
+        """-w/ln(h) is monotonic in h, so weight-1 fleets keep EXACTLY
+        the unweighted assignment — upgrading a fleet to weighted
+        routing moves zero keys until someone sets a weight != 1."""
+        uniform = {b: 1.0 for b in BACKENDS}
+        for m, r in KEYS:
+            key = ring.ring_key(m, r)
+            assert ring.assign_weighted(key, uniform) == \
+                ring.assign(key, BACKENDS)
+
+    def test_ranked_head_is_assignment_and_order_total(self):
+        uniform = {b: 1.0 for b in BACKENDS}
+        for m, r in KEYS[:100]:
+            key = ring.ring_key(m, r)
+            order = ring.ranked_weighted(key, uniform)
+            assert sorted(order) == sorted(BACKENDS)
+            assert order[0] == ring.assign_weighted(key, uniform)
+
+    def test_weight_scales_share(self):
+        """A weight-2 backend owns ~2x a weight-1 backend's keyspace
+        (binomial tolerance over 1000 keys)."""
+        weights = {"10.0.0.1:8500": 2.0, "10.0.0.2:8500": 1.0,
+                   "10.0.0.3:8500": 1.0}
+        counts = {b: 0 for b in weights}
+        for m, r in KEYS:
+            counts[ring.assign_weighted(ring.ring_key(m, r), weights)] += 1
+        assert abs(counts["10.0.0.1:8500"] / K - 0.5) < 0.06
+        assert abs(counts["10.0.0.2:8500"] / K - 0.25) < 0.05
+
+    def test_weighted_removal_stability(self):
+        """The per-backend score is independent of the set, so removing
+        a backend moves exactly its keys — the property pin recovery
+        leans on (the old owner stays #1 after a kill)."""
+        weights = {"10.0.0.1:8500": 2.0, "10.0.0.2:8500": 1.0,
+                   "10.0.0.3:8500": 1.0}
+        before = {ring.ring_key(m, r): ring.assign_weighted(
+            ring.ring_key(m, r), weights) for m, r in KEYS}
+        smaller = {b: w for b, w in weights.items()
+                   if b != "10.0.0.2:8500"}
+        for key, owner in before.items():
+            if owner != "10.0.0.2:8500":
+                assert ring.assign_weighted(key, smaller) == owner
+
+    def test_zero_weight_excluded(self):
+        weights = {"10.0.0.1:8500": 0.0, "10.0.0.2:8500": 1.0}
+        for m, r in KEYS[:50]:
+            assert ring.assign_weighted(
+                ring.ring_key(m, r), weights) == "10.0.0.2:8500"
+        assert ring.assign_weighted(ring.ring_key("m", b"x"), {}) is None
+        assert ring.ranked_weighted(ring.ring_key("m", b"x"), {}) == []
+
+
+class TestBoundedLoad:
+    WEIGHTS = {b: 1.0 for b in BACKENDS}
+
+    def test_no_load_matches_weighted(self):
+        for m, r in KEYS[:200]:
+            key = ring.ring_key(m, r)
+            assert ring.assign_bounded(key, self.WEIGHTS, {}) == \
+                ring.assign_weighted(key, self.WEIGHTS)
+
+    def test_hot_backend_spills_to_next_preference(self):
+        key = ring.ring_key("m", b"spill-me")
+        order = ring.ranked_weighted(key, self.WEIGHTS)
+        # First preference far over the c*avg cap: the key spills to
+        # its SECOND preference, not a random backend.
+        loads = {order[0]: 100, order[1]: 0, order[2]: 0}
+        assert ring.assign_bounded(key, self.WEIGHTS, loads) == order[1]
+
+    def test_all_at_cap_degenerates_to_first_preference(self):
+        key = ring.ring_key("m", b"saturated")
+        order = ring.ranked_weighted(key, self.WEIGHTS)
+        loads = {b: 1000 for b in BACKENDS}
+        assert ring.assign_bounded(key, self.WEIGHTS, loads) == order[0]
+
+    def test_bound_respected_under_sequential_placement(self):
+        """Placing 300 keys sequentially (load = placements so far)
+        keeps every backend under ceil(c * (total+1) / N) + 1."""
+        loads = {b: 0 for b in BACKENDS}
+        for i, (m, r) in enumerate(KEYS[:300]):
+            chosen = ring.assign_bounded(
+                ring.ring_key(m, r), self.WEIGHTS, loads)
+            loads[chosen] += 1
+            cap = math.ceil(ring.BOUNDED_LOAD_C * (i + 2) / len(BACKENDS))
+            assert max(loads.values()) <= cap + 1
+
+    def test_caps_scale_with_weights(self):
+        """cap_b = ceil(c * total * w_b / sum_w): a weight-4 backend
+        absorbs ~4x a weight-1 backend's bounded load instead of
+        spilling its rightful traffic onto the small replicas."""
+        weights = {"10.0.0.1:8500": 4.0, "10.0.0.2:8500": 1.0,
+                   "10.0.0.3:8500": 1.0}
+        loads = {b: 0 for b in weights}
+        for m, r in KEYS[:300]:
+            chosen = ring.assign_bounded(
+                ring.ring_key(m, r), weights, loads)
+            loads[chosen] += 1
+        big = loads["10.0.0.1:8500"]
+        small = max(loads["10.0.0.2:8500"], loads["10.0.0.3:8500"])
+        assert big / 300 > 0.5, loads       # the big box keeps its share
+        assert small / 300 < 0.25, loads    # small boxes stay near theirs
