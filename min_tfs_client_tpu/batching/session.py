@@ -433,6 +433,12 @@ class BatchedSignatureRunner:
                            queue_depth=self._queue.depth())
         task = BatchTask(inputs=arrays, size=n,
                          output_filter=tuple(output_filter), trace=trace)
+        # Pre-enqueue faultpoint: a delay here widens the batching
+        # window artificially (merge storms), a typed error exercises
+        # the fail-alone-before-joining-a-batch contract.
+        from min_tfs_client_tpu.robustness import faults
+
+        faults.point("batch.enqueue", queue=self._queue.name, size=n)
         self._scheduler.schedule(self._queue, task)
         # servelint: blocks delivery is the scheduler's hard contract —
         # the worker's finally and the window's bounded close() drain

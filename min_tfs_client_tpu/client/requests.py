@@ -80,16 +80,24 @@ class TensorServingClient:
         retry_backoff_max_s: float = 2.0,
     ) -> None:
         """`retry_unavailable=True` opts into bounded retry with
-        exponential backoff + full jitter on UNAVAILABLE, for IDEMPOTENT
-        Predict only — a routed fleet ejecting a dead backend then
-        becomes invisible to callers (docs/ROUTING.md). Off by default:
-        retrying is a policy decision, and non-idempotent calls
-        (decode_* sessioned signatures, config reloads) are never
+        exponential backoff + full jitter on UNAVAILABLE, for
+        RETRY-SAFE Predict only — a routed fleet ejecting a dead
+        backend then becomes invisible to callers (docs/ROUTING.md).
+        Retry-safe means provably so (robustness/retry.py): stateless
+        requests, and decode_step requests carrying a `step_ordinal`
+        (the server's at-most-once cache answers a duplicate resend
+        without re-ticking — this is what makes the router's
+        recovery-verdict UNAVAILABLE actually retryable for sessioned
+        streams). Off by default: retrying is a policy decision, and
+        ordinal-less sessioned calls and config reloads are never
         retried regardless."""
+        from min_tfs_client_tpu.robustness.retry import RetryPolicy
+
         self._retry_unavailable = retry_unavailable
-        self._max_retries = max(0, max_retries)
-        self._retry_backoff_s = retry_backoff_s
-        self._retry_backoff_max_s = retry_backoff_max_s
+        self._retry_policy = RetryPolicy(
+            max_retries=max(0, max_retries),
+            backoff_s=retry_backoff_s,
+            backoff_max_s=retry_backoff_max_s)
         if host.startswith(TPU_SCHEME):
             from min_tfs_client_tpu.client.inprocess import InProcessChannel
 
@@ -133,33 +141,38 @@ class TensorServingClient:
         UNAVAILABLE, propagate unchanged."""
         if not self._retry_unavailable:
             return call(request, timeout)
-        import random
         import time
 
-        for attempt in range(self._max_retries + 1):
+        policy = self._retry_policy
+        for attempt in range(policy.max_retries + 1):
             try:
                 return call(request, timeout)
             except grpc.RpcError as err:
-                if (attempt >= self._max_retries
+                if (attempt >= policy.max_retries
                         or err.code() != grpc.StatusCode.UNAVAILABLE):
                     raise
                 # Full jitter (not capped-equal steps): concurrent
                 # callers hitting the same eject must not re-converge
                 # on the recovering fleet in lockstep.
-                cap = min(self._retry_backoff_max_s,
-                          self._retry_backoff_s * (2 ** attempt))
-                time.sleep(random.uniform(0, cap))
+                time.sleep(policy.delay_s(attempt))
 
     @staticmethod
     def _predict_is_idempotent(signature_name: Optional[str],
                                input_dict) -> bool:
         """Sessioned decode traffic mutates server-side KV state
-        (models/t5.py decode_step advances the stream), so it is never
-        retried; everything else on the Predict surface is a pure
-        function of the request."""
-        if signature_name and signature_name.startswith("decode_"):
-            return False
-        return "session_id" not in input_dict
+        (models/t5.py decode_step advances the stream), so it is not
+        retried — UNLESS the step carries a `step_ordinal`, which makes
+        a resend provably at-most-once: the server caches the last
+        (ordinal, response) per session and answers a duplicate from
+        cache without re-ticking (docs/ROBUSTNESS.md). Everything else
+        on the Predict surface is a pure function of the request. The
+        verdict itself is the SHARED predicate the router's in-forward
+        retry also applies — one rule, one place."""
+        from min_tfs_client_tpu.robustness.retry import retry_safe_predict
+
+        return retry_safe_predict(signature_name,
+                                  "session_id" in input_dict,
+                                  "step_ordinal" in input_dict)
 
     def _fill_spec(self, request, model_name, model_version,
                    signature_name=None, version_label=None) -> None:
@@ -280,6 +293,7 @@ class TensorServingClient:
         session_id: Optional[bytes] = None,
         timeout: int = 60,
         model_version: Optional[int] = None,
+        step_ordinals: bool = False,
     ):
         """Generator over per-session incremental decode: yields one
         (B,) int32 token array per yielded step, driving the
@@ -287,7 +301,14 @@ class TensorServingClient:
         repeated-Predict surface; KV cache stays in server HBM between
         calls). Stops after `max_steps` or when every row finishes; the
         session is closed on normal exhaustion, generator close, and
-        errors alike."""
+        errors alike.
+
+        `step_ordinals=True` stamps each step with a monotonic
+        `step_ordinal` (1, 2, ...): the server executes each ordinal at
+        most once and replays a duplicate from cache, so with
+        `retry_unavailable=True` this stream survives ambiguous
+        failures (router fail-over, connection drops mid-step) without
+        ever skipping or double-emitting a token."""
         import uuid
 
         from min_tfs_client_tpu.tensor.codec import tensor_proto_to_ndarray
@@ -298,9 +319,13 @@ class TensorServingClient:
             timeout=timeout, model_version=model_version,
             signature_name="decode_init")
         try:
-            for _ in range(max_steps):
+            for step in range(max_steps):
+                inputs = {"session_id": sid}
+                if step_ordinals:
+                    inputs["step_ordinal"] = np.asarray(
+                        step + 1, np.int64)
                 resp = self.predict_request(
-                    model_name, {"session_id": sid}, timeout=timeout,
+                    model_name, inputs, timeout=timeout,
                     model_version=model_version,
                     signature_name="decode_step")
                 token = tensor_proto_to_ndarray(resp.outputs["token"])

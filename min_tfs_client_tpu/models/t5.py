@@ -1283,6 +1283,8 @@ def build_session_signatures(params: dict, config: T5Config, *, seq_len: int,
             kv_use_step_contract=kv_use_step_contract)
     from min_tfs_client_tpu.servables.decode_sessions import (
         DecodeSessionStore,
+        StepDeduper,
+        read_step_ordinal,
     )
     from min_tfs_client_tpu.servables.servable import Signature, TensorSpec
     from min_tfs_client_tpu.utils.status import ServingError
@@ -1291,6 +1293,10 @@ def build_session_signatures(params: dict, config: T5Config, *, seq_len: int,
 
     store = DecodeSessionStore(max_sessions=max_sessions,
                                ttl_s=session_ttl_s, metric_label="t5")
+    # is_live = the store's membership test: a LIVE session's guard is
+    # never LRU-evicted (only closed/exhausted/expired entries shed).
+    dedup = StepDeduper(max_entries=max(2 * max_sessions, 64),
+                        is_live=store.__contains__)
     prefill_fn, read_sampling, extra_specs = _sampling_session_helpers(
         config, max_decode_len, sampling, sampling_top_p)
     from min_tfs_client_tpu.observability import runtime as rt
@@ -1314,6 +1320,10 @@ def build_session_signatures(params: dict, config: T5Config, *, seq_len: int,
 
     def init_fn(inputs):
         sid = _session_id(inputs)
+        # A re-init over a previously-used id is a NEW stream: drop any
+        # surviving dedup entry or its first ordinal-guarded step would
+        # be judged against (or replayed from) the dead stream's cache.
+        dedup.forget(sid)
         ids = np.asarray(inputs["input_ids"]).astype(np.int32)
         args = (params, jax.device_put(ids))
         if read_sampling is not None:
@@ -1325,6 +1335,7 @@ def build_session_signatures(params: dict, config: T5Config, *, seq_len: int,
 
     def init_prefix_fn(inputs):
         sid = _session_id(inputs)
+        dedup.forget(sid)  # new stream: see init_fn
         ids = np.asarray(inputs["input_ids"]).astype(np.int32)
         if ids.shape[0] != 1:
             raise ServingError.invalid_argument(
@@ -1347,23 +1358,41 @@ def build_session_signatures(params: dict, config: T5Config, *, seq_len: int,
         from min_tfs_client_tpu.servables.servable import fetch_outputs
 
         sid = _session_id(inputs)
-        state, host_step = store.take(sid)
-        state, token = step_jit(params, state)
-        host_step += 1
-        if host_step < max_decode_len:
-            store.put(sid, (state, host_step))
-        else:
-            store.close(sid)  # cache exhausted: session ends
-        # One overlapped fetch: the step's whole wire cost is one token
-        # row (+ the finished flags) each way.
-        fetched = fetch_outputs(
-            {"token": token, "finished": state["finished"]})
-        return {"token": fetched["token"],
-                "finished": fetched["finished"].astype(np.int32),
-                "step": np.asarray(host_step, np.int32)}
+        # At-most-once guard BEFORE the store lookup: a duplicate
+        # resend of the final step must replay from cache even after
+        # exhaustion closed the session.
+        ordinal = read_step_ordinal(inputs)
+        cached = dedup.replay(sid, ordinal)  # marks ordinal in flight
+        if cached is not None:
+            return cached
+        try:
+            state, host_step = store.take(sid)
+            state, token = step_jit(params, state)
+            host_step += 1
+            if host_step < max_decode_len:
+                store.put(sid, (state, host_step))
+            else:
+                store.close(sid)  # cache exhausted: session ends
+            # One overlapped fetch: the step's whole wire cost is one
+            # token row (+ the finished flags) each way.
+            fetched = fetch_outputs(
+                {"token": token, "finished": state["finished"]})
+            out = {"token": fetched["token"],
+                   "finished": fetched["finished"].astype(np.int32),
+                   "step": np.asarray(host_step, np.int32)}
+        except BaseException:
+            # The failed attempt never produced a response: unmark so
+            # a retry of this ordinal executes instead of waiting on a
+            # commit that will never come.
+            dedup.abandon(sid, ordinal)
+            raise
+        dedup.commit(sid, ordinal, out)
+        return out
 
     def close_fn(inputs):
-        closed = store.close(_session_id(inputs))
+        sid = _session_id(inputs)
+        dedup.forget(sid)
+        closed = store.close(sid)
         return {"closed": np.asarray(int(closed), np.int32)}
 
     session_spec = TensorSpec("DT_STRING", ())
@@ -1380,6 +1409,10 @@ def build_session_signatures(params: dict, config: T5Config, *, seq_len: int,
     step_sig = Signature(
         fn=step_fn,
         inputs={"session_id": session_spec},
+        # step_ordinal is the OPTIONAL at-most-once guard: absent =
+        # historical wire behavior byte-for-byte (docs/ROBUSTNESS.md
+        # "Retry & idempotency").
+        optional_inputs={"step_ordinal": TensorSpec(np.int64, ())},
         outputs={"token": TensorSpec(np.int32, (None,)),
                  "finished": TensorSpec(np.int32, (None,)),
                  "step": TensorSpec(np.int32, ())},
@@ -1483,8 +1516,10 @@ def _build_pooled_session_signatures(params: dict, config: T5Config, *,
         DecodeSessionStore,
         PagedSlotPool,
         SlotPool,
+        StepDeduper,
         TickBatcher,
         default_paging,
+        read_step_ordinal,
     )
     from min_tfs_client_tpu.servables.servable import Signature, TensorSpec
     from min_tfs_client_tpu.utils.status import ServingError
@@ -1544,6 +1579,9 @@ def _build_pooled_session_signatures(params: dict, config: T5Config, *,
         max_sessions=max_slots, ttl_s=session_ttl_s,
         metric_label="t5-pooled",
         on_evict=lambda entry: pool.release_slot(entry[0]))
+    # is_live = store membership: a live session's guard never sheds.
+    dedup = StepDeduper(max_entries=max(2 * max_slots, 64),
+                        is_live=store.__contains__)
     from min_tfs_client_tpu.observability import runtime as rt
 
     prefill_jit = rt.instrument_jit(
@@ -1559,6 +1597,10 @@ def _build_pooled_session_signatures(params: dict, config: T5Config, *,
 
     def init_fn(inputs):
         sid = _session_id(inputs)
+        # A re-init over a previously-used id is a NEW stream: drop any
+        # surviving dedup entry (cache deliberately outlives exhaustion,
+        # so only close/init may clear it).
+        dedup.forget(sid)
         ids = np.asarray(inputs["input_ids"]).astype(np.int32)
         if ids.shape[0] != 1:
             raise ServingError.invalid_argument(
@@ -1580,6 +1622,7 @@ def _build_pooled_session_signatures(params: dict, config: T5Config, *,
 
     def init_prefix_fn(inputs):
         sid = _session_id(inputs)
+        dedup.forget(sid)  # new stream: see init_fn
         ids = np.asarray(inputs["input_ids"]).astype(np.int32)
         if ids.shape[0] != 1:
             raise ServingError.invalid_argument(
@@ -1623,41 +1666,61 @@ def _build_pooled_session_signatures(params: dict, config: T5Config, *,
 
     def step_fn(inputs):
         sid = _session_id(inputs)
-        slot, host_step = store.take(sid)
+        # At-most-once guard BEFORE the store lookup: a duplicate
+        # resend of the final step must replay from cache even after
+        # exhaustion released the slot.
+        ordinal = read_step_ordinal(inputs)
+        cached = dedup.replay(sid, ordinal)  # marks ordinal in flight
+        if cached is not None:
+            return cached
         try:
-            row = batcher.step(slot)
-            while row is PREFILL_PENDING:
-                # The slot is mid-prefix: each batcher round streamed one
-                # chunk; re-entering lets tick-mates' decode steps (and
-                # other prefills) interleave until this session's first
-                # real token arrives.
+            slot, host_step = store.take(sid)
+            try:
                 row = batcher.step(slot)
-        except Exception:
-            # The pool row may be in an undefined state; retire the slot
-            # rather than hand it to a future session mid-generation.
-            pool.release_slot(slot)
-            raise
-        if isinstance(row, Exception):
-            # Per-slot failure from the paged pool's tick (typed capacity
-            # errors, eviction under kv_evict_policy=close). slot_fatal
-            # distinguishes a dead session from a capacity REFUSAL whose
-            # state is intact and may retry after others close.
-            if getattr(row, "slot_fatal", True):
+                while row is PREFILL_PENDING:
+                    # The slot is mid-prefix: each batcher round
+                    # streamed one chunk; re-entering lets tick-mates'
+                    # decode steps (and other prefills) interleave
+                    # until this session's first real token arrives.
+                    row = batcher.step(slot)
+            except Exception:
+                # The pool row may be in an undefined state; retire the
+                # slot rather than hand it to a future session
+                # mid-generation.
                 pool.release_slot(slot)
-            else:
+                raise
+            if isinstance(row, Exception):
+                # Per-slot failure from the paged pool's tick (typed
+                # capacity errors, eviction under kv_evict_policy=
+                # close). slot_fatal distinguishes a dead session from
+                # a capacity REFUSAL whose state is intact and may
+                # retry after others close.
+                if getattr(row, "slot_fatal", True):
+                    pool.release_slot(slot)
+                else:
+                    store.put(sid, (slot, host_step))
+                raise row
+            host_step += 1
+            if host_step < max_decode_len:
                 store.put(sid, (slot, host_step))
-            raise row
-        host_step += 1
-        if host_step < max_decode_len:
-            store.put(sid, (slot, host_step))
-        else:
-            pool.release_slot(slot)  # cache exhausted: session ends
-        return {"token": row["token"].reshape(-1),
-                "finished": row["finished"].reshape(-1).astype(np.int32),
-                "step": np.asarray(host_step, np.int32)}
+            else:
+                pool.release_slot(slot)  # cache exhausted: session ends
+            out = {"token": row["token"].reshape(-1),
+                   "finished":
+                       row["finished"].reshape(-1).astype(np.int32),
+                   "step": np.asarray(host_step, np.int32)}
+        except BaseException:
+            # Failed attempt = no response to replay: unmark so a
+            # retry of this ordinal executes.
+            dedup.abandon(sid, ordinal)
+            raise
+        dedup.commit(sid, ordinal, out)
+        return out
 
     def close_fn(inputs):
-        closed = store.close(_session_id(inputs))  # on_evict frees slot
+        sid = _session_id(inputs)
+        dedup.forget(sid)
+        closed = store.close(sid)  # on_evict frees slot
         return {"closed": np.asarray(int(closed), np.int32)}
 
     session_spec = TensorSpec("DT_STRING", ())
@@ -1674,6 +1737,10 @@ def _build_pooled_session_signatures(params: dict, config: T5Config, *,
     step_sig = Signature(
         fn=step_fn,
         inputs={"session_id": session_spec},
+        # step_ordinal is the OPTIONAL at-most-once guard: absent =
+        # historical wire behavior byte-for-byte (docs/ROBUSTNESS.md
+        # "Retry & idempotency").
+        optional_inputs={"step_ordinal": TensorSpec(np.int64, ())},
         outputs={"token": TensorSpec(np.int32, (None,)),
                  "finished": TensorSpec(np.int32, (None,)),
                  "step": TensorSpec(np.int32, ())},

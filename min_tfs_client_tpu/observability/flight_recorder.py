@@ -156,6 +156,18 @@ class FlightRecorder:
             _log.exception("flight recorder dump failed")
             return None
 
+    def rearm(self) -> bool:
+        """Re-arm the one-shot dump latch WITHOUT touching the ring.
+        Multi-phase chaos runs need one latched dump per phase — the
+        first INTERNAL of phase 2 matters exactly as much as phase 1's,
+        and the crash-loop disk protection only requires the latch
+        within a phase. Exposed at `/monitoring/flightrecorder?rearm=1`
+        (backend and router alike); returns whether a dump had been
+        latched since the last re-arm."""
+        with self._lock:
+            was_dumped, self._dumped = self._dumped, False
+        return was_dumped
+
     def reset(self) -> None:
         """Test hook: empty the ring and re-arm the INTERNAL latch."""
         with self._lock:
@@ -173,6 +185,7 @@ to_json = recorder.to_json
 dump = recorder.dump
 configure = recorder.configure
 reset = recorder.reset
+rearm = recorder.rearm
 
 
 def record_state_transition(event) -> None:

@@ -1,0 +1,19 @@
+"""Chaos-grade robustness: deterministic fault injection, at-most-once
+decode-step retry policy, and the fleet_storm scenario harness.
+
+Three pieces, layered (docs/ROBUSTNESS.md):
+
+ * `faults` — named, zero-cost-when-disarmed injection points woven
+   through the router data plane, the server handlers/batching queues,
+   and the paged KV pools, armed by a seeded JSON fault plan
+   (`--fault_plan` / TPU_SERVING_FAULT_PLAN);
+ * `retry` — the bounded exponential-backoff-with-jitter policy shared
+   by the client SDK and the router, scoped to provably-safe cases;
+ * `storm` — the seeded, replayable open-loop scenario generator the
+   fleet_storm suites and bench leg drive, with invariants asserted
+   WHILE the fleet burns, not after.
+"""
+
+from min_tfs_client_tpu.robustness import faults  # noqa: F401
+
+__all__ = ["faults"]

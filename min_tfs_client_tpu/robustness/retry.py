@@ -1,0 +1,86 @@
+"""Bounded exponential-backoff-with-jitter retry, scoped to PROVABLE
+safety.
+
+Retrying is only honest when re-execution cannot double-apply. The
+serving surface has exactly three such cases (docs/ROBUSTNESS.md
+"Retry & idempotency"):
+
+ * stateless requests — pure functions of the request bytes;
+ * decode steps carrying a `step_ordinal` — the backend's at-most-once
+   cache (servables/decode_sessions.StepDeduper) answers a duplicate
+   resend from the cached response instead of re-ticking;
+ * connect-stage failures — the request provably never reached a
+   process that could execute it.
+
+Everything else (ordinal-less sessioned steps, inits, closes, config
+reloads) must NOT be retried by infrastructure; the error propagates
+and the CALLER decides. The same policy object drives the client SDK's
+opt-in retry and the router's in-forward retry, so the two tiers
+cannot drift on backoff discipline.
+
+Full jitter (uniform over [0, cap]), not equal steps: concurrent
+callers bounced by one ejection must not re-converge on the recovering
+fleet in lockstep.
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass
+from typing import Optional
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    """Attempts = 1 + max_retries; sleep before retry k (0-based) is
+    uniform(0, min(backoff_max_s, backoff_s * 2**k))."""
+
+    max_retries: int = 2
+    backoff_s: float = 0.02
+    backoff_max_s: float = 0.5
+
+    def delay_s(self, attempt: int,
+                rng: Optional[random.Random] = None) -> float:
+        cap = min(self.backoff_max_s, self.backoff_s * (2 ** attempt))
+        return (rng or random).uniform(0.0, cap)
+
+
+# The router's in-forward policy: small and fast — it only papers over
+# transient connection blips (a backend restarting its listener, an
+# injected connection drop); anything longer is the health poller's
+# job, and the client's own retry rides the typed UNAVAILABLE.
+ROUTER_FORWARD_POLICY = RetryPolicy(max_retries=2, backoff_s=0.02,
+                                    backoff_max_s=0.25)
+
+
+def next_forward_retry_delay_s(policy: Optional[RetryPolicy],
+                               code_name: str, attempt: int,
+                               rng: Optional[random.Random] = None
+                               ) -> Optional[float]:
+    """THE in-forward retry decision, shared by both router data
+    planes (the sleep/abort mechanics stay plane-specific): None =
+    propagate the error now; a float = sleep that long, then retry.
+    Only UNAVAILABLE is ever retryable (connection-level, provably
+    undelivered for the retry-safe request classes), and only within
+    the policy's attempt budget."""
+    if policy is None or code_name != "UNAVAILABLE" \
+            or attempt >= policy.max_retries:
+        return None
+    return policy.delay_s(attempt, rng)
+
+
+def retry_safe_predict(signature: Optional[str], sessioned: bool,
+                       has_step_ordinal: bool) -> bool:
+    """May infrastructure re-send this Predict after an UNAVAILABLE
+    whose delivery is unknown? The ONE predicate the client SDK and
+    both router data planes call, so the tiers cannot drift:
+
+     * an ordinal-guarded decode_step — the backend dedups a re-send;
+     * any other decode_* signature — never (mutates session state);
+     * everything else — exactly when it carries no session state
+       (pure function of the request bytes)."""
+    if signature == "decode_step" and has_step_ordinal:
+        return True
+    if signature and signature.startswith("decode_"):
+        return False
+    return not sessioned

@@ -66,6 +66,7 @@ from min_tfs_client_tpu.router.proxy import (
     _forwardable_metadata,
     _recovery_verdict,
     routing_info,
+    step_ordinal_guarded,
 )
 from min_tfs_client_tpu.utils.status import (
     ServingError,
@@ -80,6 +81,45 @@ log = logging.getLogger(__name__)
 # asyncio bookkeeping (no syscalls beyond the timerfd) while catching
 # any stall long enough to matter against a millisecond-scale forward.
 LAG_TICK_S = 0.1
+
+# ONE grpc.aio event loop per process — not a style preference, a crash
+# boundary: a second loop in one process races grpc's C-core
+# PollerCompletionQueue and dies with BlockingIOError deep inside the
+# cython layer, long after construction and only under load. This
+# registry turns that latent crash into a typed error AT START.
+# (pid, plane) so a fork doesn't inherit the parent's claim.
+_active_plane_lock = threading.Lock()
+_active_plane = None  # guarded_by: _active_plane_lock
+
+
+def _claim_aio_plane(plane) -> None:
+    import os
+
+    from min_tfs_client_tpu.utils.status import ServingError
+
+    global _active_plane
+    with _active_plane_lock:
+        pid = os.getpid()
+        if _active_plane is not None and _active_plane[0] == pid:
+            raise ServingError.failed_precondition(
+                "a grpc.aio data plane is already running in this "
+                "process: grpc's completion queue supports ONE asyncio "
+                "event loop per process (a second crashes "
+                "PollerCompletionQueue with BlockingIOError under "
+                "load). Run additional routers as separate processes, "
+                "or use --data_plane=threads for an in-process "
+                "second router.")
+        _active_plane = (pid, plane)
+
+
+def _release_aio_plane(plane) -> None:
+    import os
+
+    global _active_plane
+    with _active_plane_lock:
+        if _active_plane is not None and \
+                _active_plane == (os.getpid(), plane):
+            _active_plane = None
 
 
 class AioChannelPool:
@@ -147,7 +187,10 @@ class AioDataPlane:
 
     def start(self, port: int) -> int:
         """Boot the loop thread, bind the port, return the bound port.
-        Raises the boot error (e.g. port in use) in the caller."""
+        Raises the boot error (e.g. port in use) in the caller — and a
+        typed FAILED_PRECONDITION when this process already runs an aio
+        plane (the one-loop-per-process invariant; see _claim_aio_plane)."""
+        _claim_aio_plane(self)
         # servelint: thread-ok written once HERE, before the loop
         # thread spawns below; the loop thread only reads it
         self._requested_port = port
@@ -155,9 +198,11 @@ class AioDataPlane:
             target=self._run, name="router-aio-data-plane", daemon=True)
         self._thread.start()
         if not self._started.wait(timeout=30.0):
+            _release_aio_plane(self)
             raise RuntimeError("aio data plane failed to start within 30s")
         if self._boot_error is not None:
             self._thread.join(timeout=5.0)
+            _release_aio_plane(self)
             raise self._boot_error
         self._core.loop_health.set_mode("aio")
         return self._bound_port
@@ -174,6 +219,7 @@ class AioDataPlane:
             # channel closes; past that the daemon thread dies with the
             # process (same discipline as the threaded plane's stop).
             self._thread.join(timeout=grace + 10.0)
+        _release_aio_plane(self)
 
     def wait_for_termination(self) -> None:
         if self._thread is not None:
@@ -286,7 +332,8 @@ class AioDataPlane:
     async def _forward(self, backend: Backend, full_method: str,
                        request_bytes: bytes, context,
                        on_rpc_error=None,
-                       probing: bool = False) -> bytes:
+                       probing: bool = False,
+                       retry_safe: bool = False) -> bytes:
         """One awaited unary forward over the backend's persistent aio
         channel. Same contract as the threaded plane's _forward: client
         deadline propagated, hop metadata stripped, trace id injected
@@ -296,43 +343,82 @@ class AioDataPlane:
         connection-level UNAVAILABLE (candidate unreachable — says
         nothing about the session) instead of aborting, so the probe
         walk continues; DEADLINE_EXCEEDED aborts even while probing —
-        the request may have EXECUTED on that backend."""
+        the request may have EXECUTED on that backend. `retry_safe`
+        (stateless, or ordinal-guarded decode step) enables the bounded
+        in-forward UNAVAILABLE retry — the backoff is an awaited sleep,
+        so a retrying forward never stalls the loop's other in-flight
+        requests."""
         import grpc
 
+        from min_tfs_client_tpu.robustness import faults
+        from min_tfs_client_tpu.robustness.retry import (
+            ROUTER_FORWARD_POLICY,
+            next_forward_retry_delay_s,
+        )
+        from min_tfs_client_tpu.router.proxy import _record_forward_retry
+
         call = self._channels.unary_unary(backend, full_method)
-        timeout = context.time_remaining()
-        if timeout is None:
-            timeout = self._default_timeout_s
         metadata = _forwardable_metadata(context)
         trace = tracing.current_trace()
         if trace is not None:
             metadata = [(k, v) for k, v in metadata
                         if k.lower() != tracing.TRACE_HEADER]
             metadata.append((tracing.TRACE_HEADER, trace.trace_id))
+        policy = ROUTER_FORWARD_POLICY if retry_safe and not probing \
+            else None
         self._core.note_forward_start(backend.backend_id)
         try:
-            try:
-                with tracing.span("router/forward",
-                                  backend=backend.backend_id):
-                    with tracing.span("router/backend_wait",
+            attempt = 0
+            while True:
+                # Deadline re-read per attempt: a retry must spend the
+                # CLIENT'S remaining budget, not a fresh default.
+                timeout = context.time_remaining()
+                if timeout is None:
+                    timeout = self._default_timeout_s
+                try:
+                    try:
+                        fired = faults.point(
+                            "router.forward.pre",
+                            backend=backend.backend_id,
+                            method=full_method,
+                            probing=probing, attempt=attempt)
+                    except ServingError as exc:
+                        tracing.set_status(exc.code)
+                        await context.abort(to_grpc_code(exc.code),
+                                            exc.message)
+                    if fired is not None and fired.deadline_ms:
+                        timeout = fired.deadline_ms / 1e3
+                    with tracing.span("router/forward",
                                       backend=backend.backend_id):
-                        response = await call(request_bytes,
-                                              timeout=timeout,
-                                              metadata=metadata)
-            except grpc.RpcError as err:
-                code = err.code()
-                if probing and code in (grpc.StatusCode.NOT_FOUND,
-                                        grpc.StatusCode.UNAVAILABLE):
-                    raise
-                unreachable = code in (grpc.StatusCode.UNAVAILABLE,
-                                       grpc.StatusCode.DEADLINE_EXCEEDED)
-                self._core.note_result(backend, full_method,
-                                       error_code=code.name,
-                                       unreachable=unreachable)
-                tracing.set_status(code.name)
-                if on_rpc_error is not None:
-                    on_rpc_error(code, err.details() or code.name)
-                await context.abort(code, err.details() or code.name)
+                        with tracing.span("router/backend_wait",
+                                          backend=backend.backend_id):
+                            response = await call(request_bytes,
+                                                  timeout=timeout,
+                                                  metadata=metadata)
+                    break
+                except grpc.RpcError as err:
+                    code = err.code()
+                    if probing and code in (grpc.StatusCode.NOT_FOUND,
+                                            grpc.StatusCode.UNAVAILABLE):
+                        raise
+                    delay_s = next_forward_retry_delay_s(
+                        policy, code.name, attempt)
+                    if delay_s is not None:
+                        _record_forward_retry(backend, full_method,
+                                              attempt, trace)
+                        await asyncio.sleep(delay_s)
+                        attempt += 1
+                        continue
+                    unreachable = code in (
+                        grpc.StatusCode.UNAVAILABLE,
+                        grpc.StatusCode.DEADLINE_EXCEEDED)
+                    self._core.note_result(backend, full_method,
+                                           error_code=code.name,
+                                           unreachable=unreachable)
+                    tracing.set_status(code.name)
+                    if on_rpc_error is not None:
+                        on_rpc_error(code, err.details() or code.name)
+                    await context.abort(code, err.details() or code.name)
         finally:
             self._core.note_forward_done(backend.backend_id)
         self._core.note_result(backend, full_method)
@@ -422,9 +508,22 @@ class AioDataPlane:
                 decision, full_method, request_bytes, context,
                 model, session_id, trace, on_rpc_error)
         else:
+            # Provably-safe retry scope — the SHARED predicate
+            # (robustness/retry.py), same as the threaded plane.
+            from min_tfs_client_tpu.robustness.retry import (
+                retry_safe_predict,
+            )
+
+            # Ordinal scan gated on decode_step, same as the threaded
+            # plane: never a second wire walk for stateless payloads.
+            retry_safe = retry_safe_predict(
+                signature, session_id is not None,
+                signature == "decode_step"
+                and step_ordinal_guarded(request_bytes))
             response = await self._forward(decision.backend, full_method,
                                            request_bytes, context,
-                                           on_rpc_error=on_rpc_error)
+                                           on_rpc_error=on_rpc_error,
+                                           retry_safe=retry_safe)
         if session_id is not None and \
                 signature == _SESSION_CLOSE_SIGNATURE:
             self._core.session_closed(model, session_id)

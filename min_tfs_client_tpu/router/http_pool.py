@@ -138,6 +138,8 @@ class KeepAliveHTTPPool:
         tight bound; the forward path wants the default) — every
         request re-applies its own timeout, so a pooled connection
         never carries a previous caller's override."""
+        from min_tfs_client_tpu.robustness import faults
+
         conn, reused = self._checkout(host, port)
         sent = False
         try:
@@ -147,10 +149,21 @@ class KeepAliveHTTPPool:
                 # settimeout on a locally-dead socket object: nothing
                 # sent at all — unconditionally stale.
                 raise _STALE_CLOSE_ERRORS[0]("pooled socket unusable")
+            # connection_drop HERE = a closure surfacing mid-send
+            # (before the request is provably on the wire): retried on
+            # a fresh connection for ANY method when the socket was a
+            # reused keep-alive one — the exact discipline the storm
+            # suites pin (docs/ROBUSTNESS.md).
+            faults.point("http_pool.send", host=host, port=port,
+                         method=method, reused=reused)
             conn.request(method, path, body=body, headers=headers or {})
             # The request is fully on the wire: from here a closure
             # error no longer proves non-delivery.
             sent = True
+            # connection_drop HERE = the ambiguous post-send closure:
+            # retried for idempotent methods only; a POST propagates.
+            faults.point("http_pool.response", host=host, port=port,
+                         method=method, reused=reused)
             resp = conn.getresponse()
         except _STALE_CLOSE_ERRORS:
             conn.close()

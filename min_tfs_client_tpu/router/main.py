@@ -58,6 +58,10 @@ class RouterOptions:
     # Router-local request-trace ring capacity (/monitoring/traces);
     # 0 = TPU_SERVING_TRACE_RING env or the 256 default.
     trace_ring_size: int = 0
+    # Seeded JSON fault plan (path or inline JSON) arming the
+    # robustness/faults.py points in THIS router process; "" = honor
+    # TPU_SERVING_FAULT_PLAN, else disarmed (docs/ROBUSTNESS.md).
+    fault_plan: str = ""
 
 
 class RouterServer:
@@ -84,6 +88,12 @@ class RouterServer:
         flight_recorder.install_signal_handler()
         if opts.trace_ring_size:
             tracing.configure_ring(opts.trace_ring_size)
+        from min_tfs_client_tpu.robustness import faults
+
+        if opts.fault_plan:
+            faults.arm(opts.fault_plan)
+        else:
+            faults.arm_from_env()
         self.core = RouterCore(
             parse_backends(opts.backends),
             poll_interval_s=opts.health_poll_interval_s,
@@ -240,6 +250,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="capacity of the router-local request-trace "
                         "ring behind /monitoring/traces (0 = "
                         "TPU_SERVING_TRACE_RING env or the 256 default)")
+    p.add_argument("--fault_plan", default="",
+                   help="seeded JSON fault plan (path or inline JSON) "
+                        "arming the deterministic fault-injection "
+                        "points in this router — TESTING/CHAOS ONLY "
+                        "(docs/ROBUSTNESS.md). Empty = honor "
+                        "TPU_SERVING_FAULT_PLAN, else disarmed")
     return p
 
 
@@ -259,6 +275,7 @@ def options_from_args(args) -> RouterOptions:
         grpc_max_threads=args.grpc_max_threads,
         flight_recorder_dir=args.flight_recorder_dir,
         trace_ring_size=args.trace_ring_size,
+        fault_plan=args.fault_plan,
     )
 
 

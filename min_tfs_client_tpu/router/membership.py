@@ -326,6 +326,22 @@ class MembershipTable:
             backends = [e.backend for e in self._entries.values()]
 
         def probe(backend):
+            # Import OUTSIDE the quiet except: a real import failure of
+            # the robustness package must crash the poll pass loudly
+            # (poll_once's own handler logs it), never silently read as
+            # "every backend unreachable".
+            from min_tfs_client_tpu.robustness import faults
+
+            try:
+                # An injected poll fault reads as a health-plane
+                # failure for THIS backend: error/connection_drop =
+                # unreachable probe (drives ejection), delay = a slow
+                # plane (drives eject-latency storms). Quiet on
+                # purpose — no log.exception for a planned fault.
+                faults.point("membership.poll",
+                             backend=backend.backend_id)
+            except Exception:  # noqa: BLE001 - injected unreachability
+                return UNREACHABLE, None
             try:
                 return self._poller(backend)
             except Exception:  # noqa: BLE001 - a poller bug reads as dead

@@ -187,6 +187,28 @@ def _scan_routing_info(data, *, multi_inference: bool,
     return model, session_id, signature
 
 
+def step_ordinal_guarded(request_bytes) -> bool:
+    """True when a Predict request's inputs map carries a
+    `step_ordinal` entry — the at-most-once guard that makes a
+    retry-on-UNAVAILABLE provably safe for a sessioned decode step
+    (docs/ROBUSTNESS.md). Same zero-copy wire scan as routing_info;
+    only consulted for sessioned decode_step requests, which are tiny
+    (a session id and an ordinal), so the second pass costs nothing
+    measurable."""
+    try:
+        for field, wire_type, value in _iter_fields(
+                memoryview(request_bytes)):
+            if field != 2 or wire_type != 2:
+                continue
+            for efield, ewt, evalue in _iter_fields(value):
+                if efield == 1 and ewt == 2 and \
+                        bytes(evalue) == b"step_ordinal":
+                    return True
+        return False
+    except Exception:  # noqa: BLE001 - malformed = unguarded
+        return False
+
+
 def _recovery_verdict(first_not_found,
                       unreachable: int) -> tuple:
     """Terminal (code, details) for a pin-recovery walk that exhausted
@@ -208,6 +230,23 @@ def _recovery_verdict(first_not_found,
             first_not_found.details() or "unknown session")
 
 
+def _record_forward_retry(backend: Backend, full_method: str,
+                          attempt: int, trace) -> None:
+    """Every in-forward retry is black-box + trace evidence (shared by
+    both data planes): silent retries would mask the very instability a
+    storm exists to surface."""
+    from min_tfs_client_tpu.observability import flight_recorder
+    from min_tfs_client_tpu.server import metrics
+
+    metrics.router_forward_retries.increment(backend.backend_id)
+    flight_recorder.record(
+        "router_retry", backend=backend.backend_id,
+        method=full_method, attempt=attempt,
+        trace_id=trace.trace_id if trace else "")
+    if trace is not None:
+        trace.annotate(forward_retries=attempt + 1)
+
+
 class GrpcProxy:
     """Generic raw-bytes handlers for the three serving services plus
     the router's own grpc.health.v1."""
@@ -222,7 +261,8 @@ class GrpcProxy:
     def _forward(self, backend: Backend, full_method: str,
                  request_bytes: bytes, context,
                  on_rpc_error=None,
-                 probing: bool = False) -> bytes:
+                 probing: bool = False,
+                 retry_safe: bool = False) -> bytes:
         """`on_rpc_error(code, details)` runs before the abort with the
         BACKEND'S status — the caller's chance to undo routing side
         effects selectively and to record the failure (the abort
@@ -235,14 +275,20 @@ class GrpcProxy:
         walk can continue; DEADLINE_EXCEEDED still aborts even while
         probing — the request may have EXECUTED on that backend, and
         walking on could double-apply a decode step elsewhere's
-        NOT_FOUND would mask."""
+        NOT_FOUND would mask. `retry_safe` (stateless request, or an
+        ordinal-guarded decode step the backend dedups) enables the
+        bounded in-forward UNAVAILABLE retry — robustness/retry.py;
+        never combined with probing (the walk IS the retry there)."""
         import grpc
+
+        from min_tfs_client_tpu.robustness import faults
+        from min_tfs_client_tpu.robustness.retry import (
+            ROUTER_FORWARD_POLICY,
+            next_forward_retry_delay_s,
+        )
 
         # Cached multicallable (None serializers: raw bytes in/out)
         call = self._core.channels.unary_unary(backend, full_method)
-        timeout = context.time_remaining()
-        if timeout is None:
-            timeout = self._default_timeout_s
         metadata = _forwardable_metadata(context)
         trace = tracing.current_trace()
         if trace is not None:
@@ -252,29 +298,70 @@ class GrpcProxy:
             metadata = [(k, v) for k, v in metadata
                         if k.lower() != tracing.TRACE_HEADER]
             metadata.append((tracing.TRACE_HEADER, trace.trace_id))
+        policy = ROUTER_FORWARD_POLICY if retry_safe and not probing \
+            else None
         self._core.note_forward_start(backend.backend_id)
         try:
-            try:
-                with tracing.span("router/forward",
-                                  backend=backend.backend_id):
-                    with tracing.span("router/backend_wait",
+            attempt = 0
+            while True:
+                # Deadline re-read per attempt: a retry must spend the
+                # CLIENT'S remaining budget, not a fresh default.
+                timeout = context.time_remaining()
+                if timeout is None:
+                    timeout = self._default_timeout_s
+                try:
+                    try:
+                        fired = faults.point(
+                            "router.forward.pre",
+                            backend=backend.backend_id,
+                            method=full_method,
+                            probing=probing, attempt=attempt)
+                    except ServingError as exc:
+                        # A typed-error fault surfaces exactly like a
+                        # routing-layer error would: typed on the wire.
+                        tracing.set_status(exc.code)
+                        context.abort(to_grpc_code(exc.code),
+                                      exc.message)
+                    if fired is not None and fired.deadline_ms:
+                        timeout = fired.deadline_ms / 1e3
+                    with tracing.span("router/forward",
                                       backend=backend.backend_id):
-                        response = call(request_bytes, timeout=timeout,
-                                        metadata=metadata)
-            except grpc.RpcError as err:
-                code = err.code()
-                if probing and code in (grpc.StatusCode.NOT_FOUND,
-                                        grpc.StatusCode.UNAVAILABLE):
-                    raise
-                unreachable = code in (grpc.StatusCode.UNAVAILABLE,
-                                       grpc.StatusCode.DEADLINE_EXCEEDED)
-                self._core.note_result(backend, full_method,
-                                       error_code=code.name,
-                                       unreachable=unreachable)
-                tracing.set_status(code.name)
-                if on_rpc_error is not None:
-                    on_rpc_error(code, err.details() or code.name)
-                context.abort(code, err.details() or code.name)
+                        with tracing.span("router/backend_wait",
+                                          backend=backend.backend_id):
+                            response = call(request_bytes,
+                                            timeout=timeout,
+                                            metadata=metadata)
+                    break
+                except grpc.RpcError as err:
+                    code = err.code()
+                    if probing and code in (grpc.StatusCode.NOT_FOUND,
+                                            grpc.StatusCode.UNAVAILABLE):
+                        raise
+                    delay_s = next_forward_retry_delay_s(
+                        policy, code.name, attempt)
+                    if delay_s is not None:
+                        # Provably-safe bounded retry: the backend never
+                        # delivered a response, the request is stateless
+                        # or ordinal-deduped, and the backoff is
+                        # jittered so a fleet-wide blip doesn't
+                        # re-converge in lockstep.
+                        _record_forward_retry(backend, full_method,
+                                              attempt, trace)
+                        import time as _time
+
+                        _time.sleep(delay_s)
+                        attempt += 1
+                        continue
+                    unreachable = code in (
+                        grpc.StatusCode.UNAVAILABLE,
+                        grpc.StatusCode.DEADLINE_EXCEEDED)
+                    self._core.note_result(backend, full_method,
+                                           error_code=code.name,
+                                           unreachable=unreachable)
+                    tracing.set_status(code.name)
+                    if on_rpc_error is not None:
+                        on_rpc_error(code, err.details() or code.name)
+                    context.abort(code, err.details() or code.name)
         finally:
             self._core.note_forward_done(backend.backend_id)
         self._core.note_result(backend, full_method)
@@ -419,9 +506,25 @@ class GrpcProxy:
                 decision, full_method, request_bytes, context,
                 model, session_id, trace, on_rpc_error)
         else:
+            # Provably-safe retry scope — the SHARED predicate
+            # (robustness/retry.py): stateless requests are pure; an
+            # ordinal-guarded decode step is deduped server-side.
+            # Everything else propagates its first UNAVAILABLE.
+            from min_tfs_client_tpu.robustness.retry import (
+                retry_safe_predict,
+            )
+
+            # The ordinal scan runs ONLY for decode_step (tiny
+            # requests); a stateless multi-MB Predict must not pay a
+            # second wire walk whose answer the predicate ignores.
+            retry_safe = retry_safe_predict(
+                signature, session_id is not None,
+                signature == "decode_step"
+                and step_ordinal_guarded(request_bytes))
             response = self._forward(decision.backend, full_method,
                                      request_bytes, context,
-                                     on_rpc_error=on_rpc_error)
+                                     on_rpc_error=on_rpc_error,
+                                     retry_safe=retry_safe)
         if session_id is not None and \
                 signature == _SESSION_CLOSE_SIGNATURE:
             self._core.session_closed(model, session_id)
@@ -585,10 +688,9 @@ def rest_route_request(core: RouterCore, method: str, path: str,
     if method == "GET" and bare == rest_mod.TRACES_DEFAULT_PATH:
         return _router_traces_reply(core, _query)
     if method == "GET" and bare == rest_mod.FLIGHT_RECORDER_PATH:
-        from min_tfs_client_tpu.observability import flight_recorder
-
-        return 200, "application/json", json.dumps(
-            flight_recorder.to_json()).encode()
+        # Shared implementation with the backend endpoint — ?rearm=1
+        # re-arms the router's one-shot dump latch identically.
+        return rest_mod._flight_recorder_reply(_query)
     if method == "GET" and bare == rest_mod.HEALTHZ_PATH:
         ok = core.membership.poll_thread_alive()
         return ((200 if ok else 503), "application/json",
@@ -656,6 +758,14 @@ def _rest_forward(core: RouterCore, method: str, path: str,
         fwd_headers[tracing.TRACE_HEADER] = trace.trace_id
     core.note_forward_start(backend.backend_id)
     try:
+        from min_tfs_client_tpu.robustness import faults
+
+        # connection_drop / delay faults here exercise the 503 path and
+        # the pool's discipline from the router side; raised errors fall
+        # into the unreachable handling below like a real socket death.
+        faults.point("router.rest.forward.pre",
+                     backend=backend.backend_id, path=path,
+                     method=method)
         with tracing.span("router/forward", backend=backend.backend_id):
             with tracing.span("router/backend_wait",
                               backend=backend.backend_id):

@@ -319,6 +319,12 @@ class DecodeSessionStore:
         with self._lock:
             return len(self._states)
 
+    def __contains__(self, session_id: bytes) -> bool:
+        """Membership WITHOUT the TTL sweep (a liveness probe must not
+        mutate) — the StepDeduper's is_live oracle."""
+        with self._lock:
+            return session_id in self._states
+
     def put(self, session_id: bytes, state: object) -> None:
         """Insert/refresh a session. A NEW session past capacity raises
         RESOURCE_EXHAUSTED after TTL sweeping (backpressure at init time;
@@ -383,6 +389,175 @@ class DecodeSessionStore:
                 self._on_evict(state)
         if expired:
             self._report()
+
+
+class StepDeduper:
+    """At-most-once decode steps: the per-session (ordinal, response)
+    cache that makes retry-on-UNAVAILABLE honest for sessioned traffic.
+
+    A decode step that fails AMBIGUOUSLY (connection died after the
+    request was fully sent) may or may not have ticked the session —
+    resending it blind could advance the stream twice, which is why the
+    router and client refuse to retry bare sessioned requests
+    (docs/ROUTING.md, http_pool's idempotency discipline). The ordinal
+    closes that hole from the SERVER side: a step request carrying a
+    monotonic per-session `step_ordinal` is executed at most once —
+
+     * a NEW ordinal (first seen, or last+1) ticks and caches the
+       response under that ordinal;
+     * the SAME ordinal again (a retry of an ambiguous failure) returns
+       the cached response — bit-identical bytes, no tick;
+     * anything else (gaps, rewinds) is a typed FAILED_PRECONDITION:
+       the client's bookkeeping is broken and silently ticking would
+       corrupt the stream it was trying to protect.
+
+    Ordinal-less steps bypass this entirely (today's wire behavior,
+    byte-for-byte); mixing guarded and bare steps on one session voids
+    the guard for the bare steps only. Entries survive session
+    exhaustion (the LAST step's retry must still answer from cache
+    after the pool slot is gone) and are dropped on decode_close, on a
+    re-init of the same id, or — past the size bound — by shedding
+    DEAD sessions' entries oldest-first. With `is_live` wired (the
+    session store's membership test), a LIVE session's entry is NEVER
+    silently evicted: voiding a live guard would turn the advertised
+    safe-retry into exactly the double-tick it exists to prevent, so
+    the cache prefers growing to the live-session count (itself
+    bounded by the store's capacity backpressure) over breaking the
+    contract. Every shed entry is flight-recorded."""
+
+    def __init__(self, max_entries: int = 256, is_live=None):
+        self._lock = threading.Lock()
+        self._max = max(8, int(max_entries))
+        self._is_live = is_live
+        # sid -> (ordinal, outputs); OrderedDict as LRU.
+        self._cache: "collections.OrderedDict[bytes, tuple]" = \
+            collections.OrderedDict()  # guarded_by: self._lock
+        # sid -> ordinal currently EXECUTING (replay marked it, commit/
+        # abandon clears it): a duplicate racing the original mid-tick
+        # must answer typed-retryable, not fall through to the store's
+        # NOT_FOUND ("a step is in flight") and kill a healthy stream.
+        self._pending: dict[bytes, int] = {}  # guarded_by: self._lock
+
+    def replay(self, session_id: bytes,
+               ordinal: Optional[int]) -> Optional[dict]:
+        """The cached response when `ordinal` is a duplicate resend;
+        None when the step should execute — in which case the ordinal
+        is marked IN FLIGHT until commit() or abandon(). A duplicate
+        arriving while the original still executes raises a typed
+        retryable UNAVAILABLE (the retry tiers back off and collect the
+        cached response once the original commits). Out-of-order
+        ordinals raise FAILED_PRECONDITION. `ordinal` None = unguarded
+        step: always execute, never marked."""
+        if ordinal is None:
+            return None
+        if ordinal < 1:
+            raise ServingError.invalid_argument(
+                f"step_ordinal must be >= 1, got {ordinal}")
+        last = None
+        with self._lock:
+            if self._pending.get(session_id) == ordinal:
+                raise ServingError.unavailable(
+                    f"step_ordinal {ordinal} is already executing for "
+                    "this session (the first attempt is in flight) — "
+                    "retry to collect its response")
+            entry = self._cache.get(session_id)
+            if entry is not None:
+                self._cache.move_to_end(session_id)
+                last, outputs = entry
+                if ordinal == last:
+                    return outputs  # duplicate resend: cached, no tick
+            if last is None or ordinal == last + 1:
+                self._pending[session_id] = ordinal
+                return None  # first guarded step / the next step
+        raise ServingError.failed_precondition(
+            f"step_ordinal {ordinal} is out of order for this session "
+            f"(last executed: {last}; a retry must resend {last}, the "
+            f"next step must send {last + 1})")
+
+    def abandon(self, session_id: bytes,
+                ordinal: Optional[int]) -> None:
+        """The marked step FAILED before producing a response: clear
+        the in-flight marker so a retry of the same ordinal executes
+        (the failed attempt never ticked — errors propagate before the
+        store re-parks state)."""
+        if ordinal is None:
+            return
+        with self._lock:
+            if self._pending.get(session_id) == ordinal:
+                del self._pending[session_id]
+
+    def commit(self, session_id: bytes, ordinal: Optional[int],
+               outputs: dict) -> None:
+        """Record an EXECUTED step's response before it leaves the
+        server — a resend must replay even when the first reply never
+        reached the client."""
+        if ordinal is None:
+            return
+        shed = []
+        with self._lock:
+            if self._pending.get(session_id) == ordinal:
+                del self._pending[session_id]
+            self._cache[session_id] = (ordinal, outputs)
+            self._cache.move_to_end(session_id)
+            if len(self._cache) > self._max:
+                for key in list(self._cache):
+                    if len(self._cache) <= self._max:
+                        break
+                    if key == session_id:
+                        continue
+                    if self._is_live is not None:
+                        if self._is_live(key):
+                            # NEVER void a live session's guard — see
+                            # the class docstring; the cache grows
+                            # toward the (store-bounded) live count
+                            # instead.
+                            continue
+                        del self._cache[key]
+                        shed.append(key)
+                    else:
+                        # No liveness oracle (standalone use): plain
+                        # LRU, still observable below.
+                        del self._cache[key]
+                        shed.append(key)
+        for key in shed:
+            try:
+                from min_tfs_client_tpu.observability import (
+                    flight_recorder,
+                )
+
+                flight_recorder.record(
+                    "step_dedup_evict",
+                    session=key.decode("utf-8", "replace")[:64])
+            except Exception:  # pragma: no cover - evidence best-effort
+                pass
+
+    def forget(self, session_id: bytes) -> None:
+        with self._lock:
+            self._cache.pop(session_id, None)
+            self._pending.pop(session_id, None)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._cache)
+
+
+def read_step_ordinal(inputs) -> Optional[int]:
+    """The optional `step_ordinal` wire input as a python int (scalar,
+    any integer dtype), or None when the request doesn't carry it."""
+    import numpy as np
+
+    raw = inputs.get("step_ordinal")
+    if raw is None:
+        return None
+    arr = np.asarray(raw).reshape(-1)
+    if arr.size != 1:
+        raise ServingError.invalid_argument(
+            f"step_ordinal must hold exactly one value, got {arr.size}")
+    try:
+        return int(arr[0])
+    except (TypeError, ValueError):
+        raise ServingError.invalid_argument(
+            f"step_ordinal must be an integer, got {arr.dtype}")
 
 
 class SlotPool:
@@ -473,8 +648,13 @@ class SlotPool:
         after a single overlapped fetch."""
         import numpy as np
 
+        from min_tfs_client_tpu.robustness import faults
         from min_tfs_client_tpu.servables.servable import fetch_outputs
 
+        # Pre-tick faultpoint: a delay stretches every tick-mate's step
+        # (the TickBatcher propagates one leader's fate to all riders),
+        # a typed error fails the whole tick loudly.
+        faults.point("backend.tick.pre", slots=len(slots))
         t0 = time.perf_counter()
         with self._lock:
             active = np.zeros((self.max_slots,), bool)
@@ -526,6 +706,19 @@ class PageAllocator:
 
     def try_alloc(self, n: int = 1) -> Optional[list[int]]:
         """n pages or None — callers with an eviction policy retry."""
+        from min_tfs_client_tpu.robustness import faults
+
+        # page_pressure fault = "the arena is full" WITHOUT filling
+        # HBM: the caller walks its real eviction policy (swap/close/
+        # refuse), which is exactly the path KV-pressure storms exist
+        # to exercise. Gated on armed() so the DISARMED allocation path
+        # pays one module-global read, never a lock just for the label.
+        if faults.armed():
+            with self._lock:
+                label = self._label
+            fired = faults.point("kv.alloc", label=label, n=n)
+            if fired is not None and fired.page_pressure:
+                return None
         with self._lock:
             if len(self._free) < n:
                 return None
@@ -1259,9 +1452,14 @@ class PagedSlotPool:
         tick-mates' decodes interleave with the remaining chunks)."""
         import numpy as np
 
+        from min_tfs_client_tpu.robustness import faults
         from min_tfs_client_tpu.servables.servable import fetch_outputs
 
         slots = list(slots)
+        # Pre-tick faultpoint, OUTSIDE the pool lock: a delay models a
+        # slow device round; a typed error fails the whole tick (the
+        # TickBatcher propagates it to every waiter).
+        faults.point("backend.tick.pre", slots=len(slots), paged=True)
         results: dict[int, object] = {}
         live: list[int] = []
         outputs = None

@@ -148,6 +148,15 @@ class Signature:
     fn: Callable[..., dict[str, object]]
     inputs: dict[str, TensorSpec]
     outputs: dict[str, TensorSpec]
+    # OPTIONAL wire inputs: accepted and validated when the request
+    # carries them, never required. `inputs` stays all-mandatory (the
+    # reference's contract, and what the batching merge relies on), so
+    # an optional field must not live there — this is how a signature
+    # grows a wire-compatible extension (e.g. decode_step's
+    # `step_ordinal` at-most-once guard) without forking its name.
+    # Host-only: device signatures jit over a fixed input tree, and the
+    # batching merge has no notion of per-request-optional aliases.
+    optional_inputs: Optional[dict[str, TensorSpec]] = None
     params: Optional[object] = dc_field(default=None, repr=False,
                                         compare=False)
     method_name: str = PREDICT_METHOD_NAME
@@ -208,6 +217,17 @@ class Signature:
                                              compare=False)
 
     def __post_init__(self):
+        if self.optional_inputs:
+            if not self.on_host or self.batched:
+                raise ValueError(
+                    "optional_inputs is supported on host, non-batched "
+                    "signatures only (device jit and the batching merge "
+                    "both assume a fixed mandatory input tree)")
+            overlap = set(self.optional_inputs) & set(self.inputs)
+            if overlap:
+                raise ValueError(
+                    f"optional_inputs {sorted(overlap)} duplicate "
+                    "mandatory inputs")
         if self.transfer_casts:
             import jax.numpy as jnp
 
@@ -307,7 +327,8 @@ class Signature:
             raise ServingError.invalid_argument(
                 "Request inputs do not match required inputs for the "
                 f"signature. Missing: {sorted(missing)}")
-        extra = set(inputs) - set(self.inputs)
+        extra = set(inputs) - set(self.inputs) \
+            - set(self.optional_inputs or ())
         if extra:
             raise ServingError.invalid_argument(
                 f"inputs contain aliases not in the signature: {sorted(extra)}")
@@ -317,7 +338,11 @@ class Signature:
                     f"output_filter name {name!r} is not in the signature "
                     f"outputs {sorted(self.outputs)}")
         arrays = {}
-        for alias, spec in self.inputs.items():
+        to_check = dict(self.inputs)
+        for alias, spec in (self.optional_inputs or {}).items():
+            if alias in inputs:  # present: validated like any input
+                to_check[alias] = spec
+        for alias, spec in to_check.items():
             arr = np.asarray(inputs[alias])
             if spec.dtype.is_string:
                 if arr.dtype.kind not in ("O", "S", "U"):

@@ -76,6 +76,17 @@ def _instrumented(api: str):
                                    if spec is not None else "")) as trace:
                     if trace is not None:
                         trace_id = trace.trace_id
+                    # Inside the trace + error funnel: an injected
+                    # typed error counts, records, and surfaces on the
+                    # wire exactly like a real handler failure; a delay
+                    # lands in this request's stage timeline.
+                    from min_tfs_client_tpu.robustness import faults
+
+                    faults.point(
+                        "backend.handle.pre", api=api,
+                        model=spec.name if spec is not None else "",
+                        signature=(spec.signature_name
+                                   if spec is not None else ""))
                     response = fn(self, request)
             except Exception as exc:
                 # Same mapping the transports apply to the wire status

@@ -178,6 +178,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="capacity of the request-trace ring behind "
                         "/monitoring/traces (0 = TPU_SERVING_TRACE_RING "
                         "env or the 256 default)")
+    p.add_argument("--fault_plan", default="",
+                   help="seeded JSON fault plan (path or inline JSON) "
+                        "arming the deterministic fault-injection "
+                        "points in this process — TESTING/CHAOS ONLY "
+                        "(docs/ROBUSTNESS.md). Empty = honor "
+                        "TPU_SERVING_FAULT_PLAN, else disarmed "
+                        "(zero-cost)")
     p.add_argument("--drain_grace_seconds", type=float, default=0.0,
                    help="graceful-drain window on stop()/SIGTERM: the "
                         "health plane flips NOT_SERVING immediately, "
@@ -245,6 +252,7 @@ def options_from_args(args) -> ServerOptions:
         flight_recorder_dir=args.flight_recorder_dir,
         trace_ring_size=args.trace_ring_size,
         drain_grace_seconds=args.drain_grace_seconds,
+        fault_plan=args.fault_plan,
     )
 
 

@@ -271,6 +271,13 @@ router_session_recoveries = Counter(
     "and the current view's first choice answered NOT_FOUND), by the "
     "backend that actually held the session. Nonzero under a stable "
     "view means replicas disagree on placement.", ("backend",))
+router_forward_retries = Counter(
+    ":tpu/serving/router_forward_retries",
+    "In-forward UNAVAILABLE retries the router performed for provably-"
+    "safe requests (stateless, or decode steps carrying the at-most-"
+    "once step_ordinal guard), by backend. A sustained nonzero rate "
+    "means a backend's listener is flapping faster than the health "
+    "poller ejects it (docs/ROBUSTNESS.md).", ("backend",))
 router_event_loop_lag_ms = Gauge(
     ":tpu/serving/router_event_loop_lag_ms",
     "Sampled scheduling lag of the router's asyncio data-plane event "

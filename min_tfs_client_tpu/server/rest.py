@@ -341,10 +341,22 @@ def _runtime_reply(query: str) -> tuple[int, str, bytes]:
 
 
 def _flight_recorder_reply(query: str) -> tuple[int, str, bytes]:
-    """GET /monitoring/flightrecorder — the live event ring as JSON."""
+    """GET /monitoring/flightrecorder[?rearm=1] — the live event ring
+    as JSON. `rearm=1` additionally re-arms the one-shot dump latch
+    (multi-phase chaos runs latch one dump PER PHASE; the reply's
+    `was_latched` says whether the latch had fired since the last
+    re-arm). SIGUSR2 semantics are unchanged: it dumps on demand
+    without consuming the latch."""
+    from urllib.parse import parse_qs
+
     from min_tfs_client_tpu.observability import flight_recorder
 
-    return _json_reply(200, flight_recorder.to_json())
+    payload = flight_recorder.to_json()
+    params = parse_qs(query)
+    if params.get("rearm", [""])[0] not in ("", "0"):
+        payload["rearmed"] = True
+        payload["was_latched"] = flight_recorder.rearm()
+    return _json_reply(200, payload)
 
 
 def _sessions_reply(query: str) -> tuple[int, str, bytes]:

@@ -166,6 +166,10 @@ class ServerOptions:
     # router cannot move them; it can only stop sending NEW sessions.
     # 0 = flip and stop without waiting for sessions (old behavior).
     drain_grace_seconds: float = 0.0
+    # Seeded JSON fault plan (a path, or inline JSON) arming the
+    # robustness/faults.py injection points in THIS process; "" = also
+    # honor TPU_SERVING_FAULT_PLAN, else disarmed (docs/ROBUSTNESS.md).
+    fault_plan: str = ""
 
     def effective_inter_op_parallelism(self) -> int:
         """<= 0 = auto (leave grpc_max_threads alone; TF spells auto as
@@ -290,6 +294,14 @@ class Server:
             from min_tfs_client_tpu.observability import tracing
 
             tracing.configure_ring(opts.trace_ring_size)
+        # Fault injection arms BEFORE the core builds, so load-path
+        # points fire too; a malformed plan fails the boot loudly.
+        from min_tfs_client_tpu.robustness import faults
+
+        if opts.fault_plan:
+            faults.arm(opts.fault_plan)
+        else:
+            faults.arm_from_env()
 
         # servelint: thread-ok published exactly once, BEFORE the
         # config-poll thread spawns below; the poll loop only reads it

@@ -1,0 +1,408 @@
+"""fleet_storm: the chaos harness ROADMAP item 7 asked for — a seeded,
+replayable open-loop storm against a real subprocess fleet, with
+invariants asserted WHILE the fleet burns (robustness/storm.py).
+
+Two legs:
+
+ * tier-1 SMOKE (always on): a small seeded storm — open-loop
+   stateless + ordinal-guarded sessions, one mid-run SIGKILL — against
+   2 backends + 1 router. This is what keeps the slow storm from
+   rotting undetected.
+ * the FULL storm (marked slow): 3 backends + a mid-run joiner behind
+   2 router replicas, burst arrivals, drain + kill + join chaos, a
+   delay/page-pressure fault plan armed on the backends, and a
+   KV-pressure leg of paged-t5 sessions whose token streams are
+   asserted bit-exact against pre-storm references while the pool
+   swaps under injected pressure.
+
+The regression bar (PERF.md round-13): with a drain-race, pin-race, or
+pressure-thrash bug re-planted, these invariants fail loudly — the
+drain leg in particular dies the moment a draining backend abandons a
+live session.
+"""
+
+import json
+import pathlib
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from min_tfs_client_tpu.robustness.storm import (
+    FleetStorm,
+    StormConfig,
+    T5StormSpec,
+    generate_schedule,
+)
+from tests import fixtures
+
+pytestmark = pytest.mark.integration
+
+_ACTIVE_PROCS: set = set()
+
+
+@pytest.fixture(autouse=True)
+def _proc_watchdog():
+    fired = threading.Event()
+
+    def _fire():
+        fired.set()
+        for proc in list(_ACTIVE_PROCS):
+            proc.kill()
+
+    timer = threading.Timer(420, _fire)
+    timer.daemon = True
+    timer.start()
+    yield
+    timer.cancel()
+    assert not fired.is_set(), \
+        "proc_timeout watchdog fired after 420s; fleet was killed"
+
+
+class StormFleet:
+    """Subprocess fleet for storms: N backends (+ optional reserved
+    joiner) behind M router subprocesses, with the chaos callbacks the
+    storm schedule executes."""
+
+    def __init__(self, tmp: pathlib.Path, *, n_backends: int,
+                 n_routers: int = 1, reserve_joiner: bool = False,
+                 drain_grace_s: float = 30.0,
+                 backend_extra_args=(), backend_env_plan=None,
+                 config_file=None):
+        self.tmp = tmp
+        self.model_root = tmp / "model"
+        fixtures.write_session_jax_servable(self.model_root)
+        self.monitoring = tmp / "monitoring.config"
+        self.monitoring.write_text("prometheus_config { enable: true }\n")
+        self.drain_grace_s = drain_grace_s
+        self.backend_extra_args = tuple(backend_extra_args)
+        self.config_file = config_file
+        self.servers = []
+        self.routers = []
+        self.joiner = None
+        extra = self.backend_extra_args
+        if config_file is not None:
+            extra = (f"--model_config_file={config_file}", *extra)
+        self._backend_args = extra
+        env_note = None
+        if backend_env_plan is not None:
+            import os
+
+            env_note = os.environ.get("TPU_SERVING_FAULT_PLAN")
+            os.environ["TPU_SERVING_FAULT_PLAN"] = str(backend_env_plan)
+        try:
+            self.servers = [
+                fixtures.ModelServerProcess(
+                    self.model_root, self.monitoring,
+                    drain_grace_s=drain_grace_s, extra_args=extra)
+                for _ in range(n_backends)]
+            _ACTIVE_PROCS.update(self.servers)
+            specs = [s.wait_ready().backend_spec() for s in self.servers]
+        finally:
+            if backend_env_plan is not None:
+                import os
+
+                if env_note is None:
+                    os.environ.pop("TPU_SERVING_FAULT_PLAN", None)
+                else:
+                    os.environ["TPU_SERVING_FAULT_PLAN"] = env_note
+        self.joiner_grpc = self.joiner_rest = None
+        if reserve_joiner:
+            self.joiner_grpc, self.joiner_rest = fixtures.reserve_ports(2)
+            specs.append(f"127.0.0.1:{self.joiner_grpc}:{self.joiner_rest}")
+        try:
+            backends = ",".join(specs)
+            self.routers = [
+                fixtures.RouterProcess(backends, poll_interval_s=0.25)
+                for _ in range(n_routers)]
+            _ACTIVE_PROCS.update(self.routers)
+            for router in self.routers:
+                router.wait_ready()
+            self._wait_live(n_backends)
+        except BaseException:
+            self.close()
+            raise
+
+    def _wait_live(self, n: int, timeout_s: float = 60.0) -> None:
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if all(len(r.snapshot()["view"]["live"]) == n
+                   for r in self.routers):
+                return
+            time.sleep(0.05)
+        raise AssertionError(f"routers never saw {n} LIVE backends")
+
+    # -- chaos callbacks (handed to the storm schedule) ----------------------
+
+    def kill_backend(self, index: int):
+        victim = self.servers[index]
+        pid = victim.pid
+        victim.kill()
+        return pid  # the runner marks this pid's sessions as killable
+
+    def drain_backend(self, index: int):
+        self.servers[index].sigterm()  # graceful: sessions must finish
+        return None
+
+    def start_joiner(self):
+        self.joiner = fixtures.ModelServerProcess(
+            self.model_root, self.monitoring,
+            drain_grace_s=self.drain_grace_s,
+            extra_args=(*self._backend_args,
+                        f"--port={self.joiner_grpc}",
+                        f"--rest_api_port={self.joiner_rest}"))
+        _ACTIVE_PROCS.add(self.joiner)
+        self.joiner.wait_ready()
+        return None
+
+    # -- storm wiring --------------------------------------------------------
+
+    def router_grpc_ports(self) -> list:
+        return [r.grpc_port for r in self.routers]
+
+    def monitor_ports(self) -> list:
+        ports = [r.rest_port for r in self.routers]
+        ports += [s.rest_port for s in self.servers]
+        if self.joiner_rest is not None:
+            ports.append(self.joiner_rest)
+        return ports
+
+    def close(self) -> None:
+        for proc in (*self.routers, *self.servers,
+                     *([self.joiner] if self.joiner else ())):
+            try:
+                proc.kill()
+            except Exception:  # pragma: no cover - teardown best-effort
+                pass
+            _ACTIVE_PROCS.discard(proc)
+
+
+class TestScheduleDeterminism:
+    def test_same_seed_same_schedule(self):
+        cfg = StormConfig(seed=99, duration_s=10.0, burst_every_s=2.5,
+                          chaos=((4.0, "kill:1"),))
+        assert generate_schedule(cfg) == generate_schedule(cfg)
+
+    def test_different_seed_different_schedule(self):
+        a = StormConfig(seed=1, duration_s=10.0)
+        b = StormConfig(seed=2, duration_s=10.0)
+        assert generate_schedule(a) != generate_schedule(b)
+
+    def test_chaos_ops_land_verbatim(self):
+        cfg = StormConfig(seed=5, duration_s=8.0,
+                          chaos=((2.0, "drain:0"), (5.0, "kill:2"),
+                                 (6.0, "join")))
+        chaos = [(e.at_s, e.payload[0])
+                 for e in generate_schedule(cfg) if e.kind == "chaos"]
+        assert chaos == [(2.0, "drain:0"), (5.0, "kill:2"),
+                         (6.0, "join")]
+
+
+SMOKE_CFG = StormConfig(
+    seed=1302,
+    quiet_s=2.0,
+    duration_s=8.0,
+    stateless_rate_hz=12.0,
+    session_rate_hz=1.4,
+    session_steps_choices=(3, 5, 8),
+    session_step_interval_s=0.06,
+    chaos=((4.0, "kill:1"),),
+    # ONE-core CI: everything serializes; the p99 bound exists to catch
+    # order-of-magnitude thrash, not scheduling noise.
+    p99_budget_ratio=30.0,
+    p99_floor_ms=1000.0,
+)
+
+
+class TestFleetStormSmoke:
+    def test_seeded_smoke_storm_invariants_hold(self, tmp_path):
+        """Tier-1 smoke: a small seeded storm with a mid-run SIGKILL.
+        Every during-run invariant must hold on a clean tree — this is
+        the canary that keeps the slow storm honest."""
+        fleet = StormFleet(tmp_path, n_backends=2)
+        try:
+            storm = FleetStorm(
+                SMOKE_CFG,
+                router_grpc_ports=fleet.router_grpc_ports(),
+                monitor_rest_ports=fleet.monitor_ports(),
+                chaos_ops={
+                    "kill:1": lambda: fleet.kill_backend(1),
+                })
+            report = storm.run()
+        finally:
+            fleet.close()
+        assert report.ok(), "storm invariants violated:\n" + "\n".join(
+            f"  [{v.at_s:7.2f}s] {v.kind}: {v.detail}"
+            for v in report.violations)
+        # The storm actually stormed: traffic flowed, the kill landed,
+        # sessions ran — a vacuous green is as bad as a red.
+        assert report.chaos_executed == ["kill:1"]
+        assert report.stateless_sent >= 50
+        assert report.stateless_ok == report.stateless_sent
+        assert report.sessions_started >= 5
+        assert report.sessions_completed >= 1
+        # With no fault plan armed, the fault layer must be silent.
+        assert report.fault_events_seen == 0
+        assert report.recorder_internal_errors == 0
+
+
+FULL_CFG = StormConfig(
+    seed=4007,
+    quiet_s=3.0,
+    duration_s=30.0,
+    stateless_rate_hz=20.0,
+    session_rate_hz=1.6,
+    session_steps_choices=(4, 8, 16),
+    session_step_interval_s=0.08,
+    burst_every_s=5.0,
+    burst_size=16,
+    chaos=(
+        (6.0, "join"),       # mid-stream join: epochs move, streams don't
+        (12.0, "drain:2"),   # graceful drain: its sessions MUST finish
+        (18.0, "kill:0"),    # SIGKILL: its sessions die typed, only they
+    ),
+    p99_budget_ratio=30.0,
+    p99_floor_ms=1500.0,
+    max_workers=16,
+)
+
+# The slow storm's fault plan, armed on every BACKEND via env:
+# pure-latency + pressure faults (they must never change any result,
+# only timing and eviction traffic — the invariants stay green).
+BACKEND_FAULT_PLAN = {
+    "seed": 4007,
+    "rules": [
+        {"point": "backend.handle.pre", "action": "delay",
+         "delay_ms": 15, "probability": 0.08},
+        {"point": "kv.alloc", "action": "page_pressure",
+         "probability": 0.2},
+        {"point": "batch.enqueue", "action": "delay",
+         "delay_ms": 5, "probability": 0.05},
+    ],
+}
+
+
+@pytest.mark.slow
+class TestFleetStormFull:
+    def test_full_storm_with_faults_drain_kill_join_and_kv_pressure(
+            self, tmp_path):
+        """The full fleet_storm leg (slow; the smoke above is its
+        tier-1 canary): 3 backends + mid-run joiner, 2 router replicas,
+        bursts, drain + kill + join, delay/page-pressure faults armed
+        on every backend, and paged-t5 KV-pressure sessions asserted
+        bit-exact against pre-storm references."""
+        import jax
+
+        from min_tfs_client_tpu.models import export, t5
+
+        # A paged t5 servable (tiny dims, tight arena): 6 sessions of
+        # up to 24 tokens over a 10-block * 4-token arena guarantee
+        # organic page pressure on top of the injected kind.
+        config = t5.T5Config.tiny()
+        params = t5.init_params(jax.random.PRNGKey(7), config)
+        t5_base = tmp_path / "t5x"
+        export.export_servable(
+            t5_base, 1, "t5",
+            {"vocab_size": config.vocab_size, "d_model": config.d_model,
+             "d_kv": config.d_kv, "num_heads": config.num_heads,
+             "d_ff": config.d_ff,
+             "num_encoder_layers": config.num_encoder_layers,
+             "num_decoder_layers": config.num_decoder_layers,
+             "rel_pos_buckets": config.rel_pos_buckets,
+             "rel_pos_max_distance": config.rel_pos_max_distance},
+            params,
+            signature_kwargs={
+                "seq_len": 12, "max_decode_len": 24,
+                "continuous_batching": True, "max_sessions": 6,
+                "kv_block_size": 4, "kv_num_blocks": 10,
+                "kv_evict_policy": "swap"})
+        model_root = tmp_path / "model"
+        fixtures.write_session_jax_servable(model_root)
+        config_file = tmp_path / "models.config"
+        config_file.write_text(f"""
+model_config_list {{
+  config {{
+    name: "sess"
+    base_path: "{model_root}"
+    model_platform: "jax"
+  }}
+  config {{
+    name: "t5x"
+    base_path: "{t5_base}"
+    model_platform: "jax"
+  }}
+}}
+""")
+        plan_path = tmp_path / "backend_faults.json"
+        plan_path.write_text(json.dumps(BACKEND_FAULT_PLAN))
+
+        rng = np.random.default_rng(FULL_CFG.seed)
+        prompts = []
+        for _ in range(4):
+            ids = rng.integers(2, config.vocab_size, (1, 12)).astype(
+                np.int32)
+            ids[:, 8:] = config.pad_id
+            prompts.append(ids)
+
+        # Pre-storm references, computed IN-PROCESS on the dense
+        # per-session surface (same params, same config): greedy
+        # decode is deterministic and the paged-pool exactness suites
+        # already pin dense == paged token-for-token, so these are the
+        # fleet's ground truth — and the backends' armed fault plan
+        # (kv.alloc page_pressure) cannot contaminate them.
+        ref_sigs = t5.build_signatures(
+            params, config, seq_len=12, max_decode_len=24)
+        references = []
+        for i, ids in enumerate(prompts):
+            sid = np.asarray(b"ref-%d" % i, object)
+            ref_sigs["decode_init"].run(
+                {"session_id": sid, "input_ids": ids})
+            stream = []
+            for _ in range(24):
+                out = ref_sigs["decode_step"].run({"session_id": sid})
+                stream.append(int(out["token"][0]))
+            references.append(stream)
+
+        fleet = StormFleet(
+            tmp_path, n_backends=3, n_routers=2, reserve_joiner=True,
+            drain_grace_s=45.0, config_file=config_file,
+            backend_env_plan=plan_path)
+        try:
+            t5_spec = T5StormSpec(
+                model="t5x", prompts=tuple(prompts),
+                references=tuple(tuple(r) for r in references),
+                session_rate_hz=0.7, step_interval_s=0.05)
+            storm = FleetStorm(
+                FULL_CFG,
+                router_grpc_ports=fleet.router_grpc_ports(),
+                monitor_rest_ports=fleet.monitor_ports(),
+                chaos_ops={
+                    "join": fleet.start_joiner,
+                    "drain:2": lambda: fleet.drain_backend(2),
+                    "kill:0": lambda: fleet.kill_backend(0),
+                },
+                t5=t5_spec)
+            report = storm.run()
+            # Replication evidence rides along: the surviving routers
+            # agree on the post-chaos epoch.
+            epochs = {r.snapshot()["view"]["epoch"]
+                      for r in fleet.routers}
+            assert len(epochs) == 1, \
+                f"router replicas diverged post-storm: {epochs}"
+        finally:
+            fleet.close()
+        assert report.ok(), "storm invariants violated:\n" + "\n".join(
+            f"  [{v.at_s:7.2f}s] {v.kind}: {v.detail}"
+            for v in report.violations)
+        assert sorted(report.chaos_executed) == \
+            ["drain:2", "join", "kill:0"]
+        assert report.stateless_sent >= 400
+        assert report.stateless_ok == report.stateless_sent
+        assert report.sessions_completed >= 5
+        assert report.t5_sessions_completed >= 2, \
+            "no paged-t5 stream survived the pressure storm bit-exact"
+        # The armed plan FIRED (delays/page pressure actually happened)
+        # and still changed no result — that is the point.
+        assert report.fault_events_seen > 0
+        assert report.recorder_internal_errors == 0

@@ -376,6 +376,115 @@ class TestThreadsPlaneEscapeHatch:
             f.close()
 
 
+class TestRoutedAtMostOnce:
+    def test_duplicate_resend_through_router_is_bit_identical(
+            self, fleet):
+        """The routed half of the at-most-once proof: a decode step
+        carrying step_ordinal, re-sent THROUGH the router, returns the
+        byte-identical PredictResponse and never advances the stream;
+        an ordinal-less session on the same fleet behaves exactly as
+        before (wire compat)."""
+        with fleet.client() as client:
+            sid = np.asarray(b"amo-routed", object)
+            client.predict_request(
+                "sess", {"session_id": sid,
+                         "base": np.asarray(40, np.int32)},
+                signature_name="decode_init")
+            for step in range(1, 6):
+                inputs = {"session_id": sid,
+                          "step_ordinal": np.asarray(step, np.int64)}
+                first = client.predict_request(
+                    "sess", inputs, signature_name="decode_step")
+                resend = client.predict_request(
+                    "sess", inputs, signature_name="decode_step")
+                assert first.SerializeToString(deterministic=True) == \
+                    resend.SerializeToString(deterministic=True), \
+                    "duplicate resend was not bit-identical"
+                token = int(tensor_proto_to_ndarray(
+                    first.outputs["token"])[0])
+                assert token == 40 + step, \
+                    "a duplicate resend advanced the stream"
+            client.predict_request("sess", {"session_id": sid},
+                                   signature_name="decode_close")
+            # Ordinal-less behavior unchanged on the same surface.
+            base = 70
+            sid2 = np.asarray(b"amo-bare", object)
+            client.predict_request(
+                "sess", {"session_id": sid2,
+                         "base": np.asarray(base, np.int32)},
+                signature_name="decode_init")
+            tokens = []
+            for _ in range(3):
+                resp = client.predict_request(
+                    "sess", {"session_id": sid2},
+                    signature_name="decode_step")
+                tokens.append(int(tensor_proto_to_ndarray(
+                    resp.outputs["token"])[0]))
+            assert tokens == [base + 1, base + 2, base + 3]
+            client.predict_request("sess", {"session_id": sid2},
+                                   signature_name="decode_close")
+
+    def test_out_of_order_ordinal_is_failed_precondition_on_wire(
+            self, fleet):
+        with fleet.client() as client:
+            sid = np.asarray(b"amo-gap", object)
+            client.predict_request(
+                "sess", {"session_id": sid,
+                         "base": np.asarray(0, np.int32)},
+                signature_name="decode_init")
+            client.predict_request(
+                "sess", {"session_id": sid,
+                         "step_ordinal": np.asarray(1, np.int64)},
+                signature_name="decode_step")
+            with pytest.raises(grpc.RpcError) as err:
+                client.predict_request(
+                    "sess", {"session_id": sid,
+                             "step_ordinal": np.asarray(5, np.int64)},
+                    signature_name="decode_step")
+            assert err.value.code() == \
+                grpc.StatusCode.FAILED_PRECONDITION
+            client.predict_request("sess", {"session_id": sid},
+                                   signature_name="decode_close")
+
+
+class TestAioLoopGuard:
+    def test_second_aio_plane_in_one_process_is_typed_error(
+            self, fleet, tmp_path_factory):
+        """ONE grpc.aio event loop per process: a second used to be a
+        latent PollerCompletionQueue crash (BlockingIOError deep in
+        cython, under load, long after boot); now it is a typed
+        FAILED_PRECONDITION at start, with the escape hatch named."""
+        from min_tfs_client_tpu.utils.status import Code, ServingError
+
+        with pytest.raises(ServingError) as err:
+            Fleet(tmp_path_factory.mktemp("second_aio"), n=1)
+        assert err.value.code == Code.FAILED_PRECONDITION
+        assert "--data_plane=threads" in err.value.message
+
+    def test_claim_is_released_on_stop(self):
+        """The registry frees the slot when a plane stops — stop/start
+        cycles (and the threads escape hatch) must keep working.
+        Registry exercised directly with the module fleet's live claim
+        parked aside."""
+        from min_tfs_client_tpu.router import aio_proxy
+
+        with aio_proxy._active_plane_lock:
+            saved = aio_proxy._active_plane
+            aio_proxy._active_plane = None
+        try:
+            sentinel = object()
+            aio_proxy._claim_aio_plane(sentinel)
+            with pytest.raises(Exception, match="already running"):
+                aio_proxy._claim_aio_plane(object())
+            aio_proxy._release_aio_plane(sentinel)
+            follower = object()
+            aio_proxy._claim_aio_plane(follower)  # freed: claim works
+            aio_proxy._release_aio_plane(follower)
+        finally:
+            with aio_proxy._active_plane_lock:
+                aio_proxy._active_plane = saved
+
+
 @pytest.mark.proc_timeout(300)
 class TestDrain:
     def test_sigterm_drains_sessions_then_exits(self, tmp_path_factory):
@@ -383,8 +492,13 @@ class TestDrain:
         SIGTERM -> NOT_SERVING immediately -> router stops sending new
         sessions -> the in-flight sessioned stream finishes against the
         draining process -> it exits cleanly once its sessions close."""
+        # threads plane: the module-scoped fleet's aio router is still
+        # live in this process, and a SECOND grpc.aio loop per process
+        # is now a typed error at start (aio_proxy._claim_aio_plane) —
+        # the PollerCompletionQueue crash it prevents is real. The
+        # drain choreography under test is plane-independent.
         f = Fleet(tmp_path_factory.mktemp("drain"), n=2,
-                  drain_grace_s=30.0)
+                  drain_grace_s=30.0, data_plane="threads")
         try:
             f.wait_live(2)
             with f.client() as client:

@@ -408,3 +408,246 @@ class TestRecoveryProbeWalk:
                     assert pid == owners[sid] and token == 53
         finally:
             f.close()
+
+
+class TestRecoveryVerdictUnderPartialUnreachability:
+    def test_any_dark_candidate_degrades_not_found_to_unavailable(
+            self, tmp_path_factory):
+        """The `proxy._recovery_verdict` contract, pinned end-to-end
+        with DETERMINISTIC fault injection instead of timing games:
+
+         * a recovery walk where the session's true owner is dark
+           (injected connection-level UNAVAILABLE on exactly that
+           probe) must answer retryable UNAVAILABLE — NOT_FOUND is
+           unprovable while a candidate that may hold the session
+           cannot be asked;
+         * the SAME walk retried after the fault budget is spent
+           recovers the session with the stream intact (the 'retry'
+           in the verdict is honest);
+         * a session that truly exists nowhere — every candidate
+           answered and disclaimed — is terminal NOT_FOUND.
+
+        The fault plan arms only router B (--fault_plan), matched on
+        {probing: true, backend: <owner>}, max_fires=1: one walk sees
+        partial unreachability, the next sees the full fleet."""
+        import json as _json
+
+        from min_tfs_client_tpu.router import ring as ring_mod
+
+        tmp = tmp_path_factory.mktemp("verdict")
+        model_root = tmp / "model"
+        fixtures.write_session_jax_servable(model_root)
+        monitoring = tmp / "monitoring.config"
+        monitoring.write_text("prometheus_config { enable: true }\n")
+        servers, routers = [], []
+        try:
+            servers = [
+                fixtures.ModelServerProcess(model_root, monitoring)
+                for _ in range(3)]
+            _ACTIVE_PROCS.update(servers)
+            specs = [s.wait_ready().backend_spec() for s in servers]
+            backends = ",".join(specs)
+            ids = [f"127.0.0.1:{s.grpc_port}" for s in servers]
+            weights = {bid: 1.0 for bid in ids}
+
+            sid = b"verdict-victim"
+            owner_id = ring_mod.assign_weighted(
+                ring_mod.ring_key("sess", sid), weights)
+            owner_pid = {f"127.0.0.1:{s.grpc_port}": s.pid
+                         for s in servers}[owner_id]
+
+            plan = tmp / "fault_plan.json"
+            plan.write_text(_json.dumps({
+                "seed": 11,
+                "rules": [{
+                    "point": "router.forward.pre",
+                    "match": {"probing": True, "backend": owner_id},
+                    "action": "grpc_error", "code": "UNAVAILABLE",
+                    "message": "injected: owner dark during walk",
+                    "max_fires": 1,
+                }]}))
+            router_a = fixtures.RouterProcess(backends)
+            routers.append(router_a)
+            _ACTIVE_PROCS.add(router_a)
+            router_b = fixtures.RouterProcess(
+                backends, extra_args=(f"--fault_plan={plan}",))
+            routers.append(router_b)
+            _ACTIVE_PROCS.add(router_b)
+            for router in routers:
+                router.wait_ready()
+            wait_until(
+                lambda: all(
+                    len(r.snapshot()["view"]["live"]) == 3
+                    for r in routers),
+                60, "routers never saw 3 LIVE backends")
+
+            with TensorServingClient(
+                    "127.0.0.1", router_a.grpc_port) as ca, \
+                    TensorServingClient(
+                        "127.0.0.1", router_b.grpc_port) as cb:
+                assert _open_session(ca, sid, base=500) == owner_pid
+
+                # Walk 1 through pinless B: the owner probe takes the
+                # injected connection-level UNAVAILABLE; the other
+                # candidates honestly disclaim. Verdict MUST be
+                # retryable UNAVAILABLE, never terminal NOT_FOUND.
+                with pytest.raises(grpc.RpcError) as err:
+                    _step_session(cb, sid)
+                assert err.value.code() == grpc.StatusCode.UNAVAILABLE
+                assert "retry" in (err.value.details() or "")
+
+                # Walk 2: the fault budget (max_fires=1) is spent; the
+                # same request now recovers the session on its true
+                # owner with the token stream intact — the verdict's
+                # 'retry' was honest. Bounded loop: the failed probe
+                # pulsed membership, which may need a poll round.
+                def step_ok():
+                    try:
+                        return _step_session(cb, sid)
+                    except grpc.RpcError:
+                        return None
+                token, pid = wait_until(
+                    step_ok, 20, "retry after the UNAVAILABLE verdict "
+                                 "never recovered the session")
+                assert pid == owner_pid
+                assert token == 501, "the dark-walk attempt ticked the " \
+                                     "session (double-apply)"
+
+                # Control: a session that exists NOWHERE — every
+                # candidate answers and disclaims -> terminal NOT_FOUND
+                # (all-answered is the only provable NOT_FOUND).
+                with pytest.raises(grpc.RpcError) as err:
+                    _step_session(cb, b"verdict-ghost")
+                assert err.value.code() == grpc.StatusCode.NOT_FOUND
+
+                # Evidence trail: the injected fault is in router B's
+                # flight recorder, point-named and backend-attributed.
+                import urllib.request as _urlreq
+
+                with _urlreq.urlopen(
+                        f"http://127.0.0.1:{router_b.rest_port}"
+                        "/monitoring/flightrecorder",
+                        timeout=10) as resp:
+                    events = _json.loads(resp.read())["events"]
+                fault_events = [e for e in events
+                                if e["kind"] == "fault"]
+                assert len(fault_events) == 1
+                assert fault_events[0]["point"] == "router.forward.pre"
+                assert fault_events[0]["backend"] == owner_id
+        finally:
+            for proc in (*routers, *servers):
+                try:
+                    proc.kill()
+                except Exception:
+                    pass
+                _ACTIVE_PROCS.discard(proc)
+
+
+class TestRouterInForwardRetry:
+    def test_retry_scope_stateless_and_ordinal_guarded_only(
+            self, tmp_path_factory):
+        """The router's bounded in-forward UNAVAILABLE retry
+        (robustness/retry.py), proven with deterministic injection:
+        faults fire on every first attempt (match attempt=0) of a
+        non-probing forward, so
+
+         * a stateless Predict succeeds transparently (retried);
+         * an ordinal-guarded decode step succeeds transparently
+           (retried — the backend would dedup a true double-send);
+         * a BARE sessioned step surfaces the UNAVAILABLE untouched
+           (re-sending it could double-apply), and the stream is
+           provably un-ticked afterward."""
+        import json as _json
+        import urllib.request as _urlreq
+
+        tmp = tmp_path_factory.mktemp("fwd-retry")
+        model_root = tmp / "model"
+        fixtures.write_session_jax_servable(model_root)
+        monitoring = tmp / "monitoring.config"
+        monitoring.write_text("prometheus_config { enable: true }\n")
+        servers, routers = [], []
+        try:
+            servers = [
+                fixtures.ModelServerProcess(model_root, monitoring)
+                for _ in range(2)]
+            _ACTIVE_PROCS.update(servers)
+            backends = ",".join(
+                s.wait_ready().backend_spec() for s in servers)
+            plan = tmp / "fault_plan.json"
+            plan.write_text(_json.dumps({
+                "seed": 3,
+                "rules": [{
+                    "point": "router.forward.pre",
+                    "match": {"probing": False, "attempt": 0},
+                    "action": "grpc_error", "code": "UNAVAILABLE",
+                    "message": "injected: first attempt dies",
+                    "max_fires": 3,
+                }]}))
+            router_a = fixtures.RouterProcess(backends)
+            router_b = fixtures.RouterProcess(
+                backends, extra_args=(f"--fault_plan={plan}",))
+            routers.extend((router_a, router_b))
+            _ACTIVE_PROCS.update(routers)
+            for router in routers:
+                router.wait_ready()
+            wait_until(
+                lambda: all(
+                    len(r.snapshot()["view"]["live"]) == 2
+                    for r in routers),
+                60, "routers never saw 2 LIVE backends")
+
+            sid = b"retry-scope"
+            with TensorServingClient(
+                    "127.0.0.1", router_a.grpc_port) as ca, \
+                    TensorServingClient(
+                        "127.0.0.1", router_b.grpc_port) as cb:
+                # 1. stateless: fire #1 eaten by the in-forward retry
+                x = np.asarray([2.0], np.float32)
+                resp = cb.predict_request("sess", {"x": x})
+                np.testing.assert_allclose(
+                    tensor_proto_to_ndarray(resp.outputs["y"]),
+                    x * 3.0 + 1.0)
+
+                # 2. ordinal-guarded step: inited via A so B first
+                # recovers the pin (probing forwards don't match the
+                # rule), then the PINNED fast-path forward eats fire #2
+                owner = _open_session(ca, sid, base=900)
+                for step in (1, 2):
+                    resp = cb.predict_request(
+                        "sess",
+                        {"session_id": np.asarray(sid, object),
+                         "step_ordinal": np.asarray(step, np.int64)},
+                        signature_name="decode_step")
+                    assert int(tensor_proto_to_ndarray(
+                        resp.outputs["token"])[0]) == 900 + step
+                    assert int(tensor_proto_to_ndarray(
+                        resp.outputs["pid"])[0]) == owner
+
+                # 3. BARE sessioned step: fire #3 propagates — the
+                # router must NOT retry what it cannot prove safe
+                with pytest.raises(grpc.RpcError) as err:
+                    _step_session(cb, sid)
+                assert err.value.code() == grpc.StatusCode.UNAVAILABLE
+                assert "injected" in (err.value.details() or "")
+                # ...and the fault fired BEFORE the wire: the stream
+                # never ticked (fires exhausted; this step executes)
+                token, _ = _step_session(cb, sid)
+                assert token == 903
+
+                # Evidence: exactly 2 in-forward retries recorded, 3
+                # faults fired, in B's flight recorder
+                with _urlreq.urlopen(
+                        f"http://127.0.0.1:{router_b.rest_port}"
+                        "/monitoring/flightrecorder",
+                        timeout=10) as resp:
+                    events = _json.loads(resp.read())["events"]
+                kinds = [e["kind"] for e in events]
+                assert kinds.count("fault") == 3
+                assert kinds.count("router_retry") == 2
+        finally:
+            for proc in (*routers, *servers):
+                try:
+                    proc.kill()
+                except Exception:
+                    pass
+                _ACTIVE_PROCS.discard(proc)

@@ -110,3 +110,16 @@ class TestIdempotenceGate:
         # means re-running mutates it
         assert not TensorServingClient._predict_is_idempotent(
             "my_stateful_sig", {"session_id": np.asarray(b"s", object)})
+
+    def test_ordinal_guarded_step_is_retry_safe(self):
+        """The at-most-once extension: a decode_step carrying a
+        step_ordinal may be resent — the server's StepDeduper replays a
+        duplicate from cache instead of re-ticking. Only decode_step:
+        init/close have no ordinal semantics."""
+        guarded = {"session_id": np.asarray(b"s", object),
+                   "step_ordinal": np.asarray(3, np.int64)}
+        assert TensorServingClient._predict_is_idempotent(
+            "decode_step", guarded)
+        for signature in ("decode_init", "decode_close"):
+            assert not TensorServingClient._predict_is_idempotent(
+                signature, guarded)

@@ -214,6 +214,56 @@ def test_synthesize_warmup_primes_session_executables():
         sigs["decode_close"].run({"session_id": sid})
 
 
+class TestPooledAtMostOnce:
+    """step_ordinal on the POOLED surface: a duplicate resend must not
+    burn a shared tick (tick-mates' streams advance by real steps only)
+    and must replay bit-identically even after exhaustion released the
+    slot."""
+
+    def _step(self, sigs, sid, ordinal=None):
+        inputs = {"session_id": sid}
+        if ordinal is not None:
+            inputs["step_ordinal"] = np.asarray(ordinal, np.int64)
+        return sigs["decode_step"].run(inputs)
+
+    def test_guarded_stream_matches_oracle_and_replays(self, pooled):
+        config, params, sigs = pooled
+        rng = np.random.default_rng(17)
+        ids = _prompt(config, rng)
+        want = _oracle(params, config, ids)[0]
+        sid = np.asarray(b"pooled-ord", object)
+        sigs["decode_init"].run({"session_id": sid, "input_ids": ids})
+        for i in range(MAXDEC):
+            out = self._step(sigs, sid, ordinal=i + 1)
+            dup = self._step(sigs, sid, ordinal=i + 1)
+            for key in out:
+                np.testing.assert_array_equal(out[key], dup[key])
+            assert int(out["token"][0]) == int(want[i])
+        # the final-step duplicate above already replayed after the
+        # exhaustion path released the slot; a NEW ordinal now is an
+        # honest NOT_FOUND, not a stale replay
+        with pytest.raises(ServingError, match="does not exist"):
+            self._step(sigs, sid, ordinal=MAXDEC + 1)
+
+    def test_duplicate_resend_does_not_disturb_tick_mates(self, pooled):
+        config, params, sigs = pooled
+        rng = np.random.default_rng(23)
+        ids_a, ids_b = _prompt(config, rng), _prompt(config, rng)
+        want_b = _oracle(params, config, ids_b)[0]
+        sid_a = np.asarray(b"pooled-ord-a", object)
+        sid_b = np.asarray(b"pooled-ord-b", object)
+        sigs["decode_init"].run({"session_id": sid_a, "input_ids": ids_a})
+        sigs["decode_init"].run({"session_id": sid_b, "input_ids": ids_b})
+        for i in range(MAXDEC):
+            self._step(sigs, sid_a, ordinal=i + 1)
+            self._step(sigs, sid_a, ordinal=i + 1)  # resend storm
+            out_b = self._step(sigs, sid_b, ordinal=i + 1)
+            assert int(out_b["token"][0]) == int(want_b[i]), \
+                "a neighbor's duplicate resend advanced this stream"
+        sigs["decode_close"].run({"session_id": sid_a})
+        sigs["decode_close"].run({"session_id": sid_b})
+
+
 class TestTickBatcher:
     def test_concurrent_steps_coalesce(self):
         batch_sizes = []

@@ -1,0 +1,236 @@
+"""robustness/faults.py: the deterministic fault-injection engine —
+plan parsing, seeded rule matching, every action kind, and the
+flight-recorder/trace evidence trail. The engine is the adversary the
+fleet_storm suites arm; its own determinism is load-bearing (a storm
+that found a race must replay bit-for-bit)."""
+
+import json
+
+import grpc
+import pytest
+
+from min_tfs_client_tpu.observability import flight_recorder
+from min_tfs_client_tpu.robustness import faults
+from min_tfs_client_tpu.robustness.retry import (
+    RetryPolicy,
+    retry_safe_predict,
+)
+from min_tfs_client_tpu.utils.status import Code, ServingError
+
+
+@pytest.fixture(autouse=True)
+def _disarmed():
+    faults.disarm()
+    yield
+    faults.disarm()
+
+
+class TestArming:
+    def test_disarmed_point_is_none(self):
+        assert faults.point("router.forward.pre", backend="b") is None
+        assert not faults.armed()
+        assert faults.stats() is None
+
+    def test_arm_dict_json_and_path(self, tmp_path):
+        plan = {"seed": 7, "rules": [
+            {"point": "p", "action": "page_pressure"}]}
+        for form in (plan, json.dumps(plan)):
+            faults.arm(form)
+            assert faults.armed()
+            faults.disarm()
+        path = tmp_path / "plan.json"
+        path.write_text(json.dumps(plan))
+        faults.arm(str(path))
+        assert faults.armed()
+        assert faults.stats()["seed"] == 7
+
+    def test_arm_from_env(self, tmp_path, monkeypatch):
+        monkeypatch.delenv(faults.ENV_PLAN, raising=False)
+        assert faults.arm_from_env() is False
+        path = tmp_path / "plan.json"
+        path.write_text(json.dumps({"seed": 3, "rules": []}))
+        monkeypatch.setenv(faults.ENV_PLAN, str(path))
+        assert faults.arm_from_env() is True
+        assert faults.stats()["seed"] == 3
+        monkeypatch.setenv(
+            faults.ENV_PLAN, '{"seed": 4, "rules": []}')
+        assert faults.arm_from_env() is True
+        assert faults.stats()["seed"] == 4
+
+    @pytest.mark.parametrize("plan", [
+        {"rules": [{"point": "p", "action": "explode"}]},
+        {"rules": [{"point": "", "action": "delay", "delay_ms": 1}]},
+        {"rules": [{"point": "p", "action": "delay"}]},
+        {"rules": [{"point": "p", "action": "deadline_corrupt"}]},
+        {"rules": [{"point": "p", "action": "error", "code": "NOPE"}]},
+        {"rules": [{"point": "p", "action": "delay", "delay_ms": 1,
+                    "probability": 1.5}]},
+        {"rules": [{"point": "p", "action": "page_pressure",
+                    "typo_key": 1}]},
+        {"bogus_top": 1},
+        [],
+    ])
+    def test_malformed_plans_fail_loudly_at_arm(self, plan):
+        with pytest.raises(faults.FaultPlanError):
+            faults.arm(plan)
+        assert not faults.armed()
+
+
+class TestMatching:
+    def test_point_pattern_and_ctx_match(self):
+        faults.arm({"rules": [
+            {"point": "router.*", "match": {"backend": "b1"},
+             "action": "page_pressure"}]})
+        assert faults.point("router.forward.pre", backend="b1")
+        assert faults.point("router.forward.pre", backend="b2") is None
+        assert faults.point("kv.alloc", backend="b1") is None
+
+    def test_bool_ctx_matches_json_true(self):
+        # JSON `true` arrives as Python True; call sites pass bools.
+        faults.arm({"rules": [
+            {"point": "p", "match": {"probing": True},
+             "action": "page_pressure"}]})
+        assert faults.point("p", probing=True)
+        assert faults.point("p", probing=False) is None
+        assert faults.point("p") is None  # absent ctx key != True
+
+    def test_every_nth(self):
+        faults.arm({"rules": [
+            {"point": "p", "action": "page_pressure", "every": 3}]})
+        fired = [bool(faults.point("p")) for _ in range(9)]
+        assert fired == [False, False, True] * 3
+
+    def test_max_fires_bounds_total(self):
+        faults.arm({"rules": [
+            {"point": "p", "action": "page_pressure", "max_fires": 2}]})
+        assert sum(bool(faults.point("p")) for _ in range(10)) == 2
+
+    def test_probability_is_seeded_and_replayable(self):
+        plan = {"seed": 42, "rules": [
+            {"point": "p", "action": "page_pressure",
+             "probability": 0.5}]}
+        faults.arm(plan)
+        first = [bool(faults.point("p")) for _ in range(64)]
+        faults.arm(plan)  # re-arm resets counters AND rngs
+        second = [bool(faults.point("p")) for _ in range(64)]
+        assert first == second, "same plan must replay bit-for-bit"
+        assert 8 < sum(first) < 56, "p=0.5 should fire sometimes"
+        faults.arm({**plan, "seed": 43})
+        third = [bool(faults.point("p")) for _ in range(64)]
+        assert first != third, "a different seed must draw differently"
+
+    def test_first_matching_rule_wins(self):
+        faults.arm({"rules": [
+            {"point": "p", "action": "page_pressure"},
+            {"point": "p", "action": "error", "code": "INTERNAL"}]})
+        fired = faults.point("p")
+        assert fired.action == "page_pressure"  # never reached rule 2
+
+
+class TestActions:
+    def test_delay_sleeps_and_returns_fired(self):
+        import time
+
+        faults.arm({"rules": [
+            {"point": "p", "action": "delay", "delay_ms": 30}]})
+        t0 = time.perf_counter()
+        fired = faults.point("p")
+        assert (time.perf_counter() - t0) >= 0.025
+        assert fired.action == "delay"
+
+    def test_error_raises_typed_serving_error(self):
+        faults.arm({"rules": [
+            {"point": "p", "action": "error",
+             "code": "RESOURCE_EXHAUSTED", "message": "kv storm"}]})
+        with pytest.raises(ServingError) as err:
+            faults.point("p")
+        assert err.value.code == Code.RESOURCE_EXHAUSTED
+        assert err.value.message == "kv storm"
+
+    def test_grpc_error_raises_rpc_error_with_code(self):
+        faults.arm({"rules": [
+            {"point": "p", "action": "grpc_error",
+             "code": "UNAVAILABLE"}]})
+        with pytest.raises(grpc.RpcError) as err:
+            faults.point("p")
+        assert err.value.code() == grpc.StatusCode.UNAVAILABLE
+        assert "fault injected" in err.value.details()
+
+    def test_connection_drop_raises_reset(self):
+        faults.arm({"rules": [
+            {"point": "p", "action": "connection_drop"}]})
+        with pytest.raises(ConnectionResetError):
+            faults.point("p")
+
+    def test_deadline_corrupt_returns_override(self):
+        faults.arm({"rules": [
+            {"point": "p", "action": "deadline_corrupt",
+             "deadline_ms": 5}]})
+        fired = faults.point("p")
+        assert fired.deadline_ms == 5
+
+    def test_page_pressure_marker(self):
+        faults.arm({"rules": [
+            {"point": "kv.alloc", "action": "page_pressure"}]})
+        assert faults.point("kv.alloc").page_pressure is True
+
+
+class TestEvidence:
+    def test_fires_land_in_the_flight_recorder(self):
+        flight_recorder.reset()
+        faults.arm({"seed": 1, "rules": [
+            {"point": "p", "action": "page_pressure",
+             "match": {"model": "sess"}}]})
+        faults.point("p", model="sess")
+        kinds = [e[2] for e in flight_recorder.snapshot()]
+        assert "faults_armed" in kinds
+        assert "fault" in kinds
+        fault = next(e for e in flight_recorder.snapshot()
+                     if e[2] == "fault")
+        assert fault[3]["point"] == "p"
+        assert fault[3]["action"] == "page_pressure"
+        assert fault[3]["model"] == "sess"
+        flight_recorder.reset()
+
+    def test_fires_annotate_the_active_trace(self):
+        from min_tfs_client_tpu.observability import tracing
+
+        faults.arm({"rules": [
+            {"point": "p", "action": "page_pressure"}]})
+        trace = tracing.RequestTrace("predict")
+        with tracing.activate(trace):
+            faults.point("p")
+        assert trace.meta.get("fault") == "p:page_pressure"
+
+    def test_stats_counts(self):
+        faults.arm({"rules": [
+            {"point": "p", "action": "page_pressure", "every": 2}]})
+        for _ in range(4):
+            faults.point("p")
+        stats = faults.stats()
+        assert stats["fired_by_point"] == {"p": 2}
+        assert stats["rules"][0]["eligible"] == 4
+        assert stats["rules"][0]["fires"] == 2
+
+
+class TestRetryPolicy:
+    def test_delay_bounds_grow_then_cap(self):
+        import random as _random
+
+        policy = RetryPolicy(max_retries=5, backoff_s=0.1,
+                             backoff_max_s=0.3)
+        rng = _random.Random(0)
+        for attempt, cap in ((0, 0.1), (1, 0.2), (2, 0.3), (5, 0.3)):
+            for _ in range(20):
+                assert 0.0 <= policy.delay_s(attempt, rng) <= cap
+
+    def test_retry_safe_scope(self):
+        """The ONE predicate all three tiers (client, both router
+        planes) call: stateless and ordinal-guarded steps only."""
+        assert retry_safe_predict(None, False, False)            # pure
+        assert retry_safe_predict("serving_default", False, False)
+        assert retry_safe_predict("decode_step", True, True)     # guarded
+        assert not retry_safe_predict("decode_step", True, False)
+        assert not retry_safe_predict("decode_init", True, True)
+        assert not retry_safe_predict("decode_close", True, True)
+        assert not retry_safe_predict("my_stateful", True, False)

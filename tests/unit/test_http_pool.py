@@ -325,3 +325,110 @@ class TestStaleRetryScope:
             "a timed-out POST must NOT be re-sent on a new connection"
         pool.close()
         lsock.close()
+
+
+class TestFaultInjectedResets:
+    """The stale-reuse retry discipline under DETERMINISTIC connection
+    resets (robustness/faults.py): the organic tests above stage real
+    socket deaths; these pin the same phase-split contract with
+    injected drops at the two named points — `http_pool.send` (pre-
+    delivery: retry any method on a reused socket) and
+    `http_pool.response` (post-send ambiguity: idempotent only)."""
+
+    @pytest.fixture(autouse=True)
+    def _disarmed(self):
+        from min_tfs_client_tpu.robustness import faults
+
+        faults.disarm()
+        yield
+        faults.disarm()
+
+    def _warm(self, server):
+        """A pool with one reused keep-alive connection to `server`."""
+        pool = KeepAliveHTTPPool()
+        pool.request("127.0.0.1", server.port, "GET", "/")
+        assert pool.idle_count("127.0.0.1", server.port) == 1
+        return pool
+
+    def test_mid_send_drop_retries_any_method(self, server):
+        from min_tfs_client_tpu.robustness import faults
+
+        pool = self._warm(server)
+        faults.arm({"rules": [
+            {"point": "http_pool.send", "match": {"reused": True},
+             "action": "connection_drop", "max_fires": 1}]})
+        # POST (non-idempotent) still retries: the drop fired BEFORE
+        # the request was provably delivered.
+        status, _, body = pool.request(
+            "127.0.0.1", server.port, "POST", "/echo", body=b"x")
+        assert (status, body) == (200, b"echo:x")
+        assert faults.stats()["fired_by_point"] == {"http_pool.send": 1}
+        pool.close()
+
+    def test_post_send_drop_propagates_for_post(self, server):
+        from min_tfs_client_tpu.robustness import faults
+
+        before = len(server.client_ports)
+        pool = self._warm(server)
+        faults.arm({"rules": [
+            {"point": "http_pool.response", "match": {"reused": True},
+             "action": "connection_drop", "max_fires": 1}]})
+        with pytest.raises(ConnectionResetError):
+            pool.request("127.0.0.1", server.port, "POST", "/echo",
+                         body=b"once")
+        # The POST was fully sent before the injected drop — the server
+        # executes it exactly once (warmup GET + this POST); waiting
+        # out its handler thread IS the ambiguity under test: the
+        # client saw an error, the server executed anyway. A blind
+        # resend would make this before + 3.
+        import time as _time
+
+        deadline = _time.monotonic() + 5
+        while len(server.client_ports) < before + 2 and \
+                _time.monotonic() < deadline:
+            _time.sleep(0.01)
+        _time.sleep(0.05)  # would-be resend window
+        assert len(server.client_ports) == before + 2
+        pool.close()
+
+    def test_post_send_drop_retries_idempotent_get(self, server):
+        from min_tfs_client_tpu.robustness import faults
+
+        pool = self._warm(server)
+        faults.arm({"rules": [
+            {"point": "http_pool.response", "match": {"reused": True},
+             "action": "connection_drop", "max_fires": 1}]})
+        status, _, body = pool.request(
+            "127.0.0.1", server.port, "GET", "/")
+        assert (status, body) == (200, b"hello")
+        pool.close()
+
+    def test_fresh_connection_drop_propagates(self, server):
+        """A failure on a FRESH connection is a real backend error —
+        never papered over by the stale-reuse retry."""
+        from min_tfs_client_tpu.robustness import faults
+
+        pool = KeepAliveHTTPPool()  # nothing pooled: first use is fresh
+        faults.arm({"rules": [
+            {"point": "http_pool.send", "match": {"reused": False},
+             "action": "connection_drop", "max_fires": 1}]})
+        with pytest.raises(ConnectionResetError):
+            pool.request("127.0.0.1", server.port, "GET", "/")
+        pool.close()
+
+    def test_reset_storm_every_other_request_still_serves(self, server):
+        """A sustained reset storm on reused sockets: every affected
+        request lands exactly once (pre-send drops retry; the pool
+        culls/cycles connections), so the data plane rides through."""
+        from min_tfs_client_tpu.robustness import faults
+
+        pool = self._warm(server)
+        faults.arm({"seed": 5, "rules": [
+            {"point": "http_pool.send", "match": {"reused": True},
+             "action": "connection_drop", "every": 2}]})
+        for i in range(10):
+            status, _, body = pool.request(
+                "127.0.0.1", server.port, "POST", "/echo",
+                body=b"n%d" % i)
+            assert (status, body) == (200, b"echo:n%d" % i)
+        pool.close()

@@ -159,6 +159,52 @@ class TestRecorderTraceCorrelation:
         rec.record_error("predict", "m", "s", 13, "internal boom")
         assert dumps[-1] == "first INTERNAL error"
 
+    def test_rearm_reopens_the_latch_without_clearing_the_ring(self):
+        """Multi-phase storms latch ONE dump per phase: rearm() resets
+        the latch, keeps the events, and reports whether the latch had
+        fired — the /monitoring/flightrecorder?rearm=1 contract."""
+        rec = flight_recorder.FlightRecorder(capacity=16)
+        dumps = []
+        rec.dump = lambda reason="manual": dumps.append(reason)
+        rec.record_error("predict", "m", "s", 13, "phase-1 internal")
+        assert dumps == ["first INTERNAL error"]
+        assert rec.rearm() is True        # latch HAD fired
+        assert rec.rearm() is False       # idempotent re-arm
+        assert len(rec.snapshot()) == 1   # ring untouched
+        rec.record_error("predict", "m", "s", 13, "phase-2 internal")
+        assert dumps == ["first INTERNAL error", "first INTERNAL error"]
+
+    def test_rearm_endpoint_query(self):
+        """The REST reply honors ?rearm=1 against the process-global
+        recorder (shared by a backend's two REST front-ends and the
+        router's monitoring surface alike)."""
+        import json as _json
+
+        from min_tfs_client_tpu.server import rest as rest_mod
+
+        flight_recorder.reset()
+        dumps = []
+        original_dump = flight_recorder.recorder.dump
+        flight_recorder.recorder.dump = \
+            lambda reason="manual": dumps.append(reason)
+        try:
+            flight_recorder.record_error("predict", "m", "s", 13, "boom")
+            code, _, body = rest_mod._flight_recorder_reply("rearm=1")
+            payload = _json.loads(body)
+            assert code == 200
+            assert payload["rearmed"] is True
+            assert payload["was_latched"] is True
+            assert payload["events"], "ring must not be cleared"
+            # plain GET: no rearm key at all
+            code, _, body = rest_mod._flight_recorder_reply("")
+            assert "rearmed" not in _json.loads(body)
+            # the latch is genuinely open again
+            flight_recorder.record_error("predict", "m", "s", 13, "boom2")
+            assert len(dumps) == 2
+        finally:
+            flight_recorder.recorder.dump = original_dump
+            flight_recorder.reset()
+
 
 class TestNoLiveBackendsLatch:
     def test_router_core_records_and_latches(self):

@@ -1,0 +1,224 @@
+"""At-most-once decode steps: StepDeduper semantics, the optional
+`step_ordinal` wire input (Signature.optional_inputs), and the ordinal
+parsing — the server half of retry-on-UNAVAILABLE being honest for
+sessioned traffic (docs/ROBUSTNESS.md "Retry & idempotency")."""
+
+import numpy as np
+import pytest
+
+from min_tfs_client_tpu.servables.decode_sessions import (
+    StepDeduper,
+    read_step_ordinal,
+)
+from min_tfs_client_tpu.servables.servable import Signature, TensorSpec
+from min_tfs_client_tpu.utils.status import Code, ServingError
+
+
+class TestStepDeduper:
+    def test_unguarded_steps_bypass(self):
+        dedup = StepDeduper()
+        assert dedup.replay(b"s", None) is None
+        dedup.commit(b"s", None, {"token": 1})
+        assert len(dedup) == 0  # ordinal-less commits record nothing
+
+    def test_first_ordinal_executes_then_duplicates_replay(self):
+        dedup = StepDeduper()
+        assert dedup.replay(b"s", 1) is None  # first: execute
+        out = {"token": np.asarray([7], np.int32)}
+        dedup.commit(b"s", 1, out)
+        assert dedup.replay(b"s", 1) is out    # resend: cached, no tick
+        assert dedup.replay(b"s", 2) is None   # next: execute
+        dedup.commit(b"s", 2, {"token": np.asarray([8], np.int32)})
+        with pytest.raises(ServingError):
+            dedup.replay(b"s", 1)  # superseded: only the last is kept
+
+    def test_out_of_order_is_typed_failed_precondition(self):
+        dedup = StepDeduper()
+        dedup.commit(b"s", 5, {"t": 0})
+        for bad in (3, 7, 4):
+            with pytest.raises(ServingError) as err:
+                dedup.replay(b"s", bad)
+            assert err.value.code == Code.FAILED_PRECONDITION
+        # ...and the session is still steppable at the right ordinals.
+        assert dedup.replay(b"s", 5) == {"t": 0}
+        assert dedup.replay(b"s", 6) is None
+
+    def test_rewind_past_last_is_rejected(self):
+        dedup = StepDeduper()
+        dedup.commit(b"s", 2, {"t": 2})
+        with pytest.raises(ServingError):
+            dedup.replay(b"s", 1)  # only the LAST response is kept
+
+    def test_ordinal_below_one_rejected(self):
+        dedup = StepDeduper()
+        for bad in (0, -3):
+            with pytest.raises(ServingError) as err:
+                dedup.replay(b"s", bad)
+            assert err.value.code == Code.INVALID_ARGUMENT
+
+    def test_inflight_duplicate_is_typed_retryable(self):
+        """A duplicate racing the ORIGINAL mid-tick must answer typed
+        UNAVAILABLE (retry collects the cached response after commit),
+        never fall through to the store's NOT_FOUND and kill a healthy
+        stream — the router's in-forward retry resends within ~60ms,
+        well inside a device step."""
+        dedup = StepDeduper()
+        assert dedup.replay(b"s", 1) is None   # original: in flight
+        with pytest.raises(ServingError) as err:
+            dedup.replay(b"s", 1)              # racing duplicate
+        assert err.value.code == Code.UNAVAILABLE
+        assert "in flight" in err.value.message
+        dedup.commit(b"s", 1, {"t": 7})        # original finishes
+        assert dedup.replay(b"s", 1) == {"t": 7}  # retry collects it
+
+    def test_abandon_clears_the_inflight_marker(self):
+        """A FAILED attempt produced nothing to replay: abandon()
+        unmarks so a retry of the same ordinal executes."""
+        dedup = StepDeduper()
+        assert dedup.replay(b"s", 1) is None
+        dedup.abandon(b"s", 1)
+        assert dedup.replay(b"s", 1) is None   # retry executes
+        dedup.commit(b"s", 1, {"t": 1})
+        # abandon of a non-pending / stale ordinal is a no-op
+        dedup.abandon(b"s", 1)
+        assert dedup.replay(b"s", 1) == {"t": 1}
+
+    def test_forget_drops_the_entry(self):
+        dedup = StepDeduper()
+        dedup.commit(b"s", 1, {"t": 1})
+        dedup.forget(b"s")
+        assert len(dedup) == 0
+        assert dedup.replay(b"s", 9) is None  # fresh session semantics
+
+    def test_lru_bound(self):
+        dedup = StepDeduper(max_entries=8)
+        for i in range(20):
+            dedup.commit(b"s%d" % i, 1, {"t": i})
+        assert len(dedup) == 8
+        assert dedup.replay(b"s19", 1) == {"t": 19}   # newest kept
+        assert dedup.replay(b"s0", 1) is None          # oldest evicted
+
+    def test_live_sessions_guard_is_never_evicted(self):
+        """With the liveness oracle wired (the session store's
+        membership test), churn past the size bound sheds only DEAD
+        sessions' entries: silently voiding a live guard would turn
+        the advertised safe-retry into the double-tick it prevents."""
+        live = {b"live-a", b"live-b"}
+        dedup = StepDeduper(max_entries=8, is_live=live.__contains__)
+        dedup.commit(b"live-a", 3, {"t": "a"})
+        dedup.commit(b"live-b", 5, {"t": "b"})
+        for i in range(30):   # dead-session churn far past the bound
+            dedup.commit(b"dead-%d" % i, 1, {"t": i})
+        assert dedup.replay(b"live-a", 3) == {"t": "a"}
+        assert dedup.replay(b"live-b", 5) == {"t": "b"}
+        assert len(dedup) <= 8 + len(live)
+
+    def test_all_live_overflow_grows_instead_of_voiding(self):
+        dedup = StepDeduper(max_entries=8, is_live=lambda sid: True)
+        for i in range(20):
+            dedup.commit(b"s%d" % i, 1, {"t": i})
+        assert len(dedup) == 20  # bounded by the store's capacity
+        for i in range(20):
+            assert dedup.replay(b"s%d" % i, 1) == {"t": i}
+
+    def test_shed_entries_are_flight_recorded(self):
+        from min_tfs_client_tpu.observability import flight_recorder
+
+        flight_recorder.reset()
+        dedup = StepDeduper(max_entries=8)
+        for i in range(10):
+            dedup.commit(b"s%d" % i, 1, {"t": i})
+        kinds = [e[2] for e in flight_recorder.snapshot()]
+        assert kinds.count("step_dedup_evict") == 2
+        flight_recorder.reset()
+
+    def test_sessions_are_independent(self):
+        dedup = StepDeduper()
+        dedup.commit(b"a", 3, {"t": "a3"})
+        dedup.commit(b"b", 1, {"t": "b1"})
+        assert dedup.replay(b"a", 3) == {"t": "a3"}
+        assert dedup.replay(b"b", 2) is None
+        with pytest.raises(ServingError):
+            dedup.replay(b"a", 1)
+
+
+class TestReadStepOrdinal:
+    def test_absent_is_none(self):
+        assert read_step_ordinal({"session_id": b"s"}) is None
+
+    def test_scalar_int_forms(self):
+        for raw in (np.asarray(4, np.int64), np.asarray([4], np.int32),
+                    4):
+            assert read_step_ordinal({"step_ordinal": raw}) == 4
+
+    def test_non_scalar_rejected(self):
+        with pytest.raises(ServingError):
+            read_step_ordinal(
+                {"step_ordinal": np.asarray([1, 2], np.int64)})
+
+    def test_non_integer_rejected(self):
+        with pytest.raises(ServingError):
+            read_step_ordinal(
+                {"step_ordinal": np.asarray(b"x", object)})
+
+
+class TestOptionalInputs:
+    def _sig(self, seen):
+        def fn(inputs):
+            seen.append(dict(inputs))
+            return {"y": np.asarray(1.0, np.float32)}
+
+        return Signature(
+            fn=fn,
+            inputs={"session_id": TensorSpec("DT_STRING", ())},
+            optional_inputs={"step_ordinal": TensorSpec(np.int64, ())},
+            outputs={"y": TensorSpec(np.float32, ())},
+            on_host=True, batched=False)
+
+    def test_absent_optional_is_fine(self):
+        seen = []
+        sig = self._sig(seen)
+        sig.run({"session_id": np.asarray(b"s", object)})
+        assert "step_ordinal" not in seen[0]
+
+    def test_present_optional_is_validated_and_passed(self):
+        seen = []
+        sig = self._sig(seen)
+        sig.run({"session_id": np.asarray(b"s", object),
+                 "step_ordinal": np.asarray(3, np.int64)})
+        assert int(seen[0]["step_ordinal"]) == 3
+        # wrong dtype-kind still fails like a mandatory input would
+        with pytest.raises(ServingError):
+            sig.run({"session_id": np.asarray(b"s", object),
+                     "step_ordinal": np.asarray(b"x", object)})
+
+    def test_unknown_aliases_still_rejected(self):
+        sig = self._sig([])
+        with pytest.raises(ServingError, match="not in the signature"):
+            sig.run({"session_id": np.asarray(b"s", object),
+                     "bogus": np.asarray(1, np.int64)})
+
+    def test_mandatory_inputs_stay_mandatory(self):
+        sig = self._sig([])
+        with pytest.raises(ServingError, match="Missing"):
+            sig.run({"step_ordinal": np.asarray(1, np.int64)})
+
+    def test_device_or_batched_signatures_refuse_optionals(self):
+        for kw in ({"on_host": False, "batched": False},
+                   {"on_host": True, "batched": True}):
+            with pytest.raises(ValueError, match="optional_inputs"):
+                Signature(
+                    fn=lambda inputs: inputs,
+                    inputs={"x": TensorSpec(np.float32, (None,))},
+                    optional_inputs={"o": TensorSpec(np.int64, ())},
+                    outputs={"x": TensorSpec(np.float32, (None,))},
+                    **kw)
+
+    def test_overlap_with_mandatory_rejected(self):
+        with pytest.raises(ValueError, match="duplicate"):
+            Signature(
+                fn=lambda inputs: inputs,
+                inputs={"x": TensorSpec(np.float32, (None,))},
+                optional_inputs={"x": TensorSpec(np.float32, (None,))},
+                outputs={"x": TensorSpec(np.float32, (None,))},
+                on_host=True, batched=False)

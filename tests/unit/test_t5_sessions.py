@@ -70,6 +70,82 @@ class TestSessionDecode:
             sigs["decode_step"].run({"session_id": sid})
 
 
+class TestAtMostOnceSteps:
+    """The optional step_ordinal guard on the dense per-session surface:
+    duplicate resends replay the cached response bit-identically without
+    re-ticking; absent ordinal, the stream is unchanged."""
+
+    def _step(self, sigs, sid, ordinal=None):
+        inputs = {"session_id": sid}
+        if ordinal is not None:
+            inputs["step_ordinal"] = np.asarray(ordinal, np.int64)
+        return sigs["decode_step"].run(inputs)
+
+    def test_duplicate_resend_is_bit_identical_and_does_not_tick(
+            self, tiny):
+        config, params, sigs = tiny
+        ids = _ids(config)
+        # Reference stream WITHOUT ordinals: the guard must not change
+        # emitted tokens (wire compatibility).
+        ref_sid = np.asarray(b"ord-ref", object)
+        sigs["decode_init"].run({"session_id": ref_sid, "input_ids": ids})
+        reference = [self._step(sigs, ref_sid)["token"] for _ in range(6)]
+
+        sid = np.asarray(b"ord-guarded", object)
+        sigs["decode_init"].run({"session_id": sid, "input_ids": ids})
+        for i in range(6):
+            out = self._step(sigs, sid, ordinal=i + 1)
+            # Resend the SAME ordinal — including the final step, whose
+            # session the exhaustion path already closed: every output
+            # must come back bit-identical, and the stream must not
+            # advance (the next ordinal still yields the right token).
+            dup = self._step(sigs, sid, ordinal=i + 1)
+            for key in out:
+                np.testing.assert_array_equal(out[key], dup[key])
+            np.testing.assert_array_equal(out["token"], reference[i])
+            assert int(out["step"]) == i + 1
+
+    def test_out_of_order_ordinal_is_typed_error(self, tiny):
+        config, _, sigs = tiny
+        sid = np.asarray(b"ord-gap", object)
+        sigs["decode_init"].run({"session_id": sid,
+                                 "input_ids": _ids(config)})
+        self._step(sigs, sid, ordinal=1)
+        with pytest.raises(ServingError, match="out of order"):
+            self._step(sigs, sid, ordinal=3)  # gap
+        # the stream is intact: the correct next ordinal still works
+        out = self._step(sigs, sid, ordinal=2)
+        assert int(out["step"]) == 2
+        sigs["decode_close"].run({"session_id": sid})
+
+    def test_reinit_clears_the_ordinal_guard(self, tiny):
+        """A re-init over a previously-used session id is a NEW stream:
+        the dedup cache (which deliberately outlives exhaustion) must
+        not judge — or replay — the fresh stream against the dead one."""
+        config, _, sigs = tiny
+        ids = _ids(config)
+        sid = np.asarray(b"ord-reinit", object)
+        sigs["decode_init"].run({"session_id": sid, "input_ids": ids})
+        for i in range(6):  # exhaust WITHOUT close: cache survives
+            self._step(sigs, sid, ordinal=i + 1)
+        sigs["decode_init"].run({"session_id": sid, "input_ids": ids})
+        out = self._step(sigs, sid, ordinal=1)  # fresh numbering works
+        assert int(out["step"]) == 1
+        sigs["decode_close"].run({"session_id": sid})
+
+    def test_close_forgets_the_dedup_entry(self, tiny):
+        config, _, sigs = tiny
+        sid = np.asarray(b"ord-close", object)
+        sigs["decode_init"].run({"session_id": sid,
+                                 "input_ids": _ids(config)})
+        self._step(sigs, sid, ordinal=1)
+        sigs["decode_close"].run({"session_id": sid})
+        # after close the cache is gone: a stale resend is NOT_FOUND,
+        # not a replay of a dead session's bytes
+        with pytest.raises(ServingError, match="does not exist"):
+            self._step(sigs, sid, ordinal=1)
+
+
 class TestSessionStore:
     def test_capacity_backpressure_not_eviction(self):
         from min_tfs_client_tpu.servables.decode_sessions import (
