@@ -429,8 +429,15 @@ class BatchedSignatureRunner:
         # saw at enqueue).
         trace = tracing.current_trace()
         if trace is not None:
+            # request_examples is THIS caller's real-example count — the
+            # numerator of its amortized device-execute share (the
+            # batch-level batch_size/padding_bucket annotations are
+            # fanned out identically to every rider; without the
+            # per-rider size, cost attribution could not split the
+            # merged wall; observability/costs.py).
             trace.annotate(queue=self._queue.name,
-                           queue_depth=self._queue.depth())
+                           queue_depth=self._queue.depth(),
+                           request_examples=n)
         task = BatchTask(inputs=arrays, size=n,
                          output_filter=tuple(output_filter), trace=trace)
         # Pre-enqueue faultpoint: a delay here widens the batching

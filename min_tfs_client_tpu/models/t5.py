@@ -1574,7 +1574,10 @@ def _build_pooled_session_signatures(params: dict, config: T5Config, *,
     else:
         pool = SlotPool(template, one_step, max_slots=max_slots,
                         params=params, metric_label="t5-pooled")
-    batcher = TickBatcher(pool.tick)
+    # cost_fn: each delivered step charges its session's pages-held
+    # onto the CALLER's trace (pages x ticks, the paged pool's
+    # HBM-residency cost unit; None on the dense pool).
+    batcher = TickBatcher(pool.tick, cost_fn=pool.step_cost)
     store = DecodeSessionStore(
         max_sessions=max_slots, ttl_s=session_ttl_s,
         metric_label="t5-pooled",

@@ -66,6 +66,16 @@ def record_compile(label: str, shape_bucket: str, wall_s: float,
                                wall_ms=round(wall_s * 1e3, 3))
     except Exception:  # pragma: no cover
         pass
+    try:
+        # Cost attribution: the compile's wall time bills the request
+        # that triggered the miss (a merged batch's fanout splits it
+        # across the riders) — observability/costs.py folds it into
+        # that request's cost vector.
+        from min_tfs_client_tpu.observability import tracing
+
+        tracing.add_cost(compile_us=wall_s * 1e6)
+    except Exception:  # pragma: no cover
+        pass
 
 
 def compile_ledger() -> dict:
@@ -289,6 +299,14 @@ def count_transfer(direction: str, nbytes: int) -> None:
 
         metrics.transfer_bytes.increment(direction, by=float(nbytes))
     except Exception:  # pragma: no cover - metrics must not break serving
+        pass
+    try:
+        # Link bytes bill the request that moved them (batch fanout
+        # splits across riders; no-op off the request path).
+        from min_tfs_client_tpu.observability import tracing
+
+        tracing.add_cost(transfer_bytes=float(nbytes))
+    except Exception:  # pragma: no cover - costs must not break serving
         pass
 
 

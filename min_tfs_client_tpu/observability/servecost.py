@@ -1,0 +1,174 @@
+"""`servecost` — aggregate cost-attribution JSONL logs into a
+per-model cost dataset artifact.
+
+The servers write schema-versioned wide-event logs (`--cost_log_dir`,
+observability/costs.py): one record per sampled request carrying its
+full cost vector and `trace_id`. This CLI folds one or many such logs
+(a bench run, a fleet_storm, a soak) into ONE dataset artifact:
+
+    servecost --out dataset.json run1/ run2/costs-123.jsonl
+
+The artifact is what ROADMAP item 4's autotuner trains on, so it is
+stamped with the knob context each producing server recorded (batch
+buckets, --max_in_flight_batches, --kv_block_size, prefill chunk,
+mesh) — a cost sample without its configuration is noise. Per
+(model, signature) it aggregates count, per-request means, p50/p99 of
+the device share and total latency, and window totals.
+
+Malformed lines are counted and reported (never silently skipped into
+a "clean" dataset); records from an unknown schema fail the run —
+retraining on misparsed vectors would be worse than failing.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+
+from min_tfs_client_tpu.observability.costs import SCHEMA, VECTOR_FIELDS
+
+DATASET_SCHEMA = "servecost-dataset/1"
+
+# Fields whose distribution (not just mean) the autotuner cares about.
+_QUANTILE_FIELDS = ("device_execute_us", "total_us")
+
+
+def _iter_log_files(paths):
+    for raw in paths:
+        path = pathlib.Path(raw)
+        if path.is_dir():
+            yield from sorted(path.glob("*.jsonl"))
+        else:
+            yield path
+
+
+class _Agg:
+    __slots__ = ("count", "sums", "samples")
+
+    def __init__(self):
+        self.count = 0
+        self.sums = {f: 0.0 for f in VECTOR_FIELDS}
+        self.samples = {f: [] for f in _QUANTILE_FIELDS}
+
+    def add(self, record: dict) -> None:
+        self.count += 1
+        for field in VECTOR_FIELDS:
+            self.sums[field] += float(record.get(field, 0.0))
+        for field in _QUANTILE_FIELDS:
+            self.samples[field].append(float(record.get(field, 0.0)))
+
+    def to_dict(self) -> dict:
+        out = {
+            "count": self.count,
+            "mean": {f: round(self.sums[f] / self.count, 3)
+                     for f in VECTOR_FIELDS},
+            "total": {f: round(self.sums[f], 3) for f in VECTOR_FIELDS},
+        }
+        for field, xs in self.samples.items():
+            xs.sort()
+            out[f"{field}_p50"] = round(xs[len(xs) // 2], 3)
+            out[f"{field}_p99"] = round(
+                xs[min(len(xs) - 1, int(len(xs) * 0.99))], 3)
+        return out
+
+
+def aggregate(paths) -> dict:
+    """Fold cost logs under `paths` (files or directories) into the
+    dataset dict. Raises ValueError on an unknown record schema."""
+    models: dict = {}
+    contexts: list = []
+    sources: list = []
+    records = malformed = 0
+    for path in _iter_log_files(paths):
+        sources.append(str(path))
+        try:
+            lines = path.read_text(encoding="utf-8").splitlines()
+        except OSError as exc:
+            raise ValueError(f"cannot read {path}: {exc}") from exc
+        for line in lines:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                record = json.loads(line)
+                if not isinstance(record, dict):
+                    raise ValueError("record is not an object")
+            except (ValueError, json.JSONDecodeError):
+                malformed += 1
+                continue
+            schema = record.get("schema")
+            if schema != SCHEMA:
+                raise ValueError(
+                    f"{path}: record schema {schema!r} is not the "
+                    f"supported {SCHEMA!r} — refusing to misparse a "
+                    "cost dataset")
+            kind = record.get("kind")
+            if kind == "meta":
+                context = record.get("context") or {}
+                if context not in contexts:
+                    contexts.append(context)
+                continue
+            if kind != "cost":
+                malformed += 1
+                continue
+            records += 1
+            model = record.get("model") or "unknown"
+            signature = record.get("signature") or ""
+            agg = models.setdefault(model, {}).setdefault(
+                signature, _Agg())
+            agg.add(record)
+    return {
+        "schema": DATASET_SCHEMA,
+        "source_schema": SCHEMA,
+        "sources": sources,
+        "records": records,
+        "malformed": malformed,
+        "contexts": contexts,
+        "models": {
+            model: {sig: agg.to_dict() for sig, agg in sigs.items()}
+            for model, sigs in sorted(models.items())
+        },
+    }
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        "servecost",
+        description="Aggregate servecost JSONL cost logs into a "
+                    "per-model cost dataset artifact "
+                    "(docs/OBSERVABILITY.md 'Cost attribution').")
+    parser.add_argument("paths", nargs="+",
+                        help="cost-log files or directories "
+                             "(directories glob *.jsonl)")
+    parser.add_argument("--out", default="servecost_dataset.json",
+                        help="dataset artifact path (JSON)")
+    parser.add_argument("--allow-empty", action="store_true",
+                        help="exit 0 even when no cost records were "
+                             "found (default: that is an error — an "
+                             "empty dataset usually means the wrong "
+                             "directory)")
+    args = parser.parse_args(argv)
+    try:
+        dataset = aggregate(args.paths)
+    except ValueError as exc:
+        print(f"servecost: {exc}", file=sys.stderr)
+        return 2
+    out = pathlib.Path(args.out)
+    out.write_text(json.dumps(dataset, indent=1, sort_keys=True) + "\n",
+                   encoding="utf-8")
+    print(f"servecost: {dataset['records']} records "
+          f"({dataset['malformed']} malformed) from "
+          f"{len(dataset['sources'])} file(s) -> {out} "
+          f"[{len(dataset['models'])} model(s), "
+          f"{len(dataset['contexts'])} context(s)]")
+    if dataset["records"] == 0 and not args.allow_empty:
+        print("servecost: no cost records found (pass --allow-empty "
+              "to accept)", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

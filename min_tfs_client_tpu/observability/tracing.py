@@ -207,7 +207,8 @@ class RequestTrace:
     """
 
     __slots__ = ("id", "trace_id", "api", "model", "signature", "transport",
-                 "status", "start", "wall_start", "end", "spans", "meta")
+                 "status", "start", "wall_start", "end", "spans", "meta",
+                 "costs")
 
     def __init__(self, api: str, model: str = "", signature: str = "",
                  transport: str = "", trace_id: str | None = None):
@@ -230,6 +231,11 @@ class RequestTrace:
         self.end: float | None = None
         self.spans: list[tuple] = []  # (name, t0, t1, args|None)
         self.meta: dict = {}
+        # Accumulated cost events (observability/costs.py): compile wall
+        # attributed to the triggering request, transfer bytes, KV
+        # page-ticks. None until the first add_cost — most requests
+        # never pay the dict.
+        self.costs: dict | None = None
 
     def add_span(self, name: str, t0: float, t1: float,
                  args: dict | None = None) -> None:
@@ -247,6 +253,17 @@ class RequestTrace:
                     self.meta[k] = float(v)
                 except (TypeError, ValueError):
                     self.meta[k] = str(v)
+
+    def add_cost(self, **kv) -> None:
+        """Accumulate cost-event values (summed, not overwritten — a
+        request can trigger several compiles or transfers). Fed into
+        the per-request cost vector by observability/costs.py when the
+        trace finishes."""
+        costs = self.costs
+        if costs is None:
+            costs = self.costs = {}
+        for k, v in kv.items():
+            costs[k] = costs.get(k, 0.0) + float(v)
 
     def duration_s(self) -> float:
         return (self.end if self.end is not None
@@ -297,6 +314,17 @@ class _Fanout:
         for tr in self.traces:
             tr.annotate(**kv)
 
+    def add_cost(self, **kv):
+        """A cost event raised while executing a MERGED batch (e.g. the
+        compile the batch triggered) is shared work: split it evenly
+        across the riders so the fleet-wide sum stays conserved."""
+        n = len(self.traces)
+        if not n:
+            return
+        split = {k: float(v) / n for k, v in kv.items()}
+        for tr in self.traces:
+            tr.add_cost(**split)
+
 
 def current_trace():
     """The RequestTrace (or batch fanout) active on this thread, or None."""
@@ -307,6 +335,14 @@ def annotate(**kv) -> None:
     tr = _current.get()
     if tr is not None:
         tr.annotate(**kv)
+
+
+def add_cost(**kv) -> None:
+    """Accumulate cost events onto the current trace (no-op without
+    one). A batch fanout splits the value across its riders."""
+    tr = _current.get()
+    if tr is not None and hasattr(tr, "add_cost"):
+        tr.add_cost(**kv)
 
 
 @contextlib.contextmanager
@@ -524,6 +560,16 @@ def _export_metrics(trace: RequestTrace) -> None:
 
         slo.observe_trace(trace)
     except Exception:  # pragma: no cover - SLO must not break serving
+        pass
+    try:
+        # Cost attribution ingests here too — same off-the-hot-path
+        # discipline: the request path records spans/cost events, the
+        # drain thread folds them into vectors, aggregates, and the
+        # (sampled) JSONL wide-event log.
+        from min_tfs_client_tpu.observability import costs
+
+        costs.observe_trace(trace)
+    except Exception:  # pragma: no cover - costs must not break serving
         pass
     try:
         from min_tfs_client_tpu.server import metrics

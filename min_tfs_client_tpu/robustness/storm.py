@@ -610,6 +610,96 @@ class FleetStorm:
                 pass
 
 
+def load_cost_records(log_dir) -> tuple[list, int]:
+    """Read every servecost JSONL record under `log_dir` (the fleet's
+    shared --cost_log_dir): (cost records, malformed line count). Meta
+    records are schema-checked and skipped; a malformed line counts,
+    never hides."""
+    import pathlib
+
+    records: list = []
+    malformed = 0
+    for path in sorted(pathlib.Path(log_dir).glob("*.jsonl")):
+        data = path.read_text(encoding="utf-8")
+        lines = data.split("\n")
+        # A SIGKILLed backend can leave ONE unterminated tail line in
+        # its own file; that is the kill's signature, not a malformed
+        # record. Anything unparseable on a COMPLETE line counts.
+        unterminated_tail = bool(lines and lines[-1] != "")
+        for index, line in enumerate(lines):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                record = json.loads(line)
+                if not isinstance(record, dict):
+                    raise ValueError("record is not an object")
+            except ValueError:
+                if not (unterminated_tail and index == len(lines) - 1):
+                    malformed += 1
+                continue
+            if record.get("kind") == "cost":
+                records.append(record)
+    return records, malformed
+
+
+def ring_trace_ids(rest_port: int, timeout_s: float = 10.0) -> set:
+    """The fleet-scope trace ids currently in one process's trace ring
+    (GET /monitoring/traces request envelopes) — what a run's cost log
+    must JOIN against."""
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{rest_port}/monitoring/traces",
+            timeout=timeout_s) as resp:
+        payload = json.loads(resp.read())
+    return {event["args"]["trace_id"]
+            for event in payload.get("traceEvents", ())
+            if event.get("cat") == "request"
+            and (event.get("args") or {}).get("trace_id")}
+
+
+def verify_cost_log_join(log_dir, backend_rest_ports,
+                         min_join_fraction: float = 0.95,
+                         settle_s: float = 6.0) -> dict:
+    """The storm's cost-attribution verdict (ROADMAP item 7's
+    adversarial-training-mix increment): every record parses, every
+    record carries a wire-valid trace id, and the run's ring traces
+    JOIN the cost log by trace_id. Polls up to `settle_s` for the
+    tracing drain thread to flush the tail (records land ~0.5s after a
+    trace finishes). Returns the verdict dict; raises AssertionError on
+    violation."""
+    from min_tfs_client_tpu.observability import tracing
+
+    ring_ids: set = set()
+    for port in backend_rest_ports:
+        try:
+            ring_ids |= ring_trace_ids(port)
+        except Exception:  # noqa: BLE001 - a killed backend's port
+            continue       # legitimately stops answering
+    deadline = time.monotonic() + settle_s
+    while True:
+        records, malformed = load_cost_records(log_dir)
+        logged_ids = {r.get("trace_id") for r in records}
+        joined = ring_ids & logged_ids
+        fraction = len(joined) / len(ring_ids) if ring_ids else 0.0
+        if fraction >= min_join_fraction or time.monotonic() > deadline:
+            break
+        time.sleep(0.25)
+    assert malformed == 0, \
+        f"{malformed} malformed cost-log line(s) under {log_dir}"
+    assert records, f"no cost records under {log_dir}"
+    invalid = [r.get("trace_id") for r in records
+               if not tracing.valid_trace_id(r.get("trace_id") or "")]
+    assert not invalid, \
+        f"cost records with invalid trace ids: {invalid[:5]}"
+    assert ring_ids, "no request traces found in any backend ring"
+    assert fraction >= min_join_fraction, (
+        f"only {len(joined)}/{len(ring_ids)} ring traces joined the "
+        f"cost log (want >= {min_join_fraction:.0%})")
+    return {"records": len(records), "malformed": malformed,
+            "ring_ids": len(ring_ids), "joined": len(joined),
+            "join_fraction": round(fraction, 4)}
+
+
 def _pct(values: list, pct: float) -> float:
     ordered = sorted(values)
     index = min(len(ordered) - 1,

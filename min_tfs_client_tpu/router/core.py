@@ -165,6 +165,7 @@ class RouterCore:
         session_idle_timeout_s: float = 3600.0,
         bounded_load_c: float = ring_mod.BOUNDED_LOAD_C,
         poller=None,
+        fleet_scrape_interval_s: float = 2.0,
     ):
         self.bounded_load_c = bounded_load_c
         self.channels = ChannelPool()
@@ -193,14 +194,25 @@ class RouterCore:
             on_dead=self._backend_died,
             on_tick=self._tick,
         )
+        # Fleet-wide monitoring aggregation (/monitoring/fleet): its
+        # OWN thread + keep-alive pool — the health poller's
+        # poll-to-eject latency is a liveness contract that must not
+        # queue behind 3 monitoring fetches per backend.
+        from min_tfs_client_tpu.router.fleet import FleetScraper
+
+        self.fleet = FleetScraper(
+            self.membership, interval_s=fleet_scrape_interval_s,
+            timeout_s=min(probe_timeout_s, fleet_scrape_interval_s))
 
     # -- lifecycle -----------------------------------------------------------
 
     def start(self) -> "RouterCore":
         self.membership.start()
+        self.fleet.start()
         return self
 
     def stop(self) -> None:
+        self.fleet.stop()
         self.membership.stop()
         self.channels.close()
 

@@ -1,0 +1,314 @@
+"""Fleet-wide monitoring aggregation: the router's `/monitoring/fleet`.
+
+N backends each answer /monitoring/{slo,runtime,costs} about themselves;
+nothing saw the FLEET — "which replica is burning its SLO budget",
+"how much KV headroom is left across the tier", "what does a request
+cost on each backend" all required N scrapes and a join by hand. The
+router already owns the membership view and keep-alive connections to
+every backend's REST port, so it is the natural single pane:
+
+ * `FleetScraper` polls every backend's slo/runtime/costs payloads on
+   its own cadence (`--fleet_scrape_interval_s`), over its own
+   keep-alive pool — NEVER on the health-poll thread, whose
+   poll-to-eject latency is a liveness contract this scrape must not
+   stretch.
+ * A dark backend DEGRADES the payload, never wedges the scrape: each
+   fetch is bounded by `timeout_s`, a failure marks the backend
+   `unreachable` (and `stale` once past the staleness window) while
+   the last good payload is retained with its age — and DEAD backends
+   (per the membership table) are not probed at all, so a crashed
+   replica costs the sweep nothing.
+ * Per-backend summaries re-export as router Prometheus gauges
+   (`tpu_serving_fleet_*`), so one scrape target answers for the tier.
+
+Staleness semantics (docs/OBSERVABILITY.md "Cost attribution & fleet
+view"): `stale` = the scraper has no payload newer than
+`stale_after_s` (~2.5 poll intervals) OR the backend is DEAD/
+unreachable; `age_s` is the last good payload's age. Consumers must
+treat stale entries as history, not state.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Optional
+
+from min_tfs_client_tpu.router.http_pool import KeepAliveHTTPPool
+
+log = logging.getLogger(__name__)
+
+# The backend monitoring endpoints one sweep fetches, in fetch order.
+ENDPOINTS = ("slo", "runtime", "costs")
+
+
+class _BackendScrape:
+    """Mutable per-backend scrape state. All fields guarded by the
+    scraper lock."""
+
+    __slots__ = ("payloads", "fetched_at", "error", "unreachable",
+                 "attempts", "ok")
+
+    def __init__(self):
+        self.payloads: dict = {}
+        self.fetched_at: Optional[float] = None
+        self.error: Optional[str] = None
+        self.unreachable = False
+        self.attempts = 0
+        self.ok = 0
+
+
+class FleetScraper:
+    """The /monitoring/fleet data source: one polling thread, one
+    keep-alive pool, per-backend last-known payloads + staleness."""
+
+    def __init__(self, membership, interval_s: float = 2.0,
+                 timeout_s: float = 2.0,
+                 stale_after_s: Optional[float] = None):
+        self.membership = membership
+        self.interval_s = max(0.1, float(interval_s))
+        self.timeout_s = max(0.1, float(timeout_s))
+        # ~2.5 intervals: one missed sweep is jitter, two is an outage.
+        self.stale_after_s = (float(stale_after_s) if stale_after_s
+                              else self.interval_s * 2.5)
+        self._pool = KeepAliveHTTPPool(timeout_s=self.timeout_s,
+                                       max_idle_per_target=2)
+        self._lock = threading.Lock()
+        self._scrapes: dict[str, _BackendScrape] = {}  # guarded_by: self._lock
+        self._sweeps = 0                               # guarded_by: self._lock
+        self._stop = threading.Event()
+        # servelint: thread-ok published once here, before start() can spawn
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "FleetScraper":
+        self.scrape_once()  # synchronous first pass: fleet view at boot
+        self._thread = threading.Thread(
+            target=self._loop, name="router-fleet-scrape", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=self.interval_s
+                              + 3 * self.timeout_s + 5.0)
+        self._pool.close()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(timeout=self.interval_s):
+            try:
+                self.scrape_once()
+            except Exception:  # pragma: no cover - scrape must survive
+                if self._stop.is_set():
+                    return  # teardown race (pool closing), not a failure
+                log.exception("fleet scrape pass failed")
+
+    # -- scraping ------------------------------------------------------------
+
+    def scrape_once(self) -> None:
+        """One sweep over the fleet. Fetches run OUTSIDE the lock; a
+        backend's first failed endpoint fails the whole backend for
+        this sweep (no point paying two more timeouts against a dark
+        process)."""
+        from min_tfs_client_tpu.router.membership import DEAD
+
+        backends = self.membership.backends()
+        states = self.membership.states()
+        results: dict[str, tuple] = {}
+        for backend in backends:
+            bid = backend.backend_id
+            if not backend.rest_port:
+                continue
+            if states.get(bid) == DEAD:
+                # The health plane already proved it dark — record the
+                # verdict without burning 3 timeouts on it.
+                results[bid] = (None, "backend DEAD per health plane")
+                continue
+            payloads: dict = {}
+            error: Optional[str] = None
+            for endpoint in ENDPOINTS:
+                try:
+                    status, _, raw = self._pool.request(
+                        backend.host, backend.rest_port, "GET",
+                        f"/monitoring/{endpoint}",
+                        timeout_s=self.timeout_s)
+                    if status != 200:
+                        raise ValueError(f"HTTP {status}")
+                    import json
+
+                    payloads[endpoint] = json.loads(raw)
+                except Exception as exc:  # noqa: BLE001 - degrade, never wedge
+                    error = f"/monitoring/{endpoint}: {exc}"
+                    break
+            results[bid] = ((payloads, None) if error is None
+                            else (None, error))
+        now = time.monotonic()
+        with self._lock:
+            self._sweeps += 1
+            for bid, (payloads, error) in results.items():
+                scrape = self._scrapes.get(bid)
+                if scrape is None:
+                    scrape = self._scrapes[bid] = _BackendScrape()
+                scrape.attempts += 1
+                if payloads is not None:
+                    scrape.payloads = payloads
+                    scrape.fetched_at = now
+                    scrape.error = None
+                    scrape.unreachable = False
+                    scrape.ok += 1
+                else:
+                    # Keep the last good payloads (with their age) —
+                    # history beats a hole — but mark the miss.
+                    scrape.error = error
+                    scrape.unreachable = True
+        self._export_gauges()
+
+    # -- the payload ---------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """The /monitoring/fleet payload: per-backend condensed
+        slo/runtime/costs summaries with staleness marking, plus the
+        fleet-wide roll-up."""
+        now = time.monotonic()
+        states = self.membership.states()
+        with self._lock:
+            sweeps = self._sweeps
+            scraped = {bid: (dict(s.payloads), s.fetched_at, s.error,
+                             s.unreachable, s.attempts, s.ok)
+                       for bid, s in self._scrapes.items()}
+        backends = {}
+        fleet = {"backends": 0, "stale_backends": 0,
+                 "max_slo_burn_rate": 0.0,
+                 "kv_blocks_used": 0, "kv_blocks_total": 0,
+                 "max_tick_utilization": 0.0,
+                 "cost_entries": 0}
+        for backend in self.membership.backends():
+            bid = backend.backend_id
+            if not backend.rest_port:
+                backends[bid] = {"state": states.get(bid, "UNKNOWN"),
+                                 "rest_port": False, "stale": True,
+                                 "error": "backend advertises no REST "
+                                          "port; nothing to scrape"}
+                fleet["backends"] += 1
+                fleet["stale_backends"] += 1
+                continue
+            payloads, fetched_at, error, unreachable, attempts, ok = \
+                scraped.get(bid, ({}, None, "never scraped", True, 0, 0))
+            age_s = (now - fetched_at) if fetched_at is not None else None
+            stale = (unreachable or age_s is None
+                     or age_s > self.stale_after_s)
+            entry = {
+                "state": states.get(bid, "UNKNOWN"),
+                "rest_port": True,
+                "stale": stale,
+                "unreachable": unreachable,
+                "age_s": round(age_s, 3) if age_s is not None else None,
+                "error": error,
+                "scrapes": {"attempts": attempts, "ok": ok},
+            }
+            entry.update(_condense(payloads))
+            backends[bid] = entry
+            fleet["backends"] += 1
+            if stale:
+                fleet["stale_backends"] += 1
+            fleet["max_slo_burn_rate"] = max(
+                fleet["max_slo_burn_rate"],
+                entry.get("slo", {}).get("max_burn_rate", 0.0))
+            for pool in entry.get("kv", ()):
+                fleet["kv_blocks_used"] += pool.get("blocks_used", 0)
+                fleet["kv_blocks_total"] += pool.get("num_blocks", 0)
+            ticks = entry.get("tick_utilization", {})
+            if ticks:
+                fleet["max_tick_utilization"] = max(
+                    fleet["max_tick_utilization"], max(ticks.values()))
+            fleet["cost_entries"] += len(entry.get("costs", ()))
+        fleet["live_backends"] = len(self.membership.live_ids())
+        return {
+            "scrape_interval_s": self.interval_s,
+            "stale_after_s": self.stale_after_s,
+            "sweeps": sweeps,
+            "backends": backends,
+            "fleet": fleet,
+        }
+
+    def _export_gauges(self) -> None:
+        """Re-export the per-backend roll-ups as router gauges — one
+        Prometheus target answering for the tier."""
+        try:
+            from min_tfs_client_tpu.server import metrics
+
+            snap = self.snapshot()
+            for bid, entry in snap["backends"].items():
+                metrics.safe_set(metrics.fleet_backend_stale,
+                                 1.0 if entry.get("stale") else 0.0, bid)
+                metrics.safe_set(
+                    metrics.fleet_slo_max_burn_rate,
+                    entry.get("slo", {}).get("max_burn_rate", 0.0), bid)
+                used = total = 0
+                for pool in entry.get("kv", ()):
+                    used += pool.get("blocks_used", 0)
+                    total += pool.get("num_blocks", 0)
+                metrics.safe_set(metrics.fleet_kv_blocks_used,
+                                 float(used), bid)
+                metrics.safe_set(metrics.fleet_kv_blocks_total,
+                                 float(total), bid)
+                ticks = entry.get("tick_utilization", {})
+                metrics.safe_set(metrics.fleet_tick_utilization,
+                                 max(ticks.values()) if ticks else 0.0,
+                                 bid)
+        except Exception:  # pragma: no cover - metrics must not break scrape
+            pass
+
+
+def _condense(payloads: dict) -> dict:
+    """Per-backend summary blocks from the raw scraped payloads. The
+    full backend payloads stay one hop away (the backend's own ports);
+    the fleet view carries what cross-replica decisions need."""
+    out: dict = {}
+    slo = payloads.get("slo")
+    if isinstance(slo, dict):
+        max_burn = 0.0
+        count = 0
+        for entry in slo.get("entries", ()):
+            burn = entry.get("burn_rate") or {}
+            max_burn = max(max_burn, burn.get("max", 0.0))
+            count += entry.get("count", 0)
+        out["slo"] = {
+            "max_burn_rate": round(max_burn, 4),
+            "window_count": count,
+            "entries": len(slo.get("entries", ())),
+            "shed_burn_rate": slo.get("default_objective", {}).get(
+                "shed_burn_rate", 0.0),
+        }
+    runtime = payloads.get("runtime")
+    if isinstance(runtime, dict):
+        out["kv"] = [
+            {key: pool.get(key) for key in (
+                "model", "block_size", "num_blocks", "blocks_used",
+                "sessions", "swapped_sessions", "table_width",
+                "kv_gather_bytes_per_tick", "step_contract")}
+            for pool in runtime.get("kv_pool", ())
+            if isinstance(pool, dict)]
+        compile_ledger = runtime.get("compile") or {}
+        out["compile"] = {
+            "total_compiles": compile_ledger.get("total_compiles", 0)}
+        out["transfer"] = runtime.get("transfer") or {}
+        out["pipeline"] = {
+            name: {"in_flight": stats.get("in_flight"),
+                   "overlap_ratio": stats.get("overlap_ratio")}
+            for name, stats in (runtime.get("pipeline") or {}).items()
+            if isinstance(stats, dict)}
+    costs = payloads.get("costs")
+    if isinstance(costs, dict):
+        out["costs"] = costs.get("entries", [])
+        out["tick_utilization"] = costs.get("tick_utilization", {})
+        out["cost_context"] = costs.get("context", {})
+        log_stats = costs.get("log") or {}
+        out["cost_log"] = {
+            "records_written": log_stats.get("records_written", 0),
+            "sample": log_stats.get("sample"),
+        }
+    return out

@@ -62,6 +62,10 @@ class RouterOptions:
     # robustness/faults.py points in THIS router process; "" = honor
     # TPU_SERVING_FAULT_PLAN, else disarmed (docs/ROBUSTNESS.md).
     fault_plan: str = ""
+    # Fleet monitoring aggregation cadence (router/fleet.py): seconds
+    # between sweeps of every backend's /monitoring/{slo,runtime,
+    # costs}, served at /monitoring/fleet with per-backend staleness.
+    fleet_scrape_interval_s: float = 2.0
 
 
 class RouterServer:
@@ -102,6 +106,7 @@ class RouterServer:
             session_idle_timeout_s=opts.session_idle_timeout_s,
             bounded_load_c=opts.bounded_load_c,
             poller=self._poller,
+            fleet_scrape_interval_s=opts.fleet_scrape_interval_s,
         )
         self.core.start()
         if opts.data_plane == "aio":
@@ -256,6 +261,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "points in this router — TESTING/CHAOS ONLY "
                         "(docs/ROBUSTNESS.md). Empty = honor "
                         "TPU_SERVING_FAULT_PLAN, else disarmed")
+    p.add_argument("--fleet_scrape_interval_s", type=float, default=2.0,
+                   help="seconds between fleet-monitoring sweeps: the "
+                        "router scrapes every backend's /monitoring/"
+                        "{slo,runtime,costs} and serves the aggregate "
+                        "at /monitoring/fleet with per-backend "
+                        "staleness marking (docs/OBSERVABILITY.md)")
     return p
 
 
@@ -276,6 +287,7 @@ def options_from_args(args) -> RouterOptions:
         flight_recorder_dir=args.flight_recorder_dir,
         trace_ring_size=args.trace_ring_size,
         fault_plan=args.fault_plan,
+        fleet_scrape_interval_s=args.fleet_scrape_interval_s,
     )
 
 

@@ -52,6 +52,22 @@ from min_tfs_client_tpu.utils.status import ServingError
 
 log = logging.getLogger(__name__)
 
+
+def _executors_exiting() -> bool:
+    """True once concurrent.futures' interpreter-exit hook has run: the
+    atexit handler retires EVERY ThreadPoolExecutor (each worker marks
+    its executor shut on the way out), so any probe submit after that
+    point raises by construction — a daemon poll loop still alive then
+    is in teardown, not in trouble. Reads the module's own shutdown
+    flag; private but stable since 3.9 (bpo-39812)."""
+    try:
+        from concurrent.futures import thread as _cf_thread
+
+        return bool(_cf_thread._shutdown)
+    except Exception:  # pragma: no cover - future stdlib reshuffle
+        return False
+
+
 LIVE = "LIVE"
 DRAINING = "DRAINING"
 DEAD = "DEAD"
@@ -287,6 +303,15 @@ class MembershipTable:
         if self._thread is not None:
             self._thread.join(timeout=self.poll_interval_s
                               + self.probe_timeout_s + 5.0)
+            if self._thread.is_alive():
+                # The bounded join expired (GIL-starved box at
+                # teardown): the loop may be mid-poll, and shutting
+                # the probe pool under it would turn every remaining
+                # pass into a submit-after-shutdown error spin. Leave
+                # the pool up — the daemon loop exits at its next
+                # _stop check, and the interpreter's own atexit path
+                # reaps idle executor workers.
+                return
         self._probe_pool.shutdown(wait=False)
 
     def _poll_loop(self) -> None:
@@ -300,6 +325,15 @@ class MembershipTable:
             try:
                 self.poll_once()
             except Exception:  # pragma: no cover - poll must survive
+                if self._stop.is_set() or _executors_exiting():
+                    # Teardown, not a poll failure: either stop()'s
+                    # bounded join expired on a saturated box (probe
+                    # pool already shut), or the interpreter is
+                    # exiting and concurrent.futures' atexit hook has
+                    # retired every executor — a daemon poll loop
+                    # still alive at that point must go quietly, not
+                    # spin-log submit-after-shutdown errors.
+                    return
                 log.exception("membership poll pass failed")
 
     # -- polling -------------------------------------------------------------

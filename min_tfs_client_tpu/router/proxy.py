@@ -658,6 +658,10 @@ class GrpcProxy:
 # -- REST data plane ---------------------------------------------------------
 
 ROUTER_PAYLOAD_PATH = "/monitoring/router"
+# Fleet-wide monitoring aggregation (router/fleet.py): every backend's
+# slo/runtime/costs, scraped on a cadence, condensed with per-backend
+# staleness marking — the one endpoint that sees the whole tier.
+FLEET_PAYLOAD_PATH = "/monitoring/fleet"
 
 # Request headers forwarded to the backend (everything else is
 # hop-by-hop or transport-owned).
@@ -685,6 +689,9 @@ def rest_route_request(core: RouterCore, method: str, path: str,
     if method == "GET" and bare == ROUTER_PAYLOAD_PATH:
         return 200, "application/json", json.dumps(
             core.snapshot()).encode()
+    if method == "GET" and bare == FLEET_PAYLOAD_PATH:
+        return 200, "application/json", json.dumps(
+            core.fleet.snapshot()).encode()
     if method == "GET" and bare == rest_mod.TRACES_DEFAULT_PATH:
         return _router_traces_reply(core, _query)
     if method == "GET" and bare == rest_mod.FLIGHT_RECORDER_PATH:

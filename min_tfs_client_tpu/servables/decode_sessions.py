@@ -258,6 +258,19 @@ def _registered_timelines() -> list[SessionTimelines]:
     return [t for t in (r() for r in refs) if t is not None]
 
 
+def _note_tick_cost(label: str, busy_s: float) -> None:
+    """Report one tick-loop device round to the duty-cycle registry
+    (observability/costs.py -> tpu_serving_tick_utilization). One call
+    per device round — amortized over every session the tick advanced,
+    never per token."""
+    try:
+        from min_tfs_client_tpu.observability import costs
+
+        costs.note_tick(label, busy_s)
+    except Exception:  # pragma: no cover - telemetry must not break ticks
+        pass
+
+
 # Default event cap for the LIST view: the summary must stay scrapeable
 # with hundreds of live sessions; ?session= detail returns the full ring.
 _LIST_VIEW_EVENTS = 8
@@ -588,6 +601,7 @@ class SlotPool:
         self._jax = jax
         self.max_slots = max_slots
         self._params = params
+        self.metric_label = metric_label
         self.timeline = SessionTimelines(label=metric_label)
         shapes = jax.eval_shape(lambda: template_state)
         self._pool = jax.tree_util.tree_map(
@@ -665,11 +679,19 @@ class SlotPool:
                     self._jax.numpy.asarray(active))
         with tracing.span("decode/fetch"):
             fetched = fetch_outputs(outputs)
-        round_ms = round((time.perf_counter() - t0) * 1e3, 3)
+        round_s = time.perf_counter() - t0
+        round_ms = round(round_s * 1e3, 3)
         self.timeline.events_many(
             [(s, "tick", {"tick_ms": round_ms}) for s in slots])
+        _note_tick_cost(self.metric_label, round_s)
         return {s: {k: np.asarray(v)[s] for k, v in fetched.items()}
                 for s in slots}
+
+    def step_cost(self, slot: int):
+        """Per-step cost attribution hook (TickBatcher cost_fn). The
+        dense pool has no page accounting — every slot pins its full
+        max-length state, which HBM telemetry already covers."""
+        return None
 
 
 class PageAllocator:
@@ -976,6 +998,12 @@ class PagedSlotPool:
                           "prefill_chunks": 0}     # guarded_by: self._lock
         self._stats_lock = threading.Lock()
         self._stats_cache: dict = {}               # guarded_by: self._stats_lock
+        # Pages held per slot at its most recent device round — the
+        # per-step cost tap (step_cost). Its OWN cheap lock: a stepping
+        # caller reading its page count must never queue behind the
+        # pool lock, which is held across whole device ticks.
+        self._page_ticks_lock = threading.Lock()
+        self._page_ticks: dict[int, int] = {}  # guarded_by: self._page_ticks_lock
         # Per-session lifecycle event log behind /monitoring/sessions:
         # appended off the device path (tick events push after the
         # fetch), rings bound both axes.
@@ -1226,6 +1254,11 @@ class PagedSlotPool:
 
     def _release_locked(self, slot: int) -> None:
         self.timeline.close(slot)
+        with self._page_ticks_lock:
+            # A reused slot must not report the dead session's pages
+            # before its own first tick (pool lock -> page-ticks lock,
+            # never reversed).
+            self._page_ticks.pop(slot, None)
         self._pending.pop(slot, None)
         self._prefix.pop(slot, None)
         self._dead.pop(slot, None)
@@ -1553,9 +1586,25 @@ class PagedSlotPool:
             for _, _, fields in tick_events:
                 fields["tick_ms"] = round_ms
             self.timeline.events_many(tick_events)
+            # Publish each advanced session's page count for the
+            # per-step cost tap (pages x ticks): pre-built list, one
+            # cheap lock, never while a device call is in flight.
+            with self._page_ticks_lock:
+                for s, _, fields in tick_events:
+                    self._page_ticks[s] = fields["pages"]
             for s in live:
                 results[s] = {k: np.asarray(v)[s] for k, v in fetched.items()}
+        _note_tick_cost(self.metric_label, time.perf_counter() - t0)
         return results
+
+    def step_cost(self, slot: int):
+        """Per-step cost attribution (TickBatcher cost_fn): the KV
+        pages this session held at its most recent device round — one
+        step's pages x ticks contribution to its cost vector
+        (observability/costs.py)."""
+        with self._page_ticks_lock:
+            pages = self._page_ticks.get(slot, 0)
+        return {"kv_page_ticks": float(pages)} if pages else None
 
     def _report_gather_bytes(self, gather_bytes: int) -> None:
         try:
@@ -1707,13 +1756,29 @@ class TickBatcher:
     put), not this class's.
     """
 
-    def __init__(self, tick_fn, *, join_window_s: float = 0.0005):
+    def __init__(self, tick_fn, *, join_window_s: float = 0.0005,
+                 cost_fn=None):
         self._tick_fn = tick_fn  # (sorted list[slot]) -> {slot: result}
         self._join_window_s = join_window_s
+        # Optional per-slot cost hook (pool.step_cost): charged onto
+        # the CALLER's trace after its round delivers — leader and
+        # followers alike run it on their own thread, where their own
+        # RequestTrace is the active one.
+        self._cost_fn = cost_fn
         self._cv = threading.Condition()
         self._pending: dict[int, _TickEntry] = {}
         self._inflight: set[int] = set()
         self._leader = False
+
+    def _note_cost(self, slot: int) -> None:
+        if self._cost_fn is None:
+            return
+        try:
+            cost = self._cost_fn(slot)
+        except Exception:  # pragma: no cover - cost must not break steps
+            return
+        if cost:
+            tracing.add_cost(**cost)
 
     def step(self, slot: int):
         entry = _TickEntry()
@@ -1738,11 +1803,14 @@ class TickBatcher:
                 if entry.done:
                     if entry.error is not None:
                         raise entry.error
+                    self._note_cost(slot)
                     return entry.result
                 # fell through: we are the new leader
             else:
                 self._leader = True
-        return self._lead(entry)
+        result = self._lead(entry)
+        self._note_cost(slot)
+        return result
 
     def _lead(self, own: _TickEntry):
         try:

@@ -185,6 +185,19 @@ def build_parser() -> argparse.ArgumentParser:
                         "(docs/ROBUSTNESS.md). Empty = honor "
                         "TPU_SERVING_FAULT_PLAN, else disarmed "
                         "(zero-cost)")
+    p.add_argument("--cost_log_dir", default="",
+                   help="directory for the servecost JSONL wide-event "
+                        "log: one schema-versioned cost record per "
+                        "sampled request, every record carrying "
+                        "trace_id so logs join stitched traces "
+                        "(docs/OBSERVABILITY.md 'Cost attribution'). "
+                        "Empty = no file log; /monitoring/costs "
+                        "aggregates still serve")
+    p.add_argument("--cost_log_sample", type=float, default=1.0,
+                   help="fraction of requests written to the cost log, "
+                        "deterministic per trace id (every process "
+                        "that saw a trace keeps or drops it "
+                        "identically); 0 disables writes")
     p.add_argument("--drain_grace_seconds", type=float, default=0.0,
                    help="graceful-drain window on stop()/SIGTERM: the "
                         "health plane flips NOT_SERVING immediately, "
@@ -253,6 +266,8 @@ def options_from_args(args) -> ServerOptions:
         trace_ring_size=args.trace_ring_size,
         drain_grace_seconds=args.drain_grace_seconds,
         fault_plan=args.fault_plan,
+        cost_log_dir=args.cost_log_dir,
+        cost_log_sample=args.cost_log_sample,
     )
 
 

@@ -237,6 +237,45 @@ request_log_count = Counter(
     "Request-log sampling outcomes, by model and outcome "
     "(logged | sampled_out | dropped).", ("model", "outcome"))
 
+# -- cost-attribution metrics (observability/costs.py) -----------------------
+cost_device_execute_us = Gauge(
+    ":tpu/serving/cost_device_execute_us",
+    "Rolling-window mean amortized device-execute share per request in "
+    "microseconds (merged batch wall split across riders by real-"
+    "example share; docs/OBSERVABILITY.md 'Cost attribution'), by "
+    "model and signature.", ("model", "signature"))
+cost_queue_wait_us = Gauge(
+    ":tpu/serving/cost_queue_wait_us",
+    "Rolling-window mean batching queue + in-flight-window wait per "
+    "request in microseconds, by model and signature.",
+    ("model", "signature"))
+cost_padding_waste_us = Gauge(
+    ":tpu/serving/cost_padding_waste_us",
+    "Rolling-window mean slice of the per-request device share burned "
+    "on padding rows, microseconds (already included in "
+    "cost_device_execute_us; broken out for visibility), by model and "
+    "signature.", ("model", "signature"))
+cost_host_island_us = Gauge(
+    ":tpu/serving/cost_host_island_us",
+    "Rolling-window mean host-island time (partition pre/post + "
+    "pipeline host stages) per request in microseconds, by model and "
+    "signature.", ("model", "signature"))
+cost_kv_page_ticks = Gauge(
+    ":tpu/serving/cost_kv_page_ticks",
+    "Rolling-window mean KV pages-held-per-tick attributed to each "
+    "decode-step request (pages x ticks; the paged pool's HBM-"
+    "residency cost unit), by model and signature.",
+    ("model", "signature"))
+cost_log_records = Counter(
+    ":tpu/serving/cost_log_records",
+    "servecost JSONL wide-event log outcomes "
+    "(logged | sampled_out | dropped).", ("outcome",))
+tick_utilization = Gauge(
+    ":tpu/serving/tick_utilization",
+    "Busy fraction of the decode tick loop over a rolling 30s window "
+    "(device rounds' wall over elapsed wall), by pool metric label — "
+    "the device-idle signal for decode legs.", ("model",))
+
 
 # -- routing-tier metrics (min_tfs_client_tpu/router/; docs/ROUTING.md) ------
 router_backend_requests = Counter(
@@ -285,6 +324,31 @@ router_event_loop_lag_ms = Gauge(
     "analogue of thread-pool saturation; every in-flight forward's "
     "completion is late by about this much.", ())
 
+# -- fleet-view re-exports (router/fleet.py; docs/OBSERVABILITY.md) ----------
+fleet_backend_stale = Gauge(
+    ":tpu/serving/fleet_backend_stale",
+    "1 when the router's fleet scraper could not refresh this "
+    "backend's monitoring payloads within the staleness window (dark "
+    "backend), else 0.", ("backend",))
+fleet_slo_max_burn_rate = Gauge(
+    ":tpu/serving/fleet_slo_max_burn_rate",
+    "Max SLO burn rate the backend last reported at /monitoring/slo, "
+    "re-exported by the router's fleet scraper.", ("backend",))
+fleet_kv_blocks_used = Gauge(
+    ":tpu/serving/fleet_kv_blocks_used",
+    "KV pages in use the backend last reported (summed over its paged "
+    "pools), re-exported by the router's fleet scraper.", ("backend",))
+fleet_kv_blocks_total = Gauge(
+    ":tpu/serving/fleet_kv_blocks_total",
+    "KV page capacity the backend last reported (summed over its "
+    "paged pools), re-exported by the router's fleet scraper.",
+    ("backend",))
+fleet_tick_utilization = Gauge(
+    ":tpu/serving/fleet_tick_utilization",
+    "Max decode tick-loop duty cycle the backend last reported at "
+    "/monitoring/costs, re-exported by the router's fleet scraper.",
+    ("backend",))
+
 
 def gauge_total(gauge: Gauge) -> float:
     """Sum of a gauge over all label combinations (e.g. live decode
@@ -324,6 +388,14 @@ def prometheus_text() -> str:
         from min_tfs_client_tpu.observability import health, slo
 
         health.export_gauges(max_burn=slo.export_gauges())
+    except Exception:  # pragma: no cover - exporter must always serialize
+        pass
+    try:
+        # Cost-attribution gauges refresh at scrape time too (window
+        # means + tick duty cycles), same deferred-export discipline.
+        from min_tfs_client_tpu.observability import costs
+
+        costs.export_gauges()
     except Exception:  # pragma: no cover - exporter must always serialize
         pass
     lines: list[str] = []

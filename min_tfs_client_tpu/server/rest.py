@@ -53,6 +53,11 @@ FLIGHT_RECORDER_PATH = "/monitoring/flightrecorder"
 # fleet-debuggable summary. Cross-links with /monitoring/traces via the
 # session_id annotation on decode-step traces.
 SESSIONS_PATH = "/monitoring/sessions"
+# Per-request cost attribution (observability/costs.py): rolling
+# per-(model, signature) cost-vector aggregates, tick duty cycles, and
+# the servecost JSONL log's stats. The router's fleet scraper reads
+# this from every backend (docs/OBSERVABILITY.md "Cost attribution").
+COSTS_PATH = "/monitoring/costs"
 
 
 def _fill_spec(spec: apis.ModelSpec, m: re.Match) -> None:
@@ -359,6 +364,17 @@ def _flight_recorder_reply(query: str) -> tuple[int, str, bytes]:
     return _json_reply(200, payload)
 
 
+def _costs_reply(query: str) -> tuple[int, str, bytes]:
+    """GET /monitoring/costs — per-(model, signature) rolling cost
+    aggregates (amortized device share, queue wait, padding waste,
+    compile, transfer, KV page-ticks), tick-loop duty cycles, and the
+    cost log's sampling stats."""
+    from min_tfs_client_tpu.observability import costs, tracing
+
+    tracing.flush_metrics()  # read-your-writes for just-finished requests
+    return _json_reply(200, costs.snapshot())
+
+
 def _sessions_reply(query: str) -> tuple[int, str, bytes]:
     """GET /monitoring/sessions[?session=ID][&events=N] — per-session
     decode timelines from every live pool's event log: list view (one
@@ -389,6 +405,7 @@ _MONITORING_ROUTES = {
     RUNTIME_PATH: _runtime_reply,
     FLIGHT_RECORDER_PATH: _flight_recorder_reply,
     SESSIONS_PATH: _sessions_reply,
+    COSTS_PATH: _costs_reply,
 }
 
 

@@ -170,6 +170,13 @@ class ServerOptions:
     # robustness/faults.py injection points in THIS process; "" = also
     # honor TPU_SERVING_FAULT_PLAN, else disarmed (docs/ROBUSTNESS.md).
     fault_plan: str = ""
+    # Cost-attribution wide-event log (observability/costs.py;
+    # docs/OBSERVABILITY.md "Cost attribution"): directory for the
+    # schema-versioned servecost JSONL ("" = no file log — the
+    # /monitoring/costs aggregates still run), and the deterministic
+    # per-trace sampling fraction (0.0 writes nothing, 1.0 everything).
+    cost_log_dir: str = ""
+    cost_log_sample: float = 1.0
 
     def effective_inter_op_parallelism(self) -> int:
         """<= 0 = auto (leave grpc_max_threads alone; TF spells auto as
@@ -288,6 +295,37 @@ class Server:
         from min_tfs_client_tpu.observability import health
 
         health.set_serving_weight(opts.serving_weight)
+        # Cost attribution: the SLO window also paces the cost windows,
+        # and the knob context stamped into every servecost log header
+        # is what item 4's autotuner trains against — the dataset must
+        # say WHICH configuration produced these costs.
+        from min_tfs_client_tpu.observability import costs
+
+        batching_context = None
+        if batching is not None:
+            batching_context = {
+                "max_batch_size": batching.max_batch_size.value or 32,
+                "allowed_batch_sizes": list(batching.allowed_batch_sizes),
+            }
+        costs.configure(
+            window_s=opts.slo_window_seconds,
+            # "" must DISABLE (CostLog maps empty to no-dir), not "leave
+            # unchanged": an earlier in-process server's armed log must
+            # never keep collecting this server's requests under the old
+            # header's knob context.
+            log_dir=opts.cost_log_dir,
+            sample=opts.cost_log_sample,
+            context={
+                "model_name": opts.model_name,
+                "enable_batching": bool(opts.enable_batching),
+                "batching": batching_context,
+                "max_in_flight_batches": opts.max_in_flight_batches,
+                "kv_block_size": opts.kv_block_size,
+                "kv_num_blocks": opts.kv_num_blocks,
+                "kv_evict_policy": opts.kv_evict_policy,
+                "kv_prefill_chunk": opts.kv_prefill_chunk,
+                "mesh_axes": opts.mesh_axes,
+            })
         flight_recorder.configure(opts.flight_recorder_dir or None)
         flight_recorder.install_signal_handler()
         if opts.trace_ring_size:

@@ -1,0 +1,190 @@
+"""Fleet-wide monitoring aggregation acceptance (router/fleet.py): a
+2-backend subprocess fleet behind a real router subprocess, where
+`/monitoring/fleet` aggregates both backends' slo/runtime/costs; then
+one backend is SIGKILLed and the payload marks it stale within ~one
+scrape interval while the survivor's data stays live. Also pins the
+backend-side /monitoring/costs payload over the wire and the cost-log
+flags end to end."""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from tests import fixtures
+
+pytestmark = pytest.mark.integration
+
+_ACTIVE_PROCS: set = set()
+
+SCRAPE_INTERVAL_S = 0.5
+
+
+@pytest.fixture(autouse=True)
+def _proc_watchdog():
+    fired = threading.Event()
+
+    def _fire():
+        fired.set()
+        for proc in list(_ACTIVE_PROCS):
+            proc.kill()
+
+    timer = threading.Timer(300, _fire)
+    timer.daemon = True
+    timer.start()
+    yield
+    timer.cancel()
+    assert not fired.is_set(), \
+        "proc_timeout watchdog fired after 300s; fleet was killed"
+
+
+def _get_json(port, path):
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=15) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as err:
+        return err.code, json.loads(err.read())
+
+
+def _wait(predicate, timeout_s, what):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        value = predicate()
+        if value:
+            return value
+        time.sleep(0.1)
+    raise AssertionError(f"timed out after {timeout_s}s waiting for {what}")
+
+
+class TestFleetAggregation:
+    def test_fleet_aggregates_then_marks_sigkilled_backend_stale(
+            self, tmp_path):
+        model_root = tmp_path / "model"
+        fixtures.write_session_jax_servable(model_root)
+        monitoring = tmp_path / "monitoring.config"
+        monitoring.write_text("prometheus_config { enable: true }\n")
+        cost_dir = tmp_path / "costlogs"
+
+        servers = [
+            fixtures.ModelServerProcess(
+                model_root, monitoring,
+                extra_args=(f"--cost_log_dir={cost_dir}",
+                            "--cost_log_sample=1.0"))
+            for _ in range(2)]
+        _ACTIVE_PROCS.update(servers)
+        routers = []
+        try:
+            backends = ",".join(
+                s.wait_ready().backend_spec() for s in servers)
+            router = fixtures.RouterProcess(
+                backends,
+                extra_args=(
+                    f"--fleet_scrape_interval_s={SCRAPE_INTERVAL_S}",))
+            routers.append(router)
+            _ACTIVE_PROCS.add(router)
+            router.wait_ready()
+            _wait(lambda: len(router.snapshot()["view"]["live"]) == 2,
+                  30, "2 LIVE backends")
+            backend_ids = sorted(router.snapshot()["view"]["live"])
+
+            # Traffic through the router so slo/costs windows fill on
+            # BOTH backends (stateless spreads over the ring).
+            from min_tfs_client_tpu.client import TensorServingClient
+
+            client = TensorServingClient("127.0.0.1", router.grpc_port)
+            for i in range(40):
+                client.predict_request(
+                    "sess",
+                    {"x": np.asarray([float(i), 1.0], np.float32)})
+            client.close()
+
+            def fleet():
+                code, payload = _get_json(router.rest_port,
+                                          "/monitoring/fleet")
+                assert code == 200
+                return payload
+
+            def both_fresh_with_costs():
+                payload = fleet()
+                entries = payload["backends"]
+                if set(entries) != set(backend_ids):
+                    return None
+                for entry in entries.values():
+                    if entry.get("stale") or entry.get("unreachable"):
+                        return None
+                    if "slo" not in entry or "kv" not in entry:
+                        return None
+                    if not entry.get("costs"):
+                        return None
+                return payload
+
+            payload = _wait(both_fresh_with_costs,
+                            30, "both backends fresh with cost entries")
+            # The aggregate actually aggregates: per-backend summaries
+            # plus the fleet roll-up.
+            assert payload["fleet"]["backends"] == 2
+            assert payload["fleet"]["stale_backends"] == 0
+            assert payload["fleet"]["live_backends"] == 2
+            assert payload["scrape_interval_s"] == SCRAPE_INTERVAL_S
+            for entry in payload["backends"].values():
+                assert entry["state"] == "LIVE"
+                assert entry["age_s"] is not None
+                assert entry["slo"]["max_burn_rate"] >= 0.0
+                # Cost context carried from each backend's flags.
+                assert entry["cost_log"]["sample"] == 1.0
+                assert any(c["model"] == "sess"
+                           for c in entry["costs"]), \
+                    f"no sess cost entries: {entry['costs']}"
+            # Both backends saw traffic (the ring spreads stateless).
+            assert payload["fleet"]["cost_entries"] >= 2
+
+            # -- SIGKILL one backend: the payload must degrade, never
+            # wedge — victim stale within ~one poll, survivor live.
+            victim_index = 0
+            victim_id = f"127.0.0.1:{servers[victim_index].grpc_port}"
+            servers[victim_index].kill()
+            killed_at = time.monotonic()
+
+            def victim_stale():
+                payload = fleet()
+                entry = payload["backends"].get(victim_id)
+                return payload if entry and entry["stale"] else None
+
+            payload = _wait(victim_stale, 20,
+                            f"backend {victim_id} marked stale")
+            elapsed = time.monotonic() - killed_at
+            # "within ~one poll": generously 6 scrape intervals on a
+            # loaded 1-core CI box (the scrape itself plus the health
+            # poll both need a turn); the contract under test is that
+            # staleness shows up promptly and the scrape never wedges.
+            assert elapsed < 6 * SCRAPE_INTERVAL_S + 3.0, (
+                f"stale marking took {elapsed:.1f}s")
+            survivor_id = next(b for b in backend_ids if b != victim_id)
+            survivor = payload["backends"][survivor_id]
+            assert not survivor["stale"]
+            assert not survivor["unreachable"]
+            assert survivor["age_s"] is not None
+            assert survivor["age_s"] < 6 * SCRAPE_INTERVAL_S
+            assert payload["fleet"]["stale_backends"] >= 1
+            # The dark backend's LAST GOOD data may be retained (it is
+            # history, marked as such) — but the survivor still
+            # answers with fresh cost entries.
+            assert survivor.get("costs")
+
+            # Fleet gauges re-exported on the router's Prometheus
+            # surface.
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{router.rest_port}"
+                    "/monitoring/prometheus/metrics", timeout=15) as r:
+                text = r.read().decode()
+            assert "tpu_serving_fleet_backend_stale" in text
+            assert f'backend="{survivor_id}"' in text
+        finally:
+            for proc in (*routers, *servers):
+                proc.kill()
+                _ACTIVE_PROCS.discard(proc)

@@ -34,6 +34,7 @@ from min_tfs_client_tpu.robustness.storm import (
     StormConfig,
     T5StormSpec,
     generate_schedule,
+    verify_cost_log_join,
 )
 from tests import fixtures
 
@@ -69,13 +70,22 @@ class StormFleet:
                  n_routers: int = 1, reserve_joiner: bool = False,
                  drain_grace_s: float = 30.0,
                  backend_extra_args=(), backend_env_plan=None,
-                 config_file=None):
+                 config_file=None, cost_log_dir=None):
         self.tmp = tmp
         self.model_root = tmp / "model"
         fixtures.write_session_jax_servable(self.model_root)
         self.monitoring = tmp / "monitoring.config"
         self.monitoring.write_text("prometheus_config { enable: true }\n")
         self.drain_grace_s = drain_grace_s
+        self.cost_log_dir = cost_log_dir
+        if cost_log_dir is not None:
+            # Arm cost attribution on every backend (joiner included —
+            # it shares _backend_args): the storm's cost records must
+            # join its traces by trace_id (verify_cost_log_join).
+            pathlib.Path(cost_log_dir).mkdir(parents=True, exist_ok=True)
+            backend_extra_args = (
+                f"--cost_log_dir={cost_log_dir}",
+                "--cost_log_sample=1.0", *backend_extra_args)
         self.backend_extra_args = tuple(backend_extra_args)
         self.config_file = config_file
         self.servers = []
@@ -161,6 +171,12 @@ class StormFleet:
     def router_grpc_ports(self) -> list:
         return [r.grpc_port for r in self.routers]
 
+    def backend_rest_ports(self) -> list:
+        ports = [s.rest_port for s in self.servers]
+        if self.joiner is not None:
+            ports.append(self.joiner.rest_port)
+        return ports
+
     def monitor_ports(self) -> list:
         ports = [r.rest_port for r in self.routers]
         ports += [s.rest_port for s in self.servers]
@@ -220,7 +236,9 @@ class TestFleetStormSmoke:
         """Tier-1 smoke: a small seeded storm with a mid-run SIGKILL.
         Every during-run invariant must hold on a clean tree — this is
         the canary that keeps the slow storm honest."""
-        fleet = StormFleet(tmp_path, n_backends=2)
+        cost_dir = tmp_path / "costlogs"
+        fleet = StormFleet(tmp_path, n_backends=2,
+                           cost_log_dir=str(cost_dir))
         try:
             storm = FleetStorm(
                 SMOKE_CFG,
@@ -230,11 +248,25 @@ class TestFleetStormSmoke:
                     "kill:1": lambda: fleet.kill_backend(1),
                 })
             report = storm.run()
+            # Cost attribution rode the storm: every emitted record
+            # parses, carries a wire-valid trace id, and the run's
+            # (surviving) ring traces join the log by trace_id —
+            # ROADMAP item 7's adversarial training mix for the cost
+            # model, asserted not assumed. Skipped when the storm
+            # itself failed: the report.ok() assertion below must then
+            # surface the violation list, not a derived join error.
+            cost_join = None
+            if report.ok():
+                cost_join = verify_cost_log_join(
+                    str(cost_dir), fleet.backend_rest_ports())
         finally:
             fleet.close()
         assert report.ok(), "storm invariants violated:\n" + "\n".join(
             f"  [{v.at_s:7.2f}s] {v.kind}: {v.detail}"
             for v in report.violations)
+        assert cost_join is not None
+        assert cost_join["records"] >= 30, cost_join
+        assert cost_join["malformed"] == 0
         # The storm actually stormed: traffic flowed, the kill landed,
         # sessions ran — a vacuous green is as bad as a red.
         assert report.chaos_executed == ["kill:1"]
@@ -364,10 +396,11 @@ model_config_list {{
                 stream.append(int(out["token"][0]))
             references.append(stream)
 
+        cost_dir = tmp_path / "costlogs"
         fleet = StormFleet(
             tmp_path, n_backends=3, n_routers=2, reserve_joiner=True,
             drain_grace_s=45.0, config_file=config_file,
-            backend_env_plan=plan_path)
+            backend_env_plan=plan_path, cost_log_dir=str(cost_dir))
         try:
             t5_spec = T5StormSpec(
                 model="t5x", prompts=tuple(prompts),
@@ -390,11 +423,21 @@ model_config_list {{
                       for r in fleet.routers}
             assert len(epochs) == 1, \
                 f"router replicas diverged post-storm: {epochs}"
+            # The full storm's cost records — chaos mix included — join
+            # the surviving rings by trace_id with zero malformed
+            # lines (the slow leg's adversarial cost dataset). Skipped
+            # on a failed storm so the violation list below surfaces.
+            cost_join = None
+            if report.ok():
+                cost_join = verify_cost_log_join(
+                    str(cost_dir), fleet.backend_rest_ports())
         finally:
             fleet.close()
         assert report.ok(), "storm invariants violated:\n" + "\n".join(
             f"  [{v.at_s:7.2f}s] {v.kind}: {v.detail}"
             for v in report.violations)
+        assert cost_join is not None
+        assert cost_join["records"] >= 200, cost_join
         assert sorted(report.chaos_executed) == \
             ["drain:2", "join", "kill:0"]
         assert report.stateless_sent >= 400
