@@ -219,7 +219,8 @@ def _vs_baseline(metric: str, platform: str, value: float,
     return ratio, source
 
 
-def _emit(primary: dict, others: list[dict], platform: str) -> None:
+def _emit(primary: dict, others: list[dict], platform: str,
+          probe_outcome: str = "unknown") -> dict:
     higher = primary.get("higher_is_better", False)
     value = primary["value"]
     vs, vs_source = _vs_baseline(primary["metric"], platform, value, higher,
@@ -245,18 +246,27 @@ def _emit(primary: dict, others: list[dict], platform: str) -> None:
             "baseline is this metric's own first recording")
         vs = 0.0
     extra["platform"] = platform
+    # Measurement provenance (servetrend's gate key): which platform and
+    # chip actually produced the primary number, and whether the
+    # accelerator probe passed, failed to cpu, or was forced by env —
+    # stamped at emit time so a stale chip record is diagnosable in the
+    # record itself, not by archaeology over driver logs.
+    extra.setdefault("device_kind", None)
+    extra["probe_outcome"] = probe_outcome
     extra.setdefault("transport", "tpu:// in-process")
     extra["configs"] = {
         rec["metric"]: dict(rec.get("extra", {}), value=rec["value"],
                             unit=rec["unit"])
         for rec in others}
-    print(json.dumps({
+    line = {
         "metric": primary["metric"],
         "value": round(value, 4),
         "unit": primary["unit"],
         "vs_baseline": round(vs, 4),
         "extra": extra,
-    }))
+    }
+    print(json.dumps(line))
+    return line
 
 
 def _marshal_fallback() -> dict:
@@ -332,9 +342,26 @@ def _load_lastgood() -> list[dict]:
     return records
 
 
+def _append_trend(line: dict) -> None:
+    """Append this run's emit line to the servetrend ledger — every
+    bench run grows the gated trend history (ROADMAP item 7). Best
+    effort: the ledger must never fail the bench."""
+    try:
+        from min_tfs_client_tpu.observability import servetrend
+
+        n = servetrend.append_bench_run(
+            line, str(REPO / "bench_trend.jsonl"), source="bench")
+        print(f"bench: appended {n} trend record(s) to "
+              "bench_trend.jsonl", file=sys.stderr)
+    except Exception:
+        traceback.print_exc(file=sys.stderr)
+
+
 def main() -> None:
     deadline = _START + BUDGET
     platform = _probe_platform(deadline)
+    probe_outcome = ("forced" if os.environ.get("BENCH_PLATFORM")
+                     else "ok" if platform != "cpu" else "failed")
     fd, out_name = tempfile.mkstemp(prefix="bench_out_")
     os.close(fd)
     out = pathlib.Path(out_name)
@@ -356,6 +383,7 @@ def main() -> None:
         if reprobe and _remaining(deadline) > 90:
             platform = _probe_platform(deadline, attempt=2)
             if platform != "cpu":
+                probe_outcome = "ok"
                 _run_child(platform, ACCEL_CONFIGS, out, deadline - 8,
                            iters_cap=20)
 
@@ -398,10 +426,11 @@ def main() -> None:
             others = [r for r in pool + deduped if r is not primary]
             platform_out = primary.get("extra", {}).get(
                 "measured_platform", platform)
-            _emit(primary, others, platform_out)
+            _append_trend(
+                _emit(primary, others, platform_out, probe_outcome))
         else:
             try:
-                _emit(_marshal_fallback(), [], "none")
+                _emit(_marshal_fallback(), [], "none", probe_outcome)
             except Exception:
                 traceback.print_exc(file=sys.stderr)
                 print(json.dumps({"metric": "bench_failed", "value": 0.0,
@@ -2488,6 +2517,10 @@ def child_main(out: pathlib.Path, configs: list[str]) -> None:
     import jax
 
     measured_platform = jax.devices()[0].platform
+    # Chip provenance for the trend gate: "TPU v4" vs "TPU v5e" numbers
+    # must never compare, and the device kind is only knowable HERE, in
+    # the process that owns the measurement.
+    device_kind = getattr(jax.devices()[0], "device_kind", "") or ""
     max_iters = int(os.environ.get("BENCH_ITERS", 50))
     breakdown = os.environ.get("BENCH_BREAKDOWN", "") not in ("", "0")
     with out.open("a") as sink:
@@ -2503,6 +2536,7 @@ def child_main(out: pathlib.Path, configs: list[str]) -> None:
                 rec = _CONFIG_FNS[name](max_iters)
                 rec.setdefault("extra", {})[
                     "measured_platform"] = measured_platform
+                rec["extra"].setdefault("device_kind", device_kind)
                 if breakdown:
                     table = tracing.stage_breakdown()
                     if table:

@@ -572,6 +572,14 @@ def _export_metrics(trace: RequestTrace) -> None:
     except Exception:  # pragma: no cover - costs must not break serving
         pass
     try:
+        # The watchdog only refreshes its recent-trace joins here (O(1)
+        # dict writes) — detector evaluation stays on its own ticker.
+        from min_tfs_client_tpu.observability import watchdog
+
+        watchdog.observe_trace(trace)
+    except Exception:  # pragma: no cover - watchdog must not break serving
+        pass
+    try:
         from min_tfs_client_tpu.server import metrics
 
         stages = trace.stage_durations()

@@ -1,0 +1,985 @@
+"""Fleet watchdog: streaming anomaly detectors over the existing
+observability planes, evaluated OFF the hot path.
+
+Four planes measure (tracing, SLO windows, cost vectors, runtime
+telemetry) but none of them *acts*: an SLO burn spike or a KV page leak
+is only visible if a human scrapes the right endpoint at the right
+moment. This module closes that gap with typed streaming rules:
+
+ * each detector is a small class holding its own bounded signal
+   history; `observe(now, sample)` returns zero or more `Finding`s —
+   a pure fire/quiet function of the planted history, unit-testable
+   without a server;
+ * a `Watchdog` ticker thread samples the planes every `interval_s`
+   (never on a request thread), runs every detector, and turns rising
+   edges into `Alert` records in a bounded ring — served at
+   `/monitoring/alerts` on both REST backends, exported as
+   `tpu_serving_alerts{signal,severity}` counters and
+   `tpu_serving_alert_active{signal}` gauges;
+ * alerts JOIN the forensic planes: each carries the most relevant
+   recent trace id (error trace for SLO burn, session trace for KV
+   rules) plus the latest flight-recorder error digest, and every alert
+   ring-records into the flight recorder; a CRITICAL alert latches the
+   recorder's one-shot dump, so the 10-seconds-before context is on
+   disk before anyone ssh'es in;
+ * `FleetWatchdog` runs the router-side rules (straggler, ring
+   imbalance, dark backend, pin skew) over the `/monitoring/fleet`
+   scraper's sweep results — same Finding/Alert machinery, aggregated
+   with scraped backend-local alerts at the router's
+   `/monitoring/alerts`.
+
+Detector catalogue and thresholds: docs/OBSERVABILITY.md "Alerting &
+trend gating". The module is stdlib-only so the jax-free router can
+import it; backend-plane sampling imports (runtime, costs, slo,
+tracing) all keep jax out of module scope too.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+
+INFO = "info"
+WARN = "warn"
+CRITICAL = "critical"
+
+_SEVERITY_RANK = {INFO: 0, WARN: 1, CRITICAL: 2}
+
+
+def severity_rank(severity: str) -> int:
+    """Ordering key: info < warn < critical (unknown ranks lowest)."""
+    return _SEVERITY_RANK.get(severity, -1)
+
+
+def max_severity(severities) -> str | None:
+    """The worst severity in an iterable, None when empty."""
+    worst = None
+    for sev in severities:
+        if worst is None or severity_rank(sev) > severity_rank(worst):
+            worst = sev
+    return worst
+
+
+class Finding:
+    """One detector's verdict for one signal series (a model, a pool, a
+    backend): what was observed vs the threshold that makes it an
+    anomaly. `key` separates series within a detector so a burn on
+    model A and model B edge-trigger independently."""
+
+    __slots__ = ("severity", "observed", "threshold", "message", "key",
+                 "context")
+
+    def __init__(self, severity: str, observed: float, threshold: float,
+                 message: str, key: str = "", context: dict | None = None):
+        self.severity = severity
+        self.observed = observed
+        self.threshold = threshold
+        self.message = message
+        self.key = key
+        self.context = context or {}
+
+
+class AlertRing:
+    """Bounded, thread-safe alert store with a monotonic sequence —
+    the `/monitoring/alerts` backing. Old alerts fall off; `seq` gaps
+    tell a poller exactly how many it missed."""
+
+    def __init__(self, capacity: int = 256):
+        self._lock = threading.Lock()
+        self._alerts: collections.deque = collections.deque(
+            maxlen=max(4, int(capacity)))          # guarded_by: self._lock
+        self._seq = 0                              # guarded_by: self._lock
+
+    @property
+    def capacity(self) -> int:
+        # servelint: lock-ok maxlen is set once at construction
+        return self._alerts.maxlen
+
+    def record(self, alert: dict) -> dict:
+        with self._lock:
+            self._seq += 1
+            alert["seq"] = self._seq
+            self._alerts.append(alert)
+        return alert
+
+    def snapshot(self, limit: int | None = None) -> list[dict]:
+        with self._lock:
+            alerts = list(self._alerts)
+        if limit is not None and limit >= 0:
+            alerts = alerts[-limit:]
+        return [dict(a) for a in alerts]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._alerts.clear()
+
+
+# ---------------------------------------------------------------------------
+# Backend-plane detectors. Each holds its own history and is evaluated
+# on the watchdog ticker (or a forced `?tick=1`), never on a request
+# thread. Every `observe` takes the shared sample dict built by
+# `Watchdog._sample` so unit tests can plant histories directly.
+
+
+class Detector:
+    signal = "?"
+    window_s = 60.0
+    join = ""  # which sampled trace id an alert joins: "error"|"session"|""
+
+    def observe(self, now: float, sample: dict) -> list[Finding]:
+        raise NotImplementedError
+
+
+class SLOBurnDetector(Detector):
+    """Multi-window burn-rate spike (the SRE fast-burn page): the SHORT
+    window mean catches the spike, the LONG window mean confirms it is
+    not one bad scrape. WARN at `warn_burn`x budget consumption,
+    CRITICAL at `critical_burn`x — both require the long window to also
+    be burning (>= 1.0, i.e. over budget)."""
+
+    signal = "slo_burn"
+    join = "error"
+
+    def __init__(self, warn_burn: float = 4.0, critical_burn: float = 10.0,
+                 short_n: int = 3, long_n: int = 12):
+        self._lock = threading.Lock()
+        self.warn_burn = warn_burn
+        self.critical_burn = critical_burn
+        self.short_n = max(1, short_n)
+        self._history: collections.deque = collections.deque(
+            maxlen=max(long_n, short_n))           # guarded_by: self._lock
+
+    def observe(self, now, sample):
+        burn = sample.get("slo_max_burn")
+        if burn is None:
+            return []
+        with self._lock:
+            self._history.append(float(burn))
+            if len(self._history) < self.short_n:
+                return []
+            hist = list(self._history)
+        short = sum(hist[-self.short_n:]) / self.short_n
+        long_mean = sum(hist) / len(hist)
+        self.window_s = len(hist) * sample.get("interval_s", 5.0)
+        if long_mean < 1.0:
+            return []
+        for sev, threshold in ((CRITICAL, self.critical_burn),
+                               (WARN, self.warn_burn)):
+            if short >= threshold:
+                return [Finding(
+                    sev, round(short, 3), threshold,
+                    f"SLO burn rate {short:.1f}x budget over the short "
+                    f"window (long-window mean {long_mean:.1f}x)",
+                    context={"short_mean": round(short, 3),
+                             "long_mean": round(long_mean, 3)})]
+        return []
+
+
+class KVLeakDetector(Detector):
+    """KV occupancy leak slope + allocator-pressure trend, per pool.
+
+    Leak: blocks_used rising monotonically across the window while the
+    session count does NOT rise — organic growth (more sessions, longer
+    decodes) raises both; a leak raises pages with nothing to bill them
+    to. WARN above `occupancy_floor`, CRITICAL when the pool is nearly
+    full (>= `critical_occupancy`) and still climbing. Pressure: the
+    pool swapped sessions to host within the window while occupancy is
+    high — the allocator is already doing emergency work."""
+
+    signal = "kv_leak"
+    join = "session"
+
+    def __init__(self, min_samples: int = 5, min_rise_blocks: int = 8,
+                 occupancy_floor: float = 0.6,
+                 critical_occupancy: float = 0.95):
+        self._lock = threading.Lock()
+        self.min_samples = max(3, min_samples)
+        self.min_rise_blocks = min_rise_blocks
+        self.occupancy_floor = occupancy_floor
+        self.critical_occupancy = critical_occupancy
+        self._history: dict = {}  # guarded_by: self._lock  (model -> deque)
+
+    def observe(self, now, sample):
+        pools = sample.get("kv_pools") or []
+        findings = []
+        with self._lock:
+            seen = set()
+            for pool in pools:
+                model = str(pool.get("model", "?"))
+                seen.add(model)
+                ring = self._history.setdefault(
+                    model, collections.deque(maxlen=24))
+                ring.append((float(pool.get("blocks_used", 0)),
+                             float(pool.get("num_blocks", 0) or 1),
+                             float(pool.get("sessions", 0)),
+                             float(pool.get("swapped_sessions", 0))))
+                if len(ring) < self.min_samples:
+                    continue
+                hist = list(ring)[-self.min_samples:]
+                used = [h[0] for h in hist]
+                total = hist[-1][1]
+                occupancy = used[-1] / max(1.0, total)
+                sessions_rose = hist[-1][2] > hist[0][2]
+                monotonic_rise = all(b >= a for a, b in zip(used, used[1:]))
+                rise = used[-1] - used[0]
+                if (monotonic_rise and rise >= self.min_rise_blocks
+                        and not sessions_rose
+                        and occupancy >= self.occupancy_floor):
+                    sev = (CRITICAL
+                           if occupancy >= self.critical_occupancy
+                           else WARN)
+                    findings.append(Finding(
+                        sev, round(occupancy, 4), self.occupancy_floor,
+                        f"KV pool '{model}' leaking: +{rise:.0f} blocks "
+                        f"over the window with non-rising sessions, "
+                        f"occupancy {occupancy:.0%}",
+                        key=model,
+                        context={"kind": "leak_slope", "model": model,
+                                 "rise_blocks": rise,
+                                 "sessions": hist[-1][2]}))
+                    continue
+                swapped_max = max(h[3] for h in hist)
+                if swapped_max > 0 and occupancy >= self.occupancy_floor:
+                    findings.append(Finding(
+                        WARN, round(occupancy, 4), self.occupancy_floor,
+                        f"KV pool '{model}' under allocator pressure: "
+                        f"{swapped_max:.0f} session(s) swapped to host "
+                        f"with occupancy {occupancy:.0%}",
+                        key=model,
+                        context={"kind": "pressure_trend", "model": model,
+                                 "swapped_sessions": swapped_max}))
+            # Unloaded pools must not pin stale history (or refire
+            # against a later pool that reuses the name).
+            for model in list(self._history):
+                if model not in seen:
+                    del self._history[model]
+        return findings
+
+
+class TickCollapseDetector(Detector):
+    """Decode-tick duty-cycle collapse: a pool that WAS busy (baseline
+    utilization above `healthy_floor`) dropping below `collapse_frac`
+    of its own baseline means decode stopped making progress while the
+    pool still exists — a wedged scheduler, not an idle server (a pool
+    that was never busy stays quiet)."""
+
+    signal = "tick_collapse"
+    join = "session"
+
+    def __init__(self, healthy_floor: float = 0.4,
+                 collapse_frac: float = 0.25, min_samples: int = 6):
+        self._lock = threading.Lock()
+        self.healthy_floor = healthy_floor
+        self.collapse_frac = collapse_frac
+        self.min_samples = max(4, min_samples)
+        self._history: dict = {}  # guarded_by: self._lock  (label -> deque)
+
+    def observe(self, now, sample):
+        utils = sample.get("tick_utilization") or {}
+        findings = []
+        with self._lock:
+            for label, util in utils.items():
+                ring = self._history.setdefault(
+                    label, collections.deque(maxlen=24))
+                ring.append(float(util))
+                if len(ring) < self.min_samples:
+                    continue
+                hist = list(ring)
+                head = hist[:-2]
+                baseline = sum(head) / len(head)
+                recent = sum(hist[-2:]) / 2.0
+                threshold = self.collapse_frac * baseline
+                if baseline >= self.healthy_floor and recent <= threshold:
+                    findings.append(Finding(
+                        WARN, round(recent, 4), round(threshold, 4),
+                        f"decode tick utilization for '{label}' "
+                        f"collapsed: {recent:.0%} vs healthy baseline "
+                        f"{baseline:.0%}",
+                        key=str(label),
+                        context={"label": str(label),
+                                 "baseline": round(baseline, 4)}))
+            for label in list(self._history):
+                if label not in utils:
+                    del self._history[label]
+        return findings
+
+
+class CompileStormDetector(Detector):
+    """Compile-storm: the compile ledger's total climbing faster than
+    `storm_count` misses per window AFTER the watchdog's first sample
+    (boot warmup compiles land before the ticker starts and are
+    excluded by the delta baseline). Every miss is user-visible latency
+    on some request; a storm means shape bucketing broke."""
+
+    signal = "compile_storm"
+
+    def __init__(self, storm_count: int = 5, window_n: int = 12):
+        self._lock = threading.Lock()
+        self.storm_count = max(1, storm_count)
+        self._history: collections.deque = collections.deque(
+            maxlen=max(2, window_n))               # guarded_by: self._lock
+
+    def observe(self, now, sample):
+        total = sample.get("total_compiles")
+        if total is None:
+            return []
+        with self._lock:
+            self._history.append((float(now), int(total)))
+            if len(self._history) < 2:
+                return []
+            t0, c0 = self._history[0]
+            t1, c1 = self._history[-1]
+        delta = c1 - c0
+        self.window_s = round(max(1e-9, t1 - t0), 3)
+        if delta >= self.storm_count:
+            per_min = 60.0 * delta / max(1e-9, t1 - t0)
+            return [Finding(
+                WARN, delta, self.storm_count,
+                f"compile storm: {delta} jit cache misses in "
+                f"{t1 - t0:.0f}s ({per_min:.1f}/min) — shape bucketing "
+                "is not converging",
+                context={"compiles_per_min": round(per_min, 2),
+                         "recent_wall_ms": sample.get(
+                             "compile_recent_wall_ms", 0.0)})]
+        return []
+
+
+class CostConservationDetector(Detector):
+    """Cost-vector conservation drift: per (model, signature) entry with
+    enough samples, the attributed stage means (queue + device + host
+    island + decode tick) must not exceed the measured wall total by
+    more than `band` (5%) — attribution above wall means double
+    billing, the invariant servecost audits offline, watched live."""
+
+    signal = "cost_conservation"
+
+    def __init__(self, band: float = 0.05, min_count: int = 20):
+        self.band = band
+        self.min_count = min_count
+
+    def observe(self, now, sample):
+        findings = []
+        for entry in sample.get("cost_entries") or []:
+            if entry.get("count", 0) < self.min_count:
+                continue
+            mean = entry.get("mean") or {}
+            total = float(mean.get("total_us", 0.0))
+            if total <= 0:
+                continue
+            attributed = (float(mean.get("queue_wait_us", 0.0))
+                          + float(mean.get("device_execute_us", 0.0))
+                          + float(mean.get("host_island_us", 0.0))
+                          + float(mean.get("decode_tick_us", 0.0)))
+            drift = attributed / total - 1.0
+            if drift > self.band:
+                key = f"{entry.get('model')}:{entry.get('signature')}"
+                findings.append(Finding(
+                    WARN, round(drift, 4), self.band,
+                    f"cost conservation drift for {key}: attributed "
+                    f"stages exceed wall total by {drift:.1%} "
+                    f"(double-billed attribution)",
+                    key=key,
+                    context={"model": entry.get("model"),
+                             "signature": entry.get("signature"),
+                             "attributed_us": round(attributed, 1),
+                             "total_us": round(total, 1)}))
+        return findings
+
+
+class TickerLagDetector(Detector):
+    """Event-loop / scheduler starvation seen from the inside: the
+    watchdog's own tick arriving far later than its interval means the
+    process could not schedule a sleepy daemon thread — the same
+    starvation is hitting request threads. Fires when the worst recent
+    overshoot exceeds max(`floor_s`, `ratio` x interval)."""
+
+    signal = "ticker_lag"
+
+    def __init__(self, floor_s: float = 1.0, ratio: float = 2.0,
+                 window_n: int = 6):
+        self._lock = threading.Lock()
+        self.floor_s = floor_s
+        self.ratio = ratio
+        self._history: collections.deque = collections.deque(
+            maxlen=max(2, window_n))               # guarded_by: self._lock
+
+    def observe(self, now, sample):
+        lag = sample.get("tick_lag_s")
+        if lag is None:
+            return []
+        interval = float(sample.get("interval_s", 5.0))
+        with self._lock:
+            self._history.append(float(lag))
+            worst = max(self._history)
+            self.window_s = len(self._history) * interval
+        threshold = max(self.floor_s, self.ratio * interval)
+        if worst >= threshold:
+            return [Finding(
+                WARN, round(worst, 3), round(threshold, 3),
+                f"watchdog tick lagged {worst:.2f}s past its "
+                f"{interval:.1f}s interval — thread scheduling is "
+                "starved",
+                context={"interval_s": interval})]
+        return []
+
+
+# ---------------------------------------------------------------------------
+# Shared evaluation/emission spine (backend Watchdog + router
+# FleetWatchdog): edge-triggered alerts with refire suppression, metric
+# export, flight-recorder joins, CRITICAL -> one-shot dump latch.
+
+
+class _WatchdogBase:
+    def __init__(self, detectors, ring_size: int = 256,
+                 refire_s: float = 60.0):
+        self._lock = threading.RLock()
+        self.ring = AlertRing(ring_size)
+        self.detectors = list(detectors)
+        self.refire_s = refire_s
+        self._ticks = 0                # guarded_by: self._lock
+        self._active: dict = {}        # guarded_by: self._lock
+        self._last_emit: dict = {}     # guarded_by: self._lock
+
+    def _evaluate(self, now: float, sample: dict) -> list[dict]:
+        """Run every detector over `sample`; emit alerts for rising
+        edges, escalations, and refires past `refire_s`. Returns the
+        alerts emitted by THIS evaluation."""
+        emitted = []
+        with self._lock:
+            self._ticks += 1
+            current: dict = {}
+            for det in self.detectors:
+                try:
+                    findings = det.observe(now, sample) or []
+                except Exception:  # detectors must not kill the ticker
+                    continue
+                for finding in findings:
+                    current[(det.signal, finding.key)] = finding
+                    if self._should_emit(det.signal, finding, now):
+                        emitted.append(self._emit(det, finding, sample))
+            self._active = current
+        self._export_gauges(current)
+        return emitted
+
+    def _should_emit(self, signal: str, finding: Finding,
+                     now: float) -> bool:  # servelint: holds self._lock
+        """Caller holds self._lock. Rising edge, severity escalation,
+        or a still-firing condition past the refire window — a
+        condition persisting across ticks must not spam one alert per
+        tick."""
+        key = (signal, finding.key)
+        fresh = key not in self._active
+        last = self._last_emit.get(key)
+        if last is not None:
+            last_at, last_sev = last
+            if (not fresh and severity_rank(finding.severity)
+                    <= severity_rank(last_sev)
+                    and now - last_at < self.refire_s):
+                return False
+        self._last_emit[key] = (now, finding.severity)
+        return True
+
+    def _emit(self, det: Detector, finding: Finding, sample: dict) -> dict:
+        joins = sample.get("joins") or {}
+        if det.join == "error":
+            trace_id = joins.get("error_trace") or joins.get("last_trace")
+        elif det.join == "session":
+            trace_id = joins.get("session_trace") or joins.get("last_trace")
+        else:
+            trace_id = joins.get("last_trace")
+        alert = {
+            "at": round(time.time(), 6),
+            "severity": finding.severity,
+            "signal": det.signal,
+            "window_s": round(float(det.window_s), 3),
+            "observed": finding.observed,
+            "threshold": finding.threshold,
+            "message": finding.message,
+            "trace_id": trace_id or "",
+            "error_digest": joins.get("error_digest") or "",
+            "context": dict(finding.context),
+        }
+        self.ring.record(alert)
+        self._export_alert(alert)
+        return alert
+
+    def _export_alert(self, alert: dict) -> None:
+        try:
+            from min_tfs_client_tpu.server import metrics
+
+            metrics.alerts_total.increment(alert["signal"],
+                                           alert["severity"])
+        except Exception:  # metrics must not break the watchdog
+            pass
+        try:
+            from min_tfs_client_tpu.observability import flight_recorder
+
+            flight_recorder.record(
+                "alert", signal=alert["signal"],
+                severity=alert["severity"], observed=alert["observed"],
+                threshold=alert["threshold"], message=alert["message"],
+                trace_id=alert["trace_id"])
+            if alert["severity"] == CRITICAL:
+                # One-shot: the existing INTERNAL latch — the first
+                # critical alert dumps the 10-seconds-before context,
+                # later ones only ring-record.
+                flight_recorder.latch_dump(
+                    f"watchdog:{alert['signal']}")
+        except Exception:  # recorder must not break the watchdog
+            pass
+
+    def _export_gauges(self, current: dict) -> None:
+        try:
+            from min_tfs_client_tpu.server import metrics
+
+            counts: dict = {}
+            for (signal, _key) in current:
+                counts[signal] = counts.get(signal, 0) + 1
+            for det in self.detectors:
+                metrics.safe_set(metrics.alert_active,
+                                 float(counts.get(det.signal, 0)),
+                                 det.signal)
+        except Exception:
+            pass
+
+    def active(self) -> list[dict]:
+        with self._lock:
+            return [{"signal": signal, "key": key,
+                     "severity": f.severity, "observed": f.observed,
+                     "threshold": f.threshold, "message": f.message}
+                    for (signal, key), f in sorted(self._active.items())]
+
+    def detector_catalogue(self) -> list[dict]:
+        with self._lock:
+            active_signals = {s for (s, _k) in self._active}
+            return [{"signal": det.signal,
+                     "window_s": round(float(det.window_s), 3),
+                     "firing": det.signal in active_signals}
+                    for det in self.detectors]
+
+    def ticks(self) -> int:
+        with self._lock:
+            return self._ticks
+
+    def reset(self) -> None:
+        """Test hook: clear the ring and the edge/refire state (detector
+        histories keep accumulating — recreate detectors to drop them)."""
+        with self._lock:
+            self._active = {}
+            self._last_emit = {}
+            self._ticks = 0
+        self.ring.clear()
+
+
+# ---------------------------------------------------------------------------
+# Backend watchdog: ticker thread + plane sampling.
+
+
+def default_detectors() -> list[Detector]:
+    return [SLOBurnDetector(), KVLeakDetector(), TickCollapseDetector(),
+            CompileStormDetector(), CostConservationDetector(),
+            TickerLagDetector()]
+
+
+class Watchdog(_WatchdogBase):
+    """The backend-process watchdog: samples the observability planes on
+    its own daemon thread every `interval_s` and feeds `_WatchdogBase`.
+    `tick_now()` forces a synchronous evaluation (the `?tick=1` query
+    and the tests); `observe_trace` rides the tracing drain thread to
+    keep cheap trace-id joins fresh without ever scanning the ring."""
+
+    def __init__(self, interval_s: float = 5.0, ring_size: int = 256,
+                 detectors=None, refire_s: float = 60.0):
+        super().__init__(detectors if detectors is not None
+                         else default_detectors(),
+                         ring_size=ring_size, refire_s=refire_s)
+        self.interval_s = max(0.05, float(interval_s))
+        self._thread: threading.Thread | None = None  # guarded_by: self._lock
+        self._stop = threading.Event()
+        self._recent_lock = threading.Lock()
+        self._recent: dict = {}  # guarded_by: self._recent_lock
+        self._last_tick_mono: float | None = None     # guarded_by: self._lock
+
+    # -- trace-id joins (called on the tracing drain thread) ---------------
+
+    def observe_trace(self, trace) -> None:
+        try:
+            trace_id = getattr(trace, "trace_id", "") or ""
+            if not trace_id:
+                return
+            status = str(getattr(trace, "status", "0") or "0")
+            meta = getattr(trace, "meta", None) or {}
+            with self._recent_lock:
+                self._recent["last_trace"] = trace_id
+                if status not in ("0", "OK"):
+                    self._recent["error_trace"] = trace_id
+                if "session_id" in meta or "session" in meta \
+                        or getattr(trace, "api", "") == "decode":
+                    self._recent["session_trace"] = trace_id
+        except Exception:  # the drain thread must never pay for us
+            pass
+
+    def _joins(self) -> dict:
+        with self._recent_lock:
+            joins = dict(self._recent)
+        try:
+            from min_tfs_client_tpu.observability import flight_recorder
+
+            for _seq, _ts, kind, fields in reversed(
+                    flight_recorder.snapshot()):
+                if kind == "error" and fields.get("error_digest"):
+                    joins["error_digest"] = fields["error_digest"]
+                    joins.setdefault("error_trace",
+                                     fields.get("trace_id") or "")
+                    break
+        except Exception:
+            pass
+        return joins
+
+    # -- plane sampling (ticker thread / forced tick only) ------------------
+
+    def _sample(self, now: float) -> dict:
+        sample: dict = {"interval_s": self.interval_s, "joins": self._joins()}
+        try:
+            from min_tfs_client_tpu.observability import tracing
+
+            tracing.flush_metrics()  # read-your-writes for slo/costs
+        except Exception:
+            pass
+        try:
+            from min_tfs_client_tpu.observability import slo
+
+            entries = slo.snapshot()["entries"]
+            sample["slo_max_burn"] = slo.tracker.max_burn_rate(
+                min_count=10, entries=entries)
+        except Exception:
+            pass
+        try:
+            from min_tfs_client_tpu.observability import runtime
+
+            sample["kv_pools"] = runtime.kv_pool_stats()
+            ledger = runtime.compile_ledger()
+            sample["total_compiles"] = ledger["total_compiles"]
+            sample["compile_recent_wall_ms"] = round(
+                sum(e["wall_ms"] for e in ledger["events"][-16:]), 3)
+        except Exception:
+            pass
+        try:
+            from min_tfs_client_tpu.observability import costs
+
+            sample["tick_utilization"] = costs.tick_utilization()
+            sample["cost_entries"] = costs.snapshot()["entries"]
+        except Exception:
+            pass
+        with self._lock:
+            if self._last_tick_mono is not None:
+                sample["tick_lag_s"] = max(
+                    0.0, (now - self._last_tick_mono) - self.interval_s)
+            self._last_tick_mono = now
+        return sample
+
+    def tick_now(self) -> list[dict]:
+        """One synchronous sample+evaluate pass (the `?tick=1` query and
+        the unit tests' deterministic clock)."""
+        now = time.monotonic()
+        return self._evaluate(now, self._sample(now))
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.tick_now()
+            except Exception:  # the ticker must survive anything
+                pass
+
+    def start(self) -> None:
+        with self._lock:
+            if self._thread is not None and self._thread.is_alive():
+                return
+            self._stop = threading.Event()
+            self._last_tick_mono = None
+            self._thread = threading.Thread(
+                target=self._run, name="watchdog-ticker", daemon=True)
+            self._thread.start()
+
+    def stop(self) -> None:
+        with self._lock:
+            thread, self._thread = self._thread, None
+        self._stop.set()
+        if thread is not None and thread.is_alive():
+            thread.join(timeout=2.0)
+
+    def running(self) -> bool:
+        with self._lock:
+            return self._thread is not None and self._thread.is_alive()
+
+    def payload(self, limit: int | None = None) -> dict:
+        """The `/monitoring/alerts` body (backend shape)."""
+        return {
+            "interval_s": self.interval_s,
+            "ticks": self.ticks(),
+            "detectors": self.detector_catalogue(),
+            "active": self.active(),
+            "alerts": self.ring.snapshot(limit=limit),
+        }
+
+
+# ---------------------------------------------------------------------------
+# Router-side fleet detectors: evaluated by the FleetScraper after each
+# sweep, over per-backend summaries + the router's own ring/session
+# state. Same Finding/Alert machinery; `sample` here is the fleet view.
+
+
+class StragglerDetector(Detector):
+    """Backend p99 vs fleet median: with >= `min_backends` fresh
+    backends, one whose p99 exceeds `ratio` x the fleet median (and by
+    at least `floor_ms`, so microsecond medians don't page) is serving
+    the same traffic slower than its peers — the migration victim-picker
+    signal."""
+
+    signal = "fleet_straggler"
+
+    def __init__(self, ratio: float = 3.0, floor_ms: float = 50.0,
+                 min_backends: int = 3):
+        self.ratio = ratio
+        self.floor_ms = floor_ms
+        self.min_backends = max(2, min_backends)
+
+    def observe(self, now, sample):
+        p99s = {bid: b["p99_ms"] for bid, b in
+                (sample.get("backends") or {}).items()
+                if not b.get("stale") and b.get("p99_ms")}
+        if len(p99s) < self.min_backends:
+            return []
+        ordered = sorted(p99s.values())
+        median = ordered[len(ordered) // 2]
+        findings = []
+        for bid, p99 in p99s.items():
+            if p99 >= self.ratio * median and p99 - median >= self.floor_ms:
+                findings.append(Finding(
+                    WARN, round(p99, 3), round(self.ratio * median, 3),
+                    f"backend {bid} is a straggler: p99 {p99:.0f}ms vs "
+                    f"fleet median {median:.0f}ms",
+                    key=str(bid),
+                    context={"backend": str(bid),
+                             "fleet_median_ms": round(median, 3)}))
+        return findings
+
+
+class RingImbalanceDetector(Detector):
+    """Consistent-ring occupancy share vs serving-weight share: a
+    backend owning more than `high_ratio`x (or less than `low_ratio`x)
+    its weighted share for `sustain` consecutive sweeps means the ring
+    drifted from the declared weights (vnode skew, rebuild bug) —
+    transient churn during join/leave is exactly why one sweep is not
+    enough."""
+
+    signal = "fleet_ring_imbalance"
+
+    def __init__(self, low_ratio: float = 0.5, high_ratio: float = 2.0,
+                 min_expected: float = 0.05, sustain: int = 3):
+        self._lock = threading.Lock()
+        self.low_ratio = low_ratio
+        self.high_ratio = high_ratio
+        self.min_expected = min_expected
+        self.sustain = max(1, sustain)
+        self._strikes: dict = {}  # guarded_by: self._lock  (backend -> count)
+
+    def observe(self, now, sample):
+        occupancy = sample.get("ring_occupancy") or {}
+        weights = sample.get("weights") or {}
+        live = [b for b in occupancy if b in weights]
+        findings = []
+        total_w = sum(max(0.0, float(weights[b])) for b in live)
+        with self._lock:
+            if len(live) < 2 or total_w <= 0:
+                self._strikes.clear()
+                return []
+            for bid in live:
+                expected = max(0.0, float(weights[bid])) / total_w
+                observed = float(occupancy.get(bid, 0.0))
+                skewed = expected >= self.min_expected and (
+                    observed > self.high_ratio * expected
+                    or observed < self.low_ratio * expected)
+                if skewed:
+                    self._strikes[bid] = self._strikes.get(bid, 0) + 1
+                else:
+                    self._strikes.pop(bid, None)
+                if self._strikes.get(bid, 0) >= self.sustain:
+                    findings.append(Finding(
+                        WARN, round(observed, 4), round(expected, 4),
+                        f"ring occupancy for backend {bid} is "
+                        f"{observed:.0%} vs weighted share "
+                        f"{expected:.0%} for {self.sustain} sweeps",
+                        key=str(bid),
+                        context={"backend": str(bid),
+                                 "expected_share": round(expected, 4)}))
+            for bid in list(self._strikes):
+                if bid not in occupancy:
+                    del self._strikes[bid]
+        return findings
+
+
+class DarkBackendDetector(Detector):
+    """A scraped backend going stale/unreachable while still in the
+    serving view: the router is forwarding to (or draining from) a
+    box nobody can observe. WARN, not CRITICAL — a single dark backend
+    is survivable (the router reroutes) and routine during rolling
+    restarts; total darkness already latches `no_live_backends`."""
+
+    signal = "fleet_dark_backend"
+
+    def observe(self, now, sample):
+        findings = []
+        for bid, b in (sample.get("backends") or {}).items():
+            if b.get("stale") or b.get("unreachable"):
+                age = float(b.get("age_s") or 0.0)
+                findings.append(Finding(
+                    WARN, round(age, 3), 0.0,
+                    f"backend {bid} is dark: no successful monitoring "
+                    f"scrape for {age:.1f}s "
+                    f"(state {b.get('state', '?')})",
+                    key=str(bid),
+                    context={"backend": str(bid),
+                             "state": str(b.get("state", "?")),
+                             "error": str(b.get("error") or "")[:120]}))
+        return findings
+
+
+class PinSkewDetector(Detector):
+    """Session-pin concentration: decode sessions pin to their creating
+    backend, so a backend holding more than `ratio`x its weighted share
+    of all pins (with at least `min_pins` fleet-wide) will keep that
+    load through every rebalance — the signal that session migration
+    (ROADMAP item 1) has a victim worth moving."""
+
+    signal = "fleet_pin_skew"
+
+    def __init__(self, ratio: float = 3.0, min_pins: int = 8,
+                 sustain: int = 2):
+        self._lock = threading.Lock()
+        self.ratio = ratio
+        self.min_pins = min_pins
+        self.sustain = max(1, sustain)
+        self._strikes: dict = {}  # guarded_by: self._lock  (backend -> count)
+
+    def observe(self, now, sample):
+        pins = sample.get("pins") or {}
+        weights = sample.get("weights") or {}
+        total_pins = sum(pins.values())
+        total_w = sum(max(0.0, float(w)) for w in weights.values())
+        findings = []
+        with self._lock:
+            if total_pins < self.min_pins or total_w <= 0:
+                self._strikes.clear()
+                return []
+            for bid, count in pins.items():
+                share = count / total_pins
+                expected = (max(0.0, float(weights.get(bid, 0.0)))
+                            / total_w)
+                if expected > 0 and share > self.ratio * expected:
+                    self._strikes[bid] = self._strikes.get(bid, 0) + 1
+                else:
+                    self._strikes.pop(bid, None)
+                if self._strikes.get(bid, 0) >= self.sustain:
+                    findings.append(Finding(
+                        WARN, round(share, 4),
+                        round(self.ratio * expected, 4),
+                        f"backend {bid} holds {share:.0%} of "
+                        f"{total_pins} session pins vs weighted share "
+                        f"{expected:.0%}",
+                        key=str(bid),
+                        context={"backend": str(bid), "pins": count,
+                                 "total_pins": total_pins}))
+            for bid in list(self._strikes):
+                if bid not in pins:
+                    del self._strikes[bid]
+        return findings
+
+
+def default_fleet_detectors() -> list[Detector]:
+    return [StragglerDetector(), RingImbalanceDetector(),
+            DarkBackendDetector(), PinSkewDetector()]
+
+
+class FleetWatchdog(_WatchdogBase):
+    """Router-side watchdog: no ticker of its own — the FleetScraper
+    calls `evaluate(sample)` after each sweep (the scraper IS the
+    clock), with `sample` carrying per-backend summaries plus the
+    router's ring/pin state."""
+
+    def __init__(self, ring_size: int = 256, detectors=None,
+                 refire_s: float = 60.0):
+        super().__init__(detectors if detectors is not None
+                         else default_fleet_detectors(),
+                         ring_size=ring_size, refire_s=refire_s)
+
+    def evaluate(self, sample: dict) -> list[dict]:
+        return self._evaluate(time.monotonic(), sample)
+
+    def payload(self, limit: int | None = None) -> dict:
+        return {
+            "ticks": self.ticks(),
+            "detectors": self.detector_catalogue(),
+            "active": self.active(),
+            "alerts": self.ring.snapshot(limit=limit),
+        }
+
+
+# ---------------------------------------------------------------------------
+# Module-level backend singleton (the process watchdog), mirroring the
+# slo/costs/flight_recorder pattern: one per process, swappable by
+# configure() for tests and boot-time knobs.
+
+_singleton_lock = threading.Lock()
+_singleton: Watchdog = Watchdog()                  # guarded_by: _singleton_lock
+
+
+def get() -> Watchdog:
+    with _singleton_lock:
+        return _singleton
+
+
+def configure(interval_s: float = 5.0, ring_size: int = 256,
+              refire_s: float = 60.0) -> Watchdog:
+    """Replace the process watchdog (stopping any running ticker) with
+    one built from the boot-time knobs. Returns the new instance."""
+    global _singleton
+    with _singleton_lock:
+        old = _singleton
+    old.stop()
+    fresh = Watchdog(interval_s=interval_s, ring_size=ring_size,
+                     refire_s=refire_s)
+    with _singleton_lock:
+        _singleton = fresh
+    return fresh
+
+
+def start() -> None:
+    get().start()
+
+
+def stop() -> None:
+    get().stop()
+
+
+def observe_trace(trace) -> None:
+    """Tracing drain-thread hook (tracing._export_metrics): keeps the
+    recent-trace joins fresh. Must stay O(1) and never raise."""
+    get().observe_trace(trace)
+
+
+def payload(limit: int | None = None, tick: bool = False) -> dict:
+    """The `/monitoring/alerts` reply body; `tick=True` forces one
+    synchronous evaluation first (`?tick=1`)."""
+    wd = get()
+    if tick:
+        wd.tick_now()
+    return wd.payload(limit=limit)
+
+
+def reset() -> None:
+    """Test hook: stop the ticker and drop all alert/edge state."""
+    wd = get()
+    wd.stop()
+    wd.reset()

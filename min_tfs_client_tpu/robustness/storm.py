@@ -657,6 +657,69 @@ def ring_trace_ids(rest_port: int, timeout_s: float = 10.0) -> set:
             and (event.get("args") or {}).get("trace_id")}
 
 
+def fetch_alert_payload(rest_port: int, *, tick: bool = False,
+                        limit: Optional[int] = None,
+                        timeout_s: float = 10.0) -> dict:
+    """GET one process's /monitoring/alerts body. `tick=True` forces a
+    synchronous detector pass first (a backend watchdog tick, or a full
+    fleet sweep on a router port) so the reply reflects now, not the
+    last scheduled tick."""
+    query = []
+    if tick:
+        query.append("tick=1")
+    if limit is not None:
+        query.append(f"limit={int(limit)}")
+    suffix = ("?" + "&".join(query)) if query else ""
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{rest_port}/monitoring/alerts{suffix}",
+            timeout=timeout_s) as resp:
+        return json.loads(resp.read())
+
+
+def collect_alerts(rest_ports, *, tick: bool = True,
+                   timeout_s: float = 10.0) -> dict:
+    """Alert payloads from every port that still answers, keyed by
+    port. A killed process's port legitimately refuses — the storm's
+    alert verdict is over the survivors."""
+    payloads: dict = {}
+    for port in rest_ports:
+        try:
+            payloads[port] = fetch_alert_payload(
+                port, tick=tick, timeout_s=timeout_s)
+        except Exception:  # noqa: BLE001 - dead port is data, not error
+            continue
+    return payloads
+
+
+def alerts_at_or_above(payloads: dict, severity: str) -> list:
+    """Every alert at or above `severity` across a collect_alerts()
+    result — the ring, the active set, and (on router payloads) each
+    backend's condensed summary. This is the storm's quiet-above-WARN
+    assertion surface: a clean run must return [] for CRITICAL."""
+    from min_tfs_client_tpu.observability.watchdog import severity_rank
+
+    floor = severity_rank(severity)
+    found = []
+    for port, payload in sorted(payloads.items()):
+        sources = [("ring", payload.get("alerts") or ()),
+                   ("active", payload.get("active") or ())]
+        for bid, summary in sorted(
+                (payload.get("backends") or {}).items()):
+            if isinstance(summary, dict):
+                sources.append((f"backend[{bid}].active",
+                                summary.get("active") or ()))
+                sources.append((f"backend[{bid}].recent",
+                                summary.get("recent") or ()))
+        for source, alerts in sources:
+            for alert in alerts:
+                if not isinstance(alert, dict):
+                    continue
+                if severity_rank(alert.get("severity", "")) >= floor:
+                    found.append({"port": port, "source": source,
+                                  **alert})
+    return found
+
+
 def verify_cost_log_join(log_dir, backend_rest_ports,
                          min_join_fraction: float = 0.95,
                          settle_s: float = 6.0) -> dict:

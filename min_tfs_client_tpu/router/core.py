@@ -166,6 +166,7 @@ class RouterCore:
         bounded_load_c: float = ring_mod.BOUNDED_LOAD_C,
         poller=None,
         fleet_scrape_interval_s: float = 2.0,
+        fleet_watchdog: bool = True,
     ):
         self.bounded_load_c = bounded_load_c
         self.channels = ChannelPool()
@@ -202,7 +203,9 @@ class RouterCore:
 
         self.fleet = FleetScraper(
             self.membership, interval_s=fleet_scrape_interval_s,
-            timeout_s=min(probe_timeout_s, fleet_scrape_interval_s))
+            timeout_s=min(probe_timeout_s, fleet_scrape_interval_s),
+            watchdog=fleet_watchdog,
+            router_state=self._watchdog_state)
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -526,6 +529,17 @@ class RouterCore:
 
     def ready(self) -> bool:
         return bool(self.membership.live_ids())
+
+    def _watchdog_state(self) -> dict:
+        """The fleet watchdog's view of the router's OWN state (ring
+        occupancy shares, declared weights, session pins) — called on
+        the fleet-scrape thread once per sweep."""
+        view = self.membership.view()
+        return {
+            "occupancy": self.membership.occupancy_shares(),
+            "weights": dict(view.weights),
+            "pins": self.sessions.count_by_backend(),
+        }
 
     def snapshot(self) -> dict:
         payload = self.membership.snapshot()

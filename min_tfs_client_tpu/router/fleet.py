@@ -40,7 +40,11 @@ from min_tfs_client_tpu.router.http_pool import KeepAliveHTTPPool
 log = logging.getLogger(__name__)
 
 # The backend monitoring endpoints one sweep fetches, in fetch order.
-ENDPOINTS = ("slo", "runtime", "costs")
+# "alerts" is OPTIONAL: a pre-watchdog backend answers 404 there, which
+# must not mark an otherwise-healthy backend unreachable mid-rolling-
+# upgrade — the entry just carries no alert summary.
+ENDPOINTS = ("slo", "runtime", "costs", "alerts")
+OPTIONAL_ENDPOINTS = frozenset({"alerts"})
 
 
 class _BackendScrape:
@@ -65,8 +69,19 @@ class FleetScraper:
 
     def __init__(self, membership, interval_s: float = 2.0,
                  timeout_s: float = 2.0,
-                 stale_after_s: Optional[float] = None):
+                 stale_after_s: Optional[float] = None,
+                 watchdog: bool = True,
+                 router_state=None):
+        from min_tfs_client_tpu.observability.watchdog import FleetWatchdog
+
         self.membership = membership
+        # The fleet-scope anomaly detectors (straggler, ring imbalance,
+        # dark backend, pin skew) ride this scraper's sweep — the sweep
+        # IS their clock. `router_state` is a callable returning the
+        # router's own {occupancy, weights, pins} view (RouterCore wires
+        # it); None leaves the ring/pin detectors input-starved (quiet).
+        self.watchdog = FleetWatchdog() if watchdog else None
+        self.router_state = router_state
         self.interval_s = max(0.1, float(interval_s))
         self.timeout_s = max(0.1, float(timeout_s))
         # ~2.5 intervals: one missed sweep is jitter, two is an outage.
@@ -141,6 +156,8 @@ class FleetScraper:
 
                     payloads[endpoint] = json.loads(raw)
                 except Exception as exc:  # noqa: BLE001 - degrade, never wedge
+                    if endpoint in OPTIONAL_ENDPOINTS:
+                        continue  # pre-watchdog backend: no alert feed
                     error = f"/monitoring/{endpoint}: {exc}"
                     break
             results[bid] = ((payloads, None) if error is None
@@ -164,7 +181,9 @@ class FleetScraper:
                     # history beats a hole — but mark the miss.
                     scrape.error = error
                     scrape.unreachable = True
-        self._export_gauges()
+        snap = self.snapshot()
+        self._export_gauges(snap)
+        self._evaluate_watchdog(snap)
 
     # -- the payload ---------------------------------------------------------
 
@@ -234,13 +253,62 @@ class FleetScraper:
             "fleet": fleet,
         }
 
-    def _export_gauges(self) -> None:
+    def _evaluate_watchdog(self, snap: dict) -> None:
+        """Feed the fleet-scope detectors from this sweep's snapshot +
+        the router's own ring/pin state. Never raises — the scrape loop
+        is a liveness-adjacent thread."""
+        if self.watchdog is None:
+            return
+        try:
+            state = self.router_state() if self.router_state else {}
+        except Exception:  # pragma: no cover - state probe must not wedge
+            state = {}
+        try:
+            sample = {
+                "backends": {
+                    bid: {"stale": entry.get("stale"),
+                          "unreachable": entry.get("unreachable"),
+                          "age_s": entry.get("age_s"),
+                          "state": entry.get("state"),
+                          "error": entry.get("error"),
+                          "p99_ms": entry.get("slo", {}).get("p99_ms")}
+                    for bid, entry in snap["backends"].items()
+                    if entry.get("rest_port")},
+                "ring_occupancy": state.get("occupancy") or {},
+                "weights": state.get("weights") or {},
+                "pins": state.get("pins") or {},
+            }
+            self.watchdog.evaluate(sample)
+        except Exception:  # pragma: no cover - alerting must not break scrape
+            log.exception("fleet watchdog evaluation failed")
+
+    def alerts_payload(self, limit: Optional[int] = None) -> dict:
+        """The router's /monitoring/alerts body: the fleet-scope
+        watchdog ring plus each backend's scraped alert summary (its
+        full ring stays one hop away on the backend's own port)."""
+        if self.watchdog is not None:
+            payload = self.watchdog.payload(limit=limit)
+        else:
+            payload = {"ticks": 0, "detectors": [], "active": [],
+                       "alerts": []}
+        payload["interval_s"] = self.interval_s
+        backends: dict = {}
+        snap = self.snapshot()
+        for bid, entry in snap["backends"].items():
+            summary = entry.get("alerts")
+            backends[bid] = {
+                "stale": entry.get("stale", True),
+                **(summary if isinstance(summary, dict) else
+                   {"active": [], "recent": [], "total": 0})}
+        payload["backends"] = backends
+        return payload
+
+    def _export_gauges(self, snap: dict) -> None:
         """Re-export the per-backend roll-ups as router gauges — one
         Prometheus target answering for the tier."""
         try:
             from min_tfs_client_tpu.server import metrics
 
-            snap = self.snapshot()
             for bid, entry in snap["backends"].items():
                 metrics.safe_set(metrics.fleet_backend_stale,
                                  1.0 if entry.get("stale") else 0.0, bid)
@@ -272,14 +340,21 @@ def _condense(payloads: dict) -> dict:
     if isinstance(slo, dict):
         max_burn = 0.0
         count = 0
+        p99 = 0.0
         for entry in slo.get("entries", ()):
             burn = entry.get("burn_rate") or {}
             max_burn = max(max_burn, burn.get("max", 0.0))
             count += entry.get("count", 0)
+            # Straggler detection compares the backend's WORST key p99
+            # against the fleet median of the same statistic; keys with
+            # thin windows would make p99 pure noise.
+            if entry.get("count", 0) >= 10:
+                p99 = max(p99, entry.get("p99_ms") or 0.0)
         out["slo"] = {
             "max_burn_rate": round(max_burn, 4),
             "window_count": count,
             "entries": len(slo.get("entries", ())),
+            "p99_ms": round(p99, 3),
             "shed_burn_rate": slo.get("default_objective", {}).get(
                 "shed_burn_rate", 0.0),
         }
@@ -310,5 +385,13 @@ def _condense(payloads: dict) -> dict:
         out["cost_log"] = {
             "records_written": log_stats.get("records_written", 0),
             "sample": log_stats.get("sample"),
+        }
+    alerts = payloads.get("alerts")
+    if isinstance(alerts, dict):
+        recent = alerts.get("alerts", [])[-5:]
+        out["alerts"] = {
+            "active": alerts.get("active", []),
+            "recent": recent,
+            "total": len(alerts.get("alerts", [])),
         }
     return out

@@ -66,6 +66,12 @@ class RouterOptions:
     # between sweeps of every backend's /monitoring/{slo,runtime,
     # costs}, served at /monitoring/fleet with per-backend staleness.
     fleet_scrape_interval_s: float = 2.0
+    # Fleet watchdog (observability/watchdog.py FleetWatchdog): the
+    # straggler / ring-imbalance / dark-backend / pin-skew detectors
+    # evaluated after every fleet sweep, served (with scraped backend
+    # alert summaries) at the router's /monitoring/alerts. Default ON —
+    # it adds no fetches, only arithmetic on the sweep results.
+    fleet_watchdog: bool = True
 
 
 class RouterServer:
@@ -107,6 +113,7 @@ class RouterServer:
             bounded_load_c=opts.bounded_load_c,
             poller=self._poller,
             fleet_scrape_interval_s=opts.fleet_scrape_interval_s,
+            fleet_watchdog=opts.fleet_watchdog,
         )
         self.core.start()
         if opts.data_plane == "aio":
@@ -267,6 +274,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "{slo,runtime,costs} and serves the aggregate "
                         "at /monitoring/fleet with per-backend "
                         "staleness marking (docs/OBSERVABILITY.md)")
+    p.add_argument("--fleet_watchdog",
+                   type=lambda v: v.lower() in ("1", "true", "yes"),
+                   default=True,
+                   help="fleet-scope anomaly detectors (straggler, "
+                        "ring imbalance, dark backend, pin skew) "
+                        "evaluated after every fleet sweep and served "
+                        "at the router's /monitoring/alerts "
+                        "(docs/OBSERVABILITY.md 'Alerting & trend "
+                        "gating')")
     return p
 
 
@@ -288,6 +304,7 @@ def options_from_args(args) -> RouterOptions:
         trace_ring_size=args.trace_ring_size,
         fault_plan=args.fault_plan,
         fleet_scrape_interval_s=args.fleet_scrape_interval_s,
+        fleet_watchdog=args.fleet_watchdog,
     )
 
 

@@ -676,6 +676,32 @@ _REST_FORWARD_HEADERS = ("Content-Type", "Content-Encoding",
 _http_pool = KeepAliveHTTPPool(timeout_s=60.0)
 
 
+def _router_alerts_reply(core: RouterCore,
+                         query: str) -> tuple[int, str, bytes]:
+    """GET /monitoring/alerts[?tick=1][&limit=N] on the router: the
+    fleet-scope watchdog (straggler, ring imbalance, dark backend, pin
+    skew) plus each backend's scraped alert summary. `tick=1` forces a
+    synchronous fleet sweep (scrape + detector pass) first — the
+    router-side analogue of the backend endpoint's forced tick."""
+    from urllib.parse import parse_qs
+
+    params = parse_qs(query)
+    limit = None
+    if params.get("limit"):
+        try:
+            limit = max(0, int(params["limit"][0]))
+        except ValueError:
+            return 400, "application/json", json.dumps(
+                {"error": "limit must be an integer"}).encode()
+    if params.get("tick", [""])[0] not in ("", "0"):
+        try:
+            core.fleet.scrape_once()
+        except Exception:  # scrape hiccups must not 500 the alert read
+            pass
+    return 200, "application/json", json.dumps(
+        core.fleet.alerts_payload(limit=limit)).encode()
+
+
 def rest_route_request(core: RouterCore, method: str, path: str,
                        body_bytes: bytes,
                        headers) -> tuple[int, str, bytes]:
@@ -698,6 +724,8 @@ def rest_route_request(core: RouterCore, method: str, path: str,
         # Shared implementation with the backend endpoint — ?rearm=1
         # re-arms the router's one-shot dump latch identically.
         return rest_mod._flight_recorder_reply(_query)
+    if method == "GET" and bare == rest_mod.ALERTS_PATH:
+        return _router_alerts_reply(core, _query)
     if method == "GET" and bare == rest_mod.HEALTHZ_PATH:
         ok = core.membership.poll_thread_alive()
         return ((200 if ok else 503), "application/json",

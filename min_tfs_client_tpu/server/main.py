@@ -198,6 +198,19 @@ def build_parser() -> argparse.ArgumentParser:
                         "deterministic per trace id (every process "
                         "that saw a trace keeps or drops it "
                         "identically); 0 disables writes")
+    p.add_argument("--watchdog", type=_flag_bool, default=True,
+                   help="streaming anomaly detectors over the "
+                        "observability planes (SLO burn spike, KV leak "
+                        "slope, tick collapse, compile storm, cost "
+                        "conservation drift, ticker lag) on the "
+                        "watchdog's own thread, served at "
+                        "/monitoring/alerts (docs/OBSERVABILITY.md "
+                        "'Alerting & trend gating')")
+    p.add_argument("--watchdog_interval_s", type=float, default=5.0,
+                   help="watchdog sampling/evaluation interval")
+    p.add_argument("--watchdog_ring_size", type=int, default=256,
+                   help="bounded alert-ring capacity served at "
+                        "/monitoring/alerts")
     p.add_argument("--drain_grace_seconds", type=float, default=0.0,
                    help="graceful-drain window on stop()/SIGTERM: the "
                         "health plane flips NOT_SERVING immediately, "
@@ -268,6 +281,9 @@ def options_from_args(args) -> ServerOptions:
         fault_plan=args.fault_plan,
         cost_log_dir=args.cost_log_dir,
         cost_log_sample=args.cost_log_sample,
+        watchdog=args.watchdog,
+        watchdog_interval_s=args.watchdog_interval_s,
+        watchdog_ring_size=args.watchdog_ring_size,
     )
 
 

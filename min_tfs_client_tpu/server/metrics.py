@@ -349,6 +349,19 @@ fleet_tick_utilization = Gauge(
     "/monitoring/costs, re-exported by the router's fleet scraper.",
     ("backend",))
 
+# -- watchdog alerts (observability/watchdog.py; /monitoring/alerts) ---------
+alerts_total = Counter(
+    ":tpu/serving/alerts",
+    "Watchdog alerts emitted, by detector signal and severity "
+    "(edge-triggered with refire suppression — one persisting "
+    "condition is one alert per refire window, not one per tick).",
+    ("signal", "severity"))
+alert_active = Gauge(
+    ":tpu/serving/alert_active",
+    "Number of series (models, pools, backends) a watchdog detector "
+    "currently considers anomalous; 0 when the signal is quiet.",
+    ("signal",))
+
 
 def gauge_total(gauge: Gauge) -> float:
     """Sum of a gauge over all label combinations (e.g. live decode

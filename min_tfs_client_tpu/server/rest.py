@@ -58,6 +58,12 @@ SESSIONS_PATH = "/monitoring/sessions"
 # the servecost JSONL log's stats. The router's fleet scraper reads
 # this from every backend (docs/OBSERVABILITY.md "Cost attribution").
 COSTS_PATH = "/monitoring/costs"
+# Watchdog alert ring (observability/watchdog.py): streaming anomaly
+# detectors over the slo/costs/runtime/tracing planes, evaluated on the
+# watchdog's own ticker. The router serves the same path with the
+# fleet-scope detectors and per-backend aggregation
+# (docs/OBSERVABILITY.md "Alerting & trend gating").
+ALERTS_PATH = "/monitoring/alerts"
 
 
 def _fill_spec(spec: apis.ModelSpec, m: re.Match) -> None:
@@ -398,6 +404,28 @@ def _sessions_reply(query: str) -> tuple[int, str, bytes]:
     return _json_reply(200, payload)
 
 
+def _alerts_reply(query: str) -> tuple[int, str, bytes]:
+    """GET /monitoring/alerts[?tick=1][&limit=N] — the watchdog's alert
+    ring: detector catalogue, currently-firing conditions, and recent
+    structured alerts (each joined to a trace id and the latest
+    flight-recorder error digest). `tick=1` forces one synchronous
+    detector pass first, so tests and humans get a
+    sampled-right-now verdict instead of waiting out the interval."""
+    from urllib.parse import parse_qs
+
+    from min_tfs_client_tpu.observability import watchdog
+
+    params = parse_qs(query)
+    limit = None
+    if params.get("limit"):
+        try:
+            limit = max(0, int(params["limit"][0]))
+        except ValueError:
+            return _json_reply(400, {"error": "limit must be an integer"})
+    tick = params.get("tick", [""])[0] not in ("", "0")
+    return _json_reply(200, watchdog.payload(limit=limit, tick=tick))
+
+
 _MONITORING_ROUTES = {
     HEALTHZ_PATH: _healthz_reply,
     READYZ_PATH: _readyz_reply,
@@ -406,6 +434,7 @@ _MONITORING_ROUTES = {
     FLIGHT_RECORDER_PATH: _flight_recorder_reply,
     SESSIONS_PATH: _sessions_reply,
     COSTS_PATH: _costs_reply,
+    ALERTS_PATH: _alerts_reply,
 }
 
 

@@ -177,6 +177,14 @@ class ServerOptions:
     # per-trace sampling fraction (0.0 writes nothing, 1.0 everything).
     cost_log_dir: str = ""
     cost_log_sample: float = 1.0
+    # Watchdog (observability/watchdog.py; docs/OBSERVABILITY.md
+    # "Alerting & trend gating"): streaming anomaly detectors over the
+    # observability planes, on their own ticker thread. Default ON —
+    # sampling is a handful of snapshot reads per interval, never on a
+    # request thread (MIGRATING.md notes the new default-on flag).
+    watchdog: bool = True
+    watchdog_interval_s: float = 5.0
+    watchdog_ring_size: int = 256
 
     def effective_inter_op_parallelism(self) -> int:
         """<= 0 = auto (leave grpc_max_threads alone; TF spells auto as
@@ -328,6 +336,14 @@ class Server:
             })
         flight_recorder.configure(opts.flight_recorder_dir or None)
         flight_recorder.install_signal_handler()
+        # Watchdog detectors configure before the core builds (so the
+        # compile-storm baseline starts at the warmup total, below) but
+        # the ticker starts only after the initial loads finish.
+        from min_tfs_client_tpu.observability import watchdog
+
+        if opts.watchdog:
+            watchdog.configure(interval_s=opts.watchdog_interval_s,
+                               ring_size=opts.watchdog_ring_size)
         if opts.trace_ring_size:
             from min_tfs_client_tpu.observability import tracing
 
@@ -439,6 +455,13 @@ class Server:
                 target=self._poll_config_file, name="config-file-poll",
                 daemon=True)
             self._config_poll_thread.start()
+        if opts.watchdog:
+            # After the initial loads: warmup compiles are in the
+            # ledger, so the storm detector's first delta baseline
+            # excludes them.
+            from min_tfs_client_tpu.observability import watchdog
+
+            watchdog.start()
         return self
 
     def _bind(self, server: grpc.Server, port: int) -> int:
@@ -488,6 +511,9 @@ class Server:
         if self.core is not None:
             health.mark_draining(self.core)
         self._config_poll_stop.set()
+        from min_tfs_client_tpu.observability import watchdog
+
+        watchdog.stop()
         dg = (self.options.drain_grace_seconds if drain_grace is None
               else drain_grace)
         if dg > 0:

@@ -29,10 +29,14 @@ import time
 import numpy as np
 import pytest
 
+from min_tfs_client_tpu.observability.watchdog import CRITICAL
 from min_tfs_client_tpu.robustness.storm import (
     FleetStorm,
     StormConfig,
     T5StormSpec,
+    alerts_at_or_above,
+    collect_alerts,
+    fetch_alert_payload,
     generate_schedule,
     verify_cost_log_join,
 )
@@ -259,6 +263,24 @@ class TestFleetStormSmoke:
             if report.ok():
                 cost_join = verify_cost_log_join(
                     str(cost_dir), fleet.backend_rest_ports())
+            # The alert plane rode the same storm. The router's fleet
+            # watchdog must flag the SIGKILLed backend dark (the health
+            # plane proved it; the alert is how an operator hears), and
+            # a clean storm — chaos included — must stay quiet above
+            # WARN everywhere: a kill is expected fleet weather, not a
+            # page.
+            dark_alerts: list = []
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline:
+                payload = fetch_alert_payload(
+                    fleet.routers[0].rest_port, tick=True)
+                dark_alerts = [a for a in payload["alerts"]
+                               if a.get("signal") == "fleet_dark_backend"]
+                if dark_alerts:
+                    break
+                time.sleep(0.25)
+            alert_payloads = collect_alerts(fleet.monitor_ports(),
+                                            tick=True)
         finally:
             fleet.close()
         assert report.ok(), "storm invariants violated:\n" + "\n".join(
@@ -267,6 +289,14 @@ class TestFleetStormSmoke:
         assert cost_join is not None
         assert cost_join["records"] >= 30, cost_join
         assert cost_join["malformed"] == 0
+        assert dark_alerts, \
+            "router fleet watchdog never alerted on the killed backend"
+        critical = alerts_at_or_above(alert_payloads, CRITICAL)
+        assert not critical, \
+            f"clean smoke storm raised CRITICAL alerts: {critical[:5]}"
+        # Every surviving monitor port answered the alerts endpoint —
+        # both routers and backends serve the same surface.
+        assert len(alert_payloads) >= 2
         # The storm actually stormed: traffic flowed, the kill landed,
         # sessions ran — a vacuous green is as bad as a red.
         assert report.chaos_executed == ["kill:1"]
@@ -301,7 +331,10 @@ FULL_CFG = StormConfig(
 
 # The slow storm's fault plan, armed on every BACKEND via env:
 # pure-latency + pressure faults (they must never change any result,
-# only timing and eviction traffic — the invariants stay green).
+# only timing and eviction traffic — the invariants stay green). The
+# deadline_corrupt rule rides for fault-layer coverage: the override
+# is generous enough (10s) that it can never bite, but the action
+# parses, arms, fires, and lands in fault_events_seen like the rest.
 BACKEND_FAULT_PLAN = {
     "seed": 4007,
     "rules": [
@@ -311,6 +344,8 @@ BACKEND_FAULT_PLAN = {
          "probability": 0.2},
         {"point": "batch.enqueue", "action": "delay",
          "delay_ms": 5, "probability": 0.05},
+        {"point": "backend.handle.pre", "action": "deadline_corrupt",
+         "deadline_ms": 10000, "probability": 0.03},
     ],
 }
 
@@ -400,7 +435,11 @@ model_config_list {{
         fleet = StormFleet(
             tmp_path, n_backends=3, n_routers=2, reserve_joiner=True,
             drain_grace_s=45.0, config_file=config_file,
-            backend_env_plan=plan_path, cost_log_dir=str(cost_dir))
+            backend_env_plan=plan_path, cost_log_dir=str(cost_dir),
+            # Fast watchdog ticks: the KV-pressure window (5 samples)
+            # spans 2.5s, so the injected page_pressure swaps land
+            # inside it while the t5 arena is hot.
+            backend_extra_args=("--watchdog_interval_s=0.5",))
         try:
             t5_spec = T5StormSpec(
                 model="t5x", prompts=tuple(prompts),
@@ -431,6 +470,33 @@ model_config_list {{
             if report.ok():
                 cost_join = verify_cost_log_join(
                     str(cost_dir), fleet.backend_rest_ports())
+            # Alert-plane verdict on the full burn: the SIGKILLed
+            # backend goes dark on BOTH router replicas, the injected
+            # page_pressure surfaces as a kv_leak pressure alert in
+            # some surviving backend's ring — and everything armed
+            # (delays, pressure, drain, kill, join) still stays quiet
+            # above WARN: faults that change no result must not page.
+            dark_on: list = []
+            deadline = time.monotonic() + 15.0
+            while time.monotonic() < deadline:
+                dark_on = [
+                    r.rest_port for r in fleet.routers
+                    if any(a.get("signal") == "fleet_dark_backend"
+                           for a in fetch_alert_payload(
+                               r.rest_port, tick=True)["alerts"])]
+                if len(dark_on) == len(fleet.routers):
+                    break
+                time.sleep(0.5)
+            backend_alerts = collect_alerts(fleet.backend_rest_ports(),
+                                            tick=True)
+            pressure_alerts = [
+                alert for payload in backend_alerts.values()
+                for alert in payload["alerts"]
+                if alert.get("signal") == "kv_leak"
+                and (alert.get("context") or {}).get("kind")
+                == "pressure_trend"]
+            alert_payloads = collect_alerts(fleet.monitor_ports(),
+                                            tick=True)
         finally:
             fleet.close()
         assert report.ok(), "storm invariants violated:\n" + "\n".join(
@@ -438,6 +504,14 @@ model_config_list {{
             for v in report.violations)
         assert cost_join is not None
         assert cost_join["records"] >= 200, cost_join
+        assert len(dark_on) == 2, \
+            f"only routers {dark_on} alerted on the killed backend"
+        assert pressure_alerts, \
+            "no kv_leak pressure alert despite armed page_pressure " \
+            "faults on a 10-block arena"
+        critical = alerts_at_or_above(alert_payloads, CRITICAL)
+        assert not critical, \
+            f"full storm raised CRITICAL alerts: {critical[:5]}"
         assert sorted(report.chaos_executed) == \
             ["drain:2", "join", "kill:0"]
         assert report.stateless_sent >= 400

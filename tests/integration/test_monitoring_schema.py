@@ -30,6 +30,8 @@ SERVER_SCHEMAS = {
                           "entries", "tick_utilization", "log"},
     "/monitoring/traces": {"traceEvents", "displayTimeUnit", "otherData"},
     "/monitoring/flightrecorder": {"capacity", "events"},
+    "/monitoring/alerts": {"interval_s", "ticks", "detectors", "active",
+                           "alerts"},
 }
 
 ROUTER_SCHEMAS = {
@@ -39,6 +41,10 @@ ROUTER_SCHEMAS = {
                            "sessions_recovered", "ready"},
     "/monitoring/fleet": {"scrape_interval_s", "stale_after_s", "sweeps",
                           "backends", "fleet"},
+    # The router's alerts payload is the backend shape plus the scraped
+    # per-backend alert summaries (the fleet-scope aggregation).
+    "/monitoring/alerts": {"interval_s", "ticks", "detectors", "active",
+                           "alerts", "backends"},
 }
 
 # Second-level keys load-bearing enough to pin too: the fields the
@@ -47,7 +53,8 @@ COSTS_ENTRY_KEYS = {"model", "signature", "count", "mean", "total"}
 FLEET_BACKEND_KEYS = {"state", "rest_port", "stale", "unreachable",
                       "age_s", "error", "scrapes", "slo", "kv",
                       "compile", "transfer", "pipeline", "costs",
-                      "tick_utilization", "cost_context", "cost_log"}
+                      "tick_utilization", "cost_context", "cost_log",
+                      "alerts"}
 
 
 @pytest.fixture(scope="module")

@@ -316,23 +316,26 @@ class TestReplicatedStickiness:
             # for surviving sessions those placements are now WRONG,
             # and any replica stepping one without a pin must have
             # taken the recovery path (probed past the joiner's
-            # NOT_FOUND). Compute the stolen set with the ring
-            # functions; when it is non-empty, recovery must have
+            # NOT_FOUND). Only the odd-j survivors were stepped pinless
+            # post-join: replica B pinned the even-j half in the
+            # post-kill loop above (and A pinned everything at open), so
+            # an even-j steal rides B's pin and owes no recovery. When
+            # any pinless-stepped key was stolen, recovery must have
             # fired somewhere in the tier.
             from min_tfs_client_tpu.router import ring as ring_mod
 
             weights3 = fleet.routers[0].snapshot()["view"]["weights"]
             joiner_id = f"127.0.0.1:{fleet.joiner_grpc}"
-            stolen = [sid for sid in survivors
-                      if ring_mod.assign_weighted(
+            stolen = [sid for j, sid in enumerate(sorted(survivors))
+                      if j % 2 and ring_mod.assign_weighted(
                           ring_mod.ring_key("sess", sid),
                           weights3) == joiner_id]
             recovered = sum(r.snapshot()["sessions_recovered"]
                             for r in fleet.routers)
             if stolen:
                 assert recovered >= 1, \
-                    "joiner stole ring keys of live sessions but no " \
-                    "pin recovery ever fired"
+                    "joiner stole ring keys of pinless-stepped live " \
+                    "sessions but no pin recovery ever fired"
             # New sessions spread onto the joiner — identically placed
             # by both replicas (init on one, step on the other).
             joined = 0

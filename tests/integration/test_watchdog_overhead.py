@@ -1,0 +1,73 @@
+"""Watchdog overhead smoke, in its own module (the overhead-test
+convention: nothing else timed shares the process window). The
+watchdog samples every observability plane on its own daemon thread —
+the A/B below pins what that thread costs a request's p50 with ticks
+running absurdly hot (50ms; production default is 5s, two orders of
+magnitude cooler)."""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+import pytest
+
+from min_tfs_client_tpu.client import TensorServingClient
+from min_tfs_client_tpu.observability import slo, tracing
+from min_tfs_client_tpu.observability import watchdog as wd
+from tests import fixtures
+
+
+@pytest.fixture(scope="module")
+def native_base(tmp_path_factory):
+    base = tmp_path_factory.mktemp("wd_overhead_models") / "native"
+    fixtures.write_jax_servable(base)
+    return base
+
+
+class TestWatchdogOverheadSmoke:
+    def test_toy_overhead_within_budget(self, native_base):
+        """Watchdog ON (ticking at 50ms) vs OFF on the toy model: the
+        p50 delta must stay under 5% of the solo p50 with the 60us
+        floor (the tracing/health-plane overhead convention)."""
+        import gc
+
+        client = TensorServingClient(f"tpu://{native_base}")
+        x = np.arange(32, dtype=np.float32)
+
+        def call():
+            client.predict_request("native", {"x": x})
+
+        for _ in range(30):
+            call()  # warm jit + allocator
+
+        def chunk_p50(n=120):
+            ts = []
+            for _ in range(n):
+                t0 = time.perf_counter()
+                call()
+                ts.append(time.perf_counter() - t0)
+            ts.sort()
+            return ts[n // 2] * 1e6
+
+        dog = wd.configure(interval_s=0.05)
+        on, off = [], []
+        tracing.flush_metrics()
+        gc.collect()
+        gc.disable()
+        try:
+            for _ in range(7):  # interleave so both see the same load
+                dog.start()
+                slo.reset()
+                on.append(chunk_p50())
+                dog.stop()
+                off.append(chunk_p50())
+        finally:
+            gc.enable()
+            wd.configure()  # restore the process default (stopped)
+        ticking, quiet = min(on), min(off)
+        overhead = ticking - quiet
+        budget = max(0.05 * quiet, 60.0)
+        assert overhead < budget, (
+            f"watchdog overhead {overhead:.1f}us exceeds budget "
+            f"{budget:.1f}us (on {ticking:.1f}us, off {quiet:.1f}us)")

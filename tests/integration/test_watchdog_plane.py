@@ -1,0 +1,172 @@
+"""Watchdog acceptance against a live server (ISSUE 16): an idle
+server scrapes alert-quiet, a planted SLO-burn spike and a planted KV
+leak each produce a correctly-typed alert at /monitoring/alerts joined
+to a real trace id — driven through the REAL pipeline (traces flow the
+tracing drain into slo + watchdog; the pool registers with runtime)
+and forced detector ticks (`?tick=1`), never a sleep-and-hope."""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from min_tfs_client_tpu.observability import (
+    flight_recorder,
+    runtime,
+    slo,
+    tracing,
+)
+from min_tfs_client_tpu.observability import watchdog as wd
+from min_tfs_client_tpu.server.server import Server, ServerOptions
+from tests import fixtures
+
+pytestmark = pytest.mark.integration
+
+
+@pytest.fixture(scope="module")
+def server(tmp_path_factory):
+    root = tmp_path_factory.mktemp("watchdog_models")
+    fixtures.write_jax_servable(root / "native")
+    mon = root / "monitoring.config"
+    mon.write_text("prometheus_config { enable: true }\n")
+    srv = Server(ServerOptions(
+        grpc_port=0,
+        rest_api_port=0,
+        model_name="native",
+        model_base_path=str(root / "native"),
+        model_platform="jax",
+        file_system_poll_wait_seconds=0,
+        monitoring_config_file=str(mon),
+        # Scheduled ticks effectively off: every evaluation below is a
+        # forced `?tick=1`, so the tests are deterministic.
+        watchdog_interval_s=3600.0,
+    ))
+    srv.build_and_start()
+    yield srv
+    srv.stop()
+
+
+def _alerts(port, tick=True):
+    suffix = "?tick=1" if tick else ""
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/monitoring/alerts{suffix}",
+            timeout=30) as resp:
+        return json.loads(resp.read())
+
+
+@pytest.fixture()
+def clean_watchdog(server):
+    """Fresh alert state per test; the detector histories reset too so
+    one test's planted series can't arm another's edge."""
+    dog = wd.get()
+    dog.reset()
+    dog.detectors = type(dog.detectors)(wd.default_detectors())
+    slo.reset()
+    yield dog
+    dog.reset()
+
+
+class TestWatchdogPlane:
+    def test_idle_server_scrapes_alert_quiet(self, server,
+                                             clean_watchdog):
+        from min_tfs_client_tpu.client import TensorServingClient
+
+        client = TensorServingClient("127.0.0.1", server.grpc_port)
+        for _ in range(5):
+            client.predict_request(
+                "native", {"x": np.arange(8, dtype=np.float32)})
+        client.close()
+        for _ in range(3):
+            payload = _alerts(server.rest_port)
+        assert payload["alerts"] == []
+        assert payload["active"] == []
+        assert payload["ticks"] >= 3
+        assert len(payload["detectors"]) == 6
+        assert not any(d["firing"] for d in payload["detectors"])
+
+    def test_planted_slo_burn_spike_alerts_with_trace_join(
+            self, server, clean_watchdog, tmp_path):
+        flight_recorder.configure(dump_dir=str(tmp_path))
+        flight_recorder.reset()
+        try:
+            # 60 INTERNAL-status traces on their own model key: error
+            # fraction 1.0 vs the 1% budget = burn ~100x — far past
+            # critical_burn. The traces ride the REAL drain
+            # (flush_metrics inside the forced tick) into slo AND the
+            # watchdog's join table.
+            planted = []
+            for _ in range(60):
+                with tracing.request_trace(
+                        "predict", model="wd-burn",
+                        signature="s") as tr:
+                    planted.append(tr.trace_id)
+                    tracing.set_status(13)
+            alert = None
+            for _ in range(14):  # short_n=3 ticks arm the window
+                payload = _alerts(server.rest_port)
+                burns = [a for a in payload["alerts"]
+                         if a["signal"] == "slo_burn"]
+                if burns:
+                    alert = burns[-1]
+                    break
+            assert alert is not None, payload
+            assert alert["severity"] == "critical"
+            assert alert["observed"] >= 10.0
+            assert alert["threshold"] == 10.0
+            assert alert["window_s"] > 0
+            assert alert["context"]["long_mean"] >= 1.0
+            # Joined to a real planted trace, not a fabricated id.
+            assert alert["trace_id"] in planted
+            assert tracing.valid_trace_id(alert["trace_id"])
+            # The catalogue agrees the detector is firing, and the
+            # CRITICAL latched the flight recorder's one-shot dump.
+            assert any(d["signal"] == "slo_burn" and d["firing"]
+                       for d in payload["detectors"])
+            dumps = list(tmp_path.glob("flight_recorder_*.json"))
+            assert len(dumps) == 1
+            reasons = {json.loads(p.read_text())["reason"]
+                       for p in dumps}
+            assert reasons == {"watchdog:slo_burn"}
+        finally:
+            flight_recorder.configure(dump_dir=None)
+            flight_recorder.reset()
+
+    def test_planted_kv_leak_alerts_with_session_join(self, server,
+                                                      clean_watchdog):
+        class _LeakyPool:
+            metric_label = "leaky"
+            blocks_used = 4
+
+            def stats(self):
+                return {"blocks_used": self.blocks_used,
+                        "num_blocks": 16, "sessions": 2,
+                        "swapped_sessions": 0}
+
+        pool = _LeakyPool()
+        runtime.register_kv_pool(pool)
+        # A decode trace supplies the session join.
+        with tracing.request_trace("decode", model="leaky") as tr:
+            session_trace = tr.trace_id
+        alert = None
+        for _ in range(8):
+            payload = _alerts(server.rest_port)
+            leaks = [a for a in payload["alerts"]
+                     if a["signal"] == "kv_leak"]
+            if leaks:
+                alert = leaks[-1]
+                break
+            # +2 blocks per tick with sessions flat: 5 samples in, the
+            # rise clears min_rise_blocks=8 at 75% occupancy.
+            pool.blocks_used = min(16, pool.blocks_used + 2)
+        assert alert is not None, payload
+        assert alert["severity"] == "warn"
+        assert alert["context"]["kind"] == "leak_slope"
+        assert alert["context"]["model"] == "leaky"
+        assert alert["trace_id"] == session_trace
+        # The pool snapshot it fired on is the live registry's.
+        assert any(p["model"] == "leaky"
+                   for p in runtime.kv_pool_stats())

@@ -1,0 +1,494 @@
+"""Watchdog unit suite (observability/watchdog.py): every detector as
+a pure fire/quiet function of planted histories, the bounded alert
+ring, edge-trigger + refire suppression, the CRITICAL -> flight
+recorder one-shot latch, trace-id joins, the payload schemas both REST
+surfaces serve, and the process singleton's configure/swap."""
+
+import glob
+import time
+from types import SimpleNamespace
+
+import pytest
+
+from min_tfs_client_tpu.observability import flight_recorder
+from min_tfs_client_tpu.observability import watchdog as wd
+from min_tfs_client_tpu.observability.watchdog import (
+    CRITICAL,
+    INFO,
+    WARN,
+    AlertRing,
+    CompileStormDetector,
+    CostConservationDetector,
+    DarkBackendDetector,
+    Detector,
+    Finding,
+    FleetWatchdog,
+    KVLeakDetector,
+    PinSkewDetector,
+    RingImbalanceDetector,
+    SLOBurnDetector,
+    StragglerDetector,
+    TickCollapseDetector,
+    TickerLagDetector,
+    Watchdog,
+    default_detectors,
+    default_fleet_detectors,
+    max_severity,
+    severity_rank,
+)
+
+
+@pytest.fixture(autouse=True)
+def _schedule_witness(schedule_witness):
+    yield
+
+
+# ---------------------------------------------------------------------------
+# Severity ordering
+
+
+def test_severity_ordering_and_max():
+    assert severity_rank(INFO) < severity_rank(WARN) < severity_rank(
+        CRITICAL)
+    assert severity_rank("nonsense") < severity_rank(INFO)
+    assert max_severity([]) is None
+    assert max_severity([INFO, CRITICAL, WARN]) == CRITICAL
+    assert max_severity([WARN, INFO]) == WARN
+
+
+# ---------------------------------------------------------------------------
+# The ring
+
+
+def test_alert_ring_is_bounded_with_monotonic_seq():
+    ring = AlertRing(capacity=4)
+    assert ring.capacity == 4
+    for i in range(10):
+        ring.record({"n": i})
+    alerts = ring.snapshot()
+    assert len(alerts) == 4
+    # The seq survives eviction: a poller sees exactly what it missed.
+    assert [a["seq"] for a in alerts] == [7, 8, 9, 10]
+    assert [a["n"] for a in alerts] == [6, 7, 8, 9]
+    assert [a["seq"] for a in ring.snapshot(limit=2)] == [9, 10]
+    ring.clear()
+    assert ring.snapshot() == []
+    ring.record({"n": 99})
+    assert ring.snapshot()[0]["seq"] == 11  # seq never rewinds
+
+
+def test_alert_ring_minimum_capacity_floor():
+    assert AlertRing(capacity=0).capacity == 4
+
+
+# ---------------------------------------------------------------------------
+# Backend detectors: each a fire/quiet pair over planted histories.
+
+
+def _feed(det, samples, t0=1000.0, dt=5.0):
+    out = []
+    for i, sample in enumerate(samples):
+        out.append(det.observe(t0 + i * dt, sample))
+    return out
+
+
+def test_slo_burn_fire_and_quiet():
+    det = SLOBurnDetector(short_n=3, long_n=6)
+    # Quiet: bursty short window but the long window is under budget.
+    results = _feed(det, [{"slo_max_burn": b}
+                          for b in (0.0, 0.0, 0.0, 0.0, 5.0, 0.1)])
+    assert all(r == [] for r in results)
+    # Fire: sustained burn — long mean over budget, short mean >= warn.
+    det = SLOBurnDetector(short_n=3, long_n=6)
+    results = _feed(det, [{"slo_max_burn": b}
+                          for b in (1.2, 1.5, 2.0, 4.5, 5.0, 6.0)])
+    final = results[-1]
+    assert len(final) == 1 and final[0].severity == WARN
+    assert final[0].observed >= 4.0
+    # Escalation: the short window blowing past critical_burn pages.
+    results = det.observe(1030.0, {"slo_max_burn": 30.0})
+    assert results and results[0].severity == CRITICAL
+
+
+def test_slo_burn_critical_outranks_warn_threshold():
+    det = SLOBurnDetector(short_n=2, long_n=4)
+    for burn in (2.0, 12.0, 14.0):
+        out = det.observe(0.0, {"slo_max_burn": burn})
+    # short mean 13x clears BOTH thresholds: severity must be critical.
+    assert out[0].severity == CRITICAL
+
+
+def _pool(model="t5", used=0, total=10, sessions=1, swapped=0):
+    return {"model": model, "blocks_used": used, "num_blocks": total,
+            "sessions": sessions, "swapped_sessions": swapped}
+
+
+def test_kv_leak_slope_fires_only_without_session_growth():
+    det = KVLeakDetector(min_samples=4, min_rise_blocks=6)
+    # Organic growth: blocks AND sessions rise together -> quiet.
+    organic = [{"kv_pools": [_pool(used=u, sessions=s)]}
+               for u, s in ((1, 1), (3, 2), (6, 3), (9, 4))]
+    assert all(r == [] for r in _feed(det, organic))
+    # Leak: blocks climb monotonically, sessions flat -> WARN.
+    det = KVLeakDetector(min_samples=4, min_rise_blocks=6)
+    leak = [{"kv_pools": [_pool(used=u, sessions=2)]}
+            for u, s in ((1, 0), (3, 0), (6, 0), (8, 0))]
+    final = _feed(det, leak)[-1]
+    assert len(final) == 1 and final[0].severity == WARN
+    assert final[0].context["kind"] == "leak_slope"
+    # Still climbing into a nearly-full pool -> CRITICAL.
+    out = det.observe(0.0, {"kv_pools": [_pool(used=10, sessions=2)]})
+    assert out and out[0].severity == CRITICAL
+
+
+def test_kv_pressure_trend_fires_on_swaps_under_high_occupancy():
+    det = KVLeakDetector(min_samples=3)
+    samples = [{"kv_pools": [_pool(used=u, sessions=3, swapped=sw)]}
+               for u, sw in ((9, 0), (8, 1), (9, 0))]
+    final = _feed(det, samples)[-1]
+    assert len(final) == 1 and final[0].severity == WARN
+    assert final[0].context["kind"] == "pressure_trend"
+    # Same swaps at LOW occupancy: the allocator has headroom -> quiet.
+    det = KVLeakDetector(min_samples=3)
+    low = [{"kv_pools": [_pool(used=u, sessions=3, swapped=1)]}
+           for u in (2, 3, 2)]
+    assert all(r == [] for r in _feed(det, low))
+
+
+def test_kv_leak_prunes_unloaded_pools():
+    det = KVLeakDetector(min_samples=3)
+    det.observe(0.0, {"kv_pools": [_pool(model="gone", used=9)]})
+    det.observe(5.0, {"kv_pools": []})
+    assert det._history == {}
+
+
+def test_tick_collapse_fire_and_quiet():
+    det = TickCollapseDetector(min_samples=4)
+    # A pool that was never busy must stay quiet while idle.
+    idle = [{"tick_utilization": {"t5": 0.05}}] * 6
+    assert all(r == [] for r in _feed(det, idle))
+    # Busy baseline then a collapse below collapse_frac * baseline.
+    det = TickCollapseDetector(min_samples=4)
+    utils = (0.8, 0.7, 0.8, 0.75, 0.02, 0.01)
+    final = _feed(det, [{"tick_utilization": {"t5": u}}
+                        for u in utils])[-1]
+    assert len(final) == 1 and final[0].severity == WARN
+    assert final[0].key == "t5"
+
+
+def test_compile_storm_excludes_boot_warmup_baseline():
+    det = CompileStormDetector(storm_count=5)
+    # First sample carries 40 warmup compiles: baseline, not a storm.
+    assert det.observe(0.0, {"total_compiles": 40}) == []
+    assert det.observe(5.0, {"total_compiles": 42}) == []
+    out = det.observe(10.0, {"total_compiles": 46})
+    assert out and out[0].severity == WARN and out[0].observed == 6
+
+
+def test_cost_conservation_fires_on_double_billing_only():
+    det = CostConservationDetector(band=0.05, min_count=20)
+    entry = {"model": "m", "signature": "s", "count": 50,
+             "mean": {"total_us": 1000.0, "queue_wait_us": 600.0,
+                      "device_execute_us": 600.0, "host_island_us": 0.0,
+                      "decode_tick_us": 0.0}}
+    out = det.observe(0.0, {"cost_entries": [entry]})
+    assert out and out[0].severity == WARN and out[0].observed > 0.05
+    # Under-attribution (unattributed wall) is normal, not an alert.
+    entry["mean"]["device_execute_us"] = 100.0
+    assert det.observe(0.0, {"cost_entries": [entry]}) == []
+    # Thin entries don't page.
+    entry["mean"]["device_execute_us"] = 600.0
+    entry["count"] = 3
+    assert det.observe(0.0, {"cost_entries": [entry]}) == []
+
+
+def test_ticker_lag_fire_and_quiet():
+    det = TickerLagDetector(floor_s=1.0, ratio=2.0)
+    quiet = [{"tick_lag_s": 0.1, "interval_s": 5.0}] * 3
+    assert all(r == [] for r in _feed(det, quiet))
+    out = det.observe(0.0, {"tick_lag_s": 11.0, "interval_s": 5.0})
+    assert out and out[0].severity == WARN and out[0].observed == 11.0
+
+
+# ---------------------------------------------------------------------------
+# Fleet detectors.
+
+
+def _fleet_backends(p99s, stale=()):
+    return {bid: {"stale": bid in stale, "unreachable": bid in stale,
+                  "age_s": 9.0 if bid in stale else 0.1,
+                  "state": "DEAD" if bid in stale else "LIVE",
+                  "error": None, "p99_ms": p99}
+            for bid, p99 in p99s.items()}
+
+
+def test_straggler_fire_quiet_and_min_backends():
+    det = StragglerDetector(ratio=3.0, floor_ms=50.0, min_backends=3)
+    even = {"backends": _fleet_backends({"a": 20.0, "b": 22.0,
+                                         "c": 25.0})}
+    assert det.observe(0.0, even) == []
+    skew = {"backends": _fleet_backends({"a": 20.0, "b": 22.0,
+                                         "c": 400.0})}
+    out = det.observe(0.0, skew)
+    assert len(out) == 1 and out[0].key == "c"
+    # Two backends: no meaningful median -> quiet, never a guess.
+    two = {"backends": _fleet_backends({"a": 20.0, "c": 400.0})}
+    assert det.observe(0.0, two) == []
+    # A stale straggler is the dark detector's problem, not this one's.
+    stale = {"backends": _fleet_backends(
+        {"a": 20.0, "b": 22.0, "c": 400.0}, stale={"c"})}
+    assert det.observe(0.0, stale) == []
+
+
+def test_ring_imbalance_requires_sustained_skew():
+    det = RingImbalanceDetector(sustain=3)
+    skewed = {"ring_occupancy": {"a": 0.9, "b": 0.1},
+              "weights": {"a": 1.0, "b": 1.0}}
+    assert det.observe(0.0, skewed) == []       # strike 1
+    assert det.observe(1.0, skewed) == []       # strike 2
+    out = det.observe(2.0, skewed)              # strike 3: fires
+    # With equal weights the high side can never clear 2x its 50%
+    # share; the starved backend is the detectable half of the skew.
+    assert {f.key for f in out} == {"b"}
+    # A balanced sweep clears the strikes; skew must re-sustain.
+    balanced = {"ring_occupancy": {"a": 0.5, "b": 0.5},
+                "weights": {"a": 1.0, "b": 1.0}}
+    assert det.observe(3.0, balanced) == []
+    assert det.observe(4.0, skewed) == []
+
+
+def test_dark_backend_fires_warn_per_dark_entry():
+    det = DarkBackendDetector()
+    sample = {"backends": _fleet_backends(
+        {"a": 20.0, "b": 22.0, "c": None}, stale={"c"})}
+    out = det.observe(0.0, sample)
+    assert len(out) == 1
+    assert out[0].severity == WARN and out[0].key == "c"
+    assert out[0].context["state"] == "DEAD"
+
+
+def test_pin_skew_fire_quiet_and_min_pins():
+    det = PinSkewDetector(ratio=3.0, min_pins=8, sustain=2)
+    skew = {"pins": {"a": 9, "b": 1},
+            "weights": {"a": 1.0, "b": 1.0, "c": 8.0}}
+    assert det.observe(0.0, skew) == []         # strike 1
+    out = det.observe(1.0, skew)                # strike 2: fires
+    assert len(out) == 1 and out[0].key == "a"
+    # Below min_pins the shares are noise.
+    thin = {"pins": {"a": 3, "b": 0}, "weights": {"a": 1.0, "b": 1.0}}
+    assert det.observe(2.0, thin) == []
+
+
+# ---------------------------------------------------------------------------
+# Emission spine: edge triggers, refire suppression, escalation, latch.
+
+
+class _Planted(Detector):
+    """Detector returning a scripted list of findings per tick."""
+
+    signal = "planted"
+    window_s = 1.0
+
+    def __init__(self, script, join=""):
+        self.script = list(script)
+        self.join = join
+
+    def observe(self, now, sample):
+        return self.script.pop(0) if self.script else []
+
+
+def _warn(key="", **ctx):
+    return Finding(WARN, 1.0, 0.5, "planted warn", key=key, context=ctx)
+
+
+def _critical(key=""):
+    return Finding(CRITICAL, 2.0, 0.5, "planted critical", key=key)
+
+
+def test_edge_trigger_refire_suppression_and_escalation():
+    det = _Planted([[_warn()], [_warn()], [_critical()], [_critical()],
+                    [], [_warn()], [_warn()]])
+    w = Watchdog(detectors=[det], refire_s=60.0)
+    t = 1000.0
+    assert len(w._evaluate(t, {})) == 1        # rising edge: emits
+    assert len(w._evaluate(t + 5, {})) == 0    # same severity: suppressed
+    assert len(w._evaluate(t + 10, {})) == 1   # escalation: emits
+    assert len(w._evaluate(t + 15, {})) == 0   # suppressed again
+    assert len(w._evaluate(t + 20, {})) == 0   # cleared: nothing active
+    assert w.active() == []
+    assert len(w._evaluate(t + 25, {})) == 1   # re-fires on a NEW edge
+    # Ring kept every emission in order.
+    sevs = [a["severity"] for a in w.ring.snapshot()]
+    assert sevs == [WARN, CRITICAL, WARN]
+
+
+def test_refire_window_expiry_re_emits_persistent_condition():
+    det = _Planted([[_warn()]] * 3)
+    w = Watchdog(detectors=[det], refire_s=60.0)
+    assert len(w._evaluate(1000.0, {})) == 1
+    assert len(w._evaluate(1030.0, {})) == 0   # inside the window
+    assert len(w._evaluate(1061.0, {})) == 1   # past refire_s: re-page
+
+
+def test_findings_edge_trigger_per_key_independently():
+    det = _Planted([[_warn(key="a")], [_warn(key="a"), _warn(key="b")]])
+    w = Watchdog(detectors=[det], refire_s=60.0)
+    assert len(w._evaluate(0.0, {})) == 1
+    emitted = w._evaluate(1.0, {})
+    assert len(emitted) == 1                   # only the NEW key pages
+    assert {a["signal"] for a in emitted} == {"planted"}
+    assert len(w.active()) == 2
+
+
+def test_critical_latches_flight_recorder_dump_once(tmp_path):
+    flight_recorder.configure(dump_dir=str(tmp_path))
+    flight_recorder.reset()
+    try:
+        det = _Planted([[_critical(key="a")], [_critical(key="b")]])
+        w = Watchdog(detectors=[det], refire_s=60.0)
+        w._evaluate(0.0, {})
+        w._evaluate(1.0, {})   # second CRITICAL: ring-records only
+        dumps = glob.glob(str(tmp_path / "flight_recorder_*.json"))
+        assert len(dumps) == 1, "one-shot latch dumped more than once"
+        # Every alert ring-recorded into the recorder regardless.
+        kinds = [k for _s, _t, k, _f in flight_recorder.snapshot()]
+        assert kinds.count("alert") == 2
+        # Re-arming (the chaos-phase hook) reports the latched dump and
+        # lets the NEXT critical dump again.
+        assert flight_recorder.rearm() is True
+        det.script = [[_critical(key="c")]]
+        w._evaluate(2.0, {})
+        dumps = glob.glob(str(tmp_path / "flight_recorder_*.json"))
+        assert len(dumps) == 2
+    finally:
+        flight_recorder.configure(dump_dir=None)
+        flight_recorder.reset()
+
+
+def test_detector_exception_does_not_kill_the_tick():
+    class _Broken(Detector):
+        signal = "broken"
+
+        def observe(self, now, sample):
+            raise RuntimeError("detector bug")
+
+    det = _Planted([[_warn()]])
+    w = Watchdog(detectors=[_Broken(), det])
+    assert len(w._evaluate(0.0, {})) == 1
+    assert w.ticks() == 1
+
+
+# ---------------------------------------------------------------------------
+# Joins: alerts carry the most relevant recent trace id + error digest.
+
+
+def _trace(trace_id, status="0", meta=None, api="predict"):
+    return SimpleNamespace(trace_id=trace_id, status=status,
+                           meta=meta or {}, api=api)
+
+
+def test_observe_trace_classifies_joins():
+    w = Watchdog(detectors=[])
+    w.observe_trace(_trace("t-plain"))
+    w.observe_trace(_trace("t-err", status="13"))
+    w.observe_trace(_trace("t-sess", meta={"session_id": "s1"}))
+    joins = w._joins()
+    assert joins["last_trace"] == "t-sess"
+    assert joins["error_trace"] == "t-err"
+    assert joins["session_trace"] == "t-sess"
+
+
+def test_emitted_alert_joins_error_trace_and_digest(tmp_path):
+    flight_recorder.reset()
+    try:
+        flight_recorder.record_error("predict", "m", "s", 13,
+                                     "boom 42", trace_id="t-err")
+        det = _Planted([[_warn()]], join="error")
+        w = Watchdog(detectors=[det])
+        w.observe_trace(_trace("t-err", status="13"))
+        w.observe_trace(_trace("t-later"))
+        [alert] = w._evaluate(0.0, {"joins": w._joins()})
+        assert alert["trace_id"] == "t-err"
+        assert alert["error_digest"]  # blake2s failure-mode digest
+    finally:
+        flight_recorder.reset()
+
+
+# ---------------------------------------------------------------------------
+# Payload schemas (what /monitoring/alerts serves) + lifecycle.
+
+
+def test_backend_payload_schema_and_catalogue():
+    w = Watchdog(detectors=default_detectors(), interval_s=2.5)
+    payload = w.payload()
+    assert set(payload) == {"interval_s", "ticks", "detectors",
+                            "active", "alerts"}
+    assert payload["interval_s"] == 2.5
+    signals = {d["signal"] for d in payload["detectors"]}
+    assert signals == {"slo_burn", "kv_leak", "tick_collapse",
+                       "compile_storm", "cost_conservation",
+                       "ticker_lag"}
+    assert all(set(d) == {"signal", "window_s", "firing"}
+               for d in payload["detectors"])
+
+
+def test_fleet_payload_schema_and_catalogue():
+    fw = FleetWatchdog()
+    payload = fw.payload()
+    assert set(payload) == {"ticks", "detectors", "active", "alerts"}
+    signals = {d["signal"] for d in payload["detectors"]}
+    assert signals == {"fleet_straggler", "fleet_ring_imbalance",
+                       "fleet_dark_backend", "fleet_pin_skew"}
+    assert len(default_fleet_detectors()) == 4
+
+
+def test_emitted_alert_schema():
+    det = _Planted([[_warn(extra="x")]])
+    w = Watchdog(detectors=[det])
+    [alert] = w._evaluate(0.0, {})
+    assert set(alert) == {"at", "severity", "signal", "window_s",
+                          "observed", "threshold", "message",
+                          "trace_id", "error_digest", "context", "seq"}
+    assert alert["context"] == {"extra": "x"}
+
+
+def test_ticker_thread_lifecycle_and_forced_tick():
+    w = Watchdog(interval_s=0.05, detectors=[])
+    assert not w.running()
+    w.tick_now()
+    assert w.ticks() == 1
+    w.start()
+    try:
+        deadline = time.monotonic() + 5.0
+        while w.ticks() < 3 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert w.ticks() >= 3, "ticker thread never ticked"
+        assert w.running()
+    finally:
+        w.stop()
+    assert not w.running()
+    w.stop()  # idempotent
+
+
+def test_reset_clears_edges_and_ring():
+    det = _Planted([[_warn()], [_warn()]])
+    w = Watchdog(detectors=[det])
+    w._evaluate(0.0, {})
+    w.reset()
+    assert w.ticks() == 0 and w.active() == [] \
+        and w.ring.snapshot() == []
+    # After reset the same condition is a fresh edge again.
+    assert len(w._evaluate(1.0, {})) == 1
+
+
+def test_singleton_configure_swaps_and_stops():
+    original = wd.get()
+    try:
+        fresh = wd.configure(interval_s=0.5, ring_size=8)
+        assert wd.get() is fresh
+        assert fresh.interval_s == 0.5
+        assert fresh.ring.capacity == 8
+        assert not fresh.running()
+    finally:
+        wd.configure()  # restore process defaults for later tests
+    assert wd.get() is not original
