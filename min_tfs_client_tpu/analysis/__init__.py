@@ -1,16 +1,22 @@
 """servelint: AST-based hot-path static analysis for the serving stack.
 
-Six rule families (docs/STATIC_ANALYSIS.md) — host-sync (HS), recompile
-(RC), lock-discipline (LK), span-discipline (SP), interprocedural
-lock-order (DL, a package-level pass), and thread-root inventory (TH) —
-plus a runtime schedule witness (witness.py) that verifies the
-annotations against live schedules in the concurrency test suites. The
-comment-annotation vocabulary (`# guarded_by:`, `# servelint:
-sync-ok|lock-ok|jit-ok|span-ok|holds|blocks|thread-ok`) and a checked-in
-baseline ratchet. Gated in tier-1 via
-tests/unit/test_static_analysis.py; CLI via `servelint` /
+Eight rule families (docs/STATIC_ANALYSIS.md) — host-sync (HS),
+recompile (RC), lock-discipline (LK), span-discipline (SP),
+interprocedural lock-order (DL, a package-level pass), thread-root
+inventory (TH), error-flow (ER, package-level: raised-exception
+taxonomy at the handler boundary), and resource-lifecycle (RL,
+package-level: acquire/release + `owns` teardown contracts) — plus
+runtime witnesses (witness.py): a schedule witness that verifies lock
+annotations against live schedules and a leak witness that counts
+acquires/releases over the allocator, slot pools, pin table, connection
+pools and thread registry. The comment-annotation vocabulary
+(`# guarded_by:`, `# servelint: sync-ok|lock-ok|jit-ok|span-ok|holds|
+blocks|thread-ok|internal-ok|status-ok|retry-ok|fallback-ok|owns|
+transfers|leak-ok|boundary`) and a checked-in baseline ratchet. Gated
+in tier-1 via tests/unit/test_static_analysis.py; CLI via `servelint` /
 `python -m min_tfs_client_tpu.analysis` (`--jobs N` fans the file scan
-over processes).
+over processes; `--since REV` scans the diff, `--format sarif` feeds
+code-scanning UIs).
 """
 
 from min_tfs_client_tpu.analysis.baseline import (

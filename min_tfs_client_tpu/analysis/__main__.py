@@ -14,14 +14,43 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import subprocess
 import sys
 
 from min_tfs_client_tpu.analysis.baseline import save_baseline
 from min_tfs_client_tpu.analysis.runner import (
+    ALL_RULES,
     default_baseline_path,
     default_package_root,
+    iter_py_files,
     run_analysis,
 )
+from min_tfs_client_tpu.analysis.sarif import to_sarif
+
+
+def changed_relpaths(rev: str, paths: list[str]) -> set:
+    """Package-anchored relpaths of the .py files git reports changed
+    since `rev` (committed, staged, unstaged, and untracked), restricted
+    to the scan set. Deleted files drop out naturally — they are no
+    longer in iter_py_files."""
+    cwd = os.path.abspath(paths[0])
+    if os.path.isfile(cwd):
+        cwd = os.path.dirname(cwd)
+    out = subprocess.run(
+        ["git", "diff", "--name-only", "--diff-filter=d", rev,
+         "--", "*.py"],
+        cwd=cwd, capture_output=True, text=True, check=True).stdout
+    untracked = subprocess.run(
+        ["git", "ls-files", "--others", "--exclude-standard", "--",
+         "*.py"],
+        cwd=cwd, capture_output=True, text=True, check=True).stdout
+    top = subprocess.run(
+        ["git", "rev-parse", "--show-toplevel"],
+        cwd=cwd, capture_output=True, text=True, check=True).stdout.strip()
+    changed_abs = {os.path.normpath(os.path.join(top, line))
+                   for line in (out + untracked).splitlines() if line}
+    return {rel for ab, rel in iter_py_files(paths)
+            if os.path.normpath(ab) in changed_abs}
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -29,8 +58,9 @@ def main(argv: list[str] | None = None) -> int:
         prog="servelint",
         description="AST-based hot-path analysis for the TPU serving "
                     "stack: host-sync, recompile-hazard, lock-discipline, "
-                    "span-discipline, interprocedural lock-order and "
-                    "thread-inventory rules (docs/STATIC_ANALYSIS.md).")
+                    "span-discipline, interprocedural lock-order, "
+                    "thread-inventory, error-flow and resource-lifecycle "
+                    "rules (docs/STATIC_ANALYSIS.md).")
     parser.add_argument("paths", nargs="*",
                         help="files/directories to analyze "
                              "(default: the installed package)")
@@ -40,8 +70,13 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--write-baseline", action="store_true",
                         help="rewrite the baseline from current findings "
                              "and exit 0")
-    parser.add_argument("--format", choices=("text", "json"),
+    parser.add_argument("--format", choices=("text", "json", "sarif"),
                         default="text")
+    parser.add_argument("--since", default=None, metavar="REV",
+                        help="incremental mode: per-file rules scan only "
+                             "files git reports changed since REV; "
+                             "package passes (DL/ER/RL) still link the "
+                             "full package")
     parser.add_argument("--jobs", type=int, default=1, metavar="N",
                         help="parallel file-scan processes (0 = one per "
                              "CPU); package passes still link globally")
@@ -59,7 +94,16 @@ def main(argv: list[str] | None = None) -> int:
     if args.jobs < 0:
         parser.error(f"--jobs must be >= 0 (got {args.jobs})")
     jobs = args.jobs if args.jobs > 0 else (os.cpu_count() or 1)
-    report = run_analysis(paths, baseline_path=baseline, jobs=jobs)
+    only_paths = None
+    if args.since is not None:
+        if args.write_baseline:
+            parser.error("--write-baseline needs a full scan, not --since")
+        try:
+            only_paths = changed_relpaths(args.since, paths)
+        except (subprocess.CalledProcessError, FileNotFoundError) as exc:
+            parser.error(f"--since {args.since}: git failed ({exc})")
+    report = run_analysis(paths, baseline_path=baseline, jobs=jobs,
+                          only_paths=only_paths)
 
     if args.write_baseline:
         if baseline is None:
@@ -74,7 +118,9 @@ def main(argv: list[str] | None = None) -> int:
               f"{baseline}")
         return 0
 
-    if args.format == "json":
+    if args.format == "sarif":
+        print(json.dumps(to_sarif(report, ALL_RULES), indent=2))
+    elif args.format == "json":
         payload = {
             "files_scanned": report.files_scanned,
             "clean": report.clean,

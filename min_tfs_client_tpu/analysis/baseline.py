@@ -69,8 +69,10 @@ def load_baseline(path: str | None) -> Baseline:
 
 def save_baseline(path: str, findings: list[Finding],
                   required_guards=()) -> None:
+    # LK004/RL005 are the ratchet's OWN findings (pinned annotation
+    # removed) — baselining them would defeat the pin.
     counts = collections.Counter(f.key() for f in findings
-                                 if f.code != "LK004")
+                                 if f.code not in ("LK004", "RL005"))
     with open(path, "w", encoding="utf-8") as f:
         json.dump({"version": BASELINE_VERSION,
                    "entries": dict(sorted(counts.items())),

@@ -5,7 +5,7 @@ don't have in Python — `GUARDED_BY`/`EXCLUSIVE_LOCKS_REQUIRED` clang
 thread-safety annotations on batching/manager state, and static typing
 that makes an accidental device->host sync a visible type coercion. This
 package is the Python analogue: a self-contained `ast`-based analyzer
-(no new dependencies) with six rule families (docs/STATIC_ANALYSIS.md):
+(no new dependencies) with eight rule families (docs/STATIC_ANALYSIS.md):
 
   host-sync   (HS*)  device->host coercions in hot-path modules
   recompile   (RC*)  jit recompile hazards (per-call jit, tracer branches)
@@ -13,6 +13,8 @@ package is the Python analogue: a self-contained `ast`-based analyzer
   spans       (SP*)  trace spans opened outside `with` / leaked to threads
   lock-order  (DL*)  interprocedural lock-order cycles + untimed parks
   threads     (TH*)  thread-root inventory / undeclared shared state
+  error-flow  (ER*)  raised-exception taxonomy at the handler boundary
+  resource    (RL*)  acquire/release lifecycle + `# servelint: owns`
 
 Annotations are ordinary comments, so the runtime never pays for them:
 
@@ -25,6 +27,13 @@ Annotations are ordinary comments, so the runtime never pays for them:
   s = tracing.span("x")     # servelint: span-ok <reason>
   self._cv.wait()           # servelint: blocks <reason>
   self.core = build()       # servelint: thread-ok <reason>
+  raise RuntimeError(...)   # servelint: internal-ok <reason>
+  except ServingError: ...  # servelint: status-ok <reason>
+  while ... continue        # servelint: retry-ok <reason>
+  except Exception: ...     # servelint: fallback-ok <reason>
+  self._pages = {}          # servelint: owns pages
+  return slot               # servelint: transfers <Receiver|caller>
+  pool.release_slot(s)      # servelint: leak-ok <reason>
 """
 
 from __future__ import annotations
@@ -78,6 +87,28 @@ DEFAULT_SPAN_EXEMPT = (
     "min_tfs_client_tpu/observability/tracing.py",
 )
 
+# Handler boundary set for the ER (error-flow) family: functions whose
+# raised exceptions reach a wire status. Servicer classes and
+# `@_instrumented` handler methods are detected structurally; these are
+# the boundary entries structure can't see (router forwards + the tick
+# leader body that runs followers' steps).
+DEFAULT_BOUNDARY_FUNCTIONS = (
+    "min_tfs_client_tpu/router/proxy.py::GrpcProxy._handle",
+    "min_tfs_client_tpu/router/proxy.py::GrpcProxy._handle_routed",
+    "min_tfs_client_tpu/router/proxy.py::GrpcProxy._forward",
+    "min_tfs_client_tpu/router/proxy.py::rest_route_request",
+    "min_tfs_client_tpu/router/aio_proxy.py::AioDataPlane._handle",
+    "min_tfs_client_tpu/router/aio_proxy.py::AioDataPlane._forward",
+    "min_tfs_client_tpu/servables/decode_sessions.py::TickBatcher.step",
+)
+
+# The one module allowed to make inline retry decisions (it IS the
+# shared predicate home), and the predicate names everyone else must
+# route through (ER003).
+DEFAULT_RETRY_HOME = "min_tfs_client_tpu/robustness/retry.py"
+DEFAULT_RETRY_PREDICATES = frozenset(
+    {"next_forward_retry_delay_s", "retry_safe_predict"})
+
 
 @dataclass(frozen=True)
 class AnalysisConfig:
@@ -101,6 +132,16 @@ class AnalysisConfig:
     # Calls that return HOST data (sinks clear taint; fetch_outputs is the
     # sanctioned overlapped device->host round).
     sanctioned_fetches: frozenset = frozenset({"fetch_outputs"})
+    # ER boundary detection: explicit `path::qualname` entries plus the
+    # structural signals (class-name suffix, method-name prefix,
+    # decorator names, `# servelint: boundary` mark).
+    boundary_functions: tuple = DEFAULT_BOUNDARY_FUNCTIONS
+    boundary_class_suffixes: tuple = ("Servicer",)
+    boundary_method_prefixes: tuple = ("do_",)
+    boundary_decorators: frozenset = frozenset({"_instrumented"})
+    # ER003: the shared retry predicates and their home module.
+    retry_home: str = DEFAULT_RETRY_HOME
+    retry_predicates: frozenset = DEFAULT_RETRY_PREDICATES
 
     def is_hot(self, relpath: str) -> bool:
         return any(relpath == p or relpath.startswith(p)
@@ -124,6 +165,11 @@ class ModuleInfo:
     path: str                      # relative posix path (finding/baseline key)
     tree: ast.Module
     comments: dict[int, str] = field(default_factory=dict)  # line -> text
+    # Lines whose ONLY content is the comment: the walk-up over "the
+    # comment block above a statement" must stop at code lines, or an
+    # inline annotation on the previous statement would leak onto this
+    # one.
+    comment_only: set = field(default_factory=set)
 
     # annotation lookups -----------------------------------------------------
 
@@ -137,6 +183,32 @@ class ModuleInfo:
         holds)."""
         m = _SERVELINT_RE.search(self.comments.get(line, ""))
         return {m.group(1)} if m else set()
+
+    def mark_arg(self, line: int, mark: str) -> Optional[str]:
+        """The argument of `# servelint: <mark> <arg...>` on `line`
+        (first whitespace-separated token; trailing prose is a reason)."""
+        m = _SERVELINT_RE.search(self.comments.get(line, ""))
+        if not m or m.group(1) != mark or not m.group(2):
+            return None
+        token = m.group(2).strip().split()[0]
+        return token or None
+
+    def stmt_mark_arg(self, stmt: ast.stmt, mark: str) -> Optional[str]:
+        """mark_arg over a statement's whole line span (multi-line
+        initializers carry the comment on any of their lines) or the
+        contiguous comment block directly above it (where a line already
+        carrying another annotation pushes the mark)."""
+        for line in range(stmt.lineno, (stmt.end_lineno or stmt.lineno) + 1):
+            arg = self.mark_arg(line, mark)
+            if arg:
+                return arg
+        line = stmt.lineno - 1
+        while line in self.comment_only:
+            arg = self.mark_arg(line, mark)
+            if arg:
+                return arg
+            line -= 1
+        return None
 
     def holds_locks(self, line: int) -> set[str]:
         """Locks named by `# servelint: holds <lock>[, <lock>]` on line.
@@ -161,8 +233,10 @@ class ModuleInfo:
         if stmt is not None:
             lines.add(stmt.lineno)
             line = stmt.lineno - 1
-            # Walk up through a contiguous comment block above the stmt.
-            while line in self.comments:
+            # Walk up through a contiguous comment block above the stmt
+            # (comment-ONLY lines: an inline comment on the previous
+            # statement belongs to that statement, not this one).
+            while line in self.comment_only:
                 lines.add(line)
                 line -= 1
         return any(mark in self.servelint_marks(ln) for ln in lines)
@@ -180,13 +254,17 @@ def parse_module(path: str, relpath: str, source: str | None = None
     except SyntaxError:
         return None
     comments: dict[int, str] = {}
+    comment_only: set = set()
     try:
         for tok in tokenize.generate_tokens(io.StringIO(source).readline):
             if tok.type == tokenize.COMMENT:
                 comments[tok.start[0]] = tok.string
+                if tok.line.strip().startswith("#"):
+                    comment_only.add(tok.start[0])
     except (tokenize.TokenizeError, IndentationError):  # pragma: no cover
         pass
-    return ModuleInfo(path=relpath, tree=tree, comments=comments)
+    return ModuleInfo(path=relpath, tree=tree, comments=comments,
+                      comment_only=comment_only)
 
 
 # -- small AST helpers shared by every rule ----------------------------------

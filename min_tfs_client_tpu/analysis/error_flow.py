@@ -1,0 +1,482 @@
+"""ER: error-flow taxonomy — what reaches a handler reaches the wire.
+
+Every transport funnels handler exceptions through ONE mapping
+(utils/status.error_from_exception): ServingError passes through typed;
+ValueError/TypeError/KeyError -> INVALID_ARGUMENT; TimeoutError ->
+DEADLINE_EXCEEDED; NotImplementedError -> UNIMPLEMENTED; **everything
+else -> INTERNAL**. The review history is a drumbeat of hand-caught
+violations of that taxonomy (a bare RuntimeError serving INTERNAL in
+PR 9, IndexError->INTERNAL in pin recovery in PR 13, inline retry
+predicates drifting in PR 14); this family machine-checks all of them.
+
+  ER001  a raise of a builtin exception that maps to INTERNAL, in a
+         function REACHABLE from the handler boundary set (gRPC
+         servicers, `@_instrumented` handler methods, REST `do_*`
+         routes, router forwards, TickBatcher step fns) — the client
+         would see an anonymous INTERNAL. Sanction a deliberate
+         internal with `# servelint: internal-ok <why>`.
+  ER002  status laundering: an `except ServingError` clause that either
+         re-raises a DIFFERENT exception type (re-typing a typed error)
+         or swallows it without ever referencing the bound error.
+         Sanction with `# servelint: status-ok <why>`.
+  ER003  an inline retry scope (loop + except + continue) that is not
+         routed through the shared robustness/retry.py predicates, or
+         any retry scope referencing DEADLINE_EXCEEDED (the request may
+         have executed — retrying double-applies). Sanction with
+         `# servelint: retry-ok <why>`.
+  ER004  a hot-path `except Exception` fallback that records NOTHING
+         (no flight-recorder, metric, or log call and no re-raise) —
+         the silent-degradation pattern. Sanction with
+         `# servelint: fallback-ok <why>`.
+
+The pass is package-level (`PACKAGE_PASS = True`): raises propagate
+along the same call graph the DL family links (`lock_order._Namespace`
+/ `_FnContext` resolution), so ER001 is interprocedural while
+ER002-ER004 stay function-local and ride in the per-module summaries.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+from min_tfs_client_tpu.analysis import lock_order
+from min_tfs_client_tpu.analysis.core import (
+    AnalysisConfig,
+    Finding,
+    ModuleInfo,
+    dotted,
+    walk_function_nodes,
+    walk_scopes,
+)
+
+RULE = "error-flow"
+PACKAGE_PASS = True
+
+CODES = {
+    "ER001": "handler-reachable raise of an INTERNAL-mapping builtin",
+    "ER002": "status laundering: typed serving error swallowed/re-typed",
+    "ER003": "inline retry decision / retry scope admitting "
+             "DEADLINE_EXCEEDED",
+    "ER004": "hot-path except-Exception fallback that records nothing",
+}
+
+# Builtin exception types error_from_exception maps to INTERNAL (i.e.
+# everything it does NOT special-case). KeyboardInterrupt/SystemExit
+# excluded: they tear the process down, not a response.
+_INTERNAL_BUILTINS = frozenset({
+    "Exception", "BaseException", "RuntimeError", "IndexError",
+    "AttributeError", "OSError", "IOError", "AssertionError",
+    "ArithmeticError", "ZeroDivisionError", "OverflowError",
+    "MemoryError", "BufferError", "LookupError", "EOFError",
+    "ReferenceError", "SystemError", "StopIteration", "UnicodeError",
+    "FileNotFoundError", "PermissionError", "ConnectionError",
+    "BrokenPipeError", "ConnectionResetError", "ConnectionRefusedError",
+    "NotADirectoryError", "IsADirectoryError", "InterruptedError",
+})
+
+# A call whose dotted name contains one of these tokens counts as
+# "recording something" for ER004 (flight recorder, metrics, logging,
+# tracing, alerting — the observable side-channels).
+_RECORDING_TOKENS = ("record", "log", "warn", "error", "exception",
+                    "metric", "increment", "observe", "safe_set", "note",
+                    "debug", "alert", "dump", "print", "mark", "trace")
+
+
+# -- picklable per-module summaries ------------------------------------------
+
+
+@dataclass
+class ErFunction:
+    path: str
+    qualname: str
+    is_boundary: bool = False
+    # (exc_type, line) for unsanctioned INTERNAL-mapping raises.
+    raises: list = field(default_factory=list)
+    # callee specs (lock_order._FnContext.resolve_callee tuples).
+    calls: list = field(default_factory=list)
+
+    @property
+    def key(self):
+        return (self.path, self.qualname)
+
+
+@dataclass
+class ErModuleSummary:
+    path: str
+    functions: list = field(default_factory=list)
+    # ER002/ER003/ER004 are function-local; they ride along pre-built.
+    local_findings: list = field(default_factory=list)
+
+
+# -- per-module summarize ----------------------------------------------------
+
+
+def _exc_type_name(exc: ast.expr | None) -> str | None:
+    """Leaf type name of `raise X(...)` / `raise X`; None for re-raises
+    of a bound variable, bare `raise`, and unresolvable expressions."""
+    if exc is None:
+        return None
+    node = exc.func if isinstance(exc, ast.Call) else exc
+    name = dotted(node)
+    if not name:
+        return None
+    root = name.split(".")[0]
+    leaf = name.rsplit(".", 1)[-1]
+    # `ServingError.internal(...)` factory: root names the type.
+    if root and root[0].isupper():
+        return root if "." in name and root != leaf else leaf
+    return None
+
+
+def _is_boundary(module: ModuleInfo, config: AnalysisConfig, ns,
+                 qualname: str, func, cls_qual: str | None) -> bool:
+    if f"{module.path}::{qualname}" in config.boundary_functions:
+        return True
+    if cls_qual:
+        # The suffix may sit on the class itself OR a base it extends
+        # (PredictionServiceImpl extends gs.PredictionServiceServicer).
+        names = [cls_qual.rsplit(".", 1)[-1]]
+        classdef = ns.classes.get(cls_qual)
+        if classdef is not None:
+            names.extend((dotted(b) or "").rsplit(".", 1)[-1]
+                         for b in classdef.bases)
+        if any(n.endswith(suffix) for n in names
+               for suffix in config.boundary_class_suffixes):
+            return True
+    if any(func.name.startswith(p) for p in config.boundary_method_prefixes):
+        return True
+    for dec in func.decorator_list:
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        name = dotted(target) or ""
+        if name.rsplit(".", 1)[-1] in config.boundary_decorators:
+            return True
+    if module.suppressed(func, "boundary"):
+        return True
+    return False
+
+
+def summarize(module: ModuleInfo, config: AnalysisConfig) -> ErModuleSummary:
+    ns = lock_order._Namespace(module)
+    summary = ErModuleSummary(path=module.path)
+    for qualname, func in walk_scopes(module.tree):
+        cls = lock_order._enclosing_class(qualname, ns)
+        ctx = lock_order._FnContext(ns, cls, func)
+        fn = ErFunction(path=module.path, qualname=qualname)
+        fn.is_boundary = _is_boundary(module, config, ns, qualname, func,
+                                      cls)
+        # Type facts first (order-insensitive), then calls + raises.
+        for node in walk_function_nodes(func):
+            if isinstance(node, ast.Assign):
+                ctx.note_assign(node)
+        for node in walk_function_nodes(func):
+            if isinstance(node, ast.Call):
+                spec = ctx.resolve_callee(node)
+                if spec is not None:
+                    fn.calls.append(spec)
+            elif isinstance(node, ast.Raise):
+                exc_type = _exc_type_name(node.exc)
+                if exc_type in _INTERNAL_BUILTINS and \
+                        not module.suppressed(node, "internal-ok", node):
+                    fn.raises.append((exc_type, node.lineno))
+        if fn.raises or fn.calls or fn.is_boundary:
+            summary.functions.append(fn)
+        summary.local_findings.extend(
+            _check_laundering(module, qualname, func))
+        summary.local_findings.extend(
+            _check_retry_scopes(module, config, qualname, func))
+        if config.is_hot(module.path):
+            summary.local_findings.extend(
+                _check_silent_fallbacks(module, qualname, func))
+    return summary
+
+
+# -- ER002: status laundering ------------------------------------------------
+
+
+def _handler_type_leaves(handler: ast.ExceptHandler) -> set[str]:
+    t = handler.type
+    if t is None:
+        return {"<bare>"}
+    elts = t.elts if isinstance(t, ast.Tuple) else [t]
+    return {(dotted(e) or "").rsplit(".", 1)[-1] for e in elts}
+
+
+def _own_body_nodes(handler: ast.ExceptHandler):
+    """Nodes in the handler's own body, not descending into nested
+    defs (which run later, on their own terms)."""
+    stack = list(handler.body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _check_laundering(module: ModuleInfo, qualname: str,
+                      func) -> list[Finding]:
+    findings: list[Finding] = []
+    for node in walk_function_nodes(func):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if "ServingError" not in _handler_type_leaves(node):
+            continue
+        if module.suppressed(node, "status-ok", node):
+            continue
+        raises = [n for n in _own_body_nodes(node)
+                  if isinstance(n, ast.Raise)]
+        retyped = None
+        for r in raises:
+            exc_type = _exc_type_name(r.exc)
+            if r.exc is not None and isinstance(r.exc, ast.Name) and \
+                    r.exc.id == node.name:
+                continue  # re-raising the bound error: fine
+            if exc_type and exc_type != "ServingError":
+                retyped = (r, exc_type)
+                break
+        if retyped is not None:
+            r, exc_type = retyped
+            if module.suppressed(r, "status-ok", r):
+                continue
+            findings.append(Finding(
+                path=module.path, line=r.lineno, rule=RULE, code="ER002",
+                message=f"status laundering: typed ServingError re-raised "
+                        f"as {exc_type} — the client's status code is "
+                        "destroyed",
+                hint="re-raise the ServingError (or a ServingError factory "
+                     "carrying the right code), or `# servelint: "
+                     "status-ok <why>`",
+                scope=qualname, detail=f"retype:{exc_type}"))
+            continue
+        uses_bound = node.name is not None and any(
+            isinstance(n, ast.Name) and n.id == node.name
+            for n in _own_body_nodes(node))
+        if not raises and not uses_bound:
+            findings.append(Finding(
+                path=module.path, line=node.lineno, rule=RULE, code="ER002",
+                message="status laundering: typed ServingError swallowed "
+                        "without raising or even reading it — the caller "
+                        "sees success (or a made-up status)",
+                hint="re-raise, convert via the bound error's code, or "
+                     "`# servelint: status-ok <why>`",
+                scope=qualname, detail="swallow:ServingError"))
+    return findings
+
+
+# -- ER003: retry scopes -----------------------------------------------------
+
+
+def _mentions(nodes, token: str) -> ast.AST | None:
+    for n in nodes:
+        if isinstance(n, ast.Attribute) and n.attr == token:
+            return n
+        if isinstance(n, ast.Name) and n.id == token:
+            return n
+        if isinstance(n, ast.Constant) and n.value == token:
+            return n
+    return None
+
+
+def _deadline_gates_continue(handler_body) -> ast.AST | None:
+    """The DEADLINE_EXCEEDED reference, iff it sits in the TEST of an
+    `if` whose guarded branch reaches a `continue` — i.e. the deadline
+    is part of the retry DECISION. A mention in bookkeeping after the
+    retry was declined (`unreachable = code in (..., DEADLINE_EXCEEDED)`)
+    is classification, not retry policy, and must not fire."""
+    for n in handler_body:
+        if not isinstance(n, ast.If):
+            continue
+        hit = _mentions(ast.walk(n.test), "DEADLINE_EXCEEDED")
+        if hit is None:
+            continue
+        branch_continues = any(
+            isinstance(sub, ast.Continue)
+            for stmt in n.body for sub in ast.walk(stmt))
+        if branch_continues:
+            return hit
+    return None
+
+
+def _check_retry_scopes(module: ModuleInfo, config: AnalysisConfig,
+                        qualname: str, func) -> list[Finding]:
+    if module.path == config.retry_home:
+        return []
+    calls_predicate = any(
+        isinstance(n, ast.Call) and
+        (dotted(n.func) or "").rsplit(".", 1)[-1] in config.retry_predicates
+        for n in walk_function_nodes(func))
+    findings: list[Finding] = []
+    for loop in walk_function_nodes(func):
+        if not isinstance(loop, (ast.While, ast.For)):
+            continue
+        # `continue` in a while (or for-over-range attempt counter)
+        # re-runs the SAME operation — a retry. `continue` in a for over
+        # items merely skips to the next item; that is degradation
+        # policy, not retry policy, and ER004 owns its silent cases.
+        is_retry_loop = isinstance(loop, ast.While) or (
+            isinstance(loop.iter, ast.Call) and
+            (dotted(loop.iter.func) or "").rsplit(".", 1)[-1] == "range")
+        for node in ast.walk(loop):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            body = list(_own_body_nodes(node))
+            if not any(isinstance(n, ast.Continue) for n in body):
+                continue  # not a retry scope
+            if module.suppressed(node, "retry-ok", node):
+                continue
+            # A timed-out request may have executed — re-sending it
+            # (same backend or a failover target) double-applies, so
+            # the deadline arm covers item-loops too.
+            deadline = _deadline_gates_continue(body)
+            if deadline is not None:
+                findings.append(Finding(
+                    path=module.path, line=deadline.lineno, rule=RULE,
+                    code="ER003",
+                    message="retry scope admits DEADLINE_EXCEEDED — the "
+                            "request may have executed; re-sending "
+                            "double-applies it",
+                    hint="only connection-level UNAVAILABLE is provably "
+                         "undelivered; drop the deadline branch or "
+                         "`# servelint: retry-ok <why>`",
+                    scope=qualname, detail="retry-deadline"))
+            if is_retry_loop and not calls_predicate:
+                findings.append(Finding(
+                    path=module.path, line=node.lineno, rule=RULE,
+                    code="ER003",
+                    message="inline retry decision (loop + except + "
+                            "continue) not routed through the shared "
+                            "robustness/retry.py predicates — retry "
+                            "discipline drifts per call site",
+                    hint="gate the retry on next_forward_retry_delay_s/"
+                         "retry_safe_predict, or `# servelint: retry-ok "
+                         "<why>`",
+                    scope=qualname, detail="inline-retry"))
+    return findings
+
+
+# -- ER004: silent hot-path fallbacks ----------------------------------------
+
+
+def _records_something(body_nodes) -> bool:
+    for n in body_nodes:
+        if isinstance(n, ast.Call):
+            name = (dotted(n.func) or "").lower()
+            if any(tok in name for tok in _RECORDING_TOKENS):
+                return True
+    return False
+
+
+def _check_silent_fallbacks(module: ModuleInfo, qualname: str,
+                            func) -> list[Finding]:
+    findings: list[Finding] = []
+    telemetry_guarded = set()
+    for t in walk_function_nodes(func):
+        if not isinstance(t, ast.Try):
+            continue
+        # The try body IS the recording attempt (a metrics/flight-
+        # recorder/log call): its except-pass is a telemetry guard —
+        # the failure mode is "telemetry lost", not "serving degraded
+        # silently" — and it could not record its own failure through
+        # the very channel that just broke.
+        body_nodes = [n for stmt in t.body for n in ast.walk(stmt)]
+        if _records_something(body_nodes):
+            telemetry_guarded.update(id(h) for h in t.handlers)
+    for node in walk_function_nodes(func):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if id(node) in telemetry_guarded:
+            continue
+        leaves = _handler_type_leaves(node)
+        if not (leaves & {"Exception", "BaseException", "<bare>"}):
+            continue
+        body = list(_own_body_nodes(node))
+        if any(isinstance(n, ast.Raise) for n in body):
+            continue
+        if _records_something(body):
+            continue
+        # `except Exception as exc: task.error = exc` is DELIVERY, not
+        # swallowing — any read of the bound error means it propagates
+        # somewhere (waiters, a result latch, a re-wrap).
+        if node.name is not None and any(
+                isinstance(n, ast.Name) and n.id == node.name
+                for n in body):
+            continue
+        if module.suppressed(node, "fallback-ok", node):
+            continue
+        findings.append(Finding(
+            path=module.path, line=node.lineno, rule=RULE, code="ER004",
+            message="hot-path `except Exception` fallback records "
+                    "nothing — degradation here is silent (no flight "
+                    "recorder, metric, or log)",
+            hint="record the failure (flight_recorder/metrics/log) or "
+                 "`# servelint: fallback-ok <why>`",
+            scope=qualname, detail="silent-fallback"))
+    return findings
+
+
+# -- link + ER001 ------------------------------------------------------------
+
+
+def _resolve(spec, functions: dict, caller_path: str):
+    tag = spec[0]
+    if tag == "self":
+        key = (caller_path, f"{spec[1]}.{spec[2]}")
+    elif tag == "fn":
+        key = (spec[1], spec[2])
+    elif tag == "method":
+        key = (spec[1], f"{spec[2]}.{spec[3]}")
+    elif tag == "ctor":
+        key = (spec[1], f"{spec[2]}.__init__")
+    else:
+        return None
+    return key if key in functions else None
+
+
+def boundary_reachable(summaries: list[ErModuleSummary]) -> dict:
+    """{fn_key: boundary_qualname} for every function reachable from the
+    boundary set along resolved call edges (boundaries included)."""
+    functions = {fn.key: fn for s in summaries for fn in s.functions}
+    reached: dict = {}
+    frontier = []
+    for key, fn in sorted(functions.items()):
+        if fn.is_boundary:
+            reached[key] = fn.qualname
+            frontier.append(key)
+    while frontier:
+        key = frontier.pop()
+        fn = functions[key]
+        via = reached[key]
+        for spec in fn.calls:
+            callee = _resolve(spec, functions, fn.path)
+            if callee is not None and callee not in reached:
+                reached[callee] = via
+                frontier.append(callee)
+    return reached
+
+
+def check_package(summaries: list[ErModuleSummary],
+                  config: AnalysisConfig) -> list[Finding]:
+    findings: list[Finding] = []
+    for s in summaries:
+        findings.extend(s.local_findings)
+    functions = {fn.key: fn for s in summaries for fn in s.functions}
+    reached = boundary_reachable(summaries)
+    for key in sorted(reached):
+        fn = functions[key]
+        for exc_type, line in fn.raises:
+            findings.append(Finding(
+                path=fn.path, line=line, rule=RULE, code="ER001",
+                message=f"raise {exc_type} is reachable from handler "
+                        f"boundary '{reached[key]}' — the client sees an "
+                        "anonymous INTERNAL "
+                        "(utils/status.error_from_exception)",
+                hint="raise a typed ServingError with the honest "
+                     "canonical code, or `# servelint: internal-ok <why>` "
+                     "if INTERNAL is the truth",
+                scope=fn.qualname, detail=f"raise:{exc_type}"))
+    return findings

@@ -46,6 +46,13 @@ from min_tfs_client_tpu.analysis.core import (
 
 RULE = "host-sync"
 
+CODES = {
+    "HS001": "explicit device->host coercion on a tainted hot-path value",
+    "HS002": ".block_until_ready() in a hot-path module",
+    "HS003": "implicit bool on a tainted value (if/while/assert)",
+    "HS004": "f-string formatting a tainted value",
+}
+
 # Coercion sinks. Builtins take the value as first positional arg;
 # np-style functions likewise; methods coerce their receiver.
 _COERCION_BUILTINS = {"float", "int", "bool"}

@@ -54,6 +54,12 @@ from min_tfs_client_tpu.analysis.core import (
 RULE = "lock-order"
 PACKAGE_PASS = True
 
+CODES = {
+    "DL001": "cycle in the interprocedural lock-acquisition graph",
+    "DL002": "two locks acquired in both orders (AB/BA inversion)",
+    "DL003": "unbounded blocking call that can park a thread forever",
+}
+
 _LOCK_FACTORIES = {
     "threading.Lock": "lock",
     "threading.RLock": "rlock",

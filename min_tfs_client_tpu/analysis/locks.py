@@ -45,6 +45,13 @@ from min_tfs_client_tpu.analysis.core import (
 
 RULE = "locks"
 
+CODES = {
+    "LK001": "unguarded read of a guarded attribute",
+    "LK002": "unguarded write of a guarded attribute",
+    "LK003": "guarded_by names a lock never acquired in the module",
+    "LK004": "pinned `# guarded_by:` declaration removed",
+}
+
 _EXEMPT_METHODS = {"__init__", "__post_init__", "__del__", "__enter__"}
 
 

@@ -36,6 +36,16 @@ from min_tfs_client_tpu.analysis.core import (
 
 RULE = "recompile"
 
+CODES = {
+    "RC001": "jax.jit constructed and invoked in one expression",
+    "RC002": "jax.jit inside a loop without caching",
+    "RC003": "unhashable literal in a static argument position",
+    "RC004": "static argument derived from a per-request parameter",
+    "RC005": "Python control flow on a tracer inside a jitted function",
+    "RC006": "shape-derived Python control flow inside a jitted function",
+    "RC007": "f-string/str() on a tracer inside a jitted function",
+}
+
 
 def check(module: ModuleInfo, config: AnalysisConfig) -> list[Finding]:
     findings: list[Finding] = []

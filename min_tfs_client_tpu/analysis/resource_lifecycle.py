@@ -1,0 +1,564 @@
+"""RL: resource-lifecycle — every acquire reaches a release, every path.
+
+The leak history is concrete: stop/start cycles accumulating orphaned
+keep-alive sockets (PR 12), slot reuse inheriting stale `_page_ticks`
+(PR 11), StepDeduper entries outliving their sessions (PR 13). With
+live KV-session migration and copy-on-write prefix pages next on the
+roadmap — both ownership-transfer programs — leaks become machine-
+checked now, before that code is written.
+
+Acquisition sites are recognized by method name (`acquire_slot`,
+`alloc`/`try_alloc`, `_checkout`); releases by their duals
+(`release_slot`, `free`, `_checkin`). Classes DECLARE long-lived
+ownership: `self._pages = ...  # servelint: owns pages` — and their
+teardown methods (stop/close/unload/shutdown/__exit__) must then
+release every owned attr. Sanctioned handoff is explicit:
+`# servelint: transfers <Receiver|caller>`.
+
+  RL001  a locally-acquired handle that can leak: never released at
+         all, or released only on the straight-line path with calls/
+         raises between acquire and release (the exception edge leaks).
+         Sanction with `# servelint: leak-ok <why>`.
+  RL002  incomplete teardown: a class declares `owns <kind>` but its
+         teardown closure never releases that attr (or the class has
+         no teardown method at all).
+  RL003  double-release: the same handle released on two non-exclusive
+         paths (plain+plain, plain+finally). except+plain is the legal
+         cleanup shape and does not fire.
+  RL004  undeclared transfer: an acquisition stored onto an attr with
+         no matching `owns` declaration, returned without a
+         `transfers` mark, or transferred to a receiver that does not
+         declare ownership of that kind anywhere in the package.
+  RL005  a pinned `owns` declaration (baseline required_guards) was
+         removed — the LK004 ratchet, for ownership.
+
+Package pass (`PACKAGE_PASS = True`): RL004 receiver validation needs
+the package-wide owns inventory; everything else is function/class
+local and rides in the per-module summaries.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+from min_tfs_client_tpu.analysis.core import (
+    AnalysisConfig,
+    Finding,
+    ModuleInfo,
+    dotted,
+    walk_function_nodes,
+    walk_scopes,
+)
+
+RULE = "resource-lifecycle"
+PACKAGE_PASS = True
+
+CODES = {
+    "RL001": "acquired handle leaks (no release, or exception path)",
+    "RL002": "teardown does not release a declared-owned resource",
+    "RL003": "double-release of the same handle",
+    "RL004": "ownership transfer to an undeclared receiver",
+    "RL005": "pinned `# servelint: owns` declaration removed",
+}
+
+# method name -> resource kind, at acquisition and release sites.
+_ACQUIRE_KINDS = {
+    "acquire_slot": "slot",
+    "alloc": "pages",
+    "try_alloc": "pages",
+    "_checkout": "conn",
+}
+_RELEASE_KINDS = {
+    "release_slot": "slot",
+    "free": "pages",
+    "_checkin": "conn",
+}
+
+_TEARDOWN_METHODS = ("stop", "close", "unload", "shutdown", "__exit__")
+
+# A call with one of these leaf names, on a statement referencing the
+# owned attr, counts as releasing it in teardown.
+_TEARDOWN_RELEASES = frozenset({
+    "close", "stop", "shutdown", "unload", "release", "free", "join",
+    "clear", "drain", "terminate", "cancel", "disconnect", "evict_idle",
+    "drop_backend", "release_all", "close_all", "forget", "reset",
+    "release_slot", "uninstall", "abandon",
+})
+
+
+# -- picklable per-module summaries ------------------------------------------
+
+
+@dataclass
+class OwnsDecl:
+    path: str
+    cls: str
+    attr: str
+    kind: str
+    line: int
+
+    @property
+    def guard_id(self) -> str:
+        return f"{self.path}::{self.cls}.{self.attr}::owns:{self.kind}"
+
+
+@dataclass
+class RlModuleSummary:
+    path: str
+    owns: list = field(default_factory=list)        # [OwnsDecl]
+    # transfers awaiting package-wide receiver validation:
+    # (line, scope, receiver, kind)
+    transfers: list = field(default_factory=list)
+    local_findings: list = field(default_factory=list)
+
+
+# -- owns declarations -------------------------------------------------------
+
+
+def _walk_classes(tree: ast.Module):
+    stack = [(n, "") for n in tree.body]
+    while stack:
+        node, prefix = stack.pop()
+        if isinstance(node, ast.ClassDef):
+            qual = f"{prefix}.{node.name}" if prefix else node.name
+            yield qual, node
+            stack.extend((child, qual) for child in node.body)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+
+
+def collect_owns(module: ModuleInfo) -> list:
+    """[OwnsDecl] for every `self._attr = ...  # servelint: owns <kind>`
+    in a class body (any method)."""
+    decls = []
+    for cls_qual, classdef in _walk_classes(module.tree):
+        for node in ast.walk(classdef):
+            if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                continue
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            kind = module.stmt_mark_arg(node, "owns")
+            if not kind:
+                continue
+            for target in targets:
+                if isinstance(target, ast.Attribute) and \
+                        isinstance(target.value, ast.Name) and \
+                        target.value.id == "self":
+                    decls.append(OwnsDecl(
+                        path=module.path, cls=cls_qual, attr=target.attr,
+                        kind=kind, line=node.lineno))
+    return decls
+
+
+def missing_owns_findings(required: set, declared: set) -> list:
+    """RL005 for every pinned owns id no longer declared."""
+    findings = []
+    for guard_id in sorted(required - declared):
+        path, _, rest = guard_id.partition("::")
+        member, _, kind = rest.partition("::owns:")
+        findings.append(Finding(
+            path=path, line=0, rule=RULE, code="RL005",
+            message=f"pinned ownership declaration removed: {member} was "
+                    f"declared `# servelint: owns {kind}` in the baseline "
+                    "but the annotation is gone",
+            hint="restore the `# servelint: owns` comment, or regenerate "
+                 "the baseline if the resource genuinely moved",
+            scope=member, detail=f"owns:{kind}"))
+    return findings
+
+
+# -- per-function handle tracking (RL001/RL003/RL004) ------------------------
+
+
+@dataclass
+class _Handle:
+    name: str
+    kind: str
+    line: int
+    stmt: ast.stmt
+    releases: list = field(default_factory=list)   # [(position, node)]
+    escaped: bool = False       # returned/stored/transferred — caller's job
+    with_scoped: bool = False   # acquired as a `with` ctx — always safe
+
+
+def _stmt_spans(func) -> list:
+    """Top-to-bottom statement list of the function body (own scope)."""
+    out = []
+    stack = list(func.body)
+    while stack:
+        node = stack.pop(0)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            continue
+        out.append(node)
+        for fld in ("body", "orelse", "finalbody"):
+            stack.extend(getattr(node, fld, []))
+        for h in getattr(node, "handlers", []):
+            stack.extend(h.body)
+    return out
+
+
+def _acquire_call(node: ast.expr):
+    """(kind, call) if node is a recognized acquisition call."""
+    if isinstance(node, ast.Call):
+        leaf = (dotted(node.func) or "").rsplit(".", 1)[-1]
+        if leaf in _ACQUIRE_KINDS:
+            return _ACQUIRE_KINDS[leaf], node
+    return None
+
+
+def _position_of(node: ast.AST, func) -> str:
+    """'finally' / 'except' / 'plain' for the deepest Try region holding
+    `node` within `func`'s own statements."""
+    best = "plain"
+
+    def visit(n, pos):
+        nonlocal best
+        if n is node:
+            best = pos
+            return True
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.ClassDef)) and n is not func:
+            return False
+        if isinstance(n, ast.Try):
+            for child in n.body + n.orelse:
+                if visit(child, pos):
+                    return True
+            for h in n.handlers:
+                for child in h.body:
+                    if visit(child, "except"):
+                        return True
+            for child in n.finalbody:
+                if visit(child, "finally"):
+                    return True
+            return False
+        for child in ast.iter_child_nodes(n):
+            if visit(child, pos):
+                return True
+        return False
+
+    visit(func, "plain")
+    return best
+
+
+def _protected(handle: _Handle, func) -> bool:
+    """True when every release is on a path that also covers the
+    exception edge: a `finally` release, or an `except`+plain pair."""
+    positions = [p for p, _ in handle.releases]
+    if "finally" in positions:
+        return True
+    return "except" in positions and "plain" in positions
+
+
+def _risky_between(func, start_line: int, end_line: int) -> bool:
+    """A call or raise strictly between acquire and release lines —
+    i.e. the exception edge between them is live."""
+    for node in walk_function_nodes(func):
+        if isinstance(node, (ast.Call, ast.Raise)) and \
+                start_line < node.lineno < end_line:
+            return True
+    return False
+
+
+def _check_functions(module: ModuleInfo, config: AnalysisConfig,
+                     owns_by_class: dict) -> tuple:
+    """(findings, transfers) across every function in the module."""
+    findings: list = []
+    transfers: list = []
+    for qualname, func in walk_scopes(module.tree):
+        handles: dict[str, _Handle] = {}
+        cls_qual = qualname.rsplit(".", 1)[0] if "." in qualname else None
+        # -- collect acquisitions ---------------------------------------
+        for node in walk_function_nodes(func):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                acq = _acquire_call(node.value)
+                target = node.targets[0]
+                if acq and isinstance(target, ast.Name):
+                    handles[target.id] = _Handle(
+                        name=target.id, kind=acq[0],
+                        line=node.lineno, stmt=node)
+                elif acq and isinstance(target, ast.Attribute) and \
+                        isinstance(target.value, ast.Name) and \
+                        target.value.id == "self":
+                    # Stored straight onto self: must be a declared own.
+                    declared = owns_by_class.get(cls_qual, {})
+                    if target.attr not in declared or \
+                            declared[target.attr] != acq[0]:
+                        if not module.suppressed(node, "leak-ok", node) \
+                                and not module.stmt_mark_arg(
+                                    node, "transfers"):
+                            findings.append(Finding(
+                                path=module.path, line=node.lineno,
+                                rule=RULE, code="RL004",
+                                message=f"acquired {acq[0]} stored onto "
+                                        f"self.{target.attr} which does "
+                                        "not declare ownership of that "
+                                        "kind",
+                                hint="annotate the attr's init assignment "
+                                     f"`# servelint: owns {acq[0]}` (and "
+                                     "release it in teardown), or mark "
+                                     "the handoff `# servelint: "
+                                     "transfers <receiver>`",
+                                scope=qualname,
+                                detail=f"store:{target.attr}"))
+            elif isinstance(node, ast.withitem):
+                acq = _acquire_call(node.context_expr)
+                if acq and isinstance(node.optional_vars, ast.Name):
+                    h = _Handle(name=node.optional_vars.id, kind=acq[0],
+                                line=node.context_expr.lineno,
+                                stmt=func.body[0], with_scoped=True)
+                    handles[h.name] = h
+        if not handles:
+            continue
+        # -- releases / escapes -----------------------------------------
+        for node in walk_function_nodes(func):
+            if isinstance(node, ast.Call):
+                name = dotted(node.func) or ""
+                leaf = name.rsplit(".", 1)[-1]
+                root = name.split(".")[0]
+                if leaf in _RELEASE_KINDS:
+                    kind = _RELEASE_KINDS[leaf]
+                    # recv.release(h) form
+                    for arg in node.args[:1]:
+                        if isinstance(arg, ast.Name) and \
+                                arg.id in handles and \
+                                handles[arg.id].kind == kind:
+                            handles[arg.id].releases.append(
+                                (_position_of(node, func), node))
+                    # h.release() form
+                    if root in handles and handles[root].kind == kind:
+                        handles[root].releases.append(
+                            (_position_of(node, func), node))
+                else:
+                    # Handle passed into any other call: conservatively
+                    # an escape (ownership moved into the callee).
+                    for arg in list(node.args) + \
+                            [kw.value for kw in node.keywords]:
+                        if isinstance(arg, ast.Name) and arg.id in handles:
+                            handles[arg.id].escaped = True
+            elif isinstance(node, ast.Return) and node.value is not None \
+                    and any(isinstance(n, ast.Name) and n.id in handles
+                            for n in ast.walk(node.value)):
+                name = next(n.id for n in ast.walk(node.value)
+                            if isinstance(n, ast.Name) and n.id in handles)
+                h = handles[name]
+                receiver = module.stmt_mark_arg(node, "transfers")
+                if receiver:
+                    h.escaped = True
+                    transfers.append((node.lineno, qualname, receiver,
+                                      h.kind))
+                elif module.suppressed(node, "leak-ok", node):
+                    h.escaped = True
+                else:
+                    findings.append(Finding(
+                        path=module.path, line=node.lineno, rule=RULE,
+                        code="RL004",
+                        message=f"acquired {h.kind} handle returned "
+                                "without a `# servelint: transfers` "
+                                "mark — ownership leaves this function "
+                                "undeclared",
+                        hint="mark the return `# servelint: transfers "
+                             "<Receiver|caller>`",
+                        scope=qualname, detail=f"handoff:{h.kind}"))
+                    h.escaped = True
+            elif isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Name) and \
+                    node.value.id in handles:
+                for target in node.targets:
+                    if isinstance(target, ast.Attribute):
+                        handles[node.value.id].escaped = True
+        # -- verdicts ---------------------------------------------------
+        for h in handles.values():
+            if h.with_scoped or h.escaped:
+                continue
+            if module.suppressed(h.stmt, "leak-ok", h.stmt):
+                continue
+            if not h.releases:
+                findings.append(Finding(
+                    path=module.path, line=h.line, rule=RULE, code="RL001",
+                    message=f"{h.kind} acquired here is never released "
+                            "on any path",
+                    hint="release in a finally, use a with-scope, or "
+                         "`# servelint: leak-ok <why>`",
+                    scope=qualname, detail=f"never-released:{h.kind}"))
+                continue
+            nonexclusive = sorted(
+                (r for r in h.releases if r[0] in ("plain", "finally")),
+                key=lambda r: r[1].lineno)
+            if len(nonexclusive) >= 2:
+                _, second = nonexclusive[1]
+                findings.append(Finding(
+                    path=module.path, line=second.lineno, rule=RULE,
+                    code="RL003",
+                    message=f"double-release: this {h.kind} handle is "
+                            "already released on a path that also "
+                            "reaches here",
+                    hint="release exactly once (finally), or make the "
+                         "paths exclusive (except+plain)",
+                    scope=qualname, detail=f"double-release:{h.kind}"))
+            if not _protected(h, func):
+                first_release = min(n.lineno for _, n in h.releases)
+                if _risky_between(func, h.line, first_release):
+                    findings.append(Finding(
+                        path=module.path, line=h.line, rule=RULE,
+                        code="RL001",
+                        message=f"{h.kind} leaks on the exception path: "
+                                "calls between acquire and release can "
+                                "raise past the unprotected release",
+                        hint="move the release into a finally (or "
+                             "with-scope), or `# servelint: leak-ok "
+                             "<why>`",
+                        scope=qualname,
+                        detail=f"exception-path:{h.kind}"))
+    return findings, transfers
+
+
+# -- RL002: teardown completeness --------------------------------------------
+
+
+def _class_functions(classdef: ast.ClassDef) -> dict:
+    return {n.name: n for n in classdef.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+
+
+def _teardown_closure(methods: dict) -> list:
+    """Teardown roots plus every self-method they transitively call."""
+    seen: set = set()
+    stack = [m for m in _TEARDOWN_METHODS if m in methods]
+    while stack:
+        name = stack.pop()
+        if name in seen:
+            continue
+        seen.add(name)
+        for node in ast.walk(methods[name]):
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    isinstance(node.func.value, ast.Name) and \
+                    node.func.value.id == "self" and \
+                    node.func.attr in methods:
+                stack.append(node.func.attr)
+    return [methods[n] for n in seen]
+
+
+def _releases_attr(fn, attr: str) -> bool:
+    """A statement in `fn` that references self.<attr> and either calls
+    a teardown-release-named method or clears the attr (del / = None)."""
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            name = dotted(node.func) or ""
+            parts = name.split(".")
+            leaf = parts[-1]
+            if leaf in _TEARDOWN_RELEASES and "self" in parts and \
+                    attr in parts:
+                return True
+            # recv.release(self._attr) — owned thing passed to a release
+            if leaf in _TEARDOWN_RELEASES:
+                for arg in list(node.args) + \
+                        [kw.value for kw in node.keywords]:
+                    if isinstance(arg, ast.Attribute) and \
+                            arg.attr == attr and \
+                            isinstance(arg.value, ast.Name) and \
+                            arg.value.id == "self":
+                        return True
+        elif isinstance(node, ast.Delete):
+            for t in node.targets:
+                if isinstance(t, ast.Attribute) and t.attr == attr:
+                    return True
+        elif isinstance(node, ast.Assign):
+            # ANY store to self.<attr> inside teardown counts: direct
+            # reset (`self._x = None` / `= {}`) or the swap-and-close
+            # idiom (`x, self._x = self._x, {}` ... `x.close()`).
+            targets = []
+            for t in node.targets:
+                targets.extend(t.elts if isinstance(t, (ast.Tuple,
+                                                        ast.List)) else [t])
+            for t in targets:
+                if isinstance(t, ast.Attribute) and t.attr == attr and \
+                        isinstance(t.value, ast.Name) and \
+                        t.value.id == "self":
+                    return True
+    return False
+
+
+def _check_teardown(module: ModuleInfo, owns: list) -> list:
+    findings = []
+    by_class: dict[str, list] = {}
+    for decl in owns:
+        by_class.setdefault(decl.cls, []).append(decl)
+    classes = dict(_walk_classes(module.tree))
+    for cls_qual, decls in by_class.items():
+        classdef = classes.get(cls_qual)
+        if classdef is None:
+            continue
+        methods = _class_functions(classdef)
+        closure = _teardown_closure(methods)
+        for decl in decls:
+            if module.suppressed(classdef, "leak-ok") or \
+                    module.mark_arg(decl.line, "transfers"):
+                continue
+            if not closure:
+                findings.append(Finding(
+                    path=module.path, line=decl.line, rule=RULE,
+                    code="RL002",
+                    message=f"{cls_qual} declares `owns {decl.kind}` "
+                            f"({decl.attr}) but has no teardown method "
+                            "(stop/close/unload/shutdown) at all",
+                    hint="add a teardown that releases the owned "
+                         "resource",
+                    scope=f"{cls_qual}.{decl.attr}",
+                    detail=f"teardown:{decl.attr}"))
+                continue
+            if not any(_releases_attr(fn, decl.attr) for fn in closure):
+                findings.append(Finding(
+                    path=module.path, line=decl.line, rule=RULE,
+                    code="RL002",
+                    message=f"incomplete teardown: {cls_qual} owns "
+                            f"{decl.kind} via self.{decl.attr} but no "
+                            "teardown method releases it",
+                    hint="release/close/clear the attr in stop()/close() "
+                         "(or a helper they call)",
+                    scope=f"{cls_qual}.{decl.attr}",
+                    detail=f"teardown:{decl.attr}"))
+    return findings
+
+
+# -- package pass ------------------------------------------------------------
+
+
+def summarize(module: ModuleInfo, config: AnalysisConfig) -> RlModuleSummary:
+    summary = RlModuleSummary(path=module.path)
+    summary.owns = collect_owns(module)
+    owns_by_class: dict[str, dict] = {}
+    for decl in summary.owns:
+        owns_by_class.setdefault(decl.cls, {})[decl.attr] = decl.kind
+    findings, transfers = _check_functions(module, config, owns_by_class)
+    summary.local_findings = findings
+    summary.local_findings.extend(_check_teardown(module, summary.owns))
+    summary.transfers = transfers
+    return summary
+
+
+def check_package(summaries: list, config: AnalysisConfig) -> list:
+    findings: list = []
+    owned_kinds_by_class: dict[str, set] = {}
+    for s in summaries:
+        findings.extend(s.local_findings)
+        for decl in s.owns:
+            leaf = decl.cls.rsplit(".", 1)[-1]
+            owned_kinds_by_class.setdefault(leaf, set()).add(decl.kind)
+    for s in summaries:
+        for line, scope, receiver, kind in s.transfers:
+            if receiver == "caller":
+                continue
+            if kind in owned_kinds_by_class.get(receiver, set()):
+                continue
+            findings.append(Finding(
+                path=s.path, line=line, rule=RULE, code="RL004",
+                message=f"transfer of {kind} to '{receiver}', but no "
+                        f"class named {receiver} declares `# servelint: "
+                        f"owns {kind}` anywhere in the package",
+                hint="declare ownership on the receiver (and release in "
+                     "its teardown), or transfer to `caller`",
+                scope=scope, detail=f"transfer:{receiver}:{kind}"))
+    return findings

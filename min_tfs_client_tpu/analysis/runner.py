@@ -5,7 +5,8 @@ Two rule shapes:
   * per-file rules expose `check(module, config) -> [Finding]` and can
     scan files independently — `--jobs N` fans them out over a process
     pool (the repo gate is tier-1's slowest test; parsing dominates);
-  * package passes (`PACKAGE_PASS = True`, currently lock-order) expose
+  * package passes (`PACKAGE_PASS = True`: lock-order, error-flow,
+    resource-lifecycle) expose
     `summarize(module, config) -> summary` (picklable, computed per file
     in the same fan-out) and `check_package(summaries, config)`, which
     links summaries across the whole scanned set — the interprocedural
@@ -22,10 +23,12 @@ from concurrent.futures import ProcessPoolExecutor
 from dataclasses import dataclass, field
 
 from min_tfs_client_tpu.analysis import (
+    error_flow,
     host_sync,
     lock_order,
     locks,
     recompile,
+    resource_lifecycle,
     spans,
     threads,
 )
@@ -40,7 +43,8 @@ from min_tfs_client_tpu.analysis.core import (
     parse_module,
 )
 
-ALL_RULES = (host_sync, recompile, locks, spans, threads, lock_order)
+ALL_RULES = (host_sync, recompile, locks, spans, threads, lock_order,
+             error_flow, resource_lifecycle)
 
 
 @dataclass
@@ -131,6 +135,7 @@ def _scan_file(abspath: str, relpath: str, config: AnalysisConfig,
     for rule in per_file:
         findings.extend(rule.check(module, config))
     guards = locks.collect_declared_guards(module)
+    guards |= {d.guard_id for d in resource_lifecycle.collect_owns(module)}
     summaries = {rule.__name__: rule.summarize(module, config)
                  for rule in package}
     return relpath, findings, guards, summaries
@@ -146,12 +151,21 @@ def _scan_worker(abspath: str, relpath: str, config: AnalysisConfig,
 def analyze_paths(paths: list[str],
                   config: AnalysisConfig | None = None,
                   rules=ALL_RULES,
-                  jobs: int = 1) -> Report:
+                  jobs: int = 1,
+                  only_paths: set | None = None) -> Report:
+    """`only_paths` is incremental (--since) mode: per-file rules run
+    only on those relpaths, but every file is still parsed and
+    summarized so the package passes (DL/ER/RL links) see the FULL
+    package — an interprocedural finding doesn't care which side of the
+    diff its edge endpoints sit on."""
     config = config or AnalysisConfig()
     per_file, package = _split_rules(rules)
     report = Report()
     files = list(iter_py_files(paths))
     results = []
+    def _wants_per_file(rel: str) -> bool:
+        return only_paths is None or rel in only_paths
+
     if jobs and jobs > 1 and len(files) > 1:
         per_file_names = tuple(r.__name__ for r in per_file)
         package_names = tuple(r.__name__ for r in package)
@@ -162,12 +176,16 @@ def analyze_paths(paths: list[str],
         ctx = multiprocessing.get_context("spawn")
         with ProcessPoolExecutor(max_workers=min(jobs, len(files)),
                                  mp_context=ctx) as pool:
-            futures = [pool.submit(_scan_worker, ab, rel, config,
-                                   per_file_names, package_names)
-                       for ab, rel in files]
+            futures = [pool.submit(
+                _scan_worker, ab, rel, config,
+                per_file_names if _wants_per_file(rel) else (),
+                package_names)
+                for ab, rel in files]
             results = [f.result() for f in futures]
     else:
-        results = [_scan_file(ab, rel, config, per_file, package)
+        results = [_scan_file(ab, rel, config,
+                              per_file if _wants_per_file(rel) else [],
+                              package)
                    for ab, rel in files]
     summaries_by_rule: dict[str, list] = {r.__name__: [] for r in package}
     for res in results:
@@ -191,25 +209,52 @@ def run_analysis(paths: list[str],
                  baseline_path: str | None = None,
                  config: AnalysisConfig | None = None,
                  rules=ALL_RULES,
-                 jobs: int = 1) -> Report:
+                 jobs: int = 1,
+                 only_paths: set | None = None) -> Report:
     """Analyze `paths`, diff against the baseline, return the Report.
     `report.clean` is the gate predicate: no new findings, no stale
     baseline entries."""
-    report = analyze_paths(paths, config=config, rules=rules, jobs=jobs)
+    report = analyze_paths(paths, config=config, rules=rules, jobs=jobs,
+                           only_paths=only_paths)
     baseline = load_baseline(baseline_path)
-    # A deleted guarded_by annotation silently disables its checks; the
-    # baseline pins the expected declarations so deletion is a failure.
-    # Only guards of files actually scanned are enforced — a partial run
-    # (`servelint min_tfs_client_tpu/batching`) must not fail over files
-    # it never looked at.
+    # A deleted guarded_by/owns annotation silently disables its checks;
+    # the baseline pins the expected declarations so deletion is a
+    # failure. Only guards of files actually scanned are enforced — a
+    # partial run (`servelint min_tfs_client_tpu/batching`) must not
+    # fail over files it never looked at.
     required = [g for g in baseline.required_guards
                 if g.partition("::")[0] in report.scanned_paths]
+    required_owns = {g for g in required if "::owns:" in g}
     report.findings.extend(locks.missing_guard_findings(
-        required, report.declared_guards))
+        [g for g in required if "::owns:" not in g],
+        report.declared_guards))
+    report.findings.extend(resource_lifecycle.missing_owns_findings(
+        required_owns, report.declared_guards))
     # Same scoping for the stale check: an entry for an unscanned file is
-    # not stale, it is out of this run's view.
+    # not stale, it is out of this run's view. In --since mode, per-file
+    # findings were only computed over only_paths, so per-file entries
+    # outside it are out of view too — but package-pass findings (whose
+    # codes live in the package rules' CODES tables) are always complete
+    # and their entries stay in scope.
+    in_view = report.scanned_paths
+    if only_paths is not None:
+        package_codes = set()
+        for rule in rules:
+            if getattr(rule, "PACKAGE_PASS", False):
+                package_codes |= set(getattr(rule, "CODES", ()))
+        in_view = {p for p in report.scanned_paths if p in only_paths}
+
+        def _entry_in_view(key: str) -> bool:
+            path, _, rest = key.partition("::")
+            code = rest.partition("::")[0]
+            return path in in_view or (path in report.scanned_paths and
+                                       code in package_codes)
+    else:
+        def _entry_in_view(key: str) -> bool:
+            return key.partition("::")[0] in in_view
+
     entries = {k: v for k, v in baseline.entries.items()
-               if k.partition("::")[0] in report.scanned_paths}
+               if _entry_in_view(k)}
     report.diff = diff_baseline(report.findings, entries)
     return report
 

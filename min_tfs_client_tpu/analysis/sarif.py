@@ -1,0 +1,69 @@
+"""SARIF 2.1.0 emitter: servelint findings for code-scanning UIs.
+
+One run, one tool (`servelint`), one rule per finding code (the rule
+metadata comes from each family module's CODES table). Locations use
+the same package-anchored relpaths the baseline keys use, so a SARIF
+result and a baseline entry for the same finding always agree on the
+file identity regardless of invocation shape.
+
+Findings NEW against the baseline are `error` (they fail the gate);
+baselined ones are `note` (visible debt, not a failure).
+"""
+
+from __future__ import annotations
+
+SARIF_VERSION = "2.1.0"
+SARIF_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+                "master/Sarif-2.1.0/sarif-schema-2.1.0.json")
+
+
+def rule_metadata(rules) -> list:
+    """SARIF reportingDescriptor list from the rule modules' CODES
+    tables, sorted by code so the output is deterministic."""
+    descriptors = {}
+    for rule in rules:
+        family = getattr(rule, "RULE", rule.__name__)
+        for code, short in getattr(rule, "CODES", {}).items():
+            descriptors[code] = {
+                "id": code,
+                "name": family,
+                "shortDescription": {"text": short},
+                "helpUri": "docs/STATIC_ANALYSIS.md",
+            }
+    return [descriptors[c] for c in sorted(descriptors)]
+
+
+def to_sarif(report, rules) -> dict:
+    """Serialize a runner.Report as a SARIF 2.1.0 log dict."""
+    new_identity = {(f.path, f.line, f.code) for f in report.diff.new}
+    results = []
+    for f in sorted(report.findings,
+                    key=lambda f: (f.path, f.line, f.code)):
+        results.append({
+            "ruleId": f.code,
+            "level": "error" if (f.path, f.line, f.code) in new_identity
+            else "note",
+            "message": {"text": f.message +
+                        (f"  [fix: {f.hint}]" if f.hint else "")},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": f.path},
+                    "region": {"startLine": max(f.line, 1)},
+                },
+                "logicalLocations": [{"fullyQualifiedName": f.scope}]
+                if f.scope else [],
+            }],
+            "partialFingerprints": {"servelintKey": f.key()},
+        })
+    return {
+        "$schema": SARIF_SCHEMA,
+        "version": SARIF_VERSION,
+        "runs": [{
+            "tool": {"driver": {
+                "name": "servelint",
+                "informationUri": "docs/STATIC_ANALYSIS.md",
+                "rules": rule_metadata(rules),
+            }},
+            "results": results,
+        }],
+    }

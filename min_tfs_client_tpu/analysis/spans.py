@@ -43,6 +43,11 @@ from min_tfs_client_tpu.analysis.core import (
 
 RULE = "spans"
 
+CODES = {
+    "SP001": "span/request_trace constructed outside a `with`",
+    "SP002": "trace/span handed to a thread outside the BatchTask API",
+}
+
 _SPAN_FACTORIES = {"span", "tracing.span", "request_trace",
                    "tracing.request_trace"}
 _TRACE_SOURCES = _SPAN_FACTORIES | {"current_trace", "tracing.current_trace",

@@ -38,6 +38,11 @@ from min_tfs_client_tpu.analysis.core import (
 
 RULE = "threads"
 
+CODES = {
+    "TH001": "cross-domain mutable state with no guarded_by declaration",
+    "TH002": "thread spawned without explicit name= and daemon=",
+}
+
 _THREAD_CTORS = {"threading.Thread", "Thread"}
 _SYNCHRONIZER_FACTORIES = {
     "threading.Lock", "threading.RLock", "threading.Condition",

@@ -737,3 +737,236 @@ def _find_cycle(edges) -> list | None:
                 color[node] = BLACK
                 stack.pop()
     return None
+
+
+# -- runtime leak witness ----------------------------------------------------
+
+
+@functools.lru_cache(maxsize=1)
+def package_owns() -> frozenset:
+    """{(class_leaf, kind)} for every `# servelint: owns` declaration in
+    the package — the static side of the leak witness's cross-check."""
+    from min_tfs_client_tpu.analysis import resource_lifecycle
+    from min_tfs_client_tpu.analysis.runner import (
+        default_package_root,
+        iter_py_files,
+    )
+
+    pairs = set()
+    for abspath, relpath in iter_py_files([default_package_root()]):
+        module = parse_module(abspath, relpath)
+        if module is None:
+            continue
+        for decl in resource_lifecycle.collect_owns(module):
+            pairs.add((decl.cls.rsplit(".", 1)[-1], decl.kind))
+    return frozenset(pairs)
+
+
+def _load_attr(dotted_mod: str, name: str):
+    try:
+        mod = importlib.import_module(dotted_mod)
+    except Exception:
+        return None
+    return getattr(mod, name, None)
+
+
+class LeakWitness:
+    """Counting proxies over the serving stack's resource pools.
+
+    The static RL family proves acquire/release pairing about the code;
+    this witness watches the code RUN. Installed (autouse in the
+    paged-KV, router-scaleout, and storm-smoke suites) it patches
+    counting wrappers over
+
+      pages    PageAllocator.try_alloc / free   (net pages out)
+      slots    SlotPool / PagedSlotPool acquire_slot / release_slot
+      pins     SessionTable instances created while armed
+      conns    ChannelPool / KeepAliveHTTPPool / AioChannelPool
+               instances created while armed
+      threads  threading.Thread.start while armed
+
+    and asserts at teardown that every pool still alive (after a
+    gc.collect() — a pool that died took its resources with it) holds
+    zero net resources, and that no non-daemon thread started during the
+    test outlives it. Daemon ticker/completion threads parked on their
+    bounded waits are joined with a timeout and then tolerated — the
+    1-core CI host must not produce spurious leak verdicts.
+
+    It also cross-checks the static `# servelint: owns` declarations as
+    runtime-verified facts: every pool class the witness counts must
+    still carry its declaration, so deleting the annotation breaks the
+    armed suites, not just the lint gate.
+    """
+
+    # (module, class name, kind) — the long-lived pools. Their `owns`
+    # declarations are cross-checked at assert_no_leaks time.
+    _DECLARED_POOLS = (
+        ("min_tfs_client_tpu.router.core", "ChannelPool", "conns"),
+        ("min_tfs_client_tpu.router.http_pool", "KeepAliveHTTPPool",
+         "conns"),
+        ("min_tfs_client_tpu.router.aio_proxy", "AioChannelPool", "conns"),
+    )
+
+    def __init__(self):
+        self._installed = False
+        self._patches: list[tuple] = []        # (cls, name, original)
+        self._thread_start = None
+        # net counters / registries, all weak so the witness never keeps
+        # a dead pool (and its resources) alive.
+        self.pages = weakref.WeakKeyDictionary()      # allocator -> int
+        self.slots = weakref.WeakKeyDictionary()      # pool -> {slot,...}
+        self.pin_tables = weakref.WeakSet()           # SessionTable
+        self.conn_pools = weakref.WeakSet()           # channel/http pools
+        self.threads: list = []                       # started while armed
+
+    # -- install / uninstall -------------------------------------------------
+
+    def _patch(self, cls, name, wrapper):
+        original = cls.__dict__[name]
+        wrapper.__name__ = name
+        setattr(cls, name, wrapper)
+        self._patches.append((cls, name, original))
+        return original
+
+    def install(self) -> "LeakWitness":
+        if self._installed:
+            return self
+        self._installed = True
+        witness = self
+
+        from min_tfs_client_tpu.servables import decode_sessions as ds
+
+        def try_alloc(alloc_self, n=1, *, _orig=ds.PageAllocator.try_alloc):
+            pages = _orig(alloc_self, n)
+            if pages:
+                witness.pages[alloc_self] = \
+                    witness.pages.get(alloc_self, 0) + len(pages)
+            return pages
+
+        def free(alloc_self, pages, *, _orig=ds.PageAllocator.free):
+            _orig(alloc_self, pages)
+            witness.pages[alloc_self] = \
+                witness.pages.get(alloc_self, 0) - len(pages)
+
+        self._patch(ds.PageAllocator, "try_alloc", try_alloc)
+        self._patch(ds.PageAllocator, "free", free)
+
+        for pool_cls in (ds.SlotPool, ds.PagedSlotPool):
+            def acquire_slot(pool_self, *,
+                             _orig=pool_cls.__dict__["acquire_slot"]):
+                slot = _orig(pool_self)
+                witness.slots.setdefault(pool_self, set()).add(slot)
+                return slot
+
+            def release_slot(pool_self, slot, *,
+                             _orig=pool_cls.__dict__["release_slot"]):
+                _orig(pool_self, slot)
+                witness.slots.setdefault(pool_self, set()).discard(slot)
+
+            self._patch(pool_cls, "acquire_slot", acquire_slot)
+            self._patch(pool_cls, "release_slot", release_slot)
+
+        from min_tfs_client_tpu.router import sessions as sess_mod
+
+        def table_init(table_self, *args,
+                       _orig=sess_mod.SessionTable.__init__, **kwargs):
+            _orig(table_self, *args, **kwargs)
+            witness.pin_tables.add(table_self)
+
+        self._patch(sess_mod.SessionTable, "__init__", table_init)
+
+        for dotted_mod, cls_name, _kind in self._DECLARED_POOLS:
+            cls = _load_attr(dotted_mod, cls_name)
+            if cls is None:
+                continue
+
+            def pool_init(pool_self, *args, _orig=cls.__init__, **kwargs):
+                _orig(pool_self, *args, **kwargs)
+                witness.conn_pools.add(pool_self)
+
+            self._patch(cls, "__init__", pool_init)
+
+        real_start = threading.Thread.start
+
+        def start(thread_self, *, _orig=real_start):
+            _orig(thread_self)
+            witness.threads.append(thread_self)
+
+        self._patch(threading.Thread, "start", start)
+        return self
+
+    def uninstall(self) -> None:
+        if not self._installed:
+            return
+        self._installed = False
+        for cls, name, original in reversed(self._patches):
+            setattr(cls, name, original)
+        self._patches.clear()
+
+    # -- accounting ----------------------------------------------------------
+
+    @staticmethod
+    def _conns_held(pool) -> int:
+        channels = getattr(pool, "_channels", None)
+        if channels is not None:
+            return len(channels)
+        idle = getattr(pool, "_idle", None)
+        if idle is not None:
+            return sum(len(conns) for conns in idle.values())
+        return 0
+
+    def outstanding(self) -> dict:
+        """Net resources held by pools still alive, by kind."""
+        import gc
+
+        gc.collect()
+        out = {"pages": 0, "slots": 0, "pins": 0, "conns": 0}
+        for count in self.pages.values():
+            out["pages"] += count
+        for held in self.slots.values():
+            out["slots"] += len(held)
+        for table in self.pin_tables:
+            out["pins"] += len(getattr(table, "_pins", ()))
+        for pool in self.conn_pools:
+            out["conns"] += self._conns_held(pool)
+        return out
+
+    def leaked_threads(self, join_timeout_s: float = 2.0) -> list:
+        """Non-daemon threads started while armed that outlive the test.
+        Daemon tickers parked on bounded waits are joined with a timeout
+        and tolerated — net counts only, no spurious CI verdicts."""
+        for thread in self.threads:
+            if thread.is_alive():
+                thread.join(timeout=join_timeout_s)
+        return [t for t in self.threads
+                if t.is_alive() and not t.daemon]
+
+    def owns_cross_check(self) -> list:
+        """Pool classes the witness counts whose static `owns`
+        declaration went missing."""
+        declared = package_owns()
+        missing = []
+        for _mod, cls_name, kind in self._DECLARED_POOLS:
+            if (cls_name, kind) not in declared:
+                missing.append(f"{cls_name} lost its `# servelint: owns "
+                               f"{kind}` declaration")
+        return missing
+
+    def assert_no_leaks(self, join_timeout_s: float = 2.0) -> None:
+        problems = []
+        stuck = self.leaked_threads(join_timeout_s)
+        counts = self.outstanding()
+        for kind, count in sorted(counts.items()):
+            if count:
+                problems.append(
+                    f"{count} net leaked {kind} held by pools that "
+                    "outlived the test")
+        if stuck:
+            names = ", ".join(repr(t.name) for t in stuck[:10])
+            problems.append(
+                f"{len(stuck)} non-daemon thread(s) started during the "
+                f"test still alive after join({join_timeout_s}s): {names}")
+        problems.extend(self.owns_cross_check())
+        if problems:
+            raise AssertionError(
+                "leak witness found problems:\n  " + "\n  ".join(problems))

@@ -109,8 +109,8 @@ class BatchQueue:
         and stick stale."""
         try:
             from min_tfs_client_tpu.server import metrics
-        except Exception:  # pragma: no cover
-            return
+        except Exception:  # servelint: fallback-ok metrics unimportable
+            return  # means there is no channel to record with
         metrics.safe_set(metrics.batch_queue_depth, len(self._batches),
                          self.name)
 
@@ -250,8 +250,8 @@ def _default_thread_count() -> int:
         import jax
 
         return max(1, len(jax.local_devices()))
-    except Exception:  # pragma: no cover
-        return 2
+    except Exception:  # servelint: fallback-ok jax absent in pure-unit
+        return 2  # runs; 2 is the documented no-device default
 
 
 _global_scheduler: SharedBatchScheduler | None = None

@@ -55,9 +55,9 @@ def register_server(target: str, invoker: LocalInvoker) -> None:
         _registry[_normalize(target)] = invoker
 
 
-def unregister_server(target: str) -> None:
+def unregister_server(target: str) -> Optional[LocalInvoker]:
     with _registry_lock:
-        _registry.pop(_normalize(target), None)
+        return _registry.pop(_normalize(target), None)
 
 
 def _normalize(target: str) -> str:

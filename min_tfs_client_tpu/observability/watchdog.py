@@ -698,7 +698,7 @@ class Watchdog(_WatchdogBase):
                 return
             self._stop = threading.Event()
             self._last_tick_mono = None
-            self._thread = threading.Thread(
+            self._thread = threading.Thread(  # servelint: owns thread
                 target=self._run, name="watchdog-ticker", daemon=True)
             self._thread.start()
 

@@ -128,7 +128,7 @@ class AioChannelPool:
     loop), so the dicts need no lock — the loop IS the serialization."""
 
     def __init__(self):
-        self._channels: dict[str, object] = {}
+        self._channels: dict[str, object] = {}  # servelint: owns conns
         # Cached multicallables per (backend, method): building one per
         # request costs ~tens of us of cython setup on the loop.
         self._calls: dict[tuple, object] = {}

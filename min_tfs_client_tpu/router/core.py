@@ -59,6 +59,7 @@ class ChannelPool:
 
     def __init__(self):
         self._lock = threading.Lock()
+        # servelint: owns conns
         self._channels: dict[str, object] = {}   # guarded_by: self._lock
         # channel.unary_unary() builds a fresh multicallable each time
         # (~tens of us of cython setup) — cache per (backend, method);

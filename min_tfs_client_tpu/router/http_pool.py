@@ -64,6 +64,7 @@ class KeepAliveHTTPPool:
         self._timeout_s = timeout_s
         self._max_idle = max_idle_per_target
         self._lock = threading.Lock()
+        # servelint: owns conns
         self._idle: dict[tuple[str, int], list] = {}  # guarded_by: self._lock
 
     # -- connection checkout/return ------------------------------------------

@@ -292,7 +292,7 @@ class MembershipTable:
 
     def start(self) -> None:
         self.poll_once()  # synchronous first pass: route correctly at boot
-        self._thread = threading.Thread(
+        self._thread = threading.Thread(  # servelint: owns thread
             target=self._poll_loop, name="router-membership-poll",
             daemon=True)
         self._thread.start()

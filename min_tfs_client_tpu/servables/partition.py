@@ -450,7 +450,17 @@ class GraphPartition:
             args = [
                 jax.device_put(consts[name], NamedSharding(mesh, spec))
                 for name, spec in lift]
-        except Exception:  # GraphImportError, device_put OOM, ...
+        except Exception as exc:  # GraphImportError, device_put OOM, ...
+            # Serving stays correct on the replicated interior, but the
+            # HBM saving silently never happened — leave evidence.
+            try:
+                from min_tfs_client_tpu.observability import flight_recorder
+
+                flight_recorder.record(
+                    "param_lift_fallback", params=len(lift),
+                    error=str(exc)[:160])
+            except Exception:  # pragma: no cover - evidence best-effort
+                pass
             return
         seg.interior = interior
         seg.param_refs = refs

@@ -309,8 +309,8 @@ def _index_by_variable_name(tensors: dict[str, np.ndarray]) -> None:
         raw = og.reshape(-1)[0]
         graph = tf_bundle_pb2.TrackableObjectGraph()
         graph.ParseFromString(raw if isinstance(raw, bytes) else bytes(raw))
-    except Exception:
-        return  # malformed/newer object graph: keep raw keys only
+    except Exception:  # servelint: fallback-ok malformed/newer object
+        return  # graph: raw checkpoint keys still serve every signature
     for node in graph.nodes:
         for attr in node.attributes:
             if attr.full_name and attr.checkpoint_key in tensors:

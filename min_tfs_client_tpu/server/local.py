@@ -14,6 +14,7 @@ from min_tfs_client_tpu.client.inprocess import (
     InProcessRpcError,
     LocalInvoker,
     register_server,
+    unregister_server,
 )
 from min_tfs_client_tpu.core.server_core import ServerCore, single_model_config
 from min_tfs_client_tpu.server.handlers import Handlers
@@ -80,3 +81,17 @@ def boot_local_server(base_path: str) -> LocalServer:
     server = LocalServer(core)
     register_server(base_path, server)
     return server
+
+
+def shutdown_local_server(base_path: str) -> bool:
+    """Stop and unregister the in-process server for ``base_path``.
+
+    Lazily-booted tpu:// servers are otherwise process-lifetime: the
+    registry pins the core, whose manager holds live servable-load/unload
+    worker threads. Anything that boots one for a bounded scope (tests,
+    one-shot tools) owns its teardown and must call this."""
+    server = unregister_server(base_path)
+    if server is None:
+        return False
+    server.stop()
+    return True

@@ -46,3 +46,23 @@ def schedule_witness():
         wit.uninstall()
     # After uninstall, so an assertion failure can't leak the patches.
     wit.assert_clean()
+
+
+# Runtime leak witness (docs/STATIC_ANALYSIS.md "Leak witness"): the
+# paged-KV, router-scaleout, and storm-smoke suites arm this autouse;
+# every pool that outlives the test must then hold zero net
+# pages/slots/pins/conns, and no non-daemon thread may outlive it.
+
+
+@pytest.fixture
+def leak_witness():
+    from min_tfs_client_tpu.analysis import witness as witness_mod
+
+    wit = witness_mod.LeakWitness()
+    wit.install()
+    try:
+        yield wit
+    finally:
+        wit.uninstall()
+    # After uninstall, so an assertion failure can't leak the patches.
+    wit.assert_no_leaks()

@@ -48,6 +48,13 @@ _ACTIVE_PROCS: set = set()
 
 
 @pytest.fixture(autouse=True)
+def _leak_witness(leak_witness):
+    """Runtime leak witness: pools (sessions pins, channel/HTTP conns)
+    that outlive a test must hold zero net resources at teardown."""
+    yield
+
+
+@pytest.fixture(autouse=True)
 def _proc_watchdog():
     fired = threading.Event()
 

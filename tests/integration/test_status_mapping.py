@@ -1,0 +1,175 @@
+"""The exception→wire-status taxonomy, pinned end to end.
+
+`utils/status.error_from_exception` is the ONE funnel every transport
+maps handler exceptions through (ServingError passes typed; ValueError/
+TypeError/KeyError → INVALID_ARGUMENT; TimeoutError → DEADLINE_EXCEEDED;
+NotImplementedError → UNIMPLEMENTED; everything else → INTERNAL). The
+static ER family polices the raise sites; this suite pins the mapping
+itself on every plane — gRPC and the REST surface on BOTH HTTP backends
+(native epoll + http.server fallback) — with a servable whose input
+selects which exception its signature raises.
+"""
+
+import json
+import urllib.error
+import urllib.request
+
+import grpc
+import numpy as np
+import pytest
+
+from min_tfs_client_tpu.client import TensorServingClient
+from min_tfs_client_tpu.server.server import Server, ServerOptions
+
+RAISER_SRC = '''
+"""Raising servable: the input value selects the exception the
+signature raises — the probe behind the status-mapping contract test."""
+import numpy as np
+
+from min_tfs_client_tpu.servables.servable import Signature, TensorSpec
+from min_tfs_client_tpu.utils.status import ServingError
+
+
+def build(path):
+    def raise_fn(inputs):
+        kind = int(np.asarray(inputs["kind"]).reshape(-1)[0])
+        if kind == 0:
+            return {"y": np.asarray(inputs["kind"], np.float32)}
+        if kind == 1:
+            raise RuntimeError("anonymous internal failure")
+        if kind == 2:
+            raise ValueError("bad batch shape")
+        if kind == 3:
+            raise TimeoutError("tick budget exceeded")
+        if kind == 4:
+            raise NotImplementedError("streaming not built")
+        raise ServingError.resource_exhausted("page pool exhausted")
+
+    return {
+        "serving_default": Signature(
+            fn=raise_fn,
+            inputs={"kind": TensorSpec(np.float32, (None,))},
+            outputs={"y": TensorSpec(np.float32, (None,))},
+            on_host=True, batched=False,
+        ),
+    }
+'''
+
+# (kind, canonical gRPC status, REST HTTP status). RESOURCE_EXHAUSTED
+# rides a typed ServingError end to end; REST folds it (and INTERNAL)
+# to 500 — the codes REST distinguishes are pinned by the others.
+CASES = [
+    pytest.param(1, grpc.StatusCode.INTERNAL, 500, id="runtime-internal"),
+    pytest.param(2, grpc.StatusCode.INVALID_ARGUMENT, 400,
+                 id="value-invalid"),
+    pytest.param(3, grpc.StatusCode.DEADLINE_EXCEEDED, 504,
+                 id="timeout-deadline"),
+    pytest.param(4, grpc.StatusCode.UNIMPLEMENTED, 501,
+                 id="notimpl-unimplemented"),
+    pytest.param(5, grpc.StatusCode.RESOURCE_EXHAUSTED, 500,
+                 id="typed-exhausted"),
+]
+
+
+@pytest.fixture(scope="module")
+def config_file(tmp_path_factory):
+    root = tmp_path_factory.mktemp("raiser_models")
+    vdir = root / "raiser" / "1"
+    vdir.mkdir(parents=True)
+    (vdir / "servable.py").write_text(RAISER_SRC)
+    path = root / "models.config"
+    path.write_text(f"""
+model_config_list {{
+  config {{
+    name: "raiser"
+    base_path: "{root}/raiser"
+    model_platform: "jax"
+  }}
+}}
+""")
+    return path
+
+
+@pytest.fixture(scope="module")
+def server(config_file):
+    srv = Server(ServerOptions(
+        grpc_port=0,
+        model_config_file=str(config_file),
+        file_system_poll_wait_seconds=0,
+    ))
+    srv.build_and_start()
+    yield srv
+    srv.stop()
+
+
+@pytest.fixture(scope="module", params=["native", "python"])
+def rest_server(config_file, request):
+    if request.param == "native":
+        from min_tfs_client_tpu.server.native_http import (
+            native_http_available,
+        )
+
+        if not native_http_available():
+            pytest.skip("native HTTP library not buildable here")
+    mon = config_file.parent / "monitoring.config"
+    mon.write_text('prometheus_config { enable: true }\n')
+    srv = Server(ServerOptions(
+        grpc_port=0,
+        rest_api_port=0,
+        model_config_file=str(config_file),
+        file_system_poll_wait_seconds=0,
+        monitoring_config_file=str(mon),
+        rest_api_impl=request.param,
+    ))
+    srv.build_and_start()
+    yield srv
+    srv.stop()
+
+
+@pytest.fixture(scope="module")
+def client(server):
+    with TensorServingClient("127.0.0.1", server.grpc_port) as c:
+        yield c
+
+
+def _predict(client, kind):
+    return client.predict_request(
+        "raiser", {"kind": np.array([float(kind)], np.float32)})
+
+
+class TestGrpcPlane:
+    def test_success_path_sane(self, client):
+        resp = _predict(client, 0)
+        assert "y" in resp.outputs
+
+    @pytest.mark.parametrize("kind,status,_http", CASES)
+    def test_exception_maps_to_canonical_status(self, client, kind,
+                                                status, _http):
+        with pytest.raises(grpc.RpcError) as err:
+            _predict(client, kind)
+        assert err.value.code() == status
+
+
+class TestRestPlanes:
+    """Both REST backends — the mapping is a transport contract, not a
+    backend detail."""
+
+    def _post(self, srv, kind):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.rest_port}/v1/models/raiser:predict",
+            data=json.dumps({"instances": [{"kind": float(kind)}]}).encode(),
+            headers={"Content-Type": "application/json"})
+        return urllib.request.urlopen(req, timeout=10)
+
+    def test_success_path_sane(self, rest_server):
+        with self._post(rest_server, 0) as r:
+            assert json.load(r)["predictions"] == [0.0]
+
+    @pytest.mark.parametrize("kind,_status,http_code", CASES)
+    def test_exception_maps_to_http_status(self, rest_server, kind,
+                                           _status, http_code):
+        with pytest.raises(urllib.error.HTTPError) as err:
+            self._post(rest_server, kind)
+        assert err.value.code == http_code
+        body = json.loads(err.value.read() or b"{}")
+        assert "error" in body

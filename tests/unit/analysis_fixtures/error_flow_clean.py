@@ -1,0 +1,101 @@
+"""servelint fixture: error-flow rule must NOT fire anywhere here."""
+
+
+class ServingError(Exception):
+    """Stands in for utils/status.ServingError (leaf-name match)."""
+
+    @classmethod
+    def invalid(cls, msg):
+        return cls(msg)
+
+
+UNAVAILABLE = 14
+DEADLINE_EXCEEDED = 4
+
+
+class EchoServicer:
+    def Echo(self, request, context):
+        if request is None:
+            raise ServingError.invalid("empty request")
+        return _render(request)
+
+
+def _render(request):
+    """Boundary-reachable, but the internal raise is sanctioned."""
+    if request == "boom":
+        # servelint: internal-ok crash-only by design; the supervisor
+        # restarts the process and the client's INTERNAL is the truth
+        raise RuntimeError("supervisor restarts us")
+    return request
+
+
+def relay(table, name):
+    """Typed error read and re-raised: status preserved."""
+    try:
+        return table[name]
+    except ServingError as exc:
+        table.note_failure(exc)
+        raise
+
+
+def downgrade(table, name):
+    try:
+        return table[name]
+    except ServingError:  # servelint: status-ok capability probe
+        return None
+
+
+def forward(channel, payload, retry):
+    """Retry decisions routed through the shared predicates, and the
+    deadline mention is post-decision bookkeeping, not retry policy."""
+    attempt = 0
+    while True:
+        try:
+            return channel.send(payload)
+        except OSError as exc:
+            undelivered = exc.errno not in (UNAVAILABLE, DEADLINE_EXCEEDED)
+            if undelivered:
+                raise
+            delay = retry.next_forward_retry_delay_s(attempt)
+            if delay is None:
+                raise
+            attempt += 1
+            continue
+
+
+def poll(channel):
+    while True:
+        try:
+            return channel.recv()
+        except OSError:  # servelint: retry-ok idempotent poll, no body
+            continue
+
+
+class Codec:
+    def decode(self, blob, recorder):
+        try:
+            return self._fast(blob)
+        except Exception as exc:
+            recorder.record("decode_fallback", error=str(exc))
+            return None
+
+    def complete(self, task):
+        """Delivery, not swallowing: the bound error propagates."""
+        try:
+            task.result = self._fast(task.blob)
+        except Exception as exc:
+            task.error = exc
+
+    def note(self, metrics, value):
+        """Telemetry guard: the try body IS the recording attempt."""
+        try:
+            metrics.observe("decode_ms", value)
+        except Exception:
+            pass
+
+    def warm(self, cache):
+        try:
+            cache.prefill()
+        except Exception:  # servelint: fallback-ok warmup is optional
+            return False
+        return True

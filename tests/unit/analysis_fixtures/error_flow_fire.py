@@ -1,0 +1,77 @@
+"""servelint fixture: error-flow rule SHOULD fire on every marked line."""
+
+
+class ServingError(Exception):
+    """Stands in for utils/status.ServingError (leaf-name match)."""
+
+    @classmethod
+    def internal(cls, msg):
+        return cls(msg)
+
+
+DEADLINE_EXCEEDED = 4
+
+
+class PredictServicer:
+    """Class-name suffix makes every method a handler boundary."""
+
+    def Predict(self, request, context):
+        return decode_request(request)
+
+    def Close(self, request, context):
+        if request is None:
+            raise RuntimeError("no request")      # ER001
+        return request
+
+
+def decode_request(request):
+    """Reachable from PredictServicer.Predict via the call graph."""
+    if not request:
+        raise IndexError("empty batch")           # ER001
+    return request
+
+
+def lookup(table, name):
+    """NOT boundary-reachable, but launders the typed status."""
+    try:
+        return table[name]
+    except ServingError:
+        raise RuntimeError("lookup failed")       # ER002
+
+
+def probe(backend):
+    try:
+        backend.ping()
+        return True
+    except ServingError:                          # ER002
+        return False
+
+
+def fetch_with_retry(channel, payload):
+    for attempt in range(3):
+        try:
+            return channel.send(payload)
+        except OSError:                           # ER003
+            continue
+    return None
+
+
+def forward(channel, payload, retry):
+    attempt = 0
+    while True:
+        try:
+            return channel.send(payload)
+        except OSError as exc:
+            delay = retry.next_forward_retry_delay_s(attempt)
+            if exc.errno == DEADLINE_EXCEEDED:    # ER003
+                attempt += delay
+                continue
+            raise
+
+
+class Codec:
+    def decode(self, blob):
+        try:
+            return self._fast_path(blob)
+        except Exception:                         # ER004
+            return None

@@ -1,0 +1,82 @@
+"""servelint fixture: resource-lifecycle must NOT fire anywhere here."""
+
+
+class SessionTable:
+    """Declared receiver for transferred slots, with a real teardown."""
+
+    def __init__(self):
+        self._slots = {}        # servelint: owns slot
+
+    def adopt(self, key, slot):
+        self._slots[key] = slot
+
+    def close(self):
+        for slot in self._slots.values():
+            slot.release_slot()
+        self._slots.clear()
+
+
+class ConnCache:
+    """Acquisition stored straight onto a DECLARED own."""
+
+    def __init__(self, pool):
+        self._conn = pool._checkout("seed")  # servelint: owns conn
+
+    def close(self):
+        self._conn.close()
+        self._conn = None
+
+
+def with_scoped(pool, payload):
+    with pool.acquire_slot("scoped") as slot:
+        slot.fill(payload)
+    return payload
+
+
+def released_in_finally(pool, codec, payload):
+    pages = pool.alloc(4)
+    try:
+        return codec.decode(payload)
+    finally:
+        pool.free(pages)
+
+
+def exclusive_paths(pool, channel, payload):
+    slot = pool.acquire_slot("x")
+    try:
+        channel.send(payload)
+    except OSError:
+        pool.release_slot(slot)
+        raise
+    pool.release_slot(slot)
+    return True
+
+
+def straight_line(pool):
+    """No raising call between acquire and release: plain release ok."""
+    slot = pool.acquire_slot("fast")
+    pool.release_slot(slot)
+    return True
+
+
+def handout(pool):
+    pages = pool.alloc(2)
+    return pages  # servelint: transfers caller (session frees on unpin)
+
+
+def adopt_into_table(pool, table):
+    slot = pool.acquire_slot("kept")
+    table.adopt("kept", slot)
+    return True
+
+
+def handoff_to_declared(pool):
+    slot = pool.acquire_slot("kept")
+    return slot  # servelint: transfers SessionTable
+
+
+def sampler_probe(pool):
+    # servelint: leak-ok the reaper thread owns probe slots by contract
+    slot = pool.acquire_slot("probe")
+    slot.touch()
+    return True

@@ -1,0 +1,69 @@
+"""servelint fixture: resource-lifecycle SHOULD fire on every marked line."""
+
+
+class SlotPool:
+    def acquire_slot(self, key):
+        return object()
+
+    def release_slot(self, slot):
+        pass
+
+
+def leak_forever(pool):
+    slot = pool.acquire_slot("never")             # RL001
+    if slot is None:
+        return False
+    return True
+
+
+def leak_on_raise(pool, codec, payload):
+    pages = pool.alloc(4)                         # RL001
+    decoded = codec.decode(payload)
+    pool.free(pages)
+    return decoded
+
+
+def double_release(pool):
+    slot = pool.acquire_slot("twice")
+    pool.release_slot(slot)
+    pool.release_slot(slot)                       # RL003
+    return True
+
+
+class StaleCache:
+    """Acquisition stored onto an attr with no `owns` declaration."""
+
+    def __init__(self):
+        self._pages = None
+
+    def refill(self, pool):
+        self._pages = pool.try_alloc(2)           # RL004
+
+
+def checkout_undeclared(pool):
+    conn = pool._checkout("backend-0")
+    return conn                                   # RL004
+
+
+def transfer_to_ghost(pool):
+    pages = pool.alloc(1)
+    return pages  # servelint: transfers GhostCache (nobody owns it: RL004)
+
+
+class Hoarder:
+    """Declares ownership but has no teardown method at all."""
+
+    def __init__(self):
+        self._conns = {}        # servelint: owns conn (RL002: no teardown)
+
+
+class Sloppy:
+    """Has a teardown, but it skips one of the two owned attrs."""
+
+    def __init__(self):
+        self._ticker = object()  # servelint: owns thread (RL002: skipped)
+        self._sock = object()    # servelint: owns conn
+
+    def stop(self):
+        self._sock.close()
+        self._sock = None

@@ -38,6 +38,13 @@ def _schedule_witness(schedule_witness):
     yield
 
 
+@pytest.fixture(autouse=True)
+def _leak_witness(leak_witness):
+    """Runtime leak witness: every PageAllocator page and slot-pool slot
+    acquired in a test must be net-released by teardown."""
+    yield
+
+
 @pytest.fixture(scope="module")
 def model():
     config = t5.T5Config.tiny()
@@ -278,11 +285,24 @@ class TestCapacityAndLeaks:
         except ServingError as exc:
             assert exc.code == RESOURCE_EXHAUSTED
         assert paged_admitted >= 4 * dense_admitted
+
+        # Release the admitted sessions: the jit cache pins both pools
+        # past this test (tick closures live in global PjitFunctions),
+        # so abandoned sessions would be REAL leaks — and the armed
+        # leak witness treats them as exactly that.
+        for i in range(dense_admitted):
+            dense["decode_close"].run({"session_id": _sid(f"dn-{i}")})
+        # +1: the REFUSED admission keeps its slot by design (refuse
+        # policy leaves state intact for retry); close is idempotent.
+        for i in range(paged_admitted + 1):
+            paged["decode_close"].run({"session_id": _sid(f"pg-{i}")})
         # ... and the admitted sessions are still token-exact.
         dense2 = _sigs(model, max_sessions=2)
         for i in range(2):
             want = _run(dense2, _sid(f"w-{i}"), prompts[i], steps=2)
             assert streams[i] == want
+        for i in range(2):
+            dense2["decode_close"].run({"session_id": _sid(f"w-{i}")})
 
 
 class TestEviction:
@@ -489,6 +509,12 @@ class TestServerSurface:
             client.predict_request(
                 "t5paged", {"session_id": _sid(f"h-{i}")},
                 signature_name="decode_close", timeout=600)
+        client.close()
+        # The lazily-booted tpu:// server is registry-pinned with live
+        # servable-load workers until someone owns its teardown.
+        from min_tfs_client_tpu.server.local import shutdown_local_server
+
+        assert shutdown_local_server(str(base))
 
 
 class TestStepContract:
@@ -703,6 +729,8 @@ class TestChunkedPrefill:
         got_b = self._run_prefix(sigs, "pp-b", ids_b, pre, MAXDEC - 6)
         assert got_b == want_b
         assert pool.stats()["evicted_swap"] > 0
+        for sid in ("pp-a", "pp-b"):
+            sigs["decode_close"].run({"session_id": _sid(sid)})
 
     def test_refuse_policy_mid_prefix_surfaces_typed_error_then_resumes(
             self, model):

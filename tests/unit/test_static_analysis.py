@@ -22,15 +22,20 @@ from min_tfs_client_tpu.analysis import (
     save_baseline,
 )
 from min_tfs_client_tpu.analysis import (
+    error_flow,
     host_sync,
     lock_order,
     locks,
     recompile,
+    resource_lifecycle,
     spans,
     threads,
 )
+from min_tfs_client_tpu.analysis.__main__ import changed_relpaths
 from min_tfs_client_tpu.analysis.core import AnalysisConfig as _Config
 from min_tfs_client_tpu.analysis.core import parse_module
+from min_tfs_client_tpu.analysis.runner import ALL_RULES
+from min_tfs_client_tpu.analysis.sarif import to_sarif
 
 FIXTURES = os.path.join(os.path.dirname(__file__), "analysis_fixtures")
 # Every fixture module counts as hot-path so the host-sync rule applies
@@ -41,7 +46,7 @@ SUBPROC_ENV = {**os.environ, "JAX_PLATFORMS": "cpu",
                "PYTHONPATH": REPO_ROOT + os.pathsep +
                os.environ.get("PYTHONPATH", "")}
 
-_MARKER = re.compile(r"\b((?:HS|RC|LK|SP|DL|TH)\d{3})\b")
+_MARKER = re.compile(r"\b((?:HS|RC|LK|SP|DL|TH|ER|RL)\d{3})\b")
 
 
 def _expected_markers(fname: str, prefix: str) -> list[tuple[int, str]]:
@@ -70,6 +75,9 @@ RULESET = [
     ("spans_fire.py", "spans_clean.py", spans, "SP"),
     ("lock_order_fire.py", "lock_order_clean.py", lock_order, "DL"),
     ("threads_fire.py", "threads_clean.py", threads, "TH"),
+    ("error_flow_fire.py", "error_flow_clean.py", error_flow, "ER"),
+    ("resource_lifecycle_fire.py", "resource_lifecycle_clean.py",
+     resource_lifecycle, "RL"),
 ]
 
 
@@ -244,6 +252,88 @@ class TestAnnotationsAreLoadBearing:
         assert [f.code for f in missing] == ["LK004"]
         assert guard.split("::")[1] in missing[0].message
 
+    def _er_codes(self, path, relpath, source):
+        module = parse_module(path, relpath, source=source)
+        summary = error_flow.summarize(module, _Config())
+        return [f.code for f in error_flow.check_package([summary],
+                                                         _Config())]
+
+    def test_internal_ok_removal_fires_er001(self):
+        path = os.path.join(FIXTURES, "error_flow_clean.py")
+        with open(path, "r", encoding="utf-8") as f:
+            source = f.read()
+        pattern = r"# servelint: internal-ok[^\n]*"
+        assert re.search(pattern, source)
+        module = parse_module(path, "error_flow_clean.py", source=source)
+        fixture_cfg = _Config(hot_paths=("",))
+        summary = error_flow.summarize(module, fixture_cfg)
+        assert error_flow.check_package([summary], fixture_cfg) == []
+        stripped = re.sub(pattern, "# stripped", source)
+        module = parse_module(path, "error_flow_clean.py", source=stripped)
+        summary = error_flow.summarize(module, fixture_cfg)
+        assert any(f.code == "ER001" for f in
+                   error_flow.check_package([summary], fixture_cfg))
+
+    def test_fallback_ok_removal_fires_er004(self):
+        relpath = "min_tfs_client_tpu/servables/decode_sessions.py"
+        path = os.path.join(default_package_root(), "servables",
+                            "decode_sessions.py")
+        with open(path, "r", encoding="utf-8") as f:
+            source = f.read()
+        pattern = r"# servelint: fallback-ok metrics unimportable"
+        assert re.search(pattern, source)
+        assert "ER004" not in self._er_codes(path, relpath, source)
+        stripped = re.sub(pattern, "# stripped", source)
+        assert "ER004" in self._er_codes(path, relpath, stripped)
+
+    def test_transfers_removal_fires_rl004(self):
+        relpath = "min_tfs_client_tpu/servables/decode_sessions.py"
+        path = os.path.join(default_package_root(), "servables",
+                            "decode_sessions.py")
+        with open(path, "r", encoding="utf-8") as f:
+            source = f.read()
+        pattern = r"# servelint: transfers caller"
+        assert re.search(pattern, source)
+
+        def rl(src):
+            module = parse_module(path, relpath, source=src)
+            summary = resource_lifecycle.summarize(module, _Config())
+            return [f.code for f in resource_lifecycle.check_package(
+                [summary], _Config())]
+
+        assert "RL004" not in rl(source)
+        assert "RL004" in rl(re.sub(pattern, "# stripped", source))
+
+    def test_owns_pin_removal_fails_via_required_guards(self):
+        """Satellite: the baseline pins every `# servelint: owns`
+        declaration; deleting one is RL005, not silence."""
+        baseline = load_baseline(default_baseline_path())
+        guard = ("min_tfs_client_tpu/router/core.py::"
+                 "ChannelPool._channels::owns:conns")
+        assert guard in baseline.required_guards
+        owns = {g for g in baseline.required_guards if "::owns:" in g}
+        assert len(owns) >= 5
+        missing = resource_lifecycle.missing_owns_findings(
+            owns, owns - {guard})
+        assert [f.code for f in missing] == ["RL005"]
+        assert "ChannelPool._channels" in missing[0].message
+
+    def test_planted_status_laundering_fires_er002(self):
+        source = (
+            "from min_tfs_client_tpu.utils.status import ServingError\n"
+            "\n\n"
+            "class PredictServicer:\n"
+            "    def Predict(self, request, context):\n"
+            "        try:\n"
+            "            return self._run(request)\n"
+            "        except ServingError as err:\n"
+            "            raise RuntimeError(str(err))\n"
+            "\n"
+            "    def _run(self, request):\n"
+            "        raise ServingError.internal('boom')\n")
+        codes = self._er_codes("planted.py", "planted.py", source)
+        assert "ER002" in codes
+
 
 class TestTier1Gate:
     """THE gate: the shipped tree must be clean against the shipped
@@ -336,6 +426,106 @@ class TestTier1Gate:
     def test_cli_default_invocation_is_clean(self):
         proc = subprocess.run(
             [sys.executable, "-m", "min_tfs_client_tpu.analysis"],
+            capture_output=True, text=True, check=False,
+            env=SUBPROC_ENV, cwd=REPO_ROOT)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+class TestSarifOutput:
+    """`--format sarif` (satellite): the emitter is golden-pinned over
+    the ER/RL fire corpus, and level reflects baseline status."""
+
+    GOLDEN = os.path.join(FIXTURES, "servelint_golden.sarif")
+    PATHS = [os.path.join(FIXTURES, f) for f in
+             ("error_flow_fire.py", "resource_lifecycle_fire.py")]
+
+    def test_matches_golden_file(self):
+        report = run_analysis(self.PATHS, config=FIXTURE_CONFIG)
+        doc = to_sarif(report, ALL_RULES)
+        with open(self.GOLDEN, "r", encoding="utf-8") as f:
+            golden = json.load(f)
+        assert doc == golden, (
+            "SARIF output drifted from the golden file; if the change "
+            "is intentional, regenerate tests/unit/analysis_fixtures/"
+            "servelint_golden.sarif")
+
+    def test_baselined_findings_downgrade_to_note(self, tmp_path):
+        paths = self.PATHS[:1]
+        base = str(tmp_path / "baseline.json")
+        save_baseline(base, run_analysis(
+            paths, config=FIXTURE_CONFIG).findings)
+        report = run_analysis(paths, config=FIXTURE_CONFIG,
+                              baseline_path=base)
+        doc = to_sarif(report, ALL_RULES)
+        results = doc["runs"][0]["results"]
+        assert results and {r["level"] for r in results} == {"note"}
+
+    def test_cli_sarif_on_clean_subtree(self):
+        proc = subprocess.run(
+            [sys.executable, "-m", "min_tfs_client_tpu.analysis",
+             "--format", "sarif",
+             os.path.join(default_package_root(), "analysis")],
+            capture_output=True, text=True, check=False,
+            env=SUBPROC_ENV, cwd=REPO_ROOT)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        doc = json.loads(proc.stdout)
+        assert doc["version"] == "2.1.0"
+        driver = doc["runs"][0]["tool"]["driver"]
+        assert driver["name"] == "servelint"
+        assert {r["id"] for r in driver["rules"]} >= {"ER001", "RL001"}
+        assert doc["runs"][0]["results"] == []
+
+
+class TestIncrementalSince:
+    """`--since REV` (satellite): the changed-file view must report
+    exactly what a full scan reports for those files."""
+
+    LK_VIOLATION = (
+        "import threading\n\n\n"
+        "class Q:\n"
+        "    def __init__(self):\n"
+        "        self._mu = threading.Lock()\n"
+        "        self._items = []  # guarded_by: self._mu\n\n"
+        "    def peek(self):\n"
+        "        return len(self._items)\n")
+
+    def _git(self, cwd, *args):
+        subprocess.run(
+            ["git", "-c", "user.email=ci@test", "-c", "user.name=ci",
+             *args],
+            cwd=cwd, check=True, capture_output=True, text=True)
+
+    def test_since_matches_full_scan_on_synthetic_diff(self, tmp_path):
+        work = tmp_path / "work"
+        work.mkdir()
+        (work / "untouched.py").write_text("X = 1\n")
+        (work / "edited.py").write_text("Y = 2\n")
+        self._git(work, "init", "-q")
+        self._git(work, "add", ".")
+        self._git(work, "commit", "-q", "-m", "seed")
+
+        # The synthetic diff: one tracked file edited into a violation,
+        # one untracked file born with one, one file untouched.
+        (work / "edited.py").write_text(self.LK_VIOLATION)
+        (work / "untracked.py").write_text(self.LK_VIOLATION)
+
+        changed = changed_relpaths("HEAD", [str(work)])
+        assert changed == {"edited.py", "untracked.py"}
+
+        full = run_analysis([str(work)], config=FIXTURE_CONFIG)
+        inc = run_analysis([str(work)], config=FIXTURE_CONFIG,
+                           only_paths=changed)
+        assert full.findings, "synthetic diff must produce findings"
+        assert sorted(f.key() for f in inc.findings) == \
+            sorted(f.key() for f in full.findings if f.path in changed)
+        # ... and nothing lived outside the diff, so the views agree.
+        assert sorted(f.key() for f in inc.findings) == \
+            sorted(f.key() for f in full.findings)
+
+    def test_cli_since_head_is_clean_on_the_repo(self):
+        proc = subprocess.run(
+            [sys.executable, "-m", "min_tfs_client_tpu.analysis",
+             "--since", "HEAD"],
             capture_output=True, text=True, check=False,
             env=SUBPROC_ENV, cwd=REPO_ROOT)
         assert proc.returncode == 0, proc.stdout + proc.stderr
