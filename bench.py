@@ -2512,6 +2512,31 @@ _CONFIG_FNS = {"bert": bench_bert, "bert_int8": bench_bert_int8,
                "fleet_storm": bench_fleet_storm}
 
 
+def _hot_frame_table(profiling) -> dict:
+    """One leg's sampled CPU attribution, compacted for the JSONL
+    record: overall top self frames, the subsystem sample mix, and the
+    top self frames of each of the busiest threads (which for the
+    routed leg includes the in-process router's aio event loop — the
+    byte-path share ROADMAP item 4 cites)."""
+    body = profiling.payload(limit=6)
+    if not body["sampler"]["samples"]:
+        return {}
+    threads = sorted(body["threads"].items(),
+                     key=lambda kv: -kv[1]["samples"])[:6]
+    return {
+        "samples": body["sampler"]["samples"],
+        "attributed_pct": body["sampler"]["attributed_pct"],
+        "top_self": profiling.top_hot_frames(10),
+        "subsystems": body["subsystems"],
+        "threads": {
+            label: {
+                "subsystem": info["subsystem"],
+                "samples": info["samples"],
+                "top_self": info["top_self"][:5],
+            } for label, info in threads},
+    }
+
+
 def child_main(out: pathlib.Path, configs: list[str]) -> None:
     _child_setup()
     import jax
@@ -2530,9 +2555,20 @@ def child_main(out: pathlib.Path, configs: list[str]) -> None:
                     # Per-leg per-stage table: every request in this leg
                     # lands in the tracing ring; clear between legs so
                     # each record aggregates only its own traffic.
-                    from min_tfs_client_tpu.observability import tracing
+                    from min_tfs_client_tpu.observability import (
+                        profiling,
+                        tracing,
+                    )
 
                     tracing.ring_clear()
+                    # Per-leg hot-frame table: a fresh sampler per leg
+                    # (configure resets the fold) at a rate high enough
+                    # to resolve a one-leg window. The imported leg's
+                    # samples are the host-island attribution; the
+                    # routed leg's router-event-loop rows are the
+                    # router's byte-path profile (ROADMAP items 5, 4).
+                    profiling.configure(hz=67.0)
+                    profiling.start()
                 rec = _CONFIG_FNS[name](max_iters)
                 rec.setdefault("extra", {})[
                     "measured_platform"] = measured_platform
@@ -2541,6 +2577,10 @@ def child_main(out: pathlib.Path, configs: list[str]) -> None:
                     table = tracing.stage_breakdown()
                     if table:
                         rec["extra"]["stage_breakdown"] = table
+                    frames = _hot_frame_table(profiling)
+                    profiling.stop()
+                    if frames:
+                        rec["extra"]["hot_frames"] = frames
                 sink.write(json.dumps(rec) + "\n")
                 sink.flush()
                 print(f"bench child: {name} -> "
@@ -2558,8 +2598,10 @@ if __name__ == "__main__":
     parser.add_argument(
         "--breakdown", action="store_true",
         help="attach a per-stage p50/p99 latency table (from the request-"
-             "tracing ring) to each leg's extra.stage_breakdown, so the "
-             "emitted JSON line carries the stage attribution")
+             "tracing ring) to each leg's extra.stage_breakdown, plus a "
+             "sampled hot-frame table (observability/profiling.py) to "
+             "extra.hot_frames, so the emitted JSON line carries both "
+             "the stage and the code-level attribution")
     ns = parser.parse_args()
     if ns.breakdown:
         os.environ["BENCH_BREAKDOWN"] = "1"  # children inherit via env

@@ -712,6 +712,17 @@ int tpuhttp_port(void* server) {
   return server == nullptr ? -1 : static_cast<Server*>(server)->port;
 }
 
+const char* tpuhttp_request_header(void* req_ptr, const char* name) {
+  // Valid only for the duration of the synchronous handler callback:
+  // WorkerMain deletes the Request right after the handler returns, so
+  // callers must copy the value before returning. `name` must already
+  // be lower-cased (the parser lower-cases keys on ingest).
+  Request* req = static_cast<Request*>(req_ptr);
+  if (req == nullptr || name == nullptr) return nullptr;
+  const std::string* value = req->parsed.Header(name);
+  return value == nullptr ? nullptr : value->c_str();
+}
+
 void tpuhttp_send_response(void* req_ptr, int status,
                            const char* content_type, const char* body,
                            uint64_t body_len) {

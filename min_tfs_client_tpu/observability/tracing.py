@@ -466,7 +466,9 @@ class span:
 
     Deliberately slim — this sits on the hot path of every request. The
     profiler bridge (TraceAnnotation) only engages when bridge_profiler
-    turned it on.
+    turned it on, and the active-stage registry (the sampling profiler's
+    sample→stage join) only when track_stages armed it — the common OFF
+    path pays one module-bool check per side.
     """
 
     __slots__ = ("name", "args", "_t0", "_ann")
@@ -479,17 +481,80 @@ class span:
         self._ann = _annotation(self.name) if _bridge else None
         if self._ann is not None:
             self._ann.__enter__()
+        if _stage_tracking:
+            ident = threading.get_ident()
+            _stage_active[ident] = (self.name, _stage_active.get(ident))
         self._t0 = time.perf_counter()
         return self
 
     def __exit__(self, exc_type, exc, tb):
         t1 = time.perf_counter()
+        if _stage_tracking:
+            ident = threading.get_ident()
+            entry = _stage_active.get(ident)
+            # Pop whatever is on top; well-paired spans make that this
+            # span's own entry. A toggle mid-span leaves entry None (armed
+            # after enter) or a stale head (disarmed then re-armed) — both
+            # self-heal because track_stages(False) clears the registry.
+            if entry is not None:
+                if entry[1] is None:
+                    _stage_active.pop(ident, None)
+                else:
+                    _stage_active[ident] = entry[1]
         if self._ann is not None:
             self._ann.__exit__(exc_type, exc, tb)
         tr = _current.get()
         if tr is not None:
             tr.add_span(self.name, self._t0, t1, self.args)
         return False
+
+
+# ---------------------------------------------------------------------------
+# Active-stage registry: ident -> (stage, prev) linked stack, armed only
+# while the sampling profiler (observability/profiling.py) runs. The
+# sampler thread reads it to join each stack sample to the serving stage
+# the sampled thread was inside at that instant.
+
+_stage_tracking = False
+# servelint: lock-ok per-key store/delete where the key is the WRITING
+# thread's own ident (no other thread writes that key) — single dict ops
+# are GIL-atomic, and the sampler's cross-thread reads are best-effort
+# point-in-time by design (a racy read misattributes one sample at most)
+_stage_active: dict = {}
+
+
+def track_stages(on: bool) -> None:
+    """Arm/disarm the registry. OFF (the default): span enter/exit pays
+    one module-bool check and nothing else, which keeps the tracing
+    overhead smoke budgets intact when no profiler is running."""
+    global _stage_tracking
+    _stage_tracking = bool(on)
+    if not on:
+        _stage_active.clear()
+
+
+def stage_tracking() -> bool:
+    return _stage_tracking
+
+
+def active_stage(ident) -> str | None:
+    """The stage the thread with `ident` is inside right now, or None."""
+    entry = _stage_active.get(ident)
+    return entry[0] if entry is not None else None
+
+
+def active_stages() -> dict:
+    """Point-in-time ident -> stage snapshot (best-effort: retries the
+    GIL-atomic copy if a concurrent resize lands mid-iteration)."""
+    for _ in range(4):
+        try:
+            items = list(_stage_active.items())
+        # servelint: retry-ok not an RPC — re-reads a local dict snapshot
+        # after a concurrent-resize race; no side effects to repeat
+        except RuntimeError:  # pragma: no cover - concurrent resize
+            continue
+        return {ident: entry[0] for ident, entry in items}
+    return {}  # pragma: no cover - four consecutive resize collisions
 
 
 # ---------------------------------------------------------------------------

@@ -126,6 +126,11 @@ class Detector:
     signal = "?"
     window_s = 60.0
     join = ""  # which sampled trace id an alert joins: "error"|"session"|""
+    # CPU-shaped signals additionally join the sampler's top hot frames
+    # at fire time (observability/profiling.py) — the "which code was
+    # burning when this fired" forensics, the way trace ids joined in
+    # the alert ring's first iteration.
+    join_frames = False
 
     def observe(self, now: float, sample: dict) -> list[Finding]:
         raise NotImplementedError
@@ -266,6 +271,7 @@ class TickCollapseDetector(Detector):
 
     signal = "tick_collapse"
     join = "session"
+    join_frames = True  # a wedged scheduler: the hot frames NAME the wedge
 
     def __init__(self, healthy_floor: float = 0.4,
                  collapse_frac: float = 0.25, min_samples: int = 6):
@@ -395,6 +401,7 @@ class TickerLagDetector(Detector):
     overshoot exceeds max(`floor_s`, `ratio` x interval)."""
 
     signal = "ticker_lag"
+    join_frames = True  # starvation forensics: what was hogging the GIL
 
     def __init__(self, floor_s: float = 1.0, ratio: float = 2.0,
                  window_n: int = 6):
@@ -500,6 +507,12 @@ class _WatchdogBase:
             "error_digest": joins.get("error_digest") or "",
             "context": dict(finding.context),
         }
+        if det.join_frames:
+            from min_tfs_client_tpu.observability import profiling
+
+            frames = profiling.top_hot_frames(3)
+            if frames:
+                alert["hot_frames"] = frames
         self.ring.record(alert)
         self._export_alert(alert)
         return alert
@@ -738,6 +751,7 @@ class StragglerDetector(Detector):
     signal."""
 
     signal = "fleet_straggler"
+    join_frames = True  # the ROUTER-side hot frames when a peer lags
 
     def __init__(self, ratio: float = 3.0, floor_ms: float = 50.0,
                  min_backends: int = 3):

@@ -72,6 +72,12 @@ class RouterOptions:
     # alert summaries) at the router's /monitoring/alerts. Default ON —
     # it adds no fetches, only arithmetic on the sweep results.
     fleet_watchdog: bool = True
+    # Sampling profiler (observability/profiling.py, stdlib-only so the
+    # jax-free router runs it too): continuous per-thread CPU attribution
+    # at /monitoring/profile — the router's byte-path proof (ROADMAP
+    # item 4). Default ON at the same low rate as the backend; 0
+    # disables the ticker.
+    profile_sampler_hz: float = 11.0
 
 
 class RouterServer:
@@ -98,6 +104,11 @@ class RouterServer:
         flight_recorder.install_signal_handler()
         if opts.trace_ring_size:
             tracing.configure_ring(opts.trace_ring_size)
+        from min_tfs_client_tpu.observability import profiling
+
+        profiling.configure(hz=opts.profile_sampler_hz)
+        if opts.profile_sampler_hz > 0:
+            profiling.start()
         from min_tfs_client_tpu.robustness import faults
 
         if opts.fault_plan:
@@ -168,6 +179,9 @@ class RouterServer:
             self._rest_server.shutdown()
         if self.core is not None:
             self.core.stop()
+        from min_tfs_client_tpu.observability import profiling
+
+        profiling.stop()
         # Drop the idle keep-alive sockets held against this router's
         # backends. The pool is process-global (like the tracing ring);
         # close() only empties the idle lists, so an in-process sibling
@@ -283,6 +297,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "at the router's /monitoring/alerts "
                         "(docs/OBSERVABILITY.md 'Alerting & trend "
                         "gating')")
+    p.add_argument("--profile_sampler_hz", type=float, default=11.0,
+                   help="continuous sampling-profiler rate: the "
+                        "router's own per-thread CPU attribution and "
+                        "flame graphs at /monitoring/profile "
+                        "(docs/OBSERVABILITY.md 'Profiling plane'); "
+                        "0 disables the ticker")
     return p
 
 
@@ -305,6 +325,7 @@ def options_from_args(args) -> RouterOptions:
         fault_plan=args.fault_plan,
         fleet_scrape_interval_s=args.fleet_scrape_interval_s,
         fleet_watchdog=args.fleet_watchdog,
+        profile_sampler_hz=args.profile_sampler_hz,
     )
 
 

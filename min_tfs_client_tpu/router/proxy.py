@@ -726,6 +726,12 @@ def rest_route_request(core: RouterCore, method: str, path: str,
         return rest_mod._flight_recorder_reply(_query)
     if method == "GET" and bare == rest_mod.ALERTS_PATH:
         return _router_alerts_reply(core, _query)
+    if method == "GET" and bare == rest_mod.PROFILE_PATH:
+        # Shared implementation: the sampler is process-global, so the
+        # router serves its own per-thread/per-stage attribution (the
+        # byte-path proof ROADMAP item 4 wants) through the same reply.
+        # ?device=1 answers 501 here — the router is jax-free.
+        return rest_mod._profile_reply(_query)
     if method == "GET" and bare == rest_mod.HEALTHZ_PATH:
         ok = core.membership.poll_thread_alive()
         return ((200 if ok else 503), "application/json",
@@ -788,8 +794,9 @@ def _rest_forward(core: RouterCore, method: str, path: str,
     trace = tracing.current_trace()
     if trace is not None:
         # Propagate the fleet-scope trace id (header only, body
-        # verbatim). NOTE: the backend adopts it only on its Python REST
-        # backend — the native epoll front-end surfaces no headers.
+        # verbatim). Both backend REST front-ends adopt it: the Python
+        # one from the parsed request, the native epoll one through
+        # tpuhttp_request_header (server/native_http.py).
         fwd_headers[tracing.TRACE_HEADER] = trace.trace_id
     core.note_forward_start(backend.backend_id)
     try:

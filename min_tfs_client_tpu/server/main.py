@@ -211,6 +211,17 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--watchdog_ring_size", type=int, default=256,
                    help="bounded alert-ring capacity served at "
                         "/monitoring/alerts")
+    p.add_argument("--profile_sampler_hz", type=float, default=11.0,
+                   help="continuous sampling-profiler rate: per-thread/"
+                        "per-stage CPU attribution and flame graphs at "
+                        "/monitoring/profile (docs/OBSERVABILITY.md "
+                        "'Profiling plane'). Low and off-round by "
+                        "design; 0 disables the ticker (on-demand "
+                        "?seconds= capture still works)")
+    p.add_argument("--profile_dir", default="",
+                   help="directory for /monitoring/profile?device=1 "
+                        "programmatic jax.profiler.trace captures "
+                        "(XPlane dumps); empty disables device capture")
     p.add_argument("--drain_grace_seconds", type=float, default=0.0,
                    help="graceful-drain window on stop()/SIGTERM: the "
                         "health plane flips NOT_SERVING immediately, "
@@ -284,6 +295,8 @@ def options_from_args(args) -> ServerOptions:
         watchdog=args.watchdog,
         watchdog_interval_s=args.watchdog_interval_s,
         watchdog_ring_size=args.watchdog_ring_size,
+        profile_sampler_hz=args.profile_sampler_hz,
+        profile_dir=args.profile_dir,
     )
 
 

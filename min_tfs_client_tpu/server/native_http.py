@@ -4,8 +4,11 @@ The C++ server owns all sockets (non-blocking event loop, keep-alive,
 pipelining, chunked request bodies, gzip both directions, idle timeouts,
 header/body limits — parity with the reference's libevent net_http stack,
 util/net_http/server/internal/evhttp_server.cc). Its worker threads call
-back into Python with one plain (method, uri, body) triple per request;
-Python runs the shared `/v1` router (`rest.route_request`) and replies via
+back into Python with one plain (method, uri, body) triple per request,
+plus an opaque request handle through which `tpuhttp_request_header`
+exposes parsed request headers for the callback's duration (how the
+`x-tpu-serving-trace` context adopts on this backend too); Python runs
+the shared `/v1` router (`rest.route_request`) and replies via
 `tpuhttp_send_response`. ctypes releases the GIL around foreign calls and
 re-acquires it inside callbacks, so N native workers overlap wherever the
 handler blocks in native code (device waits, protobuf C++ parsing).
@@ -27,6 +30,7 @@ import ctypes
 import json
 from typing import Callable, Optional
 
+from min_tfs_client_tpu.observability.tracing import TRACE_HEADER
 from min_tfs_client_tpu.server.handlers import Handlers
 from min_tfs_client_tpu.server.rest import (
     prometheus_path_from,
@@ -75,8 +79,26 @@ def _load_lib() -> Optional[ctypes.CDLL]:
     ]
     lib.tpuhttp_stop.restype = None
     lib.tpuhttp_stop.argtypes = [ctypes.c_void_p]
+    try:
+        # Added after the first libtpunethttp.so shipped: a stale cached
+        # .so (mtime newer than the source it was built from, e.g. a
+        # copied artifact) may predate the symbol — degrade to the old
+        # no-headers behavior instead of failing the whole front-end.
+        lib.tpuhttp_request_header.restype = ctypes.c_char_p
+        lib.tpuhttp_request_header.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p,
+        ]
+    except AttributeError:  # pragma: no cover - stale prebuilt library
+        pass
     _lib = lib
     return _lib
+
+
+def native_headers_available() -> bool:
+    """Whether the loaded library exports tpuhttp_request_header (False
+    only with a stale prebuilt .so; a fresh build always has it)."""
+    lib = _load_lib()
+    return lib is not None and hasattr(lib, "tpuhttp_request_header")
 
 
 def native_http_available() -> bool:
@@ -111,6 +133,22 @@ class NativeRestServer:
             raise RuntimeError(f"native HTTP server failed to bind port {port}")
         self.port = lib.tpuhttp_port(self._server)
 
+    def _request_trace_id(self, req) -> str:
+        """The x-tpu-serving-trace request header, fetched through the
+        C side's header table while the Request is still alive (the
+        returned pointer is only valid during the synchronous callback;
+        ctypes' c_char_p restype copies it to Python bytes here)."""
+        header_fn = getattr(self._lib, "tpuhttp_request_header", None)
+        if header_fn is None:  # pragma: no cover - stale prebuilt library
+            return ""
+        value = header_fn(req, TRACE_HEADER.encode())
+        if not value:
+            return ""
+        try:
+            return value.decode("ascii")
+        except UnicodeDecodeError:
+            return ""
+
     def _on_request(self, _user, req, method, uri, body, body_len):
         try:
             raw = ctypes.string_at(body, body_len) if body_len else b""
@@ -122,7 +160,8 @@ class NativeRestServer:
             else:
                 status, ctype, payload = self._route(
                     self._handlers, self._prometheus_path,
-                    method.decode(), uri_str, raw)
+                    method.decode(), uri_str, raw,
+                    trace_id=self._request_trace_id(req))
         except Exception as exc:  # noqa: BLE001 - must answer every request
             status, ctype, payload = (
                 500, "application/json",

@@ -64,6 +64,13 @@ COSTS_PATH = "/monitoring/costs"
 # fleet-scope detectors and per-backend aggregation
 # (docs/OBSERVABILITY.md "Alerting & trend gating").
 ALERTS_PATH = "/monitoring/alerts"
+# Sampling-profiler plane (observability/profiling.py): per-thread /
+# per-stage CPU attribution from the continuous StackSampler, folded
+# stacks for speedscope/flamegraph.pl, on-demand high-rate windows,
+# differential views, and programmatic device capture
+# (docs/OBSERVABILITY.md "Profiling plane"). Served by both REST
+# backends and the router (router/proxy.py shares _profile_reply).
+PROFILE_PATH = "/monitoring/profile"
 
 
 def _fill_spec(spec: apis.ModelSpec, m: re.Match) -> None:
@@ -189,9 +196,9 @@ def route_request(
     front-end (`server/native_http.py`). Mirrors the reference's route
     dispatch (http_rest_api_handler.cc:106-123); transport concerns
     (gzip, keep-alive, limits) live in the respective servers.
-    `trace_id` is the x-tpu-serving-trace request header when the
-    transport surfaces headers (the Python backend does; the native
-    front-end's C callback carries no headers and passes "").
+    `trace_id` is the x-tpu-serving-trace request header — the Python
+    backend reads it from the parsed request, the native front-end
+    fetches it through `tpuhttp_request_header` during the callback.
     """
     from min_tfs_client_tpu.observability import tracing
 
@@ -426,6 +433,57 @@ def _alerts_reply(query: str) -> tuple[int, str, bytes]:
     return _json_reply(200, watchdog.payload(limit=limit, tick=tick))
 
 
+def _profile_reply(query: str) -> tuple[int, str, bytes]:
+    """GET /monitoring/profile — the sampling-profiler plane.
+
+    Bare: JSON summary (top self/total frames per thread and per stage,
+    subsystem mix). `?format=collapsed`: folded stacks
+    (`thread;frame;... count`) for speedscope / flamegraph.pl.
+    `?seconds=N[&hz=H]`: on-demand high-rate window sampled in this
+    worker thread (composes with format=collapsed). `?diff=1&seconds=N`:
+    capture-window frame shares vs the rolling baseline ring.
+    `?device=1&seconds=N`: programmatic jax.profiler.trace capture to
+    --profile_dir — 501 where jax is absent (the router)."""
+    from urllib.parse import parse_qs
+
+    from min_tfs_client_tpu.observability import profiling
+
+    params = parse_qs(query)
+    seconds = None
+    if params.get("seconds"):
+        try:
+            seconds = float(params["seconds"][0])
+        except ValueError:
+            return _json_reply(400, {"error": "seconds must be a number"})
+    hz = None
+    if params.get("hz"):
+        try:
+            hz = float(params["hz"][0])
+        except ValueError:
+            return _json_reply(400, {"error": "hz must be a number"})
+    if params.get("device", [""])[0] not in ("", "0"):
+        try:
+            return _json_reply(
+                200, profiling.device_capture(seconds or 3.0))
+        except ValueError as exc:
+            return _json_reply(400, {"error": str(exc)})
+        except Exception as exc:  # noqa: BLE001 - jax absent/broken here
+            return _json_reply(
+                501, {"error": f"device capture unavailable: {exc}"})
+    if params.get("diff", [""])[0] not in ("", "0"):
+        return _json_reply(200, profiling.diff_payload(seconds or 2.0, hz))
+    collapsed = params.get("format", [""])[0] == "collapsed"
+    if seconds is not None:
+        if collapsed:
+            return (200, "text/plain; charset=utf-8",
+                    profiling.capture_collapsed(seconds, hz).encode())
+        return _json_reply(200, profiling.capture_payload(seconds, hz))
+    if collapsed:
+        return (200, "text/plain; charset=utf-8",
+                profiling.collapsed().encode())
+    return _json_reply(200, profiling.payload())
+
+
 _MONITORING_ROUTES = {
     HEALTHZ_PATH: _healthz_reply,
     READYZ_PATH: _readyz_reply,
@@ -435,6 +493,7 @@ _MONITORING_ROUTES = {
     SESSIONS_PATH: _sessions_reply,
     COSTS_PATH: _costs_reply,
     ALERTS_PATH: _alerts_reply,
+    PROFILE_PATH: _profile_reply,
 }
 
 

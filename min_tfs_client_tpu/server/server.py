@@ -185,6 +185,17 @@ class ServerOptions:
     watchdog: bool = True
     watchdog_interval_s: float = 5.0
     watchdog_ring_size: int = 256
+    # Sampling profiler (observability/profiling.py; docs/OBSERVABILITY.md
+    # "Profiling plane"): continuous per-thread/per-stage CPU attribution
+    # at /monitoring/profile. Default ON at a deliberately low rate —
+    # one sys._current_frames() walk per tick on the sampler's own
+    # thread, never on a request thread (MIGRATING.md notes the
+    # default-on flag). 0 disables the ticker (on-demand ?seconds=
+    # capture still works).
+    profile_sampler_hz: float = 11.0
+    # Destination for ?device=1 programmatic jax.profiler.trace captures
+    # (XPlane dumps). Empty = device capture answers 400.
+    profile_dir: str = ""
 
     def effective_inter_op_parallelism(self) -> int:
         """<= 0 = auto (leave grpc_max_threads alone; TF spells auto as
@@ -348,6 +359,15 @@ class Server:
             from min_tfs_client_tpu.observability import tracing
 
             tracing.configure_ring(opts.trace_ring_size)
+        # The sampler starts BEFORE the core builds so the load/warmup
+        # phase is profiled too (compile-heavy boots are exactly when
+        # "which code" matters); stop() joins it.
+        from min_tfs_client_tpu.observability import profiling
+
+        profiling.configure(hz=opts.profile_sampler_hz,
+                            profile_dir=opts.profile_dir)
+        if opts.profile_sampler_hz > 0:
+            profiling.start()
         # Fault injection arms BEFORE the core builds, so load-path
         # points fire too; a malformed plan fails the boot loudly.
         from min_tfs_client_tpu.robustness import faults
@@ -511,9 +531,10 @@ class Server:
         if self.core is not None:
             health.mark_draining(self.core)
         self._config_poll_stop.set()
-        from min_tfs_client_tpu.observability import watchdog
+        from min_tfs_client_tpu.observability import profiling, watchdog
 
         watchdog.stop()
+        profiling.stop()
         dg = (self.options.drain_grace_seconds if drain_grace is None
               else drain_grace)
         if dg > 0:

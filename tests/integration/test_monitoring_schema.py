@@ -32,6 +32,7 @@ SERVER_SCHEMAS = {
     "/monitoring/flightrecorder": {"capacity", "events"},
     "/monitoring/alerts": {"interval_s", "ticks", "detectors", "active",
                            "alerts"},
+    "/monitoring/profile": {"sampler", "threads", "subsystems", "stages"},
 }
 
 ROUTER_SCHEMAS = {
@@ -45,6 +46,9 @@ ROUTER_SCHEMAS = {
     # per-backend alert summaries (the fleet-scope aggregation).
     "/monitoring/alerts": {"interval_s", "ticks", "detectors", "active",
                            "alerts", "backends"},
+    # Same reply implementation as the backends — the sampler is
+    # process-global, so the router serves its own attribution.
+    "/monitoring/profile": {"sampler", "threads", "subsystems", "stages"},
 }
 
 # Second-level keys load-bearing enough to pin too: the fields the

@@ -85,6 +85,20 @@ class TestCleanPaths:
             gate.set()
             t.join()
 
+    def test_stopped_profile_sampler_passes(self, wit):
+        """The sampler ticker is a daemon thread that stop() JOINS: a
+        started-then-stopped sampler leaves nothing for the witness."""
+        from min_tfs_client_tpu.observability import profiling
+
+        sampler = profiling.StackSampler(hz=100.0)
+        sampler.start()
+        assert any(th.name == "profile-sampler"
+                   for th in threading.enumerate())
+        sampler.stop()
+        assert not any(th.name == "profile-sampler"
+                       for th in threading.enumerate())
+        wit.assert_no_leaks(join_timeout_s=0.05)
+
     def test_uninstall_restores_unpatched_methods(self):
         w = witness_mod.LeakWitness()
         before = PageAllocator.__dict__["try_alloc"]
